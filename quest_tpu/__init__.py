@@ -1,0 +1,189 @@
+"""quest_tpu — a TPU-native quantum circuit simulation framework.
+
+State-vector and density-matrix simulation of universal quantum circuits
+with the full capability surface of QuEST (the reference at
+/root/reference): 29 gate functions with arbitrary controls, measurement
+and collapse, five decoherence channels, fidelity/purity/inner-product
+calculations, OpenQASM 2.0 recording, and single/double precision — built
+JAX/XLA-first with amplitudes sharded over a device mesh, pairwise
+exchanges as ``lax.ppermute`` over ICI, and reductions as ``psum``.
+
+Both pythonic snake_case names and the reference's camelCase names are
+exported (``hadamard(qureg, 0)`` works under either convention).
+"""
+
+from . import precision
+from .precision import (
+    set_default_precision,
+    default_real_dtype,
+    enable_double_precision,
+    real_eps,
+    get_precision_code,
+)
+from .env import (
+    QuESTEnv,
+    create_env,
+    destroy_env,
+    sync_env,
+    report_env,
+    seed_quest,
+    seed_quest_default,
+    AMP_AXIS,
+)
+from .register import (
+    Qureg,
+    create_qureg,
+    create_density_qureg,
+    destroy_qureg,
+    get_num_qubits,
+    get_num_amps,
+    init_zero_state,
+    init_plus_state,
+    init_classical_state,
+    init_pure_state,
+    init_state_debug,
+    init_state_of_single_qubit,
+    init_state_from_amps,
+    set_amps,
+    clone_qureg,
+    get_amp,
+    get_real_amp,
+    get_imag_amp,
+    get_prob_amp,
+    get_density_amp,
+    get_state_vector,
+    get_density_matrix,
+    compare_states,
+)
+from .validation import QuESTError
+from .ops.gates import (
+    hadamard,
+    pauli_x,
+    pauli_y,
+    pauli_z,
+    s_gate,
+    t_gate,
+    phase_shift,
+    controlled_phase_shift,
+    multi_controlled_phase_shift,
+    controlled_phase_flip,
+    multi_controlled_phase_flip,
+    compact_unitary,
+    unitary,
+    rotate_x,
+    rotate_y,
+    rotate_z,
+    rotate_around_axis,
+    controlled_compact_unitary,
+    controlled_unitary,
+    multi_controlled_unitary,
+    controlled_not,
+    controlled_pauli_y,
+    controlled_rotate_x,
+    controlled_rotate_y,
+    controlled_rotate_z,
+    controlled_rotate_around_axis,
+)
+from .ops.calc import (
+    calc_total_prob,
+    calc_prob_of_outcome,
+    calc_inner_product,
+    calc_purity,
+    calc_fidelity,
+)
+from .ops.measure import (
+    measure,
+    measure_with_stats,
+    collapse_to_outcome,
+)
+from .ops.noise import (
+    apply_one_qubit_dephase_error,
+    apply_two_qubit_dephase_error,
+    apply_one_qubit_depolarise_error,
+    apply_one_qubit_damping_error,
+    apply_two_qubit_depolarise_error,
+    add_density_matrix,
+)
+from .qasm import (
+    start_recording_qasm,
+    stop_recording_qasm,
+    clear_recorded_qasm,
+    print_recorded_qasm,
+    write_recorded_qasm_to_file,
+    get_recorded_qasm,
+)
+
+# ---------------------------------------------------------------------------
+# camelCase aliases matching the reference API (QuEST/include/QuEST.h)
+# ---------------------------------------------------------------------------
+
+createQuESTEnv = create_env
+destroyQuESTEnv = destroy_env
+syncQuESTEnv = sync_env
+reportQuESTEnv = report_env
+seedQuEST = seed_quest
+seedQuESTDefault = seed_quest_default
+createQureg = create_qureg
+createDensityQureg = create_density_qureg
+destroyQureg = destroy_qureg
+getNumQubits = get_num_qubits
+getNumAmps = get_num_amps
+initZeroState = init_zero_state
+initPlusState = init_plus_state
+initClassicalState = init_classical_state
+initPureState = init_pure_state
+initStateDebug = init_state_debug
+initStateOfSingleQubit = init_state_of_single_qubit
+initStateFromAmps = init_state_from_amps
+setAmps = set_amps
+cloneQureg = clone_qureg
+getAmp = get_amp
+getRealAmp = get_real_amp
+getImagAmp = get_imag_amp
+getProbAmp = get_prob_amp
+getDensityAmp = get_density_amp
+compareStates = compare_states
+pauliX = pauli_x
+pauliY = pauli_y
+pauliZ = pauli_z
+sGate = s_gate
+tGate = t_gate
+phaseShift = phase_shift
+controlledPhaseShift = controlled_phase_shift
+multiControlledPhaseShift = multi_controlled_phase_shift
+controlledPhaseFlip = controlled_phase_flip
+multiControlledPhaseFlip = multi_controlled_phase_flip
+compactUnitary = compact_unitary
+rotateX = rotate_x
+rotateY = rotate_y
+rotateZ = rotate_z
+rotateAroundAxis = rotate_around_axis
+controlledCompactUnitary = controlled_compact_unitary
+controlledUnitary = controlled_unitary
+multiControlledUnitary = multi_controlled_unitary
+controlledNot = controlled_not
+controlledPauliY = controlled_pauli_y
+controlledRotateX = controlled_rotate_x
+controlledRotateY = controlled_rotate_y
+controlledRotateZ = controlled_rotate_z
+controlledRotateAroundAxis = controlled_rotate_around_axis
+calcTotalProb = calc_total_prob
+calcProbOfOutcome = calc_prob_of_outcome
+calcInnerProduct = calc_inner_product
+calcPurity = calc_purity
+calcFidelity = calc_fidelity
+measureWithStats = measure_with_stats
+collapseToOutcome = collapse_to_outcome
+applyOneQubitDephaseError = apply_one_qubit_dephase_error
+applyTwoQubitDephaseError = apply_two_qubit_dephase_error
+applyOneQubitDepolariseError = apply_one_qubit_depolarise_error
+applyOneQubitDampingError = apply_one_qubit_damping_error
+applyTwoQubitDepolariseError = apply_two_qubit_depolarise_error
+addDensityMatrix = add_density_matrix
+startRecordingQASM = start_recording_qasm
+stopRecordingQASM = stop_recording_qasm
+clearRecordedQASM = clear_recorded_qasm
+printRecordedQASM = print_recorded_qasm
+writeRecordedQASMToFile = write_recorded_qasm_to_file
+
+__version__ = "0.1.0"

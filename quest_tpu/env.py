@@ -1,0 +1,126 @@
+"""Execution environment: device mesh discovery and the measurement RNG.
+
+The reference's ``QuESTEnv`` carries MPI rank/size discovered in
+``createQuESTEnv`` (reference: QuEST/src/CPU/QuEST_cpu_distributed.c:
+135-164) and seeds a global Mersenne-Twister identically on every rank
+(:1294-1305).  Here the environment instead discovers the JAX device
+topology and builds a 1-D amplitude mesh: the top ``log2(num_devices)``
+qubits of every register created in this env live on the mesh axis, and
+all communication is XLA collectives over ICI/DCN.  SPMD-by-construction
+replaces rank branching, so there is no chunkId/numChunks state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import os
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from . import precision
+
+#: Mesh axis name used for amplitude sharding throughout the framework.
+AMP_AXIS = "amp"
+
+
+@dataclasses.dataclass
+class QuESTEnv:
+    """Execution context (reference type: QuEST/include/QuEST.h:117-121).
+
+    ``mesh`` is None for single-device execution, else a 1-D
+    ``jax.sharding.Mesh`` over a power-of-two number of devices.
+    """
+
+    mesh: Mesh | None = None
+
+    @property
+    def num_devices(self) -> int:
+        return 1 if self.mesh is None else self.mesh.devices.size
+
+    @property
+    def num_device_bits(self) -> int:
+        return (self.num_devices - 1).bit_length()
+
+
+def create_env(num_devices: int | None = None, devices=None) -> QuESTEnv:
+    """Discover topology and build the amplitude mesh
+    (reference: createQuESTEnv, QuEST_cpu_distributed.c:135-164).
+
+    By default all visible devices are used (like an MPI world); a mesh is
+    only created when more than one device participates.  ``num_devices``
+    must be a power of two so that device index bits are qubit bits.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} present"
+            )
+        devices = devices[:num_devices]
+    n = len(devices)
+    if n & (n - 1):
+        raise ValueError(f"device count must be a power of two, got {n}")
+    if n == 1:
+        return QuESTEnv(mesh=None)
+    return QuESTEnv(mesh=Mesh(np.array(devices), (AMP_AXIS,)))
+
+
+def destroy_env(env: QuESTEnv) -> None:
+    """No-op for API parity (reference: destroyQuESTEnv); JAX owns devices."""
+
+
+def sync_env(env: QuESTEnv) -> None:
+    """Block until all outstanding device work completes (reference:
+    syncQuESTEnv = MPI_Barrier, QuEST_cpu_distributed.c:166-168)."""
+    jax.block_until_ready(jax.device_put(0))
+
+
+def report_env(env: QuESTEnv) -> str:
+    """Human-readable environment summary (reference: reportQuESTEnv,
+    QuEST_cpu_distributed.c:183-196)."""
+    plat = jax.devices()[0].platform.upper()
+    s = (
+        f"EXECUTION ENVIRONMENT:\n"
+        f"Running on {plat} with {env.num_devices} device(s) in the "
+        f"amplitude mesh (of {jax.device_count()} visible)\n"
+        f"Default precision: {precision.default_real_dtype().name}\n"
+    )
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Measurement RNG
+# ---------------------------------------------------------------------------
+# The reference uses one global Mersenne-Twister seeded from {time_ms, pid}
+# and broadcast so every rank draws identical outcomes (reference:
+# QuEST_common.c:133-148, mt19937ar.c, QuEST_cpu_distributed.c:1294-1305).
+# numpy's legacy RandomState is the same MT19937 generator; under SPMD the
+# sampling happens once on the host, so cross-device agreement is free.
+
+_rng = np.random.RandomState()
+
+
+def seed_quest(seeds) -> None:
+    """Seed the global measurement RNG (reference: seedQuEST,
+    QuEST_common.c:273-279)."""
+    _rng.seed(np.array(seeds, dtype=np.uint64) & 0xFFFFFFFF)
+
+
+def seed_quest_default() -> None:
+    """Default-seed from time and pid (reference: getQuESTDefaultSeedKey,
+    QuEST_common.c:133-148)."""
+    key = [int(time.time() * 1000) & 0xFFFFFFFF, os.getpid()]
+    _rng.seed(key)
+
+
+def random_real() -> float:
+    """One uniform draw in [0, 1) from the global RNG (reference:
+    genrand_real1 via generateMeasurementOutcome, QuEST_common.c:103-121)."""
+    return float(_rng.random_sample())
+
+
+seed_quest_default()

@@ -1,0 +1,93 @@
+"""Algorithm/benchmark circuit library.
+
+Builders mirror the reference's examples and test workloads:
+
+* ``qft``                 — tests/algor/QFT.test's quantum Fourier transform
+* ``bernstein_vazirani``  — examples/bernstein_vazirani_circuit.c
+* ``ghz``                 — the tutorial's H + chained CNOTs
+  (examples/tutorial_example.c)
+* ``random_circuit``      — the root benchmark driver's random
+  Clifford+rotation circuit (/root/reference/tutorial_example.c)
+
+Each returns a :class:`quest_tpu.circuit.Circuit`; ``.run(qureg)`` applies
+it, ``.compile(mesh)`` gives the one-XLA-program form.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..circuit import Circuit
+
+
+def qft(num_qubits: int, is_density: bool = False) -> Circuit:
+    """Standard QFT: per-qubit Hadamard + controlled phase ladder, then a
+    qubit-reversal swap network (swaps built from 3 CNOTs)."""
+    c = Circuit(num_qubits, is_density)
+    for t in range(num_qubits - 1, -1, -1):
+        c.hadamard(t)
+        for k, ctrl in enumerate(range(t - 1, -1, -1), start=2):
+            c.controlled_phase_shift(ctrl, t, math.pi / (1 << (k - 1)))
+    for a in range(num_qubits // 2):
+        b = num_qubits - 1 - a
+        c.cnot(a, b)
+        c.cnot(b, a)
+        c.cnot(a, b)
+    return c
+
+
+def ghz(num_qubits: int, is_density: bool = False) -> Circuit:
+    """|0..0> + |1..1> via H + CNOT chain (the tutorial circuit's core,
+    examples/tutorial_example.c)."""
+    c = Circuit(num_qubits, is_density)
+    c.hadamard(0)
+    for t in range(1, num_qubits):
+        c.cnot(t - 1, t)
+    return c
+
+
+def bernstein_vazirani(num_qubits: int, secret: int,
+                       is_density: bool = False) -> Circuit:
+    """Bernstein-Vazirani for an n-bit secret using phase kickback
+    (reference workload: examples/bernstein_vazirani_circuit.c).
+
+    H^n, oracle as Z on secret bits, H^n; the measured register then reads
+    the secret directly.
+    """
+    c = Circuit(num_qubits, is_density)
+    for t in range(num_qubits):
+        c.hadamard(t)
+    for t in range(num_qubits):
+        if (secret >> t) & 1:
+            c.pauli_z(t)
+    for t in range(num_qubits):
+        c.hadamard(t)
+    return c
+
+
+def random_circuit(num_qubits: int, depth: int, seed: int = 0,
+                   is_density: bool = False) -> Circuit:
+    """Random Clifford+rotation benchmark circuit, one gate per qubit per
+    layer (the shape of the reference's 30-qubit, 667-gate timing driver,
+    /root/reference/tutorial_example.c:29-515)."""
+    rng = np.random.RandomState(seed)
+    c = Circuit(num_qubits, is_density)
+    for _ in range(depth):
+        for t in range(num_qubits):
+            kind = rng.randint(6)
+            if kind == 0:
+                c.hadamard(t)
+            elif kind == 1:
+                c.t_gate(t)
+            elif kind == 2:
+                c.rotate_x(t, float(rng.uniform(0, 2 * math.pi)))
+            elif kind == 3:
+                c.rotate_z(t, float(rng.uniform(0, 2 * math.pi)))
+            elif kind == 4:
+                other = (t + 1 + rng.randint(num_qubits - 1)) % num_qubits
+                c.cnot(other, t)
+            else:
+                c.s_gate(t)
+    return c

@@ -1,0 +1,2 @@
+from . import kernels  # noqa: F401  (registers kernel bodies)
+from .lattice import run_kernel, amp_sharding, Lattice, KERNELS  # noqa: F401

@@ -1,0 +1,96 @@
+"""Scalar calculations: probabilities, inner products, purity, fidelity
+(reference: QuEST/src/QuEST.c:601-645 'calculations' section).
+
+All reductions are single jitted kernels ending in ``psum`` — the TPU
+analogue of the reference's per-rank partial + ``MPI_Allreduce(SUM)``
+pattern (reference: QuEST_cpu_distributed.c:41-123, :1236-1272, :407-420).
+Results are returned as host floats (these APIs are synchronisation
+points in the reference too).
+"""
+
+from __future__ import annotations
+
+from ..register import Qureg
+from ..validation import (
+    QuESTError,
+    validate_matching_dims,
+    validate_target,
+    validate_outcome,
+    validate_density_qureg,
+)
+from .lattice import run_kernel
+
+
+def calc_total_prob(qureg: Qureg) -> float:
+    """Total probability: sum |amp|^2, or trace for density matrices
+    (reference: calcTotalProb, QuEST.c:606-611; Kahan-summed serially in
+    statevec_calcTotalProb QuEST_cpu_local.c:123 — XLA's tree reductions
+    give comparable error growth without the serial dependency)."""
+    if qureg.is_density:
+        v = run_kernel(
+            (qureg.re, qureg.im), (), kind="dm_total_prob",
+            statics=(qureg.num_qubits,), mesh=qureg.mesh, out_kind="scalar",
+        )
+    else:
+        v = run_kernel(
+            (qureg.re, qureg.im), (), kind="sv_total_prob",
+            mesh=qureg.mesh, out_kind="scalar",
+        )
+    return float(v)
+
+
+def calc_prob_of_outcome(qureg: Qureg, target: int, outcome: int) -> float:
+    """(reference: calcProbOfOutcome, QuEST.c:613-621: computes P(0) and
+    returns 1-P(0) for outcome 1, statevec path QuEST_cpu_distributed.c:
+    1236-1262, density path via diagonal scan QuEST_cpu.c:2789-2842.)"""
+    validate_target(qureg, target, "calcProbOfOutcome")
+    validate_outcome(outcome, "calcProbOfOutcome")
+    kind = "dm_prob_zero" if qureg.is_density else "sv_prob_zero"
+    statics = (qureg.num_qubits, target) if qureg.is_density else (target,)
+    p0 = float(
+        run_kernel((qureg.re, qureg.im), (), kind=kind, statics=statics,
+                   mesh=qureg.mesh, out_kind="scalar")
+    )
+    return p0 if outcome == 0 else 1.0 - p0
+
+
+def calc_inner_product(bra: Qureg, ket: Qureg) -> complex:
+    """<bra|ket> (reference: calcInnerProduct, QuEST.c:623-635; kernel
+    QuEST_cpu.c:994-1036 + allreduce QuEST_cpu_distributed.c:41-57)."""
+    if bra.is_density or ket.is_density:
+        raise QuESTError("calcInnerProduct requires state-vectors")
+    validate_matching_dims(bra, ket, "calcInnerProduct")
+    r, i = run_kernel(
+        (bra.re, bra.im, ket.re, ket.im), (), kind="sv_inner_product",
+        mesh=bra.mesh, out_kind="scalar",
+    )
+    return complex(float(r), float(i))
+
+
+def calc_purity(qureg: Qureg) -> float:
+    """Tr(rho^2) (reference: calcPurity, QuEST.c:647 region; kernel
+    QuEST_cpu.c:854-881, allreduce QuEST_cpu_distributed.c:1264-1272)."""
+    validate_density_qureg(qureg, "calcPurity")
+    return float(
+        run_kernel((qureg.re, qureg.im), (), kind="dm_purity",
+                   mesh=qureg.mesh, out_kind="scalar")
+    )
+
+
+def calc_fidelity(qureg: Qureg, pure_state: Qureg) -> float:
+    """Fidelity against a pure state: |<psi|phi>|^2 for state-vectors,
+    <psi|rho|psi> for density matrices (reference: calcFidelity,
+    QuEST.c:637-645; statevec form QuEST_common.c:321-327; density form
+    QuEST_cpu_distributed.c:407-420)."""
+    if pure_state.is_density:
+        raise QuESTError("second argument of calcFidelity must be a state-vector")
+    validate_matching_dims(qureg, pure_state, "calcFidelity")
+    if not qureg.is_density:
+        ip = calc_inner_product(qureg, pure_state)
+        return ip.real * ip.real + ip.imag * ip.imag
+    r, _ = run_kernel(
+        (qureg.re, qureg.im, pure_state.re, pure_state.im), (),
+        kind="dm_fidelity", statics=(qureg.num_qubits,),
+        mesh=qureg.mesh, out_kind="scalar",
+    )
+    return float(r)

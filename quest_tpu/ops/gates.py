@@ -1,0 +1,348 @@
+"""Public gate API: the reference's 29 gate functions
+(reference: QuEST/src/QuEST.c:156-470, decompositions QuEST_common.c:
+62-301).
+
+Each gate funnels into one of two kernels — ``apply_2x2`` (mixing) or
+``apply_phase`` (diagonal) — and mutates the register in place.  Density
+matrices get the U (x) U* routing: the same gate is re-applied with a
+conjugated matrix to the column ("outer") qubit copy at ``target + N``,
+with control masks shifted likewise (reference pattern: QuEST.c:167-176,
+:247-270; conjugation helpers QuEST_common.c:44-60).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import qasm
+from ..register import Qureg
+from ..validation import (
+    validate_target,
+    validate_control_target,
+    validate_multi_controls,
+    validate_unique_targets,
+    validate_unitary_complex_pair,
+    validate_unitary_matrix,
+    validate_unit_vector,
+)
+from .lattice import run_kernel
+
+_INV_SQRT2 = 1.0 / math.sqrt(2.0)
+
+# A 2x2 matrix is a nested tuple ((ar,ai),(br,bi),(cr,ci),(dr,di)) of
+# (possibly traced) real scalars, rows first: [[a, b], [c, d]].
+
+
+def _conj_m(m):
+    (ar, ai), (br, bi), (cr, ci), (dr, di) = m
+    return ((ar, -ai), (br, -bi), (cr, -ci), (dr, -di))
+
+
+def _compact_m(alpha: complex, beta: complex):
+    """U(alpha, beta) = [[alpha, -beta*], [beta, alpha*]] (reference:
+    statevec_compactUnitaryLocal's update, QuEST_cpu.c:1570-1627)."""
+    ar, ai = alpha.real, alpha.imag
+    br, bi = beta.real, beta.imag
+    return ((ar, ai), (-br, bi), (br, bi), (ar, -ai))
+
+
+def _rotation_pair(angle: float, axis) -> tuple[complex, complex]:
+    """(alpha, beta) for exp(-i angle/2 (axis . sigma)) (reference:
+    getComplexPairFromRotation, QuEST_common.c:62-70)."""
+    x, y, z = axis
+    mag = math.sqrt(x * x + y * y + z * z)
+    x, y, z = x / mag, y / mag, z / mag
+    c, s = math.cos(angle / 2), math.sin(angle / 2)
+    return complex(c, -s * z), complex(s * y, -s * x)
+
+
+def _mat_to_m(u):
+    u = np.asarray(u, dtype=np.complex128)
+    return tuple(
+        (float(u[r, c].real), float(u[r, c].imag))
+        for r, c in ((0, 0), (0, 1), (1, 0), (1, 1))
+    )
+
+
+def _ctrl_mask(controls) -> int:
+    mask = 0
+    for c in controls:
+        mask |= 1 << c
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Core dispatch (2x2 and phase), with density-matrix routing
+# ---------------------------------------------------------------------------
+
+
+def _apply_2x2_raw(q: Qureg, target: int, m, ctrl_mask: int) -> None:
+    re, im = run_kernel(
+        (q.re, q.im), m, kind="apply_2x2", statics=(target, ctrl_mask), mesh=q.mesh
+    )
+    q._set(re, im)
+
+
+def _apply_2x2(q: Qureg, target: int, m, controls=()) -> None:
+    mask = _ctrl_mask(controls)
+    _apply_2x2_raw(q, target, m, mask)
+    if q.is_density:
+        n = q.num_qubits
+        _apply_2x2_raw(q, target + n, _conj_m(m), mask << n)
+
+
+def _apply_phase_raw(q: Qureg, sel_mask: int, term) -> None:
+    re, im = run_kernel(
+        (q.re, q.im), term, kind="apply_phase", statics=(sel_mask,), mesh=q.mesh
+    )
+    q._set(re, im)
+
+
+def _apply_phase(q: Qureg, sel_mask: int, term) -> None:
+    """term = (re, im) phase applied where all sel_mask bits are 1."""
+    _apply_phase_raw(q, sel_mask, term)
+    if q.is_density:
+        tr, ti = term
+        _apply_phase_raw(q, sel_mask << q.num_qubits, (tr, -ti))
+
+
+# ---------------------------------------------------------------------------
+# Simple gates
+# ---------------------------------------------------------------------------
+
+_H_M = (
+    (_INV_SQRT2, 0.0), (_INV_SQRT2, 0.0),
+    (_INV_SQRT2, 0.0), (-_INV_SQRT2, 0.0),
+)
+_X_M = ((0.0, 0.0), (1.0, 0.0), (1.0, 0.0), (0.0, 0.0))
+_Y_M = ((0.0, 0.0), (0.0, -1.0), (0.0, 1.0), (0.0, 0.0))
+
+
+def hadamard(qureg: Qureg, target: int) -> None:
+    """(reference: hadamard, QuEST.c:167-176; kernel QuEST_cpu.c:2559-2664.)"""
+    validate_target(qureg, target, "hadamard")
+    _apply_2x2(qureg, target, _H_M)
+    qasm.record_gate(qureg, "h", targets=(target,))
+
+
+def pauli_x(qureg: Qureg, target: int) -> None:
+    """(reference: pauliX, QuEST.c:284-293; kernel QuEST_cpu.c:2186-2271.)"""
+    validate_target(qureg, target, "pauliX")
+    _apply_2x2(qureg, target, _X_M)
+    qasm.record_gate(qureg, "x", targets=(target,))
+
+
+def pauli_y(qureg: Qureg, target: int) -> None:
+    """(reference: pauliY, QuEST.c:324-333; conjugate second pass for
+    density matrices via pauliYConj, QuEST.c:330-332.)"""
+    validate_target(qureg, target, "pauliY")
+    _apply_2x2(qureg, target, _Y_M)
+    qasm.record_gate(qureg, "y", targets=(target,))
+
+
+def pauli_z(qureg: Qureg, target: int) -> None:
+    """(reference: pauliZ -> statevec_phaseShiftByTerm with term -1,
+    QuEST_common.c:202-208.)"""
+    validate_target(qureg, target, "pauliZ")
+    _apply_phase(qureg, 1 << target, (-1.0, 0.0))
+    qasm.record_gate(qureg, "z", targets=(target,))
+
+
+def s_gate(qureg: Qureg, target: int) -> None:
+    """(reference: sGate, term i, QuEST_common.c:210-216.)"""
+    validate_target(qureg, target, "sGate")
+    _apply_phase(qureg, 1 << target, (0.0, 1.0))
+    qasm.record_gate(qureg, "s", targets=(target,))
+
+
+def t_gate(qureg: Qureg, target: int) -> None:
+    """(reference: tGate, term e^{i pi/4}, QuEST_common.c:218-224.)"""
+    validate_target(qureg, target, "tGate")
+    _apply_phase(qureg, 1 << target, (_INV_SQRT2, _INV_SQRT2))
+    qasm.record_gate(qureg, "t", targets=(target,))
+
+
+def phase_shift(qureg: Qureg, target: int, angle: float) -> None:
+    """(reference: phaseShift, QuEST.c:156-165; statevec_phaseShift
+    QuEST_common.c:195-200.)"""
+    validate_target(qureg, target, "phaseShift")
+    _apply_phase(qureg, 1 << target, (math.cos(angle), math.sin(angle)))
+    qasm.record_gate(qureg, "phase", targets=(target,), params=(angle,))
+
+
+def controlled_phase_shift(qureg: Qureg, q1: int, q2: int, angle: float) -> None:
+    """(reference: controlledPhaseShift, QuEST.c; kernel QuEST_cpu.c:2706.)"""
+    validate_unique_targets(qureg, q1, q2, "controlledPhaseShift")
+    _apply_phase(qureg, (1 << q1) | (1 << q2), (math.cos(angle), math.sin(angle)))
+    qasm.record_gate(qureg, "phase", targets=(q2,), controls=(q1,), params=(angle,))
+
+
+def multi_controlled_phase_shift(qureg: Qureg, qubits, angle: float) -> None:
+    """(reference: multiControlledPhaseShift; kernel QuEST_cpu.c:2745.)"""
+    validate_multi_controls(qureg, qubits[:-1], qubits[-1],
+                            "multiControlledPhaseShift")
+    _apply_phase(qureg, _ctrl_mask(qubits), (math.cos(angle), math.sin(angle)))
+    qasm.record_gate(qureg, "phase", targets=(qubits[-1],),
+                     controls=tuple(qubits[:-1]), params=(angle,))
+
+
+def controlled_phase_flip(qureg: Qureg, q1: int, q2: int) -> None:
+    """(reference: controlledPhaseFlip; kernel QuEST_cpu.c:2941.)"""
+    validate_unique_targets(qureg, q1, q2, "controlledPhaseFlip")
+    _apply_phase(qureg, (1 << q1) | (1 << q2), (-1.0, 0.0))
+    qasm.record_gate(qureg, "z", targets=(q2,), controls=(q1,))
+
+
+def multi_controlled_phase_flip(qureg: Qureg, qubits) -> None:
+    """(reference: multiControlledPhaseFlip; kernel QuEST_cpu.c:2972.)"""
+    validate_multi_controls(qureg, qubits[:-1], qubits[-1],
+                            "multiControlledPhaseFlip")
+    _apply_phase(qureg, _ctrl_mask(qubits), (-1.0, 0.0))
+    qasm.record_gate(qureg, "z", targets=(qubits[-1],),
+                     controls=tuple(qubits[:-1]))
+
+
+# ---------------------------------------------------------------------------
+# Unitary / compact-unitary family
+# ---------------------------------------------------------------------------
+
+
+def compact_unitary(qureg: Qureg, target: int, alpha: complex, beta: complex) -> None:
+    """(reference: compactUnitary, QuEST.c:178-188.)"""
+    validate_target(qureg, target, "compactUnitary")
+    alpha, beta = complex(alpha), complex(beta)
+    validate_unitary_complex_pair(alpha, beta, qureg.real_dtype, "compactUnitary")
+    _apply_2x2(qureg, target, _compact_m(alpha, beta))
+    qasm.record_compact_unitary(qureg, alpha, beta, target)
+
+
+def unitary(qureg: Qureg, target: int, u) -> None:
+    """(reference: unitary, QuEST.c:247-257.)"""
+    validate_target(qureg, target, "unitary")
+    m = _mat_to_m(u)
+    validate_unitary_matrix(np.asarray(u), qureg.real_dtype, "unitary")
+    _apply_2x2(qureg, target, m)
+    qasm.record_unitary(qureg, np.asarray(u, dtype=np.complex128), target)
+
+
+def rotate_x(qureg: Qureg, target: int, angle: float) -> None:
+    """(reference: rotateX, QuEST.c:178-192; axis decomposition
+    QuEST_common.c:237-260.)"""
+    validate_target(qureg, target, "rotateX")
+    a, b = _rotation_pair(angle, (1, 0, 0))
+    _apply_2x2(qureg, target, _compact_m(a, b))
+    qasm.record_gate(qureg, "Rx", targets=(target,), params=(angle,))
+
+
+def rotate_y(qureg: Qureg, target: int, angle: float) -> None:
+    validate_target(qureg, target, "rotateY")
+    a, b = _rotation_pair(angle, (0, 1, 0))
+    _apply_2x2(qureg, target, _compact_m(a, b))
+    qasm.record_gate(qureg, "Ry", targets=(target,), params=(angle,))
+
+
+def rotate_z(qureg: Qureg, target: int, angle: float) -> None:
+    validate_target(qureg, target, "rotateZ")
+    a, b = _rotation_pair(angle, (0, 0, 1))
+    _apply_2x2(qureg, target, _compact_m(a, b))
+    qasm.record_gate(qureg, "Rz", targets=(target,), params=(angle,))
+
+
+def rotate_around_axis(qureg: Qureg, target: int, angle: float, axis) -> None:
+    """(reference: rotateAroundAxis, QuEST.c:194-206.)"""
+    validate_target(qureg, target, "rotateAroundAxis")
+    validate_unit_vector(*axis, "rotateAroundAxis")
+    a, b = _rotation_pair(angle, axis)
+    _apply_2x2(qureg, target, _compact_m(a, b))
+    qasm.record_axis_rotation(qureg, angle, axis, target)
+
+
+def controlled_compact_unitary(qureg: Qureg, control: int, target: int,
+                               alpha: complex, beta: complex) -> None:
+    """(reference: controlledCompactUnitary, QuEST.c:216-228.)"""
+    validate_control_target(qureg, control, target, "controlledCompactUnitary")
+    alpha, beta = complex(alpha), complex(beta)
+    validate_unitary_complex_pair(alpha, beta, qureg.real_dtype,
+                                  "controlledCompactUnitary")
+    _apply_2x2(qureg, target, _compact_m(alpha, beta), controls=(control,))
+    qasm.record_compact_unitary(qureg, alpha, beta, target, controls=(control,))
+
+
+def controlled_unitary(qureg: Qureg, control: int, target: int, u) -> None:
+    """(reference: controlledUnitary, QuEST.c:259-270.)"""
+    validate_control_target(qureg, control, target, "controlledUnitary")
+    m = _mat_to_m(u)
+    validate_unitary_matrix(np.asarray(u), qureg.real_dtype, "controlledUnitary")
+    _apply_2x2(qureg, target, m, controls=(control,))
+    qasm.record_unitary(qureg, np.asarray(u, dtype=np.complex128), target,
+                        controls=(control,))
+
+
+def multi_controlled_unitary(qureg: Qureg, controls, target: int, u) -> None:
+    """(reference: multiControlledUnitary, QuEST.c:272-283; bitmask kernel
+    QuEST_cpu.c:1867-1928.)"""
+    validate_multi_controls(qureg, controls, target, "multiControlledUnitary")
+    m = _mat_to_m(u)
+    validate_unitary_matrix(np.asarray(u), qureg.real_dtype,
+                            "multiControlledUnitary")
+    _apply_2x2(qureg, target, m, controls=tuple(controls))
+    qasm.record_unitary(qureg, np.asarray(u, dtype=np.complex128), target,
+                        controls=tuple(controls))
+
+
+def controlled_not(qureg: Qureg, control: int, target: int) -> None:
+    """(reference: controlledNot, QuEST.c:335-345; kernel
+    QuEST_cpu.c:2273-2369.)"""
+    validate_control_target(qureg, control, target, "controlledNot")
+    _apply_2x2(qureg, target, _X_M, controls=(control,))
+    qasm.record_gate(qureg, "x", targets=(target,), controls=(control,))
+
+
+def controlled_pauli_y(qureg: Qureg, control: int, target: int) -> None:
+    """(reference: controlledPauliY, QuEST.c:347-357; kernel
+    QuEST_cpu.c:2465-2557.)"""
+    validate_control_target(qureg, control, target, "controlledPauliY")
+    _apply_2x2(qureg, target, _Y_M, controls=(control,))
+    qasm.record_gate(qureg, "y", targets=(target,), controls=(control,))
+
+
+def controlled_rotate_x(qureg: Qureg, control: int, target: int,
+                        angle: float) -> None:
+    """(reference: controlledRotateX, QuEST.c:208 region;
+    QuEST_common.c:283-301.)"""
+    validate_control_target(qureg, control, target, "controlledRotateX")
+    a, b = _rotation_pair(angle, (1, 0, 0))
+    _apply_2x2(qureg, target, _compact_m(a, b), controls=(control,))
+    qasm.record_gate(qureg, "Rx", targets=(target,), controls=(control,),
+                     params=(angle,))
+
+
+def controlled_rotate_y(qureg: Qureg, control: int, target: int,
+                        angle: float) -> None:
+    validate_control_target(qureg, control, target, "controlledRotateY")
+    a, b = _rotation_pair(angle, (0, 1, 0))
+    _apply_2x2(qureg, target, _compact_m(a, b), controls=(control,))
+    qasm.record_gate(qureg, "Ry", targets=(target,), controls=(control,),
+                     params=(angle,))
+
+
+def controlled_rotate_z(qureg: Qureg, control: int, target: int,
+                        angle: float) -> None:
+    validate_control_target(qureg, control, target, "controlledRotateZ")
+    a, b = _rotation_pair(angle, (0, 0, 1))
+    _apply_2x2(qureg, target, _compact_m(a, b), controls=(control,))
+    qasm.record_gate(qureg, "Rz", targets=(target,), controls=(control,),
+                     params=(angle,))
+
+
+def controlled_rotate_around_axis(qureg: Qureg, control: int, target: int,
+                                  angle: float, axis) -> None:
+    """(reference: controlledRotateAroundAxis, QuEST.c:230-245.)"""
+    validate_control_target(qureg, control, target,
+                            "controlledRotateAroundAxis")
+    validate_unit_vector(*axis, "controlledRotateAroundAxis")
+    a, b = _rotation_pair(angle, axis)
+    _apply_2x2(qureg, target, _compact_m(a, b), controls=(control,))
+    qasm.record_axis_rotation(qureg, angle, axis, target, controls=(control,))

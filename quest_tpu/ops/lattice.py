@@ -1,0 +1,247 @@
+"""Amplitude-lattice index algebra and the local/sharded dispatch machinery.
+
+Design
+======
+A register of ``n`` "vector qubits" holds ``2**n`` amplitudes as a pair of
+real arrays; global amplitude index bit ``q`` *is* qubit ``q`` (density
+matrices reuse this with 2N vector qubits — row bits low, column bits
+high; reference: QuEST/src/QuEST.c:8-10, :534).
+
+TPU-native layout: the amplitudes are stored **2-D, shape (S, L)** with
+``L = min(128, chunk)`` lanes, so every array is tile-aligned
+((8, 128) f32 tiles) and no kernel ever materialises a padded small-minor
+shape.  The flat index of element (row, lane) is ``row * L + lane``, and
+index bits therefore split into three classes:
+
+* **lane bits**  (``b < log2(L)``)            — inside a vector register
+* **row bits**   (up to the local chunk size) — sublane/vector-memory rows
+* **device bits** (above the chunk)           — mesh coordinates; the top
+  ``log2(ndev)`` qubits, exactly the reference's rank-chunk scheme
+  (QuEST/src/CPU/QuEST_cpu.c:1202-1232, QuEST_cpu_distributed.c:231-365)
+
+Every kernel is written once against a tiny index algebra whose
+implementation is chosen per bit class:
+
+* ``bit(b)`` / ``bits_all_set(mask)`` — broadcastable iota bit tests
+  ((1, L) for lane bits, (S, 1) for row bits, traced scalars for device
+  bits).  Control qubits are evaluated on global indices this way, so
+  controlled gates never communicate (reference behaviour:
+  QuEST_cpu.c:1841, :2310, :2362).
+* ``xor_shift(x, mask)`` — the partner-fetch primitive ``y[i] = x[i^mask]``:
+    - lane bits: one (L, L) XOR-permutation **matmul on the MXU** (exact:
+      a permutation contraction reads each input once);
+    - row bits with stride < 8: paired ``jnp.roll`` on the row axis;
+    - row bits with stride >= 8: reshape (A, 2, B, L) + flip — a pure
+      leading-axis permutation, tile-aligned since B >= 8;
+    - device bits: one ``jax.lax.ppermute`` with partner ``d ^ stride`` —
+      the ICI analogue of exchangeStateVectors/getChunkPairId
+      (reference: QuEST_cpu_distributed.c:307-316, :451-479).
+* ``psum(v)`` — scalar all-reduce (reference: MPI_Allreduce(SUM),
+  QuEST_cpu_distributed.c:41-57).
+* ``all_gather(x)`` — full replication (reference:
+  copyVecIntoMatrixPairState, QuEST_cpu_distributed.c:373-405).
+
+There is deliberately no separate "local" vs "distributed" implementation
+of any op — the reference's split-by-target branching
+(halfMatrixBlockFitsInChunk, QuEST_cpu_distributed.c:360-365) falls out of
+``xor_shift``'s mask decomposition.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial, lru_cache
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: Max lane (minor-most) dimension of stored amplitude arrays.
+LANES = 128
+
+# Registry of kernel bodies, keyed by name; bodies have signature
+#   body(lat, arrays, scalars, *statics) -> pytree
+KERNELS: dict[str, callable] = {}
+
+
+def kernel(name: str):
+    """Register a kernel body under ``name`` for use with ``run_kernel``."""
+
+    def deco(fn):
+        KERNELS[name] = fn
+        return fn
+
+    return deco
+
+
+def _ilog2(x: int) -> int:
+    b = x.bit_length() - 1
+    if (1 << b) != x:
+        raise ValueError(f"{x} is not a power of two")
+    return b
+
+
+def state_shape(num_amps: int, ndev: int = 1) -> tuple[int, int]:
+    """Stored (S, L) shape for a register of ``num_amps`` over ``ndev``
+    devices (sharded on the row axis)."""
+    chunk = num_amps // ndev
+    lanes = min(LANES, chunk)
+    return num_amps // lanes, lanes
+
+
+@lru_cache(maxsize=None)
+def _xor_perm(lanes: int, mask: int) -> np.ndarray:
+    """(L, L) 0/1 matrix with P[i, i ^ mask] = 1 (symmetric)."""
+    p = np.zeros((lanes, lanes), dtype=np.float32)
+    for i in range(lanes):
+        p[i, i ^ mask] = 1.0
+    return p
+
+
+class Lattice:
+    """Index algebra over one device's (S_local, L) chunk of amplitudes."""
+
+    def __init__(self, rows: int, lanes: int, axis: str | None, ndev: int):
+        self.rows = rows
+        self.lanes = lanes
+        self.lane_bits = _ilog2(lanes)
+        self.row_bits = _ilog2(rows)
+        self.chunk_bits = self.lane_bits + self.row_bits
+        self.axis = axis
+        self.ndev = ndev
+
+    @classmethod
+    def for_array(cls, x, axis: str | None, ndev: int) -> "Lattice":
+        s, l = x.shape
+        return cls(s, l, axis, ndev)
+
+    # -- device-index helpers -------------------------------------------
+    def _dev_index(self):
+        return lax.axis_index(self.axis) if self.axis is not None else 0
+
+    # -- index algebra --------------------------------------------------
+    def _lane_iota(self):
+        return lax.broadcasted_iota(jnp.int32, (1, self.lanes), 1)
+
+    def _row_iota(self):
+        return lax.broadcasted_iota(jnp.int32, (self.rows, 1), 0)
+
+    def bit(self, b: int):
+        """Global index bit ``b`` as a broadcastable 0/1 value."""
+        if b < self.lane_bits:
+            return (self._lane_iota() >> b) & 1
+        if b < self.chunk_bits:
+            return (self._row_iota() >> (b - self.lane_bits)) & 1
+        return (self._dev_index() >> (b - self.chunk_bits)) & 1
+
+    def bits_all_set(self, mask: int):
+        """Boolean (broadcastable): every global index bit in ``mask`` is 1."""
+        lane_m = mask & (self.lanes - 1)
+        row_m = (mask >> self.lane_bits) & (self.rows - 1)
+        dev_m = mask >> self.chunk_bits
+        parts = []
+        if lane_m:
+            parts.append((self._lane_iota() & lane_m) == lane_m)
+        if row_m:
+            parts.append((self._row_iota() & row_m) == row_m)
+        if dev_m:
+            parts.append((self._dev_index() & dev_m) == dev_m)
+        if not parts:
+            return True
+        out = parts[0]
+        for p in parts[1:]:
+            out = jnp.logical_and(out, p)
+        return out
+
+    # -- data movement --------------------------------------------------
+    def xor_shift(self, x, mask: int):
+        """``y[i] = x[i XOR mask]`` over global indices (see module doc)."""
+        if mask == 0:
+            return x
+        lane_m = mask & (self.lanes - 1)
+        if lane_m:
+            perm = jnp.asarray(_xor_perm(self.lanes, lane_m), x.dtype)
+            # Permutation contraction: exact in every float precision as
+            # long as products aren't truncated — hence HIGHEST.
+            x = jax.lax.dot_general(
+                x, perm, (((1,), (0,)), ((), ())),
+                precision=lax.Precision.HIGHEST,
+            )
+        row_m = (mask >> self.lane_bits) & (self.rows - 1)
+        j = 0
+        while row_m:
+            if row_m & 1:
+                s = 1 << j
+                if s < 8 and self.rows > s:
+                    # sublane stride: paired rolls + per-row select
+                    up = jnp.roll(x, -s, axis=0)
+                    down = jnp.roll(x, s, axis=0)
+                    rb = (self._row_iota() >> j) & 1
+                    x = jnp.where(rb == 0, up, down)
+                else:
+                    x = jnp.flip(
+                        x.reshape(-1, 2, s, self.lanes), axis=1
+                    ).reshape(x.shape)
+            row_m >>= 1
+            j += 1
+        dev_m = mask >> self.chunk_bits
+        if dev_m:
+            perm = [(i, i ^ dev_m) for i in range(self.ndev)]
+            x = lax.ppermute(x, self.axis, perm)
+        return x
+
+    # -- collectives ----------------------------------------------------
+    def psum(self, v):
+        if self.axis is None:
+            return v
+        return lax.psum(v, self.axis)
+
+    def all_gather(self, x):
+        if self.axis is None:
+            return x
+        return lax.all_gather(x, self.axis, tiled=True)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("kind", "statics", "mesh", "out_kind"),
+)
+def run_kernel(arrays, scalars, *, kind: str, statics: tuple = (),
+               mesh: Mesh | None = None, out_kind: str = "arrays"):
+    """Run kernel body ``kind`` over ``arrays`` (tuple of (S, L) arrays).
+
+    ``arrays`` are global views; with a mesh they must be sharded over the
+    mesh's single axis on their leading (row) dimension.  ``scalars`` is a
+    pytree of traced scalars (gate matrix elements, probabilities, ...)
+    replicated everywhere.  ``out_kind`` is ``"arrays"`` (amp arrays back,
+    sharded like the inputs) or ``"scalar"`` (replicated reduction result).
+    """
+    body = KERNELS[kind]
+    if mesh is None or math.prod(mesh.devices.shape) == 1:
+        lat = Lattice.for_array(arrays[0], None, 1)
+        return body(lat, arrays, scalars, *statics)
+
+    (axis,) = mesh.axis_names
+    ndev = math.prod(mesh.devices.shape)
+
+    def shbody(arrays, scalars):
+        lat = Lattice.for_array(arrays[0], axis, ndev)
+        return body(lat, arrays, scalars, *statics)
+
+    out_specs = {"arrays": P(axis), "scalar": P()}[out_kind]
+    return jax.shard_map(
+        shbody,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=out_specs,
+    )(arrays, scalars)
+
+
+def amp_sharding(mesh: Mesh | None):
+    """NamedSharding for (S, L) amplitude arrays on ``mesh`` (row-sharded)."""
+    if mesh is None:
+        return None
+    (axis,) = mesh.axis_names
+    return NamedSharding(mesh, P(axis))

@@ -1,0 +1,80 @@
+"""Measurement and collapse (reference: QuEST/src/QuEST.c:546-590,
+QuEST_common.c:103-121, :305-319).
+
+``measure`` follows the reference recipe exactly: one scalar reduction for
+P(0), one host RNG draw (shared-seed semantics — see quest_tpu.env), then a
+communication-free collapse kernel (reference: statevec_measureWithStats,
+QuEST_common.c:305-311; collapse kernels QuEST_cpu.c:3023-3171,
+QuEST_cpu_distributed.c:1274-1292).  The data-dependent outcome forces one
+host sync per measurement — the same sync the reference pays; fully
+on-device measurement for jitted circuits lives in quest_tpu.circuit.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .. import env as _env
+from .. import qasm
+from ..register import Qureg
+from ..validation import (
+    validate_target,
+    validate_outcome,
+    validate_measurement_prob,
+)
+from .lattice import run_kernel
+from .calc import calc_prob_of_outcome
+from .. import precision
+
+
+def _collapse(qureg: Qureg, target: int, outcome: int, prob: float) -> None:
+    if qureg.is_density:
+        re, im = run_kernel(
+            (qureg.re, qureg.im), (outcome, 1.0 / prob), kind="dm_collapse",
+            statics=(qureg.num_qubits, target), mesh=qureg.mesh,
+        )
+    else:
+        re, im = run_kernel(
+            (qureg.re, qureg.im), (outcome, 1.0 / math.sqrt(prob)),
+            kind="sv_collapse", statics=(target,), mesh=qureg.mesh,
+        )
+    qureg._set(re, im)
+
+
+def collapse_to_outcome(qureg: Qureg, target: int, outcome: int) -> float:
+    """Deterministically project onto an outcome, returning its probability
+    (reference: collapseToOutcome, QuEST.c:546-563)."""
+    validate_target(qureg, target, "collapseToOutcome")
+    validate_outcome(outcome, "collapseToOutcome")
+    prob = calc_prob_of_outcome(qureg, target, outcome)
+    validate_measurement_prob(prob, qureg.real_dtype, "collapseToOutcome")
+    _collapse(qureg, target, outcome, prob)
+    qasm.record_measurement(qureg, target)
+    return prob
+
+
+def measure_with_stats(qureg: Qureg, target: int) -> tuple[int, float]:
+    """Measure, returning (outcome, its probability) (reference:
+    measureWithStats, QuEST.c:565-576; outcome sampling
+    generateMeasurementOutcome, QuEST_common.c:103-121)."""
+    validate_target(qureg, target, "measure")
+    zero_prob = calc_prob_of_outcome(qureg, target, 0)
+    # Edge-case handling mirrors generateMeasurementOutcome: degenerate
+    # probabilities short-circuit the RNG draw.
+    eps = precision.real_eps(qureg.real_dtype)
+    if zero_prob < eps:
+        outcome = 1
+    elif 1 - zero_prob < eps:
+        outcome = 0
+    else:
+        outcome = int(_env.random_real() > zero_prob)
+    prob = zero_prob if outcome == 0 else 1 - zero_prob
+    _collapse(qureg, target, outcome, prob)
+    qasm.record_measurement(qureg, target)
+    return outcome, prob
+
+
+def measure(qureg: Qureg, target: int) -> int:
+    """(reference: measure, QuEST.c:578-590.)"""
+    outcome, _ = measure_with_stats(qureg, target)
+    return outcome

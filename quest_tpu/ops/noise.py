@@ -1,0 +1,116 @@
+"""Decoherence channels on density matrices
+(reference: QuEST/src/QuEST.c:647-694 'decoherence' section).
+
+Parameter conventions follow the public API exactly: the user's error
+probability ``prob`` is rescaled before hitting the kernel —
+2p (one-qubit dephase), 4p/3 (two-qubit dephase, one-qubit depolarise),
+16p/15 (two-qubit depolarise) — reference: QuEST.c:652-694.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..register import Qureg
+from ..validation import (
+    validate_density_qureg,
+    validate_target,
+    validate_unique_targets,
+    validate_one_qubit_dephase_prob,
+    validate_two_qubit_dephase_prob,
+    validate_one_qubit_depol_prob,
+    validate_two_qubit_depol_prob,
+    validate_one_qubit_damping_prob,
+    validate_prob,
+    validate_matching_dims,
+)
+from .lattice import run_kernel
+
+
+def _run(qureg: Qureg, kind: str, scalars, statics) -> None:
+    re, im = run_kernel((qureg.re, qureg.im), scalars, kind=kind,
+                        statics=statics, mesh=qureg.mesh)
+    qureg._set(re, im)
+
+
+def apply_one_qubit_dephase_error(qureg: Qureg, target: int, prob: float) -> None:
+    """rho -> (1-p) rho + p Z rho Z (reference: applyOneQubitDephaseError,
+    QuEST.c:652-658: off-diagonals scaled by 1 - 2p)."""
+    validate_density_qureg(qureg, "applyOneQubitDephaseError")
+    validate_target(qureg, target, "applyOneQubitDephaseError")
+    validate_one_qubit_dephase_prob(prob, "applyOneQubitDephaseError")
+    if prob == 0:
+        return
+    _run(qureg, "dm_dephase1", (1.0 - 2.0 * prob,), (qureg.num_qubits, target))
+
+
+def apply_two_qubit_dephase_error(qureg: Qureg, q1: int, q2: int,
+                                  prob: float) -> None:
+    """(reference: applyTwoQubitDephaseError, QuEST.c:660-667: elements
+    mismatched on either qubit scaled by 1 - 4p/3.)"""
+    validate_density_qureg(qureg, "applyTwoQubitDephaseError")
+    validate_unique_targets(qureg, q1, q2, "applyTwoQubitDephaseError")
+    validate_two_qubit_dephase_prob(prob, "applyTwoQubitDephaseError")
+    if prob == 0:
+        return
+    q1, q2 = min(q1, q2), max(q1, q2)
+    _run(qureg, "dm_dephase2", (1.0 - 4.0 * prob / 3.0,),
+         (qureg.num_qubits, q1, q2))
+
+
+def apply_one_qubit_depolarise_error(qureg: Qureg, target: int,
+                                     prob: float) -> None:
+    """(reference: applyOneQubitDepolariseError, QuEST.c:669-675, level
+    d = 4p/3.)"""
+    validate_density_qureg(qureg, "applyOneQubitDepolariseError")
+    validate_target(qureg, target, "applyOneQubitDepolariseError")
+    validate_one_qubit_depol_prob(prob, "applyOneQubitDepolariseError")
+    if prob == 0:
+        return
+    _run(qureg, "dm_depolarise1", (4.0 * prob / 3.0,),
+         (qureg.num_qubits, target))
+
+
+def apply_one_qubit_damping_error(qureg: Qureg, target: int,
+                                  prob: float) -> None:
+    """Amplitude damping (reference: applyOneQubitDampingError,
+    QuEST.c:677-683)."""
+    validate_density_qureg(qureg, "applyOneQubitDampingError")
+    validate_target(qureg, target, "applyOneQubitDampingError")
+    validate_one_qubit_damping_prob(prob, "applyOneQubitDampingError")
+    if prob == 0:
+        return
+    _run(qureg, "dm_damping", (prob,), (qureg.num_qubits, target))
+
+
+def apply_two_qubit_depolarise_error(qureg: Qureg, q1: int, q2: int,
+                                     prob: float) -> None:
+    """(reference: applyTwoQubitDepolariseError, QuEST.c:685-694, level
+    d = 16p/15; delta/gamma mixing constants from
+    densmatr_twoQubitDepolarise, QuEST_cpu_local.c:40-51.)"""
+    validate_density_qureg(qureg, "applyTwoQubitDepolariseError")
+    validate_unique_targets(qureg, q1, q2, "applyTwoQubitDepolariseError")
+    validate_two_qubit_depol_prob(prob, "applyTwoQubitDepolariseError")
+    if prob == 0:
+        return
+    d = 16.0 * prob / 15.0
+    eta = 2.0 / d
+    delta = eta - 1.0 - math.sqrt((eta - 1.0) * (eta - 1.0) - 1.0)
+    gamma = 1.0 / ((1.0 + delta) ** 3)
+    q1, q2 = min(q1, q2), max(q1, q2)
+    _run(qureg, "dm_depolarise2", (d, delta, gamma),
+         (qureg.num_qubits, q1, q2))
+
+
+def add_density_matrix(combine: Qureg, prob: float, other: Qureg) -> None:
+    """combine := (1-p) combine + p other (reference: addDensityMatrix,
+    QuEST.c:590-599; kernel QuEST_cpu.c:883-912)."""
+    validate_density_qureg(combine, "addDensityMatrix")
+    validate_density_qureg(other, "addDensityMatrix")
+    validate_prob(prob, "addDensityMatrix")
+    validate_matching_dims(combine, other, "addDensityMatrix")
+    re, im = run_kernel(
+        (combine.re, combine.im, other.re, other.im), (prob,),
+        kind="dm_add_mix", mesh=combine.mesh,
+    )
+    combine._set(re, im)

@@ -1,0 +1,35 @@
+"""Multi-device parallelism utilities.
+
+The sharding model (SURVEY §2.3/§5.7): the flat amplitude array's leading
+(high-qubit) bits map onto a 1-D device mesh; gates on device-bit qubits
+become ``ppermute`` pair exchanges, reductions become ``psum``, and
+full-state replication becomes ``all_gather`` — see
+quest_tpu.ops.lattice for the primitive set.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..env import AMP_AXIS
+from ..ops.lattice import amp_sharding
+
+
+def make_amp_mesh(devices=None, num_devices: int | None = None) -> Mesh:
+    """Build the 1-D amplitude mesh over a power-of-two device count."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    n = len(devices)
+    if n & (n - 1):
+        raise ValueError(f"device count must be a power of two, got {n}")
+    return Mesh(np.array(devices), (AMP_AXIS,))
+
+
+def shard_state(re, im, mesh: Mesh):
+    """Move flat amplitude arrays onto the mesh's amplitude sharding."""
+    sh = amp_sharding(mesh)
+    return jax.device_put(re, sh), jax.device_put(im, sh)

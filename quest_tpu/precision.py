@@ -1,0 +1,83 @@
+"""Runtime precision configuration.
+
+The reference selects precision at compile time via the ``QuEST_PREC``
+preprocessor define (reference: QuEST/include/QuEST_precision.h:17-62),
+yielding ``qreal`` = float (1), double (2) or long double (4), with a
+matching ``REAL_EPS`` of 1e-5 / 1e-13 / 1e-14.
+
+Here precision is a *runtime* property of each register: quregs carry a real
+dtype (float32 or float64).  TPU hardware natively computes in f32 (f64 is
+emulated and slow), so ``single`` is the performance default; ``double`` is
+used for golden-parity testing on CPU, where the reference tolerance of
+1e-10 applies.  Long-double (QuEST_PREC=4) has no TPU analogue and is not
+supported.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_PRECISION_NAMES = {
+    "single": jnp.float32,
+    "double": jnp.float64,
+    "1": jnp.float32,
+    "2": jnp.float64,
+}
+
+# Matches the per-precision REAL_EPS table (QuEST_precision.h:25-47).
+_REAL_EPS = {
+    jnp.dtype(jnp.float32): 1e-5,
+    jnp.dtype(jnp.float64): 1e-13,
+}
+
+_default_dtype = _PRECISION_NAMES[os.environ.get("QUEST_TPU_PRECISION", "single")]
+
+
+def set_default_precision(precision: str) -> None:
+    """Set the default real dtype for newly created registers.
+
+    ``precision`` is ``"single"``/``"double"`` (or ``"1"``/``"2"``, mirroring
+    the reference's QuEST_PREC values).
+    """
+    global _default_dtype
+    if precision not in _PRECISION_NAMES:
+        raise ValueError(
+            f"unknown precision {precision!r}; expected one of "
+            f"{sorted(_PRECISION_NAMES)} (QuEST_PREC=4 / long double has no "
+            "TPU equivalent)"
+        )
+    _default_dtype = _PRECISION_NAMES[precision]
+
+
+def default_real_dtype() -> jnp.dtype:
+    """The real dtype used for new registers when none is specified."""
+    dt = jnp.dtype(_default_dtype)
+    if dt == jnp.dtype(jnp.float64) and not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "double precision requires x64 mode; call "
+            "quest_tpu.enable_double_precision() (or set jax_enable_x64) first"
+        )
+    return dt
+
+
+def real_eps(dtype) -> float:
+    """Precision-dependent epsilon used by validation, mirroring REAL_EPS."""
+    return _REAL_EPS[jnp.dtype(dtype)]
+
+
+def enable_double_precision() -> None:
+    """Enable f64 support in JAX and make it the default register precision."""
+    jax.config.update("jax_enable_x64", True)
+    set_default_precision("double")
+
+
+def get_precision_code(dtype) -> int:
+    """QuEST_PREC-compatible code for a dtype: 1 = single, 2 = double.
+
+    Mirrors ``getQuEST_PREC`` (reference: QuEST/src/QuEST.c:724-726, which
+    returns sizeof(qreal)/4).
+    """
+    return {jnp.dtype(jnp.float32): 1, jnp.dtype(jnp.float64): 2}[jnp.dtype(dtype)]

@@ -1,0 +1,163 @@
+"""OpenQASM 2.0 circuit recording (reference: QuEST/src/QuEST_qasm.c).
+
+Each register carries a growable text log (reference buffer:
+QuEST_qasm.c:31-33, :87-113 — here a Python list of lines).  Recording is
+off until ``start_recording_qasm`` (reference: startRecordingQASM,
+QuEST.c:592 region).  General unitaries are serialised as ZYZ Euler
+``U(theta,phi,lambda)`` via the same decomposition the reference uses
+(getZYZRotAnglesFromComplexPair, QuEST_common.c:72-82; emission
+QuEST_qasm.c:264-346), with an explicit global-phase ``Rz`` fix-up pair
+for controlled unitaries whose determinant phase is non-zero.
+"""
+
+from __future__ import annotations
+
+import math
+
+MEASURE_LABEL = "measure"
+
+
+class QasmLogger:
+    def __init__(self, num_qubits: int):
+        self.num_qubits = num_qubits
+        self.recording = False
+        self.lines: list[str] = []
+        self._header()
+
+    def _header(self):
+        # reference: qasm_setup emits the OPENQASM preamble (QuEST_qasm.c:55-77)
+        self.lines = [
+            "OPENQASM 2.0;",
+            f"qreg q[{self.num_qubits}];",
+            f"creg c[{self.num_qubits}];",
+        ]
+
+
+def setup(qureg) -> None:
+    qureg.qasm = QasmLogger(qureg.num_qubits)
+
+
+def start_recording_qasm(qureg) -> None:
+    qureg.qasm.recording = True
+
+
+def stop_recording_qasm(qureg) -> None:
+    qureg.qasm.recording = False
+
+
+def clear_recorded_qasm(qureg) -> None:
+    # reference: qasm_clearRecorded (QuEST_qasm.c:446-454)
+    qureg.qasm._header()
+
+
+def get_recorded_qasm(qureg) -> str:
+    return "\n".join(qureg.qasm.lines) + "\n"
+
+
+def print_recorded_qasm(qureg) -> None:
+    # reference: qasm_printRecorded
+    print(get_recorded_qasm(qureg), end="")
+
+
+def write_recorded_qasm_to_file(qureg, filename: str) -> None:
+    # reference: qasm_writeRecordedToFile (QuEST_qasm.c:456-470)
+    with open(filename, "w") as f:
+        f.write(get_recorded_qasm(qureg))
+
+
+# ---------------------------------------------------------------------------
+# Gate recording
+# ---------------------------------------------------------------------------
+
+
+def _fmt(x: float) -> str:
+    return f"{x:.15g}"
+
+
+def record_gate(qureg, name: str, targets=(), controls=(), params=()) -> None:
+    """Record a named gate (reference: addGateToQASM, QuEST_qasm.c:125-163:
+    'c' prefix per control, params in parens, qubits comma-separated)."""
+    log = qureg.qasm
+    if log is None or not log.recording:
+        return
+    label = "c" * len(controls) + name
+    if params:
+        label += "(" + ",".join(_fmt(p) for p in params) + ")"
+    qubits = ",".join(f"q[{i}]" for i in (*controls, *targets))
+    log.lines.append(f"{label} {qubits};")
+
+
+def record_measurement(qureg, target: int) -> None:
+    # reference: qasm_recordMeasurement (QuEST_qasm.c:365-380)
+    log = qureg.qasm
+    if log is None or not log.recording:
+        return
+    log.lines.append(f"{MEASURE_LABEL} q[{target}] -> c[{target}];")
+
+
+def record_init(qureg, kind: str, *params) -> None:
+    """Record state initialisation as comments + reset (reference records
+    inits as reset plus explicit gates, QuEST_qasm.c:382-442)."""
+    log = qureg.qasm
+    if log is None or not log.recording:
+        return
+    log.lines.append(f"reset q;  // init {kind}"
+                     + (f" {params}" if params else ""))
+
+
+def _zyz(alpha: complex, beta: complex) -> tuple[float, float, float]:
+    """U(alpha,beta) = Rz(rz2) Ry(ry) Rz(rz1) (reference:
+    getZYZRotAnglesFromComplexPair, QuEST_common.c:72-82)."""
+    alpha_mag = min(abs(alpha), 1.0)
+    ry = 2.0 * math.acos(alpha_mag)
+    alpha_phase = math.atan2(alpha.imag, alpha.real)
+    beta_phase = math.atan2(beta.imag, beta.real)
+    return -alpha_phase + beta_phase, ry, -alpha_phase - beta_phase
+
+
+def record_compact_unitary(qureg, alpha: complex, beta: complex, target: int,
+                           controls=()) -> None:
+    log = qureg.qasm
+    if log is None or not log.recording:
+        return
+    rz2, ry, rz1 = _zyz(alpha, beta)
+    record_gate(qureg, "U", targets=(target,), controls=controls,
+                params=(ry, rz2, rz1))
+
+
+def record_unitary(qureg, u, target: int, controls=()) -> None:
+    """Decompose a general 2x2 unitary into global phase + compact form
+    (reference: getComplexPairAndPhaseFromUnitary, QuEST_common.c:84-101;
+    phase-fix emission for controlled gates QuEST_qasm.c:264-346)."""
+    log = qureg.qasm
+    if log is None or not log.recording:
+        return
+    r0c0, r1c0 = complex(u[0, 0]), complex(u[1, 0])
+    phase = (math.atan2(r0c0.imag, r0c0.real)
+             + math.atan2(complex(u[1, 1]).imag, complex(u[1, 1]).real)) / 2.0
+    rot = complex(math.cos(-phase), math.sin(-phase))
+    alpha, beta = r0c0 * rot, r1c0 * rot
+    rz2, ry, rz1 = _zyz(alpha, beta)
+    record_gate(qureg, "U", targets=(target,), controls=controls,
+                params=(ry, rz2, rz1))
+    if controls and abs(phase) > 1e-15:
+        # The stripped determinant phase e^{i phi} is physical once
+        # controlled: c-U = c-(e^{i phi} V) needs an extra e^{i phi} on
+        # exactly the all-controls-1 branch, i.e. a (multi-controlled)
+        # phase shift over the control set (reference phase-fix pattern:
+        # QuEST_qasm.c:327-346).
+        record_gate(qureg, "phase", targets=(controls[-1],),
+                    controls=tuple(controls[:-1]), params=(phase,))
+
+
+def record_axis_rotation(qureg, angle: float, axis, target: int,
+                         controls=()) -> None:
+    log = qureg.qasm
+    if log is None or not log.recording:
+        return
+    x, y, z = axis
+    mag = math.sqrt(x * x + y * y + z * z)
+    x, y, z = x / mag, y / mag, z / mag
+    c, s = math.cos(angle / 2), math.sin(angle / 2)
+    record_compact_unitary(qureg, complex(c, -s * z), complex(s * y, -s * x),
+                           target, controls=controls)

@@ -1,0 +1,405 @@
+"""Quantum registers: creation, initial states, and amplitude access.
+
+A :class:`Qureg` owns a pair of flat real/imag device arrays (the
+reference's ``ComplexArray`` split layout, QuEST/include/QuEST.h:41-45,
+91-112), sharded over the environment's amplitude mesh when one exists
+(reference chunking: statevec_createQureg, QuEST/src/CPU/QuEST_cpu.c:
+1202-1232).  A density matrix over N qubits is stored as a 2N-qubit vector
+(reference: createDensityQureg, QuEST/src/QuEST.c:42-54).
+
+The public API mutates registers in place — matching the reference C API's
+semantics so that user programs, the golden test harness, and the C ABI
+shim port directly — while everything under the hood is pure-functional
+jitted JAX.  The pure kernel layer is available for whole-circuit jit
+compilation (see quest_tpu.circuit).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import precision
+from . import qasm
+from .env import QuESTEnv
+from .ops.lattice import amp_sharding, state_shape
+from .validation import (
+    QuESTError,
+    validate_create_num_qubits,
+    validate_state_index,
+    validate_num_amps,
+    validate_matching_dims,
+    validate_target,
+    validate_outcome,
+)
+
+
+class Qureg:
+    """A state-vector or density-matrix register.
+
+    Mirrors the reference ``Qureg`` (QuEST/include/QuEST.h:81-112) minus
+    the chunk bookkeeping, which the sharded arrays carry natively.
+    """
+
+    __slots__ = ("re", "im", "num_qubits", "is_density", "mesh", "qasm")
+
+    def __init__(self, re, im, num_qubits: int, is_density: bool, mesh):
+        self.re = re
+        self.im = im
+        self.num_qubits = num_qubits
+        self.is_density = is_density
+        self.mesh = mesh
+        self.qasm = None  # attached by quest_tpu.qasm on creation
+
+    # -- shape bookkeeping ----------------------------------------------
+    @property
+    def num_vec_qubits(self) -> int:
+        """Qubits of the underlying flat vector (2N for density matrices;
+        reference field: numQubitsInStateVec, QuEST.h:97)."""
+        return self.num_qubits * (2 if self.is_density else 1)
+
+    @property
+    def num_amps(self) -> int:
+        return 1 << self.num_vec_qubits
+
+    @property
+    def real_dtype(self):
+        return self.re.dtype
+
+    @property
+    def state_shape(self) -> tuple[int, int]:
+        """Stored 2-D (rows, lanes) shape — tile-aligned for TPU; flat
+        index = row * lanes + lane (see quest_tpu.ops.lattice)."""
+        return self.re.shape
+
+    def _set(self, re, im) -> None:
+        """Install a new functional state (in-place mutation facade)."""
+        self.re = re
+        self.im = im
+
+    def __repr__(self):
+        kind = "density-matrix" if self.is_density else "state-vector"
+        return (
+            f"Qureg({kind}, {self.num_qubits} qubits, {self.num_amps} amps, "
+            f"{self.re.dtype.name}, mesh={None if self.mesh is None else self.mesh.shape})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Creation / destruction
+# ---------------------------------------------------------------------------
+
+
+def _alloc(num_qubits: int, is_density: bool, env: QuESTEnv, dtype) -> Qureg:
+    validate_create_num_qubits(num_qubits)
+    dtype = jnp.dtype(dtype or precision.default_real_dtype())
+    nvec = num_qubits * (2 if is_density else 1)
+    ndev = env.num_devices
+    # Every device must own at least one full density-matrix column so that
+    # column-block ops (fidelity, initPureState) stay local matmuls; for
+    # state-vectors, at least one amplitude per device (the reference's
+    # limit too: numAmpsPerChunk = 2^n / numRanks >= 1, QuEST_cpu.c:1204).
+    min_bits = num_qubits if is_density else 0
+    if ndev > 1 and (1 << nvec) // ndev < (1 << min_bits):
+        raise QuESTError(
+            f"cannot shard {num_qubits}-qubit "
+            f"{'density matrix' if is_density else 'state-vector'} over "
+            f"{ndev} devices: chunks would be smaller than "
+            f"2^{min_bits} amps"
+        )
+    shape = state_shape(1 << nvec, ndev)
+    build = _init_builder("classical", shape, dtype, env.mesh)
+    re, im = build(0)
+    q = Qureg(re, im, num_qubits, is_density, env.mesh)
+    qasm.setup(q)
+    return q
+
+
+def create_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
+    """Create a state-vector register in |0...0> (reference: createQureg,
+    QuEST/src/QuEST.c:28-40; _alloc's builder already produces |0>)."""
+    return _alloc(num_qubits, False, env, dtype)
+
+
+def create_density_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
+    """Create a density-matrix register in |0><0| (reference:
+    createDensityQureg, QuEST/src/QuEST.c:42-54)."""
+    return _alloc(num_qubits, True, env, dtype)
+
+
+def destroy_qureg(qureg: Qureg, env: QuESTEnv | None = None) -> None:
+    """Release device buffers (reference: destroyQureg)."""
+    qureg.re = None
+    qureg.im = None
+
+
+def get_num_qubits(qureg: Qureg) -> int:
+    return qureg.num_qubits
+
+
+def get_num_amps(qureg: Qureg) -> int:
+    return qureg.num_amps
+
+
+# ---------------------------------------------------------------------------
+# Initial states
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=None)
+def _init_builder(kind: str, shape: tuple[int, int], dtype, mesh):
+    """Jitted initial-state builders, cached per (kind, shape, dtype, mesh).
+
+    All builders produce the (S, L) state from sharded iotas (or a scatter
+    into sharded zeros), so no full-size host array is ever materialised —
+    each device fills only its own chunk.  Bit values of the flat index
+    (= row * L + lane) are derived from row/lane iotas separately, so no
+    64-bit global iota is needed at any register size.
+    """
+    sh = amp_sharding(mesh)
+    rows, lanes = shape
+    lane_bits = (lanes - 1).bit_length()
+
+    def zeros():
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    if kind == "classical":
+        # reference: statevec_initClassicalState (QuEST_cpu.c:1352) /
+        # densmatr_initClassicalState (:1038): one unit amplitude.
+        def build(ind):
+            re, im = zeros()
+            return re.at[ind // lanes, ind % lanes].set(1), im
+
+    elif kind == "plus":
+        # reference: statevec_initPlusState (QuEST_cpu.c:1320) /
+        # densmatr_initPlusState (:1077): uniform fill.
+        def build(norm):
+            return jnp.full(shape, norm, dtype), jnp.zeros(shape, dtype)
+
+    elif kind == "debug":
+        # reference: statevec_initStateDebug (QuEST_cpu.c:1473):
+        # amp[k] = (2k)/10 + i(2k+1)/10.
+        def build():
+            k = (jax.lax.broadcasted_iota(dtype, shape, 0) * lanes
+                 + jax.lax.broadcasted_iota(dtype, shape, 1))
+            return 0.2 * k, 0.2 * k + 0.1
+
+    elif kind == "single_qubit":
+        # reference: statevec_initStateOfSingleQubit (QuEST_cpu.c:1427):
+        # uniform over basis states whose `qubit` bit equals `outcome`.
+        def build(qubit, outcome, norm):
+            lane_i = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+            row_i = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+            bit = jnp.where(
+                qubit < lane_bits,
+                (lane_i >> qubit) & 1,
+                (row_i >> jnp.maximum(qubit - lane_bits, 0)) & 1,
+            )
+            re = jnp.where(bit == outcome, jnp.asarray(norm, dtype), 0)
+            return re, jnp.zeros(shape, dtype)
+
+    else:  # pragma: no cover
+        raise ValueError(kind)
+
+    kw = {} if sh is None else {"out_shardings": (sh, sh)}
+    return jax.jit(build, **kw)
+
+
+def init_zero_state(qureg: Qureg) -> None:
+    """|0...0> or |0><0| (reference: initZeroState, QuEST.c:83-92)."""
+    build = _init_builder("classical", qureg.state_shape, qureg.real_dtype,
+                          qureg.mesh)
+    qureg._set(*build(0))
+    qasm.record_init(qureg, "zero")
+
+
+def init_plus_state(qureg: Qureg) -> None:
+    """Uniform superposition |+...+> , or |+..+><+..+| for density
+    matrices — every element 1/2^N (reference: initPlusState,
+    QuEST.c:95-105; densmatr_initPlusState QuEST_cpu.c:1077-1105)."""
+    if qureg.is_density:
+        norm = 1.0 / (1 << qureg.num_qubits)
+    else:
+        norm = 1.0 / np.sqrt(1 << qureg.num_qubits)
+    build = _init_builder("plus", qureg.state_shape, qureg.real_dtype,
+                          qureg.mesh)
+    qureg._set(*build(norm))
+    qasm.record_init(qureg, "plus")
+
+
+def init_classical_state(qureg: Qureg, state_ind: int) -> None:
+    """Basis state |ind> (or |ind><ind|) (reference: initClassicalState,
+    QuEST.c:107-117)."""
+    validate_state_index(qureg, state_ind)
+    flat_ind = state_ind
+    if qureg.is_density:
+        # diagonal element (ind, ind) of the flattened matrix
+        # (reference: densmatr_initClassicalState, QuEST_cpu.c:1038-1075)
+        flat_ind = state_ind * (1 << qureg.num_qubits) + state_ind
+    build = _init_builder("classical", qureg.state_shape, qureg.real_dtype,
+                          qureg.mesh)
+    qureg._set(*build(flat_ind))
+    qasm.record_init(qureg, "classical", state_ind)
+
+
+def init_state_debug(qureg: Qureg) -> None:
+    """Deterministic unphysical debug state (reference: initStateDebug,
+    QuEST_debug.h:17-23, QuEST_cpu.c:1473-1505)."""
+    build = _init_builder("debug", qureg.state_shape, qureg.real_dtype,
+                          qureg.mesh)
+    qureg._set(*build())
+
+
+def init_state_of_single_qubit(qureg: Qureg, qubit: int, outcome: int) -> None:
+    """Uniform state over basis states with ``qubit`` = ``outcome``
+    (reference: initStateOfSingleQubit, QuEST_debug.h:25-31,
+    QuEST_cpu.c:1427-1467)."""
+    if qureg.is_density:
+        raise QuESTError("initStateOfSingleQubit requires a state-vector")
+    validate_target(qureg, qubit)
+    validate_outcome(outcome)
+    norm = 1.0 / np.sqrt(qureg.num_amps / 2.0)
+    build = _init_builder("single_qubit", qureg.state_shape, qureg.real_dtype,
+                          qureg.mesh)
+    qureg._set(*build(qubit, outcome, norm))
+
+
+def init_pure_state(qureg: Qureg, pure: Qureg) -> None:
+    """Overwrite with a pure state: a copy for state-vectors, |psi><psi|
+    for density matrices (reference: initPureState, QuEST.c:119-130)."""
+    if pure.is_density:
+        raise QuESTError("second argument of initPureState must be a state-vector")
+    validate_matching_dims(qureg, pure)
+    if not qureg.is_density:
+        qureg._set(pure.re, pure.im)
+        return
+    from .ops.lattice import run_kernel  # deferred to avoid import cycle
+
+    re, im = run_kernel(
+        (qureg.re, qureg.im, pure.re, pure.im),
+        (),
+        kind="dm_init_pure",
+        statics=(qureg.num_qubits,),
+        mesh=qureg.mesh,
+    )
+    qureg._set(re, im)
+
+
+def init_state_from_amps(qureg: Qureg, reals, imags) -> None:
+    """Load a full amplitude list from the host (reference:
+    initStateFromAmps, QuEST.c:132-141)."""
+    reals = np.asarray(reals, dtype=qureg.real_dtype).reshape(-1)
+    imags = np.asarray(imags, dtype=qureg.real_dtype).reshape(-1)
+    if reals.shape != (qureg.num_amps,) or imags.shape != (qureg.num_amps,):
+        raise QuESTError(
+            f"initStateFromAmps needs {qureg.num_amps} reals and imags"
+        )
+    shape = qureg.state_shape
+    reals, imags = reals.reshape(shape), imags.reshape(shape)
+    sh = amp_sharding(qureg.mesh)
+    if sh is None:
+        qureg._set(jnp.asarray(reals), jnp.asarray(imags))
+    else:
+        qureg._set(jax.device_put(reals, sh), jax.device_put(imags, sh))
+
+
+def set_amps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
+    """Overwrite a contiguous window of amplitudes (reference: setAmps,
+    QuEST.c:143-152, windowed per-chunk in QuEST_cpu.c:1160-1200)."""
+    if qureg.is_density:
+        raise QuESTError("setAmps requires a state-vector")
+    validate_num_amps(qureg, start_ind, num_amps)
+    reals = jnp.asarray(np.asarray(reals[:num_amps], dtype=qureg.real_dtype))
+    imags = jnp.asarray(np.asarray(imags[:num_amps], dtype=qureg.real_dtype))
+    shape = qureg.state_shape
+    sl = slice(start_ind, start_ind + num_amps)
+    qureg._set(
+        qureg.re.reshape(-1).at[sl].set(reals).reshape(shape),
+        qureg.im.reshape(-1).at[sl].set(imags).reshape(shape),
+    )
+
+
+def clone_qureg(target: Qureg, copy: Qureg) -> None:
+    """target := copy (reference: cloneQureg, QuEST.c:73-81)."""
+    if target.is_density != copy.is_density:
+        raise QuESTError("cloneQureg requires registers of the same kind")
+    validate_matching_dims(target, copy)
+    target._set(copy.re, copy.im)
+
+
+# ---------------------------------------------------------------------------
+# Amplitude access
+# ---------------------------------------------------------------------------
+
+
+def get_real_amp(qureg: Qureg, index: int) -> float:
+    """(reference: getRealAmp, QuEST.c:497-503; distributed broadcast
+    statevec_getRealAmp QuEST_cpu_distributed.c:202-210 — the cross-device
+    fetch is a JAX gather here.)"""
+    if qureg.is_density:
+        raise QuESTError("getRealAmp requires a state-vector")
+    validate_state_index(qureg, index)
+    return float(qureg.re.reshape(-1)[index])
+
+
+def get_imag_amp(qureg: Qureg, index: int) -> float:
+    if qureg.is_density:
+        raise QuESTError("getImagAmp requires a state-vector")
+    validate_state_index(qureg, index)
+    return float(qureg.im.reshape(-1)[index])
+
+
+def get_amp(qureg: Qureg, index: int) -> complex:
+    """(reference: getAmp, QuEST.c:521-527.)"""
+    if qureg.is_density:
+        raise QuESTError("getAmp requires a state-vector")
+    validate_state_index(qureg, index)
+    return complex(float(qureg.re.reshape(-1)[index]),
+                   float(qureg.im.reshape(-1)[index]))
+
+
+def get_prob_amp(qureg: Qureg, index: int) -> float:
+    """|amp|^2 (reference: getProbAmp, QuEST.c:513-519)."""
+    a = get_amp(qureg, index)
+    return a.real * a.real + a.imag * a.imag
+
+
+def get_density_amp(qureg: Qureg, row: int, col: int) -> complex:
+    """rho[row, col], flat index row + col * 2^N (reference: getDensityAmp,
+    QuEST.c:529-539)."""
+    if not qureg.is_density:
+        raise QuESTError("getDensityAmp requires a density matrix")
+    validate_state_index(qureg, row)
+    validate_state_index(qureg, col)
+    ind = row + col * (1 << qureg.num_qubits)
+    return complex(float(qureg.re.reshape(-1)[ind]),
+                   float(qureg.im.reshape(-1)[ind]))
+
+
+def get_state_vector(qureg: Qureg) -> np.ndarray:
+    """Full state as a flat host complex array (testing/debug convenience)."""
+    re = np.asarray(qureg.re).reshape(-1)
+    im = np.asarray(qureg.im).reshape(-1)
+    return re.astype(np.complex128) + 1j * im
+
+
+def get_density_matrix(qureg: Qureg) -> np.ndarray:
+    """Full density matrix as a host (2^N, 2^N) complex array, indexed
+    [row, col]."""
+    if not qureg.is_density:
+        raise QuESTError("getDensityMatrix requires a density matrix")
+    dim = 1 << qureg.num_qubits
+    # flat index = col * dim + row -> reshape gives [col, row]; transpose.
+    return get_state_vector(qureg).reshape(dim, dim).T
+
+
+def compare_states(a: Qureg, b: Qureg, tol: float) -> bool:
+    """Elementwise comparison within ``tol`` (reference: compareStates,
+    QuEST_debug.h:38-48, QuEST_cpu.c:1557-1568)."""
+    validate_matching_dims(a, b)
+    ar, ai = np.asarray(a.re), np.asarray(a.im)
+    br, bi = np.asarray(b.re), np.asarray(b.im)
+    return bool(np.all(np.abs(ar - br) <= tol) and np.all(np.abs(ai - bi) <= tol))

@@ -1,0 +1,177 @@
+"""Input validation (reference: QuEST/src/QuEST_validation.c).
+
+The reference validates at the public API layer and exits the process on
+failure (exitWithError, QuEST_validation.c:82-92).  Here invalid input
+raises :class:`QuESTError` instead — recoverable, and the C ABI shim maps
+it back to the reference's print-and-exit behaviour.
+
+Error conditions and bounds mirror QuEST_validation.c:19-263, including
+the precision-dependent unitarity tolerance REAL_EPS
+(QuEST_precision.h:25-47) and the noise-probability caps (:240-263).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import precision
+
+
+class QuESTError(ValueError):
+    """Raised for invalid API input (reference error codes:
+    QuEST_validation.c:19-80)."""
+
+
+def _fail(msg: str, func: str | None = None):
+    raise QuESTError(msg if func is None else f"{func}: {msg}")
+
+
+def validate_create_num_qubits(num_qubits: int) -> None:
+    if num_qubits < 1:
+        _fail("Invalid number of qubits. Must create >0.")
+
+
+def validate_target(qureg, target: int, func: str | None = None) -> None:
+    if not 0 <= target < qureg.num_qubits:
+        _fail("Invalid target qubit. Note qubits are zero indexed.", func)
+
+
+def validate_control_target(qureg, control: int, target: int,
+                            func: str | None = None) -> None:
+    validate_target(qureg, target, func)
+    if not 0 <= control < qureg.num_qubits:
+        _fail("Invalid control qubit. Note qubits are zero indexed.", func)
+    if control == target:
+        _fail("Control qubit cannot equal target qubit.", func)
+
+
+def validate_unique_targets(qureg, q1: int, q2: int,
+                            func: str | None = None) -> None:
+    validate_target(qureg, q1, func)
+    validate_target(qureg, q2, func)
+    if q1 == q2:
+        _fail("Qubits must be unique.", func)
+
+
+def validate_multi_controls(qureg, controls, target: int,
+                            func: str | None = None) -> None:
+    validate_target(qureg, target, func)
+    n = len(controls)
+    if not 1 <= n <= qureg.num_qubits:
+        _fail("Invalid number of control qubits.", func)
+    seen = set()
+    for c in controls:
+        if not 0 <= c < qureg.num_qubits:
+            _fail("Invalid control qubit. Note qubits are zero indexed.", func)
+        if c == target:
+            _fail("Control qubit cannot equal target qubit.", func)
+        if c in seen:
+            _fail("Control qubits must be unique.", func)
+        seen.add(c)
+
+
+def validate_state_index(qureg, ind: int, func: str | None = None) -> None:
+    dim = 1 << qureg.num_qubits
+    if not 0 <= ind < dim:
+        _fail("Invalid amplitude index. Index must be >=0 and <2^numQubits.", func)
+
+
+def validate_num_amps(qureg, start: int, num: int,
+                      func: str | None = None) -> None:
+    if not (0 <= start < qureg.num_amps and 0 <= num <= qureg.num_amps - start):
+        _fail("Invalid number of amplitudes. Must be >=0 and <=2^numQubits-startInd.", func)
+
+
+def validate_matching_dims(a, b, func: str | None = None) -> None:
+    if a.num_qubits != b.num_qubits:
+        _fail("Dimensions of the qubit registers don't match.", func)
+
+
+def validate_density_qureg(qureg, func: str | None = None) -> None:
+    if not qureg.is_density:
+        _fail("Operation valid only for density matrices.", func)
+
+
+def validate_statevec_qureg(qureg, func: str | None = None) -> None:
+    if qureg.is_density:
+        _fail("Operation valid only for state-vectors.", func)
+
+
+def validate_outcome(outcome: int, func: str | None = None) -> None:
+    if outcome not in (0, 1):
+        _fail("Invalid measurement outcome. Must be 0 or 1.", func)
+
+
+def validate_measurement_prob(prob: float, dtype=np.float64,
+                              func: str | None = None) -> None:
+    # reference: validateMeasurementProb (QuEST_validation.c:208) — the
+    # requested outcome must have non-zero probability, to the register's
+    # precision-dependent REAL_EPS (an f32 register's rounding noise can
+    # reach ~1e-6; collapsing onto it would renormalise garbage).
+    if prob < precision.real_eps(dtype):
+        _fail("Probability of outcome is zero.", func)
+
+
+def _norm_ok(x: float, eps: float) -> bool:
+    return abs(x) <= eps
+
+
+def validate_unitary_complex_pair(alpha: complex, beta: complex,
+                                  dtype, func: str | None = None) -> None:
+    """|alpha|^2 + |beta|^2 == 1 to REAL_EPS (reference:
+    validateUnitaryComplexPair -> getValidityOfComplexPair,
+    QuEST_validation.c:94-110)."""
+    eps = precision.real_eps(dtype)
+    mag = abs(alpha) ** 2 + abs(beta) ** 2
+    if not _norm_ok(mag - 1, eps):
+        _fail("Argument alpha and beta must obey |alpha|^2 + |beta|^2 = 1.", func)
+
+
+def validate_unitary_matrix(u, dtype, func: str | None = None) -> None:
+    """U U-dagger == I to REAL_EPS (reference: validateUnitaryMatrix ->
+    getValidityOfMatrix, QuEST_validation.c:112-128, :184)."""
+    eps = precision.real_eps(dtype)
+    m = np.asarray(u, dtype=np.complex128)
+    if m.shape != (2, 2):
+        _fail("Matrix must be 2x2.", func)
+    err = np.abs(m @ m.conj().T - np.eye(2)).max()
+    if err > eps:
+        _fail("Matrix is not unitary.", func)
+
+
+def validate_unit_vector(x: float, y: float, z: float,
+                         func: str | None = None) -> None:
+    # reference: validateVector (QuEST_validation.c) — axis must be non-zero
+    if x == 0 and y == 0 and z == 0:
+        _fail("Invalid axis vector. Must be non-zero.", func)
+
+
+# Noise probability caps (reference: QuEST_validation.c:240-263).
+def validate_one_qubit_dephase_prob(p: float, func: str | None = None) -> None:
+    if not 0 <= p <= 0.5:
+        _fail("The probability of a one qubit dephase error cannot exceed 1/2.", func)
+
+
+def validate_two_qubit_dephase_prob(p: float, func: str | None = None) -> None:
+    if not 0 <= p <= 0.75:
+        _fail("The probability of a two qubit dephase error cannot exceed 3/4.", func)
+
+
+def validate_one_qubit_depol_prob(p: float, func: str | None = None) -> None:
+    if not 0 <= p <= 0.75:
+        _fail("The probability of a one qubit depolarising error cannot exceed 3/4.", func)
+
+
+def validate_two_qubit_depol_prob(p: float, func: str | None = None) -> None:
+    if not 0 <= p <= 15.0 / 16:
+        _fail("The probability of a two qubit depolarising error cannot exceed 15/16.", func)
+
+
+def validate_one_qubit_damping_prob(p: float, func: str | None = None) -> None:
+    if not 0 <= p <= 1:
+        _fail("The probability of a one qubit damping error cannot exceed 1.", func)
+
+
+def validate_prob(p: float, func: str | None = None) -> None:
+    if not 0 <= p <= 1:
+        _fail("Probabilities must be in [0, 1].", func)

@@ -1,0 +1,82 @@
+"""Test configuration.
+
+Forces CPU with 8 virtual devices (set before JAX import) so the sharded
+path — ppermute exchanges, psum reductions, all-gathers — is exercised on
+one host, the thing the reference could only test under mpirun (SURVEY §4).
+Double precision everywhere: the reference test harness tolerance is 1e-10
+(utilities/QuESTTest/__main__.py -t flag), which needs f64.
+"""
+
+import os
+
+# Force CPU for the test suite even when the machine env pins a TPU platform
+# (set QUEST_TPU_TEST_PLATFORM to override).  jax may already be imported by
+# the interpreter's sitecustomize, so set both the env vars (for fresh
+# interpreters) and the live config (for this one); backends must not have
+# been initialised yet, which holds as long as nothing called jax.devices().
+_platform = os.environ.get("QUEST_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+
+qt.set_default_precision("double")
+
+TOL = 1e-10
+
+
+@pytest.fixture(scope="session")
+def env1():
+    """Single-device environment (local kernel path)."""
+    return qt.create_env(num_devices=1)
+
+
+@pytest.fixture(scope="session")
+def env8():
+    """8-device mesh environment (sharded ppermute/psum path)."""
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return qt.create_env(num_devices=8)
+
+
+@pytest.fixture(scope="session", params=["local", "sharded"])
+def env(request, env1, env8):
+    """Run a test under both execution modes."""
+    return env1 if request.param == "local" else env8
+
+
+def random_statevector(n, seed):
+    rng = np.random.RandomState(seed)
+    v = rng.randn(2**n) + 1j * rng.randn(2**n)
+    return v / np.linalg.norm(v)
+
+
+def random_density_matrix(n, seed):
+    """A random valid (PSD, trace-1) density matrix."""
+    rng = np.random.RandomState(seed)
+    dim = 2**n
+    a = rng.randn(dim, dim) + 1j * rng.randn(dim, dim)
+    rho = a @ a.conj().T
+    return rho / np.trace(rho)
+
+
+def load_statevector(qureg, psi):
+    qt.init_state_from_amps(qureg, psi.real.copy(), psi.imag.copy())
+
+
+def load_density_matrix(qureg, rho):
+    # flat index = col * dim + row  (quest_tpu.register.get_density_amp)
+    flat = rho.T.reshape(-1)
+    qt.init_state_from_amps(qureg, flat.real.copy(), flat.imag.copy())

@@ -1,0 +1,132 @@
+"""Independent dense-matrix oracle for small systems.
+
+Deliberately implemented with a different method from the framework (full
+2^n x 2^n matrices and Kraus maps in numpy complex128) so shared-bug risk
+is minimal.  The reference C build, where available (see
+tests/test_reference_parity.py), is a second, authoritative oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+I2 = np.eye(2, dtype=np.complex128)
+X = np.array([[0, 1], [1, 0]], dtype=np.complex128)
+Y = np.array([[0, -1j], [1j, 0]], dtype=np.complex128)
+Z = np.array([[1, 0], [0, -1]], dtype=np.complex128)
+H = np.array([[1, 1], [1, -1]], dtype=np.complex128) / np.sqrt(2)
+S = np.array([[1, 0], [0, 1j]], dtype=np.complex128)
+T = np.array([[1, 0], [0, np.exp(1j * np.pi / 4)]], dtype=np.complex128)
+
+
+def rot(angle, axis):
+    x, y, z = np.asarray(axis, dtype=float)
+    n = np.sqrt(x * x + y * y + z * z)
+    x, y, z = x / n, y / n, z / n
+    c, s = np.cos(angle / 2), np.sin(angle / 2)
+    return np.array(
+        [[c - 1j * s * z, -s * y - 1j * s * x],
+         [s * y - 1j * s * x, c + 1j * s * z]]
+    )
+
+
+def compact(alpha, beta):
+    return np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+
+
+def phase_m(term):
+    return np.array([[1, 0], [0, term]], dtype=np.complex128)
+
+
+def full_gate(n, target, u2, controls=()):
+    """Dense 2^n matrix applying u2 to `target` where all `controls` are 1.
+
+    Qubit q is bit q of the basis index (LSB = qubit 0).
+    """
+    dim = 1 << n
+    m = np.zeros((dim, dim), dtype=np.complex128)
+    cmask = 0
+    for c in controls:
+        cmask |= 1 << c
+    t = 1 << target
+    for i in range(dim):
+        if (i & cmask) != cmask:
+            m[i, i] = 1.0
+            continue
+        b = (i >> target) & 1
+        i0, i1 = i & ~t, i | t
+        m[i, i0] = u2[b, 0]
+        m[i, i1] = u2[b, 1]
+    return m
+
+
+def full_phase(n, sel_mask, term):
+    """Dense diagonal: multiply by `term` where all sel_mask bits set."""
+    dim = 1 << n
+    d = np.ones(dim, dtype=np.complex128)
+    for i in range(dim):
+        if (i & sel_mask) == sel_mask:
+            d[i] = term
+    return np.diag(d)
+
+
+def apply_sv(psi, n, target, u2, controls=()):
+    return full_gate(n, target, u2, controls) @ psi
+
+
+def apply_dm(rho, n, target, u2, controls=()):
+    m = full_gate(n, target, u2, controls)
+    return m @ rho @ m.conj().T
+
+
+def kraus(rho, ops):
+    return sum(k @ rho @ k.conj().T for k in ops)
+
+
+def op_on(n, q, u2):
+    """u2 acting on qubit q of n (kron with identities)."""
+    m = np.array([[1]], dtype=np.complex128)
+    for i in range(n):
+        m = np.kron(u2 if i == q else I2, m)
+    return m
+
+
+def dephase1(rho, n, q, p):
+    return (1 - p) * rho + p * op_on(n, q, Z) @ rho @ op_on(n, q, Z)
+
+
+def dephase2(rho, n, q1, q2, p):
+    za, zb = op_on(n, q1, Z), op_on(n, q2, Z)
+    return (1 - p) * rho + (p / 3) * (
+        za @ rho @ za + zb @ rho @ zb + za @ zb @ rho @ zb @ za
+    )
+
+
+def depolarise1(rho, n, q, p):
+    xs = [op_on(n, q, P) for P in (X, Y, Z)]
+    return (1 - p) * rho + (p / 3) * sum(m @ rho @ m for m in xs)
+
+
+def depolarise2(rho, n, q1, q2, p):
+    paulis = (I2, X, Y, Z)
+    acc = np.zeros_like(rho)
+    for a in range(4):
+        for b in range(4):
+            if a == 0 and b == 0:
+                continue
+            m = op_on(n, q1, paulis[a]) @ op_on(n, q2, paulis[b])
+            acc += m @ rho @ m.conj().T
+    return (1 - p) * rho + (p / 15) * acc
+
+
+def damping(rho, n, q, p):
+    k0 = np.array([[1, 0], [0, np.sqrt(1 - p)]], dtype=np.complex128)
+    k1 = np.array([[0, np.sqrt(p)], [0, 0]], dtype=np.complex128)
+    return kraus(rho, [op_on(n, q, k0), op_on(n, q, k1)])
+
+
+def random_unitary(seed):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(2, 2) + 1j * rng.randn(2, 2)
+    q, r = np.linalg.qr(a)
+    return q * (np.diag(r) / np.abs(np.diag(r)))

@@ -1,0 +1,160 @@
+"""Reductions, measurement and collapse, under both execution paths."""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+import oracle
+from conftest import (
+    TOL,
+    random_statevector,
+    random_density_matrix,
+    load_statevector,
+    load_density_matrix,
+)
+
+N = 5
+ND = 3
+
+
+def test_calc_total_prob(env):
+    psi = random_statevector(N, 1)
+    q = qt.create_qureg(N, env)
+    load_statevector(q, psi)
+    assert abs(qt.calc_total_prob(q) - 1.0) < TOL
+
+    rho = random_density_matrix(ND, 2)
+    d = qt.create_density_qureg(ND, env)
+    load_density_matrix(d, rho)
+    assert abs(qt.calc_total_prob(d) - 1.0) < TOL
+
+
+def test_calc_prob_of_outcome_sv(env):
+    psi = random_statevector(N, 3)
+    q = qt.create_qureg(N, env)
+    for t in range(N):
+        load_statevector(q, psi)
+        for outcome in (0, 1):
+            got = qt.calc_prob_of_outcome(q, t, outcome)
+            sel = [(i >> t) & 1 == outcome for i in range(2**N)]
+            want = float(np.sum(np.abs(psi[sel]) ** 2))
+            assert abs(got - want) < TOL
+
+
+def test_calc_prob_of_outcome_dm(env):
+    rho = random_density_matrix(ND, 4)
+    d = qt.create_density_qureg(ND, env)
+    for t in range(ND):
+        load_density_matrix(d, rho)
+        for outcome in (0, 1):
+            got = qt.calc_prob_of_outcome(d, t, outcome)
+            diag = np.real(np.diag(rho))
+            sel = [(i >> t) & 1 == outcome for i in range(2**ND)]
+            want = float(diag[sel].sum())
+            assert abs(got - want) < TOL
+
+
+def test_calc_inner_product(env):
+    a = random_statevector(N, 5)
+    b = random_statevector(N, 6)
+    qa = qt.create_qureg(N, env)
+    qb = qt.create_qureg(N, env)
+    load_statevector(qa, a)
+    load_statevector(qb, b)
+    got = qt.calc_inner_product(qa, qb)
+    want = np.vdot(a, b)
+    assert abs(got - want) < TOL
+
+
+def test_calc_purity(env):
+    rho = random_density_matrix(ND, 7)
+    d = qt.create_density_qureg(ND, env)
+    load_density_matrix(d, rho)
+    want = float(np.real(np.trace(rho @ rho)))
+    assert abs(qt.calc_purity(d) - want) < TOL
+
+
+def test_calc_fidelity_sv(env):
+    a = random_statevector(N, 8)
+    b = random_statevector(N, 9)
+    qa = qt.create_qureg(N, env)
+    qb = qt.create_qureg(N, env)
+    load_statevector(qa, a)
+    load_statevector(qb, b)
+    want = abs(np.vdot(a, b)) ** 2
+    assert abs(qt.calc_fidelity(qa, qb) - want) < TOL
+
+
+def test_calc_fidelity_dm(env):
+    rho = random_density_matrix(ND, 10)
+    psi = random_statevector(ND, 11)
+    d = qt.create_density_qureg(ND, env)
+    p = qt.create_qureg(ND, env)
+    load_density_matrix(d, rho)
+    load_statevector(p, psi)
+    want = float(np.real(np.vdot(psi, rho @ psi)))
+    assert abs(qt.calc_fidelity(d, p) - want) < TOL
+
+
+def test_collapse_to_outcome_sv(env):
+    psi = random_statevector(N, 12)
+    for t in (0, N - 1):
+        for outcome in (0, 1):
+            q = qt.create_qureg(N, env)
+            load_statevector(q, psi)
+            prob = qt.collapse_to_outcome(q, t, outcome)
+            sel = np.array([(i >> t) & 1 == outcome for i in range(2**N)])
+            want_prob = float(np.sum(np.abs(psi[sel]) ** 2))
+            assert abs(prob - want_prob) < TOL
+            want = np.where(sel, psi, 0) / np.sqrt(want_prob)
+            np.testing.assert_allclose(qt.get_state_vector(q), want, atol=TOL)
+            assert abs(qt.calc_total_prob(q) - 1.0) < TOL
+
+
+def test_collapse_to_outcome_dm(env):
+    rho = random_density_matrix(ND, 13)
+    for t in (0, ND - 1):
+        d = qt.create_density_qureg(ND, env)
+        load_density_matrix(d, rho)
+        prob = qt.collapse_to_outcome(d, t, 1)
+        sel = np.array([(i >> t) & 1 == 1 for i in range(2**ND)])
+        proj = np.diag(sel.astype(float))
+        want_rho = proj @ rho @ proj / np.real(np.trace(proj @ rho @ proj))
+        np.testing.assert_allclose(qt.get_density_matrix(d), want_rho, atol=TOL)
+        assert abs(qt.calc_total_prob(d) - 1.0) < TOL
+        assert prob > 0
+
+
+def test_measure_statistics(env):
+    """Measurement outcomes follow the Born rule and collapse correctly."""
+    qt.seed_quest([1234])
+    q = qt.create_qureg(3, env)
+    counts = [0, 0]
+    trials = 200
+    for _ in range(trials):
+        qt.init_zero_state(q)
+        qt.hadamard(q, 0)
+        out, prob = qt.measure_with_stats(q, 0)
+        assert abs(prob - 0.5) < TOL
+        counts[out] += 1
+        # post-measurement state is |out> on qubit 0
+        assert abs(qt.calc_prob_of_outcome(q, 0, out) - 1.0) < TOL
+    # ~N(100, 50): 5 sigma ≈ 35
+    assert 50 <= counts[0] <= 150
+
+
+def test_measure_deterministic(env):
+    q = qt.create_qureg(3, env)
+    qt.init_classical_state(q, 0b101)
+    assert qt.measure(q, 0) == 1
+    assert qt.measure(q, 1) == 0
+    assert qt.measure(q, 2) == 1
+
+
+def test_measure_density(env):
+    d = qt.create_density_qureg(3, env)
+    qt.init_classical_state(d, 0b010)
+    out, prob = qt.measure_with_stats(d, 1)
+    assert out == 1 and abs(prob - 1.0) < TOL
+    assert qt.measure(d, 0) == 0

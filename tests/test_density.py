@@ -1,0 +1,170 @@
+"""Density-matrix gates (U (x) U* routing) and decoherence channels vs the
+Kraus-map oracle, both execution paths.
+
+The 8-device runs shard all three column ("outer") qubits of the 3-qubit
+density matrix onto device bits, so every noise channel's outer-bit partner
+exchange exercises the ppermute path (the reference needed its trickiest
+MPI choreography here — QuEST_cpu_distributed.c:697-814).
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+import oracle
+from conftest import TOL, random_density_matrix, random_statevector, \
+    load_density_matrix, load_statevector
+
+N = 3
+
+
+def fresh(env, seed):
+    rho = random_density_matrix(N, seed)
+    d = qt.create_density_qureg(N, env)
+    load_density_matrix(d, rho)
+    return d, rho
+
+
+@pytest.mark.parametrize("t", range(N))
+def test_density_gates(env, t):
+    d, rho = fresh(env, 40 + t)
+    qt.hadamard(d, t)
+    rho = oracle.apply_dm(rho, N, t, oracle.H)
+    qt.t_gate(d, t)
+    rho = oracle.apply_dm(rho, N, t, oracle.T)
+    qt.pauli_y(d, t)
+    rho = oracle.apply_dm(rho, N, t, oracle.Y)
+    ang = 0.37
+    qt.rotate_x(d, t, ang)
+    rho = oracle.apply_dm(rho, N, t, oracle.rot(ang, (1, 0, 0)))
+    u = oracle.random_unitary(17)
+    qt.unitary(d, t, u)
+    rho = oracle.apply_dm(rho, N, t, u)
+    np.testing.assert_allclose(qt.get_density_matrix(d), rho, atol=TOL)
+
+
+@pytest.mark.parametrize("c,t", [(0, 1), (2, 0), (1, 2)])
+def test_density_controlled_gates(env, c, t):
+    d, rho = fresh(env, 50 + c * 3 + t)
+    qt.controlled_not(d, c, t)
+    rho = oracle.apply_dm(rho, N, t, oracle.X, (c,))
+    u = oracle.random_unitary(23)
+    qt.controlled_unitary(d, c, t, u)
+    rho = oracle.apply_dm(rho, N, t, u, (c,))
+    qt.controlled_phase_flip(d, c, t)
+    m = oracle.full_phase(N, (1 << c) | (1 << t), -1.0)
+    rho = m @ rho @ m.conj().T
+    np.testing.assert_allclose(qt.get_density_matrix(d), rho, atol=TOL)
+
+
+@pytest.mark.parametrize("t", range(N))
+@pytest.mark.parametrize("p", [0.0, 0.1, 0.5])
+def test_dephase1(env, t, p):
+    d, rho = fresh(env, 60 + t)
+    qt.apply_one_qubit_dephase_error(d, t, p)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(d), oracle.dephase1(rho, N, t, p), atol=TOL
+    )
+
+
+@pytest.mark.parametrize("q1,q2", [(0, 1), (1, 2), (2, 0)])
+def test_dephase2(env, q1, q2):
+    p = 0.6
+    d, rho = fresh(env, 70 + q1)
+    qt.apply_two_qubit_dephase_error(d, q1, q2, p)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(d), oracle.dephase2(rho, N, q1, q2, p), atol=TOL
+    )
+
+
+@pytest.mark.parametrize("t", range(N))
+@pytest.mark.parametrize("p", [0.1, 0.75])
+def test_depolarise1(env, t, p):
+    d, rho = fresh(env, 80 + t)
+    qt.apply_one_qubit_depolarise_error(d, t, p)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(d), oracle.depolarise1(rho, N, t, p), atol=TOL
+    )
+
+
+@pytest.mark.parametrize("t", range(N))
+@pytest.mark.parametrize("p", [0.05, 0.3, 1.0])
+def test_damping(env, t, p):
+    d, rho = fresh(env, 90 + t)
+    qt.apply_one_qubit_damping_error(d, t, p)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(d), oracle.damping(rho, N, t, p), atol=TOL
+    )
+
+
+@pytest.mark.parametrize("q1,q2", [(0, 1), (1, 2), (0, 2), (2, 1)])
+@pytest.mark.parametrize("p", [0.1, 0.9])
+def test_depolarise2(env, q1, q2, p):
+    d, rho = fresh(env, 100 + q1 * 3 + q2)
+    qt.apply_two_qubit_depolarise_error(d, q1, q2, p)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(d), oracle.depolarise2(rho, N, q1, q2, p), atol=TOL
+    )
+
+
+def test_trace_preserved_by_channels(env):
+    d, _ = fresh(env, 110)
+    qt.apply_one_qubit_dephase_error(d, 0, 0.3)
+    qt.apply_one_qubit_depolarise_error(d, 1, 0.5)
+    qt.apply_one_qubit_damping_error(d, 2, 0.4)
+    qt.apply_two_qubit_dephase_error(d, 0, 2, 0.5)
+    qt.apply_two_qubit_depolarise_error(d, 1, 2, 0.7)
+    assert abs(qt.calc_total_prob(d) - 1.0) < TOL
+
+
+def test_add_density_matrix(env):
+    da, ra = fresh(env, 120)
+    db, rb = fresh(env, 121)
+    qt.add_density_matrix(da, 0.3, db)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(da), 0.7 * ra + 0.3 * rb, atol=TOL
+    )
+
+
+def test_init_pure_state(env):
+    psi = random_statevector(N, 122)
+    p = qt.create_qureg(N, env)
+    load_statevector(p, psi)
+    d = qt.create_density_qureg(N, env)
+    qt.init_pure_state(d, p)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(d), np.outer(psi, psi.conj()), atol=TOL
+    )
+    assert abs(qt.calc_purity(d) - 1.0) < TOL
+    assert abs(qt.calc_fidelity(d, p) - 1.0) < TOL
+
+
+def test_density_init_states(env):
+    d = qt.create_density_qureg(N, env)
+    # zero state
+    m = qt.get_density_matrix(d)
+    want = np.zeros((8, 8))
+    want[0, 0] = 1
+    np.testing.assert_allclose(m, want, atol=TOL)
+    # plus state: all entries 1/2^N (densmatr_initPlusState)
+    qt.init_plus_state(d)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(d), np.full((8, 8), 1 / 8), atol=TOL
+    )
+    # classical
+    qt.init_classical_state(d, 5)
+    want = np.zeros((8, 8))
+    want[5, 5] = 1
+    np.testing.assert_allclose(qt.get_density_matrix(d), want, atol=TOL)
+
+
+def test_purity_decreases_under_noise(env):
+    p = qt.create_qureg(N, env)
+    qt.hadamard(p, 0)
+    d = qt.create_density_qureg(N, env)
+    qt.init_pure_state(d, p)
+    before = qt.calc_purity(d)
+    qt.apply_one_qubit_depolarise_error(d, 0, 0.5)
+    after = qt.calc_purity(d)
+    assert after < before

@@ -1,0 +1,212 @@
+"""State-vector gate parity vs the dense numpy oracle, under both the
+single-device and the 8-device sharded execution paths.
+
+Mirrors the reference's per-function unit tests
+(tests/unit/state_vector/gates/*.test) with every target qubit swept, so
+both the local (in-chunk) and device-bit (ppermute) regimes are hit.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+import oracle
+from conftest import TOL, random_statevector, load_statevector
+
+N = 5  # 8-device sharding puts qubits 2,3,4 on device bits
+
+
+def check_gate(env, apply_fn, oracle_u, targets=range(N), controls=(),
+               seed0=0):
+    for i, t in enumerate(targets):
+        if t in controls:
+            continue
+        psi = random_statevector(N, seed0 + i)
+        q = qt.create_qureg(N, env)
+        load_statevector(q, psi)
+        apply_fn(q, t)
+        expect = oracle.apply_sv(psi, N, t, oracle_u, controls)
+        np.testing.assert_allclose(qt.get_state_vector(q), expect, atol=TOL)
+
+
+def test_hadamard(env):
+    check_gate(env, qt.hadamard, oracle.H)
+
+
+def test_pauli_x(env):
+    check_gate(env, qt.pauli_x, oracle.X)
+
+
+def test_pauli_y(env):
+    check_gate(env, qt.pauli_y, oracle.Y)
+
+
+def test_pauli_z(env):
+    check_gate(env, qt.pauli_z, oracle.Z)
+
+
+def test_s_gate(env):
+    check_gate(env, qt.s_gate, oracle.S)
+
+
+def test_t_gate(env):
+    check_gate(env, qt.t_gate, oracle.T)
+
+
+def test_phase_shift(env):
+    ang = 0.83
+    check_gate(env, lambda q, t: qt.phase_shift(q, t, ang),
+               oracle.phase_m(np.exp(1j * ang)))
+
+
+def test_rotations(env):
+    ang = 1.27
+    check_gate(env, lambda q, t: qt.rotate_x(q, t, ang), oracle.rot(ang, (1, 0, 0)))
+    check_gate(env, lambda q, t: qt.rotate_y(q, t, ang), oracle.rot(ang, (0, 1, 0)))
+    check_gate(env, lambda q, t: qt.rotate_z(q, t, ang), oracle.rot(ang, (0, 0, 1)))
+
+
+def test_rotate_around_axis(env):
+    ang, axis = 2.1, (1.0, -2.0, 0.5)
+    check_gate(env, lambda q, t: qt.rotate_around_axis(q, t, ang, axis),
+               oracle.rot(ang, axis))
+
+
+def test_compact_unitary(env):
+    a = complex(0.5, -0.5)
+    b = complex(0.5, 0.5)
+    check_gate(env, lambda q, t: qt.compact_unitary(q, t, a, b),
+               oracle.compact(a, b))
+
+
+def test_unitary(env):
+    u = oracle.random_unitary(7)
+    check_gate(env, lambda q, t: qt.unitary(q, t, u), u)
+
+
+@pytest.mark.parametrize("control", [0, 2, 4])
+def test_controlled_not(env, control):
+    check_gate(env, lambda q, t: qt.controlled_not(q, control, t), oracle.X,
+               controls=(control,))
+
+
+@pytest.mark.parametrize("control", [1, 3])
+def test_controlled_pauli_y(env, control):
+    check_gate(env, lambda q, t: qt.controlled_pauli_y(q, control, t),
+               oracle.Y, controls=(control,))
+
+
+@pytest.mark.parametrize("control", [0, 4])
+def test_controlled_unitary(env, control):
+    u = oracle.random_unitary(11)
+    check_gate(env, lambda q, t: qt.controlled_unitary(q, control, t, u), u,
+               controls=(control,))
+
+
+@pytest.mark.parametrize("control", [0, 3])
+def test_controlled_compact_unitary(env, control):
+    a, b = complex(0.6, 0.0), complex(0.0, 0.8)
+    check_gate(env,
+               lambda q, t: qt.controlled_compact_unitary(q, control, t, a, b),
+               oracle.compact(a, b), controls=(control,))
+
+
+@pytest.mark.parametrize("control", [1, 4])
+def test_controlled_rotations(env, control):
+    ang = -0.77
+    check_gate(env, lambda q, t: qt.controlled_rotate_x(q, control, t, ang),
+               oracle.rot(ang, (1, 0, 0)), controls=(control,))
+    check_gate(env, lambda q, t: qt.controlled_rotate_y(q, control, t, ang),
+               oracle.rot(ang, (0, 1, 0)), controls=(control,))
+    check_gate(env, lambda q, t: qt.controlled_rotate_z(q, control, t, ang),
+               oracle.rot(ang, (0, 0, 1)), controls=(control,))
+
+
+def test_controlled_rotate_around_axis(env):
+    ang, axis = 0.9, (0.3, 1.1, -0.2)
+    check_gate(env,
+               lambda q, t: qt.controlled_rotate_around_axis(q, 2, t, ang, axis),
+               oracle.rot(ang, axis), controls=(2,))
+
+
+@pytest.mark.parametrize("controls", [(0, 1), (1, 3, 4), (0, 2, 3)])
+def test_multi_controlled_unitary(env, controls):
+    u = oracle.random_unitary(13)
+    targets = [t for t in range(N) if t not in controls]
+    check_gate(env,
+               lambda q, t: qt.multi_controlled_unitary(q, list(controls), t, u),
+               u, targets=targets, controls=controls)
+
+
+def test_controlled_phase_shift(env):
+    ang = 0.41
+    psi = random_statevector(N, 21)
+    for q1, q2 in [(0, 1), (1, 4), (3, 2)]:
+        q = qt.create_qureg(N, env)
+        load_statevector(q, psi)
+        qt.controlled_phase_shift(q, q1, q2, ang)
+        m = oracle.full_phase(N, (1 << q1) | (1 << q2), np.exp(1j * ang))
+        np.testing.assert_allclose(qt.get_state_vector(q), m @ psi, atol=TOL)
+
+
+def test_controlled_phase_flip(env):
+    psi = random_statevector(N, 22)
+    for q1, q2 in [(0, 3), (4, 1)]:
+        q = qt.create_qureg(N, env)
+        load_statevector(q, psi)
+        qt.controlled_phase_flip(q, q1, q2)
+        m = oracle.full_phase(N, (1 << q1) | (1 << q2), -1.0)
+        np.testing.assert_allclose(qt.get_state_vector(q), m @ psi, atol=TOL)
+
+
+@pytest.mark.parametrize("qubits", [(0, 1, 2), (1, 3, 4), (0, 2, 3, 4)])
+def test_multi_controlled_phase_ops(env, qubits):
+    psi = random_statevector(N, 23)
+    mask = 0
+    for b in qubits:
+        mask |= 1 << b
+
+    q = qt.create_qureg(N, env)
+    load_statevector(q, psi)
+    qt.multi_controlled_phase_flip(q, list(qubits))
+    np.testing.assert_allclose(
+        qt.get_state_vector(q), oracle.full_phase(N, mask, -1.0) @ psi, atol=TOL
+    )
+
+    ang = 1.9
+    q = qt.create_qureg(N, env)
+    load_statevector(q, psi)
+    qt.multi_controlled_phase_shift(q, list(qubits), ang)
+    np.testing.assert_allclose(
+        qt.get_state_vector(q),
+        oracle.full_phase(N, mask, np.exp(1j * ang)) @ psi,
+        atol=TOL,
+    )
+
+
+def test_gate_sequence_matches_oracle(env):
+    """A random multi-gate circuit, checked end-to-end."""
+    rng = np.random.RandomState(42)
+    psi = random_statevector(N, 99)
+    q = qt.create_qureg(N, env)
+    load_statevector(q, psi)
+    expect = psi.copy()
+    for step in range(30):
+        t = int(rng.randint(N))
+        kind = rng.randint(4)
+        if kind == 0:
+            qt.hadamard(q, t)
+            expect = oracle.apply_sv(expect, N, t, oracle.H)
+        elif kind == 1:
+            ang = float(rng.randn())
+            qt.rotate_y(q, t, ang)
+            expect = oracle.apply_sv(expect, N, t, oracle.rot(ang, (0, 1, 0)))
+        elif kind == 2:
+            c = int(rng.choice([x for x in range(N) if x != t]))
+            qt.controlled_not(q, c, t)
+            expect = oracle.apply_sv(expect, N, t, oracle.X, (c,))
+        else:
+            qt.t_gate(q, t)
+            expect = oracle.apply_sv(expect, N, t, oracle.T)
+    np.testing.assert_allclose(qt.get_state_vector(q), expect, atol=TOL)
