@@ -269,7 +269,16 @@ def init_state_of_single_qubit(qureg: Qureg, qubit: int, outcome: int) -> None:
 
 def init_pure_state(qureg: Qureg, pure: Qureg) -> None:
     """Overwrite with a pure state: a copy for state-vectors, |psi><psi|
-    for density matrices (reference: initPureState, QuEST.c:119-130)."""
+    for density matrices (reference: initPureState, QuEST.c:119-130).
+
+    Intentional deviation: the reference kernel
+    (densmatr_initPureStateLocal, QuEST_cpu.c:1152-1154) computes
+    re = kr*br - ki*bi, im = kr*bi - ki*br, which equals
+    psi_r * conj(psi_c) only when the state is real — for complex states
+    it is not a valid density matrix (purity/fidelity invariants break).
+    This implementation computes the mathematically correct
+    rho[r, c] = psi_r * conj(psi_c); the two agree exactly on real
+    states (covered by the reference-parity test suite)."""
     if pure.is_density:
         raise QuESTError("second argument of initPureState must be a state-vector")
     validate_matching_dims(qureg, pure)

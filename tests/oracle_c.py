@@ -1,0 +1,180 @@
+"""ctypes binding to the reference C build (the authoritative oracle).
+
+A minimal, freshly written binding to the libQuEST.so built out-of-source
+into .oracle/ from /root/reference (double precision, single-threaded CPU
+backend).  Struct layouts mirror QuEST/include/QuEST.h:35-121.  Only the
+surface needed by the parity tests is bound.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), os.pardir, ".oracle",
+                         "QuEST", "libQuEST.so")
+
+qreal = ct.c_double
+
+
+class Complex(ct.Structure):
+    _fields_ = [("real", qreal), ("imag", qreal)]
+
+
+class ComplexMatrix2(ct.Structure):
+    _fields_ = [("r0c0", Complex), ("r0c1", Complex),
+                ("r1c0", Complex), ("r1c1", Complex)]
+
+
+class Vector(ct.Structure):
+    _fields_ = [("x", qreal), ("y", qreal), ("z", qreal)]
+
+
+class ComplexArray(ct.Structure):
+    _fields_ = [("real", ct.POINTER(qreal)), ("imag", ct.POINTER(qreal))]
+
+
+class Qureg(ct.Structure):
+    _fields_ = [
+        ("isDensityMatrix", ct.c_int),
+        ("numQubitsRepresented", ct.c_int),
+        ("numQubitsInStateVec", ct.c_int),
+        ("numAmpsPerChunk", ct.c_longlong),
+        ("numAmpsTotal", ct.c_longlong),
+        ("chunkId", ct.c_int),
+        ("numChunks", ct.c_int),
+        ("stateVec", ComplexArray),
+        ("pairStateVec", ComplexArray),
+        ("deviceStateVec", ComplexArray),
+        ("firstLevelReduction", ct.POINTER(qreal)),
+        ("secondLevelReduction", ct.POINTER(qreal)),
+        ("qasmLog", ct.c_void_p),
+    ]
+
+
+class QuESTEnv(ct.Structure):
+    _fields_ = [("rank", ct.c_int), ("numRanks", ct.c_int)]
+
+
+def available() -> bool:
+    return os.path.exists(_LIB_PATH)
+
+
+_lib = None
+
+
+def lib():
+    global _lib
+    if _lib is None:
+        _lib = ct.CDLL(_LIB_PATH)
+        L = _lib
+        L.createQuESTEnv.restype = QuESTEnv
+        L.createQureg.restype = Qureg
+        L.createQureg.argtypes = [ct.c_int, QuESTEnv]
+        L.createDensityQureg.restype = Qureg
+        L.createDensityQureg.argtypes = [ct.c_int, QuESTEnv]
+        L.destroyQureg.argtypes = [Qureg, QuESTEnv]
+        L.getAmp.restype = Complex
+        L.getAmp.argtypes = [Qureg, ct.c_longlong]
+        L.getDensityAmp.restype = Complex
+        L.getDensityAmp.argtypes = [Qureg, ct.c_longlong, ct.c_longlong]
+        L.calcTotalProb.restype = qreal
+        L.calcTotalProb.argtypes = [Qureg]
+        L.calcProbOfOutcome.restype = qreal
+        L.calcProbOfOutcome.argtypes = [Qureg, ct.c_int, ct.c_int]
+        L.calcPurity.restype = qreal
+        L.calcPurity.argtypes = [Qureg]
+        L.calcFidelity.restype = qreal
+        L.calcFidelity.argtypes = [Qureg, Qureg]
+        L.calcInnerProduct.restype = Complex
+        L.calcInnerProduct.argtypes = [Qureg, Qureg]
+        L.collapseToOutcome.restype = qreal
+        L.collapseToOutcome.argtypes = [Qureg, ct.c_int, ct.c_int]
+        L.initStateFromAmps.argtypes = [Qureg, ct.POINTER(qreal),
+                                        ct.POINTER(qreal)]
+        for name, argtypes in {
+            "initZeroState": [Qureg],
+            "initPlusState": [Qureg],
+            "initClassicalState": [Qureg, ct.c_longlong],
+            "initPureState": [Qureg, Qureg],
+            "initStateDebug": [Qureg],
+            "hadamard": [Qureg, ct.c_int],
+            "pauliX": [Qureg, ct.c_int],
+            "pauliY": [Qureg, ct.c_int],
+            "pauliZ": [Qureg, ct.c_int],
+            "sGate": [Qureg, ct.c_int],
+            "tGate": [Qureg, ct.c_int],
+            "phaseShift": [Qureg, ct.c_int, qreal],
+            "controlledPhaseShift": [Qureg, ct.c_int, ct.c_int, qreal],
+            "controlledPhaseFlip": [Qureg, ct.c_int, ct.c_int],
+            "rotateX": [Qureg, ct.c_int, qreal],
+            "rotateY": [Qureg, ct.c_int, qreal],
+            "rotateZ": [Qureg, ct.c_int, qreal],
+            "rotateAroundAxis": [Qureg, ct.c_int, qreal, Vector],
+            "compactUnitary": [Qureg, ct.c_int, Complex, Complex],
+            "unitary": [Qureg, ct.c_int, ComplexMatrix2],
+            "controlledNot": [Qureg, ct.c_int, ct.c_int],
+            "controlledPauliY": [Qureg, ct.c_int, ct.c_int],
+            "controlledUnitary": [Qureg, ct.c_int, ct.c_int, ComplexMatrix2],
+            "controlledCompactUnitary": [Qureg, ct.c_int, ct.c_int, Complex,
+                                         Complex],
+            "controlledRotateX": [Qureg, ct.c_int, ct.c_int, qreal],
+            "controlledRotateY": [Qureg, ct.c_int, ct.c_int, qreal],
+            "controlledRotateZ": [Qureg, ct.c_int, ct.c_int, qreal],
+            "applyOneQubitDephaseError": [Qureg, ct.c_int, qreal],
+            "applyTwoQubitDephaseError": [Qureg, ct.c_int, ct.c_int, qreal],
+            "applyOneQubitDepolariseError": [Qureg, ct.c_int, qreal],
+            "applyOneQubitDampingError": [Qureg, ct.c_int, qreal],
+            "applyTwoQubitDepolariseError": [Qureg, ct.c_int, ct.c_int, qreal],
+            "addDensityMatrix": [Qureg, qreal, Qureg],
+        }.items():
+            fn = getattr(L, name)
+            fn.restype = None
+            fn.argtypes = argtypes
+        # pointer-array variants
+        L.multiControlledUnitary.restype = None
+        L.multiControlledUnitary.argtypes = [
+            Qureg, ct.POINTER(ct.c_int), ct.c_int, ct.c_int, ComplexMatrix2]
+        L.multiControlledPhaseFlip.restype = None
+        L.multiControlledPhaseFlip.argtypes = [
+            Qureg, ct.POINTER(ct.c_int), ct.c_int]
+        L.multiControlledPhaseShift.restype = None
+        L.multiControlledPhaseShift.argtypes = [
+            Qureg, ct.POINTER(ct.c_int), ct.c_int, qreal]
+    return _lib
+
+
+def c_int_array(vals):
+    return (ct.c_int * len(vals))(*vals)
+
+
+def make_matrix2(u):
+    import numpy as np
+
+    u = np.asarray(u, dtype=np.complex128)
+    return ComplexMatrix2(
+        Complex(u[0, 0].real, u[0, 0].imag), Complex(u[0, 1].real, u[0, 1].imag),
+        Complex(u[1, 0].real, u[1, 0].imag), Complex(u[1, 1].real, u[1, 1].imag),
+    )
+
+
+def load_state(qureg: Qureg, psi) -> None:
+    """Set amplitudes from a complex numpy vector (statevector layout) or
+    an already-flattened density 'vector'."""
+    import numpy as np
+
+    re = np.ascontiguousarray(np.real(psi), dtype=np.float64)
+    im = np.ascontiguousarray(np.imag(psi), dtype=np.float64)
+    lib().initStateFromAmps(qureg,
+                            re.ctypes.data_as(ct.POINTER(qreal)),
+                            im.ctypes.data_as(ct.POINTER(qreal)))
+
+
+def get_state(qureg: Qureg):
+    """Full flat complex state from the chunk pointers (single process)."""
+    import numpy as np
+
+    n = qureg.numAmpsTotal
+    re = np.ctypeslib.as_array(qureg.stateVec.real, shape=(n,)).copy()
+    im = np.ctypeslib.as_array(qureg.stateVec.imag, shape=(n,)).copy()
+    return re + 1j * im
