@@ -42,14 +42,14 @@ def run(num_qubits: int, depth: int, reps: int):
         jax.block_until_ready(arrs)
         return float(arrs[0][0, 0])
 
-    # compile + warm-up run
-    re, im = fn(*fresh())
+    # One state set only — at 30 qubits a second (re, im) would not fit —
+    # so timed reps chain on the same donated buffers (the circuit is
+    # unitary; repeated application is a valid steady-state workload).
+    re, im = fn(*fresh())  # compile + warm-up
     sync((re, im))
 
     times = []
     for _ in range(reps):
-        re, im = fresh()
-        sync((re, im))
         t0 = time.perf_counter()
         re, im = fn(re, im)
         sync((re, im))
@@ -63,17 +63,16 @@ def main():
     depth = int(os.environ.get("QUEST_BENCH_DEPTH", "8"))
     reps = int(os.environ.get("QUEST_BENCH_REPS", "3"))
 
-    # XLA ping-pongs two (re, im) buffer sets for the fused circuit, so a
-    # register only fits if 4 * 2^n * 4 bytes stays under HBM.  (A 30-qubit
-    # f32 register itself fits in 16 GiB; running it needs the in-place
-    # Pallas gate kernel — tracked for the perf milestone.)
+    # The fused Pallas executor updates the state strictly in place
+    # (input_output_aliases through every segment), so only ONE (re, im)
+    # buffer set lives in HBM: 2 * 2^n * 4 bytes.  30 qubits f32 = 8 GiB.
     try:
         import jax
 
         hbm = jax.devices()[0].memory_stats().get("bytes_limit", 16 << 30)
     except Exception:
         hbm = 16 << 30
-    while num_qubits > 20 and 4 * (1 << num_qubits) * 4 > 0.92 * hbm:
+    while num_qubits > 20 and 2 * (1 << num_qubits) * 4 > 0.92 * hbm:
         num_qubits -= 1
 
     gates_per_sec = None

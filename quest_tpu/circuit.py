@@ -159,8 +159,9 @@ class Circuit:
         return len(self.ops) // per
 
     def as_fn(self, mesh=None):
-        """A pure (re, im) -> (re, im) function applying the circuit;
-        jit-compatible, correct for single-device or mesh-sharded arrays."""
+        """A pure (re, im) -> (re, im) function applying the circuit
+        gate-at-a-time via the XLA kernel path; jit-compatible, correct for
+        single-device or mesh-sharded arrays."""
         ops = list(self.ops)
 
         def fn(re, im):
@@ -171,25 +172,57 @@ class Circuit:
 
         return fn
 
-    def compile(self, mesh=None, donate: bool = True):
+    def as_fused_fn(self, interpret: bool = False):
+        """A pure (re, im) -> (re, im) function applying the circuit as
+        scheduled fused Pallas segments — each segment is ONE in-place
+        pass over the state (see quest_tpu.scheduler).  Single-device
+        only; runs in interpreter mode off-TPU."""
+        from .ops.pallas_kernels import apply_fused_segment
+        from .scheduler import schedule_segments
+
+        ops = list(self.ops)
+
+        def fn(re, im):
+            lanes = re.shape[1]
+            lane_bits = lanes.bit_length() - 1
+            nbits = (re.shape[0] * lanes).bit_length() - 1
+            for seg_ops, high in schedule_segments(ops, nbits,
+                                                   lane_bits=lane_bits):
+                re, im = apply_fused_segment(re, im, seg_ops, high,
+                                             interpret=interpret)
+            return re, im
+
+        return fn
+
+    def compile(self, mesh=None, donate: bool = True, pallas: str = "auto"):
         """One XLA program for the whole circuit.  ``donate`` reuses the
         input amplitude buffers (the reference's in-place update semantics,
         without which a 30-qubit f32 state needs 2x8 GiB).
 
-        Memoised per (mesh, donate, op-count): jit caches are keyed on
-        function identity, so handing out a fresh closure each call would
-        re-trace and re-compile the whole program every time."""
-        key = (mesh, donate, len(self.ops))
+        ``pallas``: True / False / "auto" — the fused-segment Pallas path
+        (single-device only; "auto" enables it when there is no mesh).
+        Off-TPU backends run the same kernels in interpreter mode, so the
+        path is testable on CPU.
+
+        Memoised per config: jit caches key on function identity, so a
+        fresh closure per call would re-trace and re-compile every time."""
+        use_pallas = mesh is None and (
+            pallas is True or pallas == "auto")
+        key = (mesh, donate, use_pallas, len(self.ops))
         fn = self._compiled.get(key)
         if fn is None:
-            fn = jax.jit(self.as_fn(mesh),
-                         donate_argnums=(0, 1) if donate else ())
+            if use_pallas:
+                interpret = jax.default_backend() != "tpu"
+                raw = self.as_fused_fn(interpret=interpret)
+            else:
+                raw = self.as_fn(mesh)
+            fn = jax.jit(raw, donate_argnums=(0, 1) if donate else ())
             self._compiled[key] = fn
         return fn
 
-    def run(self, qureg):
+    def run(self, qureg, pallas: str = "auto"):
         """Apply to a register (mutating facade, like the eager API)."""
-        fn = self.compile(mesh=qureg.mesh, donate=False)
+        fn = self.compile(mesh=qureg.mesh, donate=False, pallas=pallas)
         re, im = fn(qureg.re, qureg.im)
         qureg._set(re, im)
         return qureg

@@ -1,0 +1,126 @@
+"""Fused Pallas segment executor vs the eager XLA path (interpreter mode
+on CPU), plus scheduler commutation properties.
+
+Kept deliberately small: Pallas interpreter mode costs seconds per
+segment, so these cover each code path once; exhaustive numerics live in
+the fast interpret-mode checks inside quest_tpu/ops/pallas_kernels.py's
+development harness and in the TPU-side bench.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import models
+from quest_tpu.circuit import Circuit
+from quest_tpu.scheduler import schedule_segments, _commutes
+
+from conftest import TOL, random_statevector, load_statevector
+
+N = 12       # lane bits (0-6) + low row bits; all targets schedule "low"
+N_HIGH = 15  # low_cov = 14, so targets 14 engage the exposed-high-bit path
+
+
+def _compare(env1, circ, n=N, seed=50):
+    q1 = qt.create_qureg(n, env1)
+    q2 = qt.create_qureg(n, env1)
+    psi = random_statevector(n, seed)
+    load_statevector(q1, psi)
+    load_statevector(q2, psi)
+    circ.run(q1, pallas=False)
+    circ.run(q2, pallas=True)
+    np.testing.assert_allclose(
+        qt.get_state_vector(q2), qt.get_state_vector(q1), atol=TOL)
+
+
+def test_random_circuit_fused_matches_eager(env1):
+    _compare(env1, models.random_circuit(N, depth=2, seed=3))
+
+
+def test_exposed_high_bit_path(env1):
+    """Targets at/above low_cov exercise plan_fused_shapes' block-axis
+    exposure, the size-2-axis roll partner fetch, and the mid/top grid
+    bit-fields — the machinery N=12 circuits never reach."""
+    circ = Circuit(N_HIGH)
+    circ.hadamard(14).t_gate(14)                    # exposed high target
+    circ.controlled_not(14, 0)                      # high control, lane tgt
+    circ.rotate_y(13, 0.5)                          # top row bit below cov
+    circ.controlled_phase_shift(14, 7, 0.7)         # high+row phase mask
+    circ.hadamard(2)
+    segs = schedule_segments(circ.ops, N_HIGH)
+    assert any(high for _, high in segs), "high-bit path not engaged"
+    _compare(env1, circ, n=N_HIGH, seed=62)
+
+
+def test_mixed_classes_fused(env1):
+    """One circuit touching every kernel path: lane runs (composed to a
+    lanemm), low-row rolls, exposed high bits, high controls/phases."""
+    circ = Circuit(N)
+    circ.hadamard(0).t_gate(1).rotate_y(2, 0.3)        # lane run
+    circ.hadamard(9).controlled_not(11, 0)             # high target+ctrl
+    circ.multi_controlled_unitary([0, 10], 11, np.array([[0, 1j], [1j, 0]]))
+    circ.controlled_phase_shift(10, 2, 0.7)
+    circ.multi_controlled_phase_flip([1, 8, 11])
+    circ.rotate_x(8, 0.4).controlled_rotate_z(3, 7, -0.9)
+    _compare(env1, circ, seed=61)
+
+
+def test_density_circuit_fused(env1):
+    circ = Circuit(5, is_density=True)  # 10 vector qubits
+    circ.hadamard(0).cnot(0, 4).t_gate(4)
+    d1 = qt.create_density_qureg(5, env1)
+    d2 = qt.create_density_qureg(5, env1)
+    circ.run(d1, pallas=False)
+    circ.run(d2, pallas=True)
+    np.testing.assert_allclose(
+        qt.get_state_vector(d2), qt.get_state_vector(d1), atol=TOL)
+
+
+def test_scheduler_respects_commutation():
+    a = ("apply_2x2", (3, 0), ())
+    b = ("apply_2x2", (5, 1 << 3), ())   # controlled on 3
+    ph = ("apply_phase", ((1 << 3) | (1 << 5),), ())
+    assert not _commutes(a, b)           # a mixes 3, 3 is b's control
+    assert not _commutes(b, ph)          # b mixes 5, 5 in phase support
+    assert _commutes(a, ("apply_2x2", (7, 1 << 9), ()))
+    assert _commutes(ph, ("apply_phase", ((1 << 5),), ()))  # diag overlap
+
+    # H(20); CNOT(20->1); H(20): the second H must not hoist past the CNOT.
+    # All three ops conflict pairwise on qubit 20 (mixing vs support), so
+    # the schedule must preserve their relative order exactly.
+    c = Circuit(24)
+    c.hadamard(20).controlled_not(20, 1).hadamard(20)
+    segs = schedule_segments(c.ops, 24)
+    flat = [op for seg, high in segs for op in seg]
+    assert len(flat) == 3
+    # order check: 2x2(t=20, no ctrl), 2x2(t=1, ctrl 20), 2x2(t=20)
+    assert [((op[1], op[3]) if op[0] == "2x2" else op[0]) for op in flat] \
+        == [(20, 0), (1, 1 << 20), (20, 0)]
+
+
+def test_scheduler_packs_low_gates():
+    """All-low circuits collapse to a single segment, lane run composed."""
+    c = Circuit(10)
+    for t in range(10):
+        c.hadamard(t)
+        c.t_gate(t)
+    segs = schedule_segments(c.ops, 10)
+    assert len(segs) == 1
+    seg_ops, high = segs[0]
+    assert high == ()
+
+
+def test_scheduler_reorders_and_caps_high_bits():
+    """More than MAX_HIGH_BITS distinct high targets forces a new segment;
+    commuting low gates slide forward into the earlier segment."""
+    c = Circuit(24)
+    for t in (18, 19, 20, 21):
+        c.hadamard(t)
+    c.hadamard(0)
+    segs = schedule_segments(c.ops, 24)
+    assert len(segs) == 2
+    (seg1, high1), (seg2, high2) = segs
+    assert len(high1) == 3 and high2 == (21,)
+    # the low H(0) commutes with everything and lands in segment 1
+    assert any(op[0] in ("lanemm", "2x2") for op in seg1)
+    assert len(seg2) == 1
