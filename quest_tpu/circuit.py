@@ -206,6 +206,11 @@ class Circuit:
 
         Memoised per config: jit caches key on function identity, so a
         fresh closure per call would re-trace and re-compile every time."""
+        if pallas is True and mesh is not None:
+            raise ValueError(
+                "the fused Pallas executor is single-device only; use "
+                'pallas="auto" to fall back to the XLA path under a mesh'
+            )
         use_pallas = mesh is None and (
             pallas is True or pallas == "auto")
         key = (mesh, donate, use_pallas, len(self.ops))

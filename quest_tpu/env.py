@@ -98,29 +98,33 @@ def report_env(env: QuESTEnv) -> str:
 # The reference uses one global Mersenne-Twister seeded from {time_ms, pid}
 # and broadcast so every rank draws identical outcomes (reference:
 # QuEST_common.c:133-148, mt19937ar.c, QuEST_cpu_distributed.c:1294-1305).
-# numpy's legacy RandomState is the same MT19937 generator; under SPMD the
-# sampling happens once on the host, so cross-device agreement is free.
+# quest_tpu.rng.MT19937 reproduces the generator and the exact
+# one-draw-per-measurement genrand_real1 semantics, so seeded measurement
+# sequences match the reference bit-for-bit; under SPMD the sampling
+# happens once on the host, so cross-device agreement is free.
 
-_rng = np.random.RandomState()
+from .rng import MT19937
+
+_rng = MT19937()
 
 
 def seed_quest(seeds) -> None:
     """Seed the global measurement RNG (reference: seedQuEST,
-    QuEST_common.c:273-279)."""
-    _rng.seed(np.array(seeds, dtype=np.uint64) & 0xFFFFFFFF)
+    QuEST_common.c:273-279; seeding algorithm init_by_array,
+    mt19937ar.c)."""
+    _rng.init_by_array([int(s) for s in np.atleast_1d(np.asarray(seeds, dtype=np.uint64))])
 
 
 def seed_quest_default() -> None:
     """Default-seed from time and pid (reference: getQuESTDefaultSeedKey,
     QuEST_common.c:133-148)."""
-    key = [int(time.time() * 1000) & 0xFFFFFFFF, os.getpid()]
-    _rng.seed(key)
+    _rng.init_by_array([int(time.time() * 1000) & 0xFFFFFFFF, os.getpid()])
 
 
 def random_real() -> float:
-    """One uniform draw in [0, 1) from the global RNG (reference:
+    """One uniform draw in [0, 1] from the global RNG (reference:
     genrand_real1 via generateMeasurementOutcome, QuEST_common.c:103-121)."""
-    return float(_rng.random_sample())
+    return _rng.genrand_real1()
 
 
 seed_quest_default()

@@ -22,6 +22,7 @@ from ..validation import (
     validate_target,
     validate_control_target,
     validate_multi_controls,
+    validate_multi_qubits,
     validate_unique_targets,
     validate_unitary_complex_pair,
     validate_unitary_matrix,
@@ -181,8 +182,7 @@ def controlled_phase_shift(qureg: Qureg, q1: int, q2: int, angle: float) -> None
 
 def multi_controlled_phase_shift(qureg: Qureg, qubits, angle: float) -> None:
     """(reference: multiControlledPhaseShift; kernel QuEST_cpu.c:2745.)"""
-    validate_multi_controls(qureg, qubits[:-1], qubits[-1],
-                            "multiControlledPhaseShift")
+    validate_multi_qubits(qureg, qubits, "multiControlledPhaseShift")
     _apply_phase(qureg, _ctrl_mask(qubits), (math.cos(angle), math.sin(angle)))
     qasm.record_gate(qureg, "phase", targets=(qubits[-1],),
                      controls=tuple(qubits[:-1]), params=(angle,))
@@ -197,8 +197,7 @@ def controlled_phase_flip(qureg: Qureg, q1: int, q2: int) -> None:
 
 def multi_controlled_phase_flip(qureg: Qureg, qubits) -> None:
     """(reference: multiControlledPhaseFlip; kernel QuEST_cpu.c:2972.)"""
-    validate_multi_controls(qureg, qubits[:-1], qubits[-1],
-                            "multiControlledPhaseFlip")
+    validate_multi_qubits(qureg, qubits, "multiControlledPhaseFlip")
     _apply_phase(qureg, _ctrl_mask(qubits), (-1.0, 0.0))
     qasm.record_gate(qureg, "z", targets=(qubits[-1],),
                      controls=tuple(qubits[:-1]))

@@ -1,0 +1,95 @@
+"""Measurement RNG: a Mersenne-Twister (MT19937) stream with the exact
+draw semantics of the reference (reference: QuEST/src/mt19937ar.c;
+consumption site generateMeasurementOutcome, QuEST_common.c:103-121).
+
+The generator is the standard MT19937 of Matsumoto & Nishimura,
+implemented here from the published algorithm.  Two details matter for
+cross-framework parity of *seeded* measurement sequences:
+
+* seeding is ``init_by_array`` (the reference seeds this way both from
+  ``seedQuEST`` and the default time+pid key, QuEST_common.c:133-148,
+  :273-279), and
+* each measurement consumes exactly one 32-bit draw mapped to [0, 1] as
+  ``genrand_real1`` (x / (2^32 - 1)) — *not* the 53-bit two-draw variant
+  most Python RNGs expose — and degenerate probabilities (within
+  REAL_EPS of 0 or 1) consume **no** draw.
+
+Under multi-device SPMD the draw happens once on the host and the chosen
+outcome is closed over by the collapse kernel, so cross-device agreement
+is structural (the reference instead relies on every MPI rank seeding
+identically, QuEST_cpu_distributed.c:1294-1305).
+"""
+
+from __future__ import annotations
+
+_N = 624
+_M = 397
+_MATRIX_A = 0x9908B0DF
+_UPPER_MASK = 0x80000000
+_LOWER_MASK = 0x7FFFFFFF
+_U32 = 0xFFFFFFFF
+
+
+class MT19937:
+    """The MT19937 generator with mt19937ar-compatible seeding."""
+
+    __slots__ = ("mt", "mti")
+
+    def __init__(self, seed: int | None = None):
+        self.mt = [0] * _N
+        self.mti = _N + 1
+        if seed is not None:
+            self.init_genrand(seed)
+
+    def init_genrand(self, s: int) -> None:
+        mt = self.mt
+        mt[0] = s & _U32
+        for i in range(1, _N):
+            mt[i] = (1812433253 * (mt[i - 1] ^ (mt[i - 1] >> 30)) + i) & _U32
+        self.mti = _N
+
+    def init_by_array(self, key) -> None:
+        key = [int(k) & _U32 for k in key]
+        self.init_genrand(19650218)
+        mt = self.mt
+        i, j = 1, 0
+        for _ in range(max(_N, len(key))):
+            mt[i] = ((mt[i] ^ ((mt[i - 1] ^ (mt[i - 1] >> 30)) * 1664525))
+                     + key[j] + j) & _U32
+            i += 1
+            j += 1
+            if i >= _N:
+                mt[0] = mt[_N - 1]
+                i = 1
+            if j >= len(key):
+                j = 0
+        for _ in range(_N - 1):
+            mt[i] = ((mt[i] ^ ((mt[i - 1] ^ (mt[i - 1] >> 30)) * 1566083941))
+                     - i) & _U32
+            i += 1
+            if i >= _N:
+                mt[0] = mt[_N - 1]
+                i = 1
+        mt[0] = 0x80000000
+
+    def genrand_int32(self) -> int:
+        mt = self.mt
+        if self.mti >= _N:
+            if self.mti == _N + 1:  # never seeded: default seed
+                self.init_genrand(5489)
+            for k in range(_N):
+                y = (mt[k] & _UPPER_MASK) | (mt[(k + 1) % _N] & _LOWER_MASK)
+                mt[k] = mt[(k + _M) % _N] ^ (y >> 1) ^ (_MATRIX_A if y & 1 else 0)
+            self.mti = 0
+        y = mt[self.mti]
+        self.mti += 1
+        y ^= y >> 11
+        y ^= (y << 7) & 0x9D2C5680
+        y ^= (y << 15) & 0xEFC60000
+        y ^= y >> 18
+        return y & _U32
+
+    def genrand_real1(self) -> float:
+        """Uniform on [0, 1] with 1/(2^32-1) granularity — the draw used by
+        measurement sampling (reference: QuEST_common.c:112)."""
+        return self.genrand_int32() * (1.0 / 4294967295.0)

@@ -1,0 +1,6 @@
+"""Golden-file test harness compatible with the reference's data-driven
+``.test`` corpus (see quest_tpu.testing.golden)."""
+
+from .golden import GoldenFile, run_test_file, discover_standard_tests
+
+__all__ = ["GoldenFile", "run_test_file", "discover_standard_tests"]

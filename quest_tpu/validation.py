@@ -70,6 +70,22 @@ def validate_multi_controls(qureg, controls, target: int,
         seen.add(c)
 
 
+def validate_multi_qubits(qureg, qubits, func: str | None = None) -> None:
+    """A non-empty unique in-range qubit set (the multi-controlled phase
+    family treats every listed qubit symmetrically; the reference accepts
+    a single-element set — validateControlTarget family,
+    QuEST_validation.c:153-182)."""
+    if not 1 <= len(qubits) <= qureg.num_qubits:
+        _fail("Invalid number of control qubits.", func)
+    seen = set()
+    for c in qubits:
+        if not 0 <= c < qureg.num_qubits:
+            _fail("Invalid control qubit. Note qubits are zero indexed.", func)
+        if c in seen:
+            _fail("Control qubits must be unique.", func)
+        seen.add(c)
+
+
 def validate_state_index(qureg, ind: int, func: str | None = None) -> None:
     dim = 1 << qureg.num_qubits
     if not 0 <= ind < dim:

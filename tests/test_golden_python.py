@@ -1,0 +1,195 @@
+"""Native ports of the reference's Python-type golden tests.
+
+Eleven of the 87 reference .test files are small Python programs rather
+than data files (tests/unit/state_vector/maths/{measure,measureWithStats,
+calcFidelity,calcInnerProduct}.test, tests/essential/state_vector/
+{createQureg,createDensityQureg,destroyQureg,seedQuEST}.test,
+tests/algor/{QFT,rotate_test}.test, tests/benchmarks/rotate_benchmark
+.test).  Their assertions are reproduced here natively, including the
+exact seeded measurement outcome sequences, which depend on bit-exact
+MT19937 ``genrand_real1`` parity (quest_tpu.rng).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+
+from conftest import TOL
+
+
+# ---------------------------------------------------------------------------
+# tests/essential: create/destroy/seed
+# ---------------------------------------------------------------------------
+
+
+def test_create_qureg(env):
+    # reference: tests/essential/state_vector/createQureg.test
+    q = qt.create_qureg(3, env)
+    assert qt.get_num_qubits(q) == 3
+    assert qt.get_num_amps(q) == 8
+    assert qt.get_amp(q, 0) == pytest.approx(1.0)
+    assert all(qt.get_amp(q, i) == 0 for i in range(1, 8))
+
+
+def test_create_density_qureg(env):
+    # reference: tests/essential/state_vector/createDensityQureg.test
+    q = qt.create_density_qureg(3, env)
+    assert q.is_density
+    assert qt.get_density_amp(q, 0, 0) == pytest.approx(1.0)
+    assert qt.calc_total_prob(q) == pytest.approx(1.0, abs=TOL)
+
+
+def test_destroy_qureg(env):
+    # reference: tests/essential/state_vector/destroyQureg.test
+    q = qt.create_qureg(3, env)
+    qt.destroy_qureg(q, env)
+    assert q.re is None and q.im is None
+
+
+def test_seed_reproducibility(env):
+    # reference: tests/essential/state_vector/seedQuEST.test — the same
+    # seed must give the same measurement outcome sequence.
+    def outcomes():
+        qt.seed_quest([42])
+        q = qt.create_qureg(4, env)
+        qt.init_plus_state(q)
+        return [qt.measure(q, i) for i in range(4)]
+
+    assert outcomes() == outcomes()
+
+
+# ---------------------------------------------------------------------------
+# tests/unit/state_vector/maths: measure / measureWithStats (seeded parity)
+# ---------------------------------------------------------------------------
+
+
+def test_measure_seeded_outcomes(env):
+    """Exact outcome sequences from the reference file
+    tests/unit/state_vector/maths/measure.test under seedQuEST([1])."""
+    q = qt.create_qureg(3, env)
+    qt.seed_quest([1])
+
+    qt.init_zero_state(q)
+    assert [qt.measure(q, i) for i in range(3)] == [0, 0, 0]
+
+    qt.init_plus_state(q)
+    assert [qt.measure(q, i) for i in range(3)] == [0, 1, 1]
+
+    qt.init_state_debug(q)
+    assert [qt.measure(q, i) for i in range(3)] == [0, 1, 1]
+
+
+def test_measure_with_stats_seeded_probs(env):
+    """Outcome probabilities from the reference file
+    tests/unit/state_vector/maths/measureWithStats.test."""
+    q = qt.create_qureg(3, env)
+    qt.seed_quest([1])
+
+    qt.init_zero_state(q)
+    probs = [qt.measure_with_stats(q, i)[1] for i in range(3)]
+    assert probs == pytest.approx([1.0, 1.0, 1.0], abs=TOL)
+
+    qt.init_plus_state(q)
+    probs = [qt.measure_with_stats(q, i)[1] for i in range(3)]
+    assert probs == pytest.approx([0.5, 0.5, 0.5], abs=TOL)
+
+    qt.init_state_debug(q)
+    probs = [qt.measure_with_stats(q, i)[1] for i in range(3)]
+    assert probs == pytest.approx([5.0, 0.708, 0.884180790960452], abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# tests/unit/state_vector/maths: calcFidelity / calcInnerProduct
+# ---------------------------------------------------------------------------
+
+
+def test_calc_fidelity_golden(env):
+    # reference: tests/unit/state_vector/maths/calcFidelity.test
+    a = qt.create_qureg(3, env)
+    b = qt.create_qureg(3, env)
+    assert qt.calc_fidelity(a, b) == pytest.approx(1.0, abs=TOL)
+    qt.init_plus_state(a)
+    assert qt.calc_fidelity(a, b) == pytest.approx(0.125, abs=TOL)
+    qt.init_state_debug(a)
+    assert qt.calc_fidelity(a, b) == pytest.approx(0.01, abs=TOL)
+
+
+def test_calc_inner_product_golden(env):
+    # reference: tests/unit/state_vector/maths/calcInnerProduct.test
+    a = qt.create_qureg(3, env)
+    b = qt.create_qureg(3, env)
+    ip = qt.calc_inner_product(a, b)
+    assert ip.real == pytest.approx(1.0, abs=TOL)
+    assert ip.imag == pytest.approx(0.0, abs=TOL)
+    qt.init_plus_state(a)
+    ip = qt.calc_inner_product(a, b)
+    assert ip.real == pytest.approx(0.3535533905933, abs=TOL)
+    assert ip.imag == pytest.approx(0.0, abs=TOL)
+    qt.init_state_debug(a)
+    ip = qt.calc_inner_product(a, b)
+    assert ip.real == pytest.approx(0.0, abs=TOL)
+    assert ip.imag == pytest.approx(-0.1, abs=TOL)
+
+
+# ---------------------------------------------------------------------------
+# tests/algor: rotate_test and QFT
+# ---------------------------------------------------------------------------
+
+
+def test_rotate_forward_back(env):
+    # reference: tests/algor/rotate_test.test — rotate every qubit by a
+    # compact unitary, rotate back with the dagger, recover the state.
+    n = 10
+    angs = [1.2, -2.4, 0.3]
+    alpha = complex(math.cos(angs[0]) * math.cos(angs[1]),
+                    math.cos(angs[0]) * math.sin(angs[1]))
+    beta = complex(math.sin(angs[0]) * math.cos(angs[2]),
+                   math.sin(angs[0]) * math.sin(angs[2]))
+
+    mq = qt.create_qureg(n, env)
+    verif = qt.create_qureg(n, env)
+    qt.init_state_debug(mq)
+    qt.init_state_debug(verif)
+    for i in range(n):
+        qt.compact_unitary(mq, i, alpha, beta)
+    assert not qt.compare_states(mq, verif, TOL)
+
+    alpha_d = alpha.conjugate()
+    beta_d = complex(-beta.real, -beta.imag)
+    for i in range(n):
+        qt.compact_unitary(mq, i, alpha_d, beta_d)
+    assert qt.compare_states(mq, verif, 10 * TOL)
+
+    # normalisation survives a long rotation chain (reference does this
+    # at 25 qubits; 16 is plenty to catch drift and keeps CI light)
+    mq = qt.create_qureg(16, env)
+    qt.init_plus_state(mq)
+    for i in range(16):
+        qt.compact_unitary(mq, i, alpha, beta)
+    assert qt.calc_total_prob(mq) == pytest.approx(1.0, abs=TOL)
+
+
+def test_qft_against_dft_matrix(env):
+    """QFT circuit output equals the analytic DFT of the input state
+    (the reference's QFT.test golden check, with the oracle computed
+    analytically instead of from a stored file)."""
+    from quest_tpu import models
+
+    n = 5
+    dim = 1 << n
+    rng = np.random.RandomState(11)
+    psi = rng.randn(dim) + 1j * rng.randn(dim)
+    psi /= np.linalg.norm(psi)
+
+    q = qt.create_qureg(n, env)
+    qt.init_state_from_amps(q, psi.real.copy(), psi.imag.copy())
+    models.qft(n).run(q)
+
+    # QFT|j> = 2^{-n/2} sum_k exp(+2 pi i jk / 2^n) |k>
+    k = np.arange(dim)
+    dft = np.exp(2j * np.pi * np.outer(k, k) / dim) / math.sqrt(dim)
+    expect = dft @ psi
+    np.testing.assert_allclose(qt.get_state_vector(q), expect, atol=1e-10)
