@@ -104,6 +104,17 @@ from .ops.noise import (
     apply_two_qubit_depolarise_error,
     add_density_matrix,
 )
+from .stateio import (
+    report_state,
+    init_state_from_single_file,
+    save_checkpoint,
+    restore_checkpoint,
+)
+from .reporting import (
+    report_qureg_params,
+    report_state_to_screen,
+    get_environment_string,
+)
 from .qasm import (
     start_recording_qasm,
     stop_recording_qasm,
@@ -180,6 +191,11 @@ applyOneQubitDepolariseError = apply_one_qubit_depolarise_error
 applyOneQubitDampingError = apply_one_qubit_damping_error
 applyTwoQubitDepolariseError = apply_two_qubit_depolarise_error
 addDensityMatrix = add_density_matrix
+reportState = report_state
+initStateFromSingleFile = init_state_from_single_file
+reportQuregParams = report_qureg_params
+reportStateToScreen = report_state_to_screen
+getEnvironmentString = get_environment_string
 startRecordingQASM = start_recording_qasm
 stopRecordingQASM = stop_recording_qasm
 clearRecordedQASM = clear_recorded_qasm

@@ -1,0 +1,102 @@
+"""Observability: register/environment reporting and profiling hooks.
+
+Mirrors the reference's reporting surface (reportQuregParams
+QuEST_common.c:184-193, reportStateToScreen QuEST_cpu.c:1252-1275,
+getEnvironmentString QuEST_cpu.c:1276-1282) and adds the tracing the
+reference lacks (SURVEY §5.1): ``trace`` wraps ``jax.profiler`` so a
+circuit's XLA/Pallas execution can be inspected in TensorBoard/Perfetto,
+and ``time_fn`` gives honest per-op wall times by forcing a host sync.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+import jax
+
+from .env import QuESTEnv
+from .register import Qureg
+
+
+def report_qureg_params(qureg: Qureg) -> str:
+    """Print (and return) basic register facts (reference:
+    reportQuregParams, QuEST_common.c:184-193)."""
+    text = (
+        "QUBITS:\n"
+        f"Number of qubits is {qureg.num_vec_qubits}.\n"
+        f"Number of amps is {qureg.num_amps}.\n"
+        f"Number of amps per device is {qureg.num_amps // (1 if qureg.mesh is None else qureg.mesh.devices.size)}.\n"
+    )
+    print(text, end="")
+    return text
+
+
+def report_state_to_screen(qureg: Qureg, env: QuESTEnv | None = None,
+                           report_rank: int = 0) -> None:
+    """Print all amplitudes, gated to small registers like the reference
+    (statevec_reportStateToScreen prints <=5 qubits only,
+    QuEST_cpu.c:1252-1275)."""
+    if qureg.num_vec_qubits > 5:
+        # same gate and message as the reference (QuEST_cpu.c:1252-1275)
+        print("Error: reportStateToScreen will not print output for "
+              "systems of more than 5 qubits.")
+        return
+    re = np.asarray(qureg.re, dtype=np.float64).reshape(-1)
+    im = np.asarray(qureg.im, dtype=np.float64).reshape(-1)
+    print("Reporting state on device 0")
+    for r, i in zip(re, im):
+        print(f"{r:.14f}, {i:.14f}")
+
+
+def get_environment_string(env: QuESTEnv, qureg: Qureg) -> str:
+    """Compact run descriptor, e.g. ``30qubits_TPU_8devices`` (reference:
+    getEnvironmentString -> "30qubits_CPU_4ranksx8threads",
+    QuEST_cpu.c:1276-1282, QuEST_gpu.cu:274-276)."""
+    plat = jax.devices()[0].platform.upper()
+    return f"{qureg.num_qubits}qubits_{plat}_{env.num_devices}devices"
+
+
+# ---------------------------------------------------------------------------
+# Profiling
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace of everything run inside the block::
+
+        with quest_tpu.reporting.trace("/tmp/trace"):
+            circuit.run(qureg)
+
+    View with TensorBoard's profile plugin or Perfetto."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Label a region so it shows up named on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def time_fn(fn, *args, reps: int = 5, **kwargs) -> dict:
+    """Wall-clock a device computation honestly: each rep blocks on the
+    result (the per-gate timing hook SURVEY §5.1 calls for; analogue of
+    mytimer.hpp + tests/benchmarks/rotate_benchmark.test:42-47).
+
+    Returns {"best", "mean", "times"} in seconds; the first (compile)
+    call is excluded."""
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return {"best": min(times), "mean": sum(times) / len(times),
+            "times": times}

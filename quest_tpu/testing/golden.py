@@ -51,7 +51,6 @@ class GoldenFile:
                 break
         self._lines = raw
         self._pos = 0
-        self._skip_first_comment = True
 
     @property
     def is_python(self) -> bool:

@@ -1,0 +1,132 @@
+"""State persistence (CSV + orbax checkpoint) and reporting utilities."""
+
+import os
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import reporting
+
+from conftest import TOL, random_statevector, load_statevector
+
+
+def test_report_state_roundtrip(env, tmp_path):
+    # reference: reportState (QuEST_common.c:166-182) then
+    # initStateFromSingleFile (QuEST_cpu.c:1507-1555)
+    n = 4
+    psi = random_statevector(n, 3)
+    q = qt.create_qureg(n, env)
+    load_statevector(q, psi)
+    path = qt.report_state(q, str(tmp_path))
+    assert os.path.basename(path) == "state_rank_0.csv"
+    with open(path) as f:
+        assert f.readline().strip() == "real, imag"
+
+    q2 = qt.create_qureg(n, env)
+    assert qt.init_state_from_single_file(q2, path)
+    # CSV carries 12 decimal places
+    np.testing.assert_allclose(qt.get_state_vector(q2), psi, atol=1e-11)
+
+
+def test_init_state_from_missing_file(env):
+    q = qt.create_qureg(3, env)
+    assert not qt.init_state_from_single_file(q, "/nonexistent/state.csv")
+
+
+def test_csv_comment_lines(env, tmp_path):
+    path = tmp_path / "amps.csv"
+    path.write_text("# a comment\n1.0, 0.0\n" + "0.0, 0.0\n" * 6 + "0.0, 1.0\n")
+    q = qt.create_qureg(3, env)
+    assert qt.init_state_from_single_file(q, str(path))
+    v = qt.get_state_vector(q)
+    assert v[0] == pytest.approx(1.0)
+    assert v[7] == pytest.approx(1j)
+
+
+def test_csv_too_short_fails(env, tmp_path):
+    path = tmp_path / "short.csv"
+    path.write_text("1.0, 0.0\n0.0, 0.0\n")  # 2 amps for a 3-qubit register
+    q = qt.create_qureg(3, env)
+    assert not qt.init_state_from_single_file(q, str(path))
+
+
+def test_checkpoint_dtype_mismatch_raises(env, tmp_path):
+    import jax.numpy as jnp
+
+    q = qt.create_qureg(3, env)  # f64 under the test config
+    qt.save_checkpoint(q, str(tmp_path / "p"))
+    single = qt.create_qureg(3, env, dtype=jnp.float32)
+    with pytest.raises(qt.QuESTError):
+        qt.restore_checkpoint(single, str(tmp_path / "p"))
+
+
+def test_checkpoint_roundtrip(env, tmp_path):
+    n = 5
+    psi = random_statevector(n, 9)
+    q = qt.create_qureg(n, env)
+    load_statevector(q, psi)
+    qt.save_checkpoint(q, str(tmp_path / "ckpt"))
+
+    q2 = qt.create_qureg(n, env)
+    qt.restore_checkpoint(q2, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(qt.get_state_vector(q2), psi, atol=TOL)
+    # restored arrays keep the register's sharding
+    assert q2.re.sharding == q.re.sharding
+
+
+def test_checkpoint_density(env, tmp_path):
+    q = qt.create_density_qureg(3, env)
+    qt.hadamard(q, 0)
+    qt.apply_one_qubit_damping_error(q, 0, 0.2)
+    ref = qt.get_density_matrix(q)
+    qt.save_checkpoint(q, str(tmp_path / "dm"))
+
+    q2 = qt.create_density_qureg(3, env)
+    qt.restore_checkpoint(q2, str(tmp_path / "dm"))
+    np.testing.assert_allclose(qt.get_density_matrix(q2), ref, atol=TOL)
+
+
+def test_checkpoint_mismatch_raises(env, tmp_path):
+    q = qt.create_qureg(3, env)
+    qt.save_checkpoint(q, str(tmp_path / "c"))
+    other = qt.create_qureg(4, env)
+    with pytest.raises(qt.QuESTError):
+        qt.restore_checkpoint(other, str(tmp_path / "c"))
+    with pytest.raises(qt.QuESTError):
+        qt.restore_checkpoint(q, str(tmp_path / "nowhere"))
+
+
+def test_report_qureg_params(env, capsys):
+    q = qt.create_qureg(4, env)
+    text = qt.report_qureg_params(q)
+    assert "Number of qubits is 4." in text
+    assert "Number of amps is 16." in text
+    assert text in capsys.readouterr().out
+
+
+def test_report_state_to_screen_gated(env, capsys):
+    small = qt.create_qureg(3, env)
+    qt.report_state_to_screen(small, env)
+    out = capsys.readouterr().out
+    assert "1.00000000000000, 0.00000000000000" in out
+    big = qt.create_qureg(6, env)
+    qt.report_state_to_screen(big, env)
+    out = capsys.readouterr().out
+    assert "will not print output" in out  # gated >5 qubits
+    assert "0.00000000000000" not in out
+
+
+def test_environment_string(env):
+    q = qt.create_qureg(7, env)
+    s = qt.get_environment_string(env, q)
+    assert s.startswith("7qubits_")
+    assert s.endswith(f"_{env.num_devices}devices")
+
+
+def test_time_fn_sync(env):
+    q = qt.create_qureg(8, env)
+    import jax.numpy as jnp
+
+    stats = reporting.time_fn(lambda x: x * 2.0, q.re, reps=3)
+    assert stats["best"] > 0 and len(stats["times"]) == 3
