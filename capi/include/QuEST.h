@@ -1,0 +1,210 @@
+/* quest_tpu C ABI — the QuEST public API, served by a TPU-native backend.
+ *
+ * This header is a drop-in for the reference's QuEST/include/QuEST.h
+ * (struct layouts and all 74 function signatures are ABI-identical —
+ * reference: QuEST.h:41-121 for types, :129-1571 for functions) so that
+ * existing user programs and the ctypes-based QuESTPy bindings work
+ * unmodified.  Behind it, libQuEST.so hosts an embedded Python
+ * interpreter running the quest_tpu JAX/XLA framework: amplitudes live
+ * on the accelerator, gates are fused XLA/Pallas kernels, and the
+ * fields that the reference used for raw host storage (stateVec) act as
+ * an optionally-synced host mirror, as in the reference's GPU backend
+ * (reference: QuEST_gpu.cu statevec_createQureg).
+ */
+#ifndef QUEST_H
+#define QUEST_H
+
+#include "QuEST_precision.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- types ---------------------------------------------------------- */
+
+/* Opaque here; the QASM text lives on the Python side of the shim. */
+typedef struct QASMLogger QASMLogger;
+
+/* Split storage: one array of real parts, one of imaginary parts
+ * (reference: QuEST.h:41-45). */
+typedef struct ComplexArray {
+    qreal *real;
+    qreal *imag;
+} ComplexArray;
+
+typedef struct Complex {
+    qreal real;
+    qreal imag;
+} Complex;
+
+typedef struct ComplexMatrix2 {
+    Complex r0c0, r0c1;
+    Complex r1c0, r1c1;
+} ComplexMatrix2;
+
+typedef struct Vector {
+    qreal x, y, z;
+} Vector;
+
+/* A register of qubits: a state-vector, or a density matrix stored as a
+ * vector over twice the qubits (reference: QuEST.h:78-112).  Field order
+ * is ABI-load-bearing: QuESTPy mirrors this struct with ctypes. */
+typedef struct Qureg {
+    int isDensityMatrix;
+    int numQubitsRepresented;
+    int numQubitsInStateVec;
+    long long int numAmpsPerChunk;
+    long long int numAmpsTotal;
+    int chunkId;
+    int numChunks;
+
+    /* Host mirror of the device state (synced after each operation for
+     * small registers; see capi/README.md). */
+    ComplexArray stateVec;
+    /* Unused on the TPU backend (single-process SPMD; the reference used
+     * it for MPI exchange buffers). */
+    ComplexArray pairStateVec;
+
+    /* The TPU backend stows its register handle here (the reference GPU
+     * backend used it for the CUDA device pointer). */
+    ComplexArray deviceStateVec;
+    qreal *firstLevelReduction, *secondLevelReduction;
+
+    QASMLogger *qasmLog;
+} Qureg;
+
+/* Execution environment (reference: QuEST.h:117-121).  Always
+ * rank 0 / 1 rank: the device mesh replaces MPI ranks. */
+typedef struct QuESTEnv {
+    int rank;
+    int numRanks;
+} QuESTEnv;
+
+/* ---- environment ---------------------------------------------------- */
+
+QuESTEnv createQuESTEnv(void);
+void destroyQuESTEnv(QuESTEnv env);
+void syncQuESTEnv(QuESTEnv env);
+int syncQuESTSuccess(int successCode);
+void reportQuESTEnv(QuESTEnv env);
+void getEnvironmentString(QuESTEnv env, Qureg qureg, char str[200]);
+void seedQuESTDefault(void);
+void seedQuEST(unsigned long int *seedArray, int numSeeds);
+
+/* ---- register lifecycle -------------------------------------------- */
+
+Qureg createQureg(int numQubits, QuESTEnv env);
+Qureg createDensityQureg(int numQubits, QuESTEnv env);
+void destroyQureg(Qureg qureg, QuESTEnv env);
+void cloneQureg(Qureg targetQureg, Qureg copyQureg);
+int getNumQubits(Qureg qureg);
+int getNumAmps(Qureg qureg);
+
+/* ---- reporting ------------------------------------------------------ */
+
+void reportState(Qureg qureg);
+void reportStateToScreen(Qureg qureg, QuESTEnv env, int reportRank);
+void reportQuregParams(Qureg qureg);
+
+/* ---- state initialisation ------------------------------------------ */
+
+void initZeroState(Qureg qureg);
+void initPlusState(Qureg qureg);
+void initClassicalState(Qureg qureg, long long int stateInd);
+void initPureState(Qureg qureg, Qureg pure);
+void initStateFromAmps(Qureg qureg, qreal *reals, qreal *imags);
+void setAmps(Qureg qureg, long long int startInd, qreal *reals, qreal *imags,
+             long long int numAmps);
+
+/* ---- amplitude access ---------------------------------------------- */
+
+Complex getAmp(Qureg qureg, long long int index);
+qreal getRealAmp(Qureg qureg, long long int index);
+qreal getImagAmp(Qureg qureg, long long int index);
+qreal getProbAmp(Qureg qureg, long long int index);
+Complex getDensityAmp(Qureg qureg, long long int row, long long int col);
+
+/* ---- gates ---------------------------------------------------------- */
+
+void phaseShift(Qureg qureg, const int targetQubit, qreal angle);
+void controlledPhaseShift(Qureg qureg, const int idQubit1, const int idQubit2,
+                          qreal angle);
+void multiControlledPhaseShift(Qureg qureg, int *controlQubits,
+                               int numControlQubits, qreal angle);
+void controlledPhaseFlip(Qureg qureg, const int idQubit1, const int idQubit2);
+void multiControlledPhaseFlip(Qureg qureg, int *controlQubits,
+                              int numControlQubits);
+void sGate(Qureg qureg, const int targetQubit);
+void tGate(Qureg qureg, const int targetQubit);
+void compactUnitary(Qureg qureg, const int targetQubit, Complex alpha,
+                    Complex beta);
+void unitary(Qureg qureg, const int targetQubit, ComplexMatrix2 u);
+void rotateX(Qureg qureg, const int rotQubit, qreal angle);
+void rotateY(Qureg qureg, const int rotQubit, qreal angle);
+void rotateZ(Qureg qureg, const int rotQubit, qreal angle);
+void rotateAroundAxis(Qureg qureg, const int rotQubit, qreal angle,
+                      Vector axis);
+void controlledRotateX(Qureg qureg, const int controlQubit,
+                       const int targetQubit, qreal angle);
+void controlledRotateY(Qureg qureg, const int controlQubit,
+                       const int targetQubit, qreal angle);
+void controlledRotateZ(Qureg qureg, const int controlQubit,
+                       const int targetQubit, qreal angle);
+void controlledRotateAroundAxis(Qureg qureg, const int controlQubit,
+                                const int targetQubit, qreal angle,
+                                Vector axis);
+void controlledCompactUnitary(Qureg qureg, const int controlQubit,
+                              const int targetQubit, Complex alpha,
+                              Complex beta);
+void controlledUnitary(Qureg qureg, const int controlQubit,
+                       const int targetQubit, ComplexMatrix2 u);
+void multiControlledUnitary(Qureg qureg, int *controlQubits,
+                            const int numControlQubits, const int targetQubit,
+                            ComplexMatrix2 u);
+void pauliX(Qureg qureg, const int targetQubit);
+void pauliY(Qureg qureg, const int targetQubit);
+void pauliZ(Qureg qureg, const int targetQubit);
+void hadamard(Qureg qureg, const int targetQubit);
+void controlledNot(Qureg qureg, const int controlQubit, const int targetQubit);
+void controlledPauliY(Qureg qureg, const int controlQubit,
+                      const int targetQubit);
+
+/* ---- calculations --------------------------------------------------- */
+
+qreal calcTotalProb(Qureg qureg);
+qreal calcProbOfOutcome(Qureg qureg, const int measureQubit, int outcome);
+Complex calcInnerProduct(Qureg bra, Qureg ket);
+qreal calcPurity(Qureg qureg);
+qreal calcFidelity(Qureg qureg, Qureg pureState);
+
+/* ---- measurement ---------------------------------------------------- */
+
+qreal collapseToOutcome(Qureg qureg, const int measureQubit, int outcome);
+int measure(Qureg qureg, int measureQubit);
+int measureWithStats(Qureg qureg, int measureQubit, qreal *outcomeProb);
+
+/* ---- decoherence (density matrices) -------------------------------- */
+
+void applyOneQubitDephaseError(Qureg qureg, const int targetQubit, qreal prob);
+void applyTwoQubitDephaseError(Qureg qureg, const int qubit1, const int qubit2,
+                               qreal prob);
+void applyOneQubitDepolariseError(Qureg qureg, const int targetQubit,
+                                  qreal prob);
+void applyOneQubitDampingError(Qureg qureg, const int targetQubit, qreal prob);
+void applyTwoQubitDepolariseError(Qureg qureg, const int qubit1,
+                                  const int qubit2, qreal prob);
+void addDensityMatrix(Qureg combineQureg, qreal prob, Qureg otherQureg);
+
+/* ---- QASM recording ------------------------------------------------- */
+
+void startRecordingQASM(Qureg qureg);
+void stopRecordingQASM(Qureg qureg);
+void clearRecordedQASM(Qureg qureg);
+void printRecordedQASM(Qureg qureg);
+void writeRecordedQASMToFile(Qureg qureg, char *filename);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* QUEST_H */

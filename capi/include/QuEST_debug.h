@@ -1,0 +1,38 @@
+/* quest_tpu C ABI — developer/test hooks outside the public API.
+ *
+ * Signature-compatible with the reference's QuEST/src/QuEST_debug.h
+ * (:17-53); the QuESTPy golden-test harness links against several of
+ * these.
+ */
+#ifndef QUEST_DEBUG_H
+#define QUEST_DEBUG_H
+
+#include "QuEST.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* One qubit pinned to `outcome`, the rest in equal superposition. */
+void initStateOfSingleQubit(Qureg *qureg, int qubitId, int outcome);
+
+/* Unphysical ramp state: amp k = (2k mod 10)/10 + i((2k+1) mod 10)/10. */
+void initStateDebug(Qureg qureg);
+
+/* Load a full state from a reportState-format CSV file. */
+void initStateFromSingleFile(Qureg *qureg, char filename[200], QuESTEnv env);
+
+/* Elementwise equality within `precision`; returns 1 if equal. */
+int compareStates(Qureg mq1, Qureg mq2, qreal precision);
+
+/* Overwrite every amplitude of a density matrix's underlying vector. */
+void setDensityAmps(Qureg qureg, qreal *reals, qreal *imags);
+
+/* The compiled QuEST_PREC value (1=float, 2=double). */
+int QuESTPrecision(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* QUEST_DEBUG_H */

@@ -1,0 +1,35 @@
+/* quest_tpu C ABI — precision selection.
+ *
+ * Interface-compatible with the reference's QuEST_precision.h
+ * (reference: QuEST/include/QuEST_precision.h:17-62): the compile-time
+ * macro QuEST_PREC in {1, 2, 4} selects the width of the `qreal` type
+ * used throughout the public API.  The TPU backend computes in f32
+ * (QuEST_PREC=1) or f64 (QuEST_PREC=2); QuEST_PREC=4 (long double) has
+ * no accelerator equivalent and is rejected at shim compile time.
+ */
+#ifndef QUEST_PRECISION_H
+#define QUEST_PRECISION_H
+
+#ifndef QuEST_PREC
+#define QuEST_PREC 2
+#endif
+
+#if QuEST_PREC == 1
+typedef float qreal;
+#define REAL_STRING_FORMAT "%.8f"
+#define REAL_EPS 1e-5
+#elif QuEST_PREC == 2
+typedef double qreal;
+#define REAL_STRING_FORMAT "%.14f"
+#define REAL_EPS 1e-13
+#elif QuEST_PREC == 4
+/* Kept so sources naming QuEST_PREC=4 still parse; the TPU shim refuses
+ * to build with it (see capi/src/quest_capi.c). */
+typedef long double qreal;
+#define REAL_STRING_FORMAT "%.17Lf"
+#define REAL_EPS 1e-14
+#else
+#error "QuEST_PREC must be 1, 2 or 4"
+#endif
+
+#endif /* QUEST_PRECISION_H */

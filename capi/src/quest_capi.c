@@ -1,0 +1,627 @@
+/* quest_tpu C ABI shim — implements the QuEST public API (see
+ * capi/include/QuEST.h) by embedding a CPython interpreter and
+ * forwarding every call to quest_tpu.capi_bridge, where the TPU-native
+ * JAX framework executes it.
+ *
+ * Design notes:
+ *  - Registers are identified by an integer handle stowed in
+ *    Qureg.deviceStateVec.real (the reference GPU backend kept its CUDA
+ *    pointer there; reference: QuEST_gpu.cu statevec_createQureg).
+ *  - Qureg.stateVec is a host MIRROR of the device state, refreshed
+ *    after each mutating call for registers up to
+ *    QUEST_CAPI_MIRROR_MAX amps (default 2^22).  API reads (getAmp,
+ *    calc*, measure) never touch it — they go to the device — it exists
+ *    so that code poking the raw arrays (e.g. QuESTPy's state printer)
+ *    keeps working, mirroring the reference GPU build's host copy.
+ *  - Errors surface as Python exceptions; like the reference's
+ *    exitWithError (QuEST_validation.c:82-92) we print and exit.
+ */
+
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <Python.h>
+
+#include "QuEST.h"
+#include "QuEST_debug.h"
+
+#if QuEST_PREC == 4
+#error "QuEST_PREC=4 (long double) is not supported by the TPU backend"
+#endif
+
+#ifndef QUEST_TPU_ROOT
+#define QUEST_TPU_ROOT "."
+#endif
+
+static PyObject *bridge = NULL;
+
+static void fatal(const char *what) {
+    fprintf(stderr, "QuEST-TPU: fatal error in %s\n", what);
+    if (PyErr_Occurred())
+        PyErr_Print();
+    exit(EXIT_FAILURE);
+}
+
+/* Initialise (or attach to) the interpreter and import the bridge.
+ * Two modes: embedded in a plain C program (we own Py_Initialize), or
+ * loaded via ctypes into an already-running Python process (e.g. the
+ * QuESTPy golden-test harness), where the interpreter and quest_tpu
+ * already exist and only the import is needed. */
+static void ensure_bridge_once(void) {
+    /* Configure JAX before the interpreter first imports it: default to
+     * host CPU (overridable), and enable x64 when qreal is double. */
+    /* The accelerator is opt-in via QUEST_CAPI_PLATFORM (e.g. "tpu"):
+     * the C API defaults to double precision, whose TPU emulation would
+     * silently degrade accuracy, so host CPU is the right default even
+     * when the machine environment pins JAX_PLATFORMS to a TPU. */
+    const char *plat = getenv("QUEST_CAPI_PLATFORM");
+    setenv("JAX_PLATFORMS", plat ? plat : "cpu", 1);
+    /* The interpreter is never finalized (JAX teardown from atexit is not
+     * worth the risk), so Python-side prints must hit fd 1 unbuffered to
+     * interleave with — and not be dropped after — C-side printf. */
+    setenv("PYTHONUNBUFFERED", "1", 1);
+#if QuEST_PREC == 2
+    setenv("JAX_ENABLE_X64", "1", 0);
+#endif
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        /* Drop the GIL acquired by initialisation; every call below
+         * re-acquires it through PyGILState_Ensure, which also makes the
+         * shim usable from arbitrary threads. */
+        PyEval_SaveThread();
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    const char *root = getenv("QUEST_TPU_ROOT");
+    if (!root)
+        root = QUEST_TPU_ROOT;
+    {
+        PyObject *sys_path = PySys_GetObject("path"); /* borrowed */
+        PyObject *entry = sys_path ? PyUnicode_FromString(root) : NULL;
+        if (!entry || PyList_Insert(sys_path, 0, entry) < 0)
+            fatal("sys.path setup");
+        Py_DECREF(entry);
+    }
+    bridge = PyImport_ImportModule("quest_tpu.capi_bridge");
+    if (!bridge)
+        fatal("import quest_tpu.capi_bridge");
+    PyObject *r = PyObject_CallMethod(bridge, "init", "(i)", (int)QuEST_PREC);
+    if (!r)
+        fatal("capi_bridge.init");
+    Py_DECREF(r);
+    PyGILState_Release(g);
+}
+
+static pthread_once_t bridge_once = PTHREAD_ONCE_INIT;
+
+static void ensure_bridge(void) {
+    pthread_once(&bridge_once, ensure_bridge_once);
+}
+
+/* Drop a reference under the GIL (safe from any thread). */
+static void bdone(PyObject *o) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_DECREF(o);
+    PyGILState_Release(g);
+}
+
+/* Call a bridge function; returns a new reference or exits on error. */
+static PyObject *bcall(const char *name, const char *fmt, ...) {
+    ensure_bridge();
+    /* Python-side prints are unbuffered; flush C stdio first so output
+     * interleaves in program order even when stdout is a pipe/file. */
+    fflush(stdout);
+    PyGILState_STATE g = PyGILState_Ensure();
+    va_list va;
+    va_start(va, fmt);
+    PyObject *args = Py_VaBuildValue(fmt, va);
+    va_end(va);
+    if (!args)
+        fatal(name);
+    PyObject *fn = PyObject_GetAttrString(bridge, name);
+    if (!fn)
+        fatal(name);
+    PyObject *res = PyObject_CallObject(fn, args);
+    Py_DECREF(fn);
+    Py_DECREF(args);
+    if (!res)
+        fatal(name);
+    PyGILState_Release(g);
+    return res;
+}
+
+#define BVOID(...)                                                            \
+    do {                                                                      \
+        bdone(bcall(__VA_ARGS__));                                            \
+    } while (0)
+
+static double as_double(PyObject *o, const char *what) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    double v = PyFloat_AsDouble(o);
+    if (v == -1.0 && PyErr_Occurred())
+        fatal(what);
+    Py_DECREF(o);
+    PyGILState_Release(g);
+    return v;
+}
+
+static long long as_longlong(PyObject *o, const char *what) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    long long v = PyLong_AsLongLong(o);
+    if (v == -1 && PyErr_Occurred())
+        fatal(what);
+    Py_DECREF(o);
+    PyGILState_Release(g);
+    return v;
+}
+
+static Complex as_complex(PyObject *o, const char *what) {
+    Complex c = {0, 0};
+    double re, im;
+    PyGILState_STATE g = PyGILState_Ensure();
+    if (!PyArg_ParseTuple(o, "dd", &re, &im))
+        fatal(what);
+    Py_DECREF(o);
+    PyGILState_Release(g);
+    c.real = (qreal)re;
+    c.imag = (qreal)im;
+    return c;
+}
+
+/* ---- handle plumbing and the host mirror --------------------------- */
+
+static long qh(Qureg q) { return (long)(intptr_t)q.deviceStateVec.real; }
+
+static long long mirror_max(void) {
+    const char *s = getenv("QUEST_CAPI_MIRROR_MAX");
+    return s ? atoll(s) : (1LL << 22);
+}
+
+static void mirror(Qureg q) {
+    if (!q.stateVec.real || !q.stateVec.imag)
+        return;
+    BVOID("syncMirror", "(lKKL)", qh(q),
+          (unsigned long long)(uintptr_t)q.stateVec.real,
+          (unsigned long long)(uintptr_t)q.stateVec.imag, q.numAmpsTotal);
+}
+
+static Qureg make_qureg(long handle, int numQubits, int isDensity) {
+    Qureg q;
+    memset(&q, 0, sizeof q);
+    q.isDensityMatrix = isDensity;
+    q.numQubitsRepresented = numQubits;
+    q.numQubitsInStateVec = isDensity ? 2 * numQubits : numQubits;
+    q.numAmpsTotal = 1LL << q.numQubitsInStateVec;
+    q.numAmpsPerChunk = q.numAmpsTotal;
+    q.chunkId = 0;
+    q.numChunks = 1;
+    q.deviceStateVec.real = (qreal *)(intptr_t)handle;
+    if (q.numAmpsTotal <= mirror_max()) {
+        q.stateVec.real = malloc(sizeof(qreal) * q.numAmpsTotal);
+        q.stateVec.imag = malloc(sizeof(qreal) * q.numAmpsTotal);
+        if (!q.stateVec.real || !q.stateVec.imag) {
+            free(q.stateVec.real);
+            free(q.stateVec.imag);
+            q.stateVec.real = q.stateVec.imag = NULL;
+        }
+    }
+    mirror(q);
+    return q;
+}
+
+/* ---- environment ---------------------------------------------------- */
+
+QuESTEnv createQuESTEnv(void) {
+    QuESTEnv env = {0, 1};
+    BVOID("createQuESTEnv", "()");
+    return env;
+}
+
+void destroyQuESTEnv(QuESTEnv env) {
+    (void)env;
+    BVOID("destroyQuESTEnv", "()");
+}
+
+void syncQuESTEnv(QuESTEnv env) {
+    (void)env;
+    BVOID("syncQuESTEnv", "()");
+}
+
+int syncQuESTSuccess(int successCode) {
+    /* Single-process SPMD: agreement is trivial (reference:
+     * MPI_Allreduce(LAND), QuEST_cpu_distributed.c:170-174). */
+    return successCode;
+}
+
+void reportQuESTEnv(QuESTEnv env) {
+    (void)env;
+    BVOID("reportQuESTEnv", "()");
+}
+
+void getEnvironmentString(QuESTEnv env, Qureg qureg, char str[200]) {
+    (void)env;
+    PyObject *r = bcall("getEnvironmentString", "(l)", qh(qureg));
+    PyGILState_STATE g = PyGILState_Ensure();
+    const char *s = PyUnicode_AsUTF8(r);
+    if (!s)
+        fatal("getEnvironmentString");
+    strncpy(str, s, 199);
+    str[199] = '\0';
+    Py_DECREF(r);
+    PyGILState_Release(g);
+}
+
+void seedQuESTDefault(void) { BVOID("seedQuESTDefault", "()"); }
+
+void seedQuEST(unsigned long int *seedArray, int numSeeds) {
+    BVOID("seedQuEST", "(Ki)", (unsigned long long)(uintptr_t)seedArray,
+          numSeeds);
+}
+
+/* ---- register lifecycle -------------------------------------------- */
+
+Qureg createQureg(int numQubits, QuESTEnv env) {
+    (void)env;
+    long h = (long)as_longlong(bcall("createQureg", "(i)", numQubits),
+                               "createQureg");
+    return make_qureg(h, numQubits, 0);
+}
+
+Qureg createDensityQureg(int numQubits, QuESTEnv env) {
+    (void)env;
+    long h = (long)as_longlong(bcall("createDensityQureg", "(i)", numQubits),
+                               "createDensityQureg");
+    return make_qureg(h, numQubits, 1);
+}
+
+void destroyQureg(Qureg qureg, QuESTEnv env) {
+    (void)env;
+    BVOID("destroyQureg", "(l)", qh(qureg));
+    free(qureg.stateVec.real);
+    free(qureg.stateVec.imag);
+}
+
+void cloneQureg(Qureg targetQureg, Qureg copyQureg) {
+    BVOID("cloneQureg", "(ll)", qh(targetQureg), qh(copyQureg));
+    mirror(targetQureg);
+}
+
+int getNumQubits(Qureg qureg) {
+    return (int)as_longlong(bcall("getNumQubits", "(l)", qh(qureg)),
+                            "getNumQubits");
+}
+
+int getNumAmps(Qureg qureg) {
+    return (int)as_longlong(bcall("getNumAmps", "(l)", qh(qureg)),
+                            "getNumAmps");
+}
+
+/* ---- reporting ------------------------------------------------------ */
+
+void reportState(Qureg qureg) { BVOID("reportState", "(l)", qh(qureg)); }
+
+void reportStateToScreen(Qureg qureg, QuESTEnv env, int reportRank) {
+    (void)env;
+    BVOID("reportStateToScreen", "(li)", qh(qureg), reportRank);
+}
+
+void reportQuregParams(Qureg qureg) {
+    BVOID("reportQuregParams", "(l)", qh(qureg));
+}
+
+/* ---- initialisation ------------------------------------------------- */
+
+#define INIT0(cname)                                                          \
+    void cname(Qureg qureg) {                                                 \
+        BVOID(#cname, "(l)", qh(qureg));                                      \
+        mirror(qureg);                                                        \
+    }
+
+INIT0(initZeroState)
+INIT0(initPlusState)
+INIT0(initStateDebug)
+
+void initClassicalState(Qureg qureg, long long int stateInd) {
+    BVOID("initClassicalState", "(lL)", qh(qureg), stateInd);
+    mirror(qureg);
+}
+
+void initPureState(Qureg qureg, Qureg pure) {
+    BVOID("initPureState", "(ll)", qh(qureg), qh(pure));
+    mirror(qureg);
+}
+
+void initStateFromAmps(Qureg qureg, qreal *reals, qreal *imags) {
+    BVOID("initStateFromAmps", "(lKK)", qh(qureg),
+          (unsigned long long)(uintptr_t)reals,
+          (unsigned long long)(uintptr_t)imags);
+    mirror(qureg);
+}
+
+void setAmps(Qureg qureg, long long int startInd, qreal *reals, qreal *imags,
+             long long int numAmps) {
+    BVOID("setAmps", "(lLKKL)", qh(qureg), startInd,
+          (unsigned long long)(uintptr_t)reals,
+          (unsigned long long)(uintptr_t)imags, numAmps);
+    mirror(qureg);
+}
+
+void setDensityAmps(Qureg qureg, qreal *reals, qreal *imags) {
+    BVOID("setDensityAmps", "(lKK)", qh(qureg),
+          (unsigned long long)(uintptr_t)reals,
+          (unsigned long long)(uintptr_t)imags);
+    mirror(qureg);
+}
+
+void initStateOfSingleQubit(Qureg *qureg, int qubitId, int outcome) {
+    BVOID("initStateOfSingleQubit", "(lii)", qh(*qureg), qubitId, outcome);
+    mirror(*qureg);
+}
+
+void initStateFromSingleFile(Qureg *qureg, char filename[200], QuESTEnv env) {
+    (void)env;
+    bdone(bcall("initStateFromSingleFile", "(ls)", qh(*qureg), filename));
+    mirror(*qureg);
+}
+
+int compareStates(Qureg mq1, Qureg mq2, qreal precision) {
+    return (int)as_longlong(bcall("compareStates", "(lld)", qh(mq1), qh(mq2),
+                                  (double)precision),
+                            "compareStates");
+}
+
+int QuESTPrecision(void) { return (int)QuEST_PREC; }
+
+/* ---- amplitude access ---------------------------------------------- */
+
+Complex getAmp(Qureg qureg, long long int index) {
+    return as_complex(bcall("getAmp", "(lL)", qh(qureg), index), "getAmp");
+}
+
+qreal getRealAmp(Qureg qureg, long long int index) {
+    return (qreal)as_double(bcall("getRealAmp", "(lL)", qh(qureg), index),
+                            "getRealAmp");
+}
+
+qreal getImagAmp(Qureg qureg, long long int index) {
+    return (qreal)as_double(bcall("getImagAmp", "(lL)", qh(qureg), index),
+                            "getImagAmp");
+}
+
+qreal getProbAmp(Qureg qureg, long long int index) {
+    return (qreal)as_double(bcall("getProbAmp", "(lL)", qh(qureg), index),
+                            "getProbAmp");
+}
+
+Complex getDensityAmp(Qureg qureg, long long int row, long long int col) {
+    return as_complex(bcall("getDensityAmp", "(lLL)", qh(qureg), row, col),
+                      "getDensityAmp");
+}
+
+/* ---- gates ---------------------------------------------------------- */
+
+#define GATE_T(cname)                                                         \
+    void cname(Qureg qureg, const int targetQubit) {                          \
+        BVOID(#cname, "(li)", qh(qureg), targetQubit);                        \
+        mirror(qureg);                                                        \
+    }
+
+GATE_T(pauliX)
+GATE_T(pauliY)
+GATE_T(pauliZ)
+GATE_T(hadamard)
+GATE_T(sGate)
+GATE_T(tGate)
+
+#define GATE_TA(cname)                                                        \
+    void cname(Qureg qureg, const int targetQubit, qreal angle) {             \
+        BVOID(#cname, "(lid)", qh(qureg), targetQubit, (double)angle);        \
+        mirror(qureg);                                                        \
+    }
+
+GATE_TA(phaseShift)
+GATE_TA(rotateX)
+GATE_TA(rotateY)
+GATE_TA(rotateZ)
+
+#define GATE_CT(cname)                                                        \
+    void cname(Qureg qureg, const int q1, const int q2) {                     \
+        BVOID(#cname, "(lii)", qh(qureg), q1, q2);                            \
+        mirror(qureg);                                                        \
+    }
+
+GATE_CT(controlledPhaseFlip)
+GATE_CT(controlledNot)
+GATE_CT(controlledPauliY)
+
+#define GATE_CTA(cname)                                                       \
+    void cname(Qureg qureg, const int q1, const int q2, qreal angle) {        \
+        BVOID(#cname, "(liid)", qh(qureg), q1, q2, (double)angle);            \
+        mirror(qureg);                                                        \
+    }
+
+GATE_CTA(controlledPhaseShift)
+GATE_CTA(controlledRotateX)
+GATE_CTA(controlledRotateY)
+GATE_CTA(controlledRotateZ)
+
+void multiControlledPhaseShift(Qureg qureg, int *controlQubits,
+                               int numControlQubits, qreal angle) {
+    BVOID("multiControlledPhaseShift", "(lKid)", qh(qureg),
+          (unsigned long long)(uintptr_t)controlQubits, numControlQubits,
+          (double)angle);
+    mirror(qureg);
+}
+
+void multiControlledPhaseFlip(Qureg qureg, int *controlQubits,
+                              int numControlQubits) {
+    BVOID("multiControlledPhaseFlip", "(lKi)", qh(qureg),
+          (unsigned long long)(uintptr_t)controlQubits, numControlQubits);
+    mirror(qureg);
+}
+
+void compactUnitary(Qureg qureg, const int targetQubit, Complex alpha,
+                    Complex beta) {
+    BVOID("compactUnitary", "(lidddd)", qh(qureg), targetQubit,
+          (double)alpha.real, (double)alpha.imag, (double)beta.real,
+          (double)beta.imag);
+    mirror(qureg);
+}
+
+void controlledCompactUnitary(Qureg qureg, const int controlQubit,
+                              const int targetQubit, Complex alpha,
+                              Complex beta) {
+    BVOID("controlledCompactUnitary", "(liidddd)", qh(qureg), controlQubit,
+          targetQubit, (double)alpha.real, (double)alpha.imag,
+          (double)beta.real, (double)beta.imag);
+    mirror(qureg);
+}
+
+void unitary(Qureg qureg, const int targetQubit, ComplexMatrix2 u) {
+    BVOID("unitary", "(lidddddddd)", qh(qureg), targetQubit,
+          (double)u.r0c0.real, (double)u.r0c0.imag, (double)u.r0c1.real,
+          (double)u.r0c1.imag, (double)u.r1c0.real, (double)u.r1c0.imag,
+          (double)u.r1c1.real, (double)u.r1c1.imag);
+    mirror(qureg);
+}
+
+void controlledUnitary(Qureg qureg, const int controlQubit,
+                       const int targetQubit, ComplexMatrix2 u) {
+    BVOID("controlledUnitary", "(liidddddddd)", qh(qureg), controlQubit,
+          targetQubit, (double)u.r0c0.real, (double)u.r0c0.imag,
+          (double)u.r0c1.real, (double)u.r0c1.imag, (double)u.r1c0.real,
+          (double)u.r1c0.imag, (double)u.r1c1.real, (double)u.r1c1.imag);
+    mirror(qureg);
+}
+
+void multiControlledUnitary(Qureg qureg, int *controlQubits,
+                            const int numControlQubits, const int targetQubit,
+                            ComplexMatrix2 u) {
+    BVOID("multiControlledUnitary", "(lKiidddddddd)", qh(qureg),
+          (unsigned long long)(uintptr_t)controlQubits, numControlQubits,
+          targetQubit, (double)u.r0c0.real, (double)u.r0c0.imag,
+          (double)u.r0c1.real, (double)u.r0c1.imag, (double)u.r1c0.real,
+          (double)u.r1c0.imag, (double)u.r1c1.real, (double)u.r1c1.imag);
+    mirror(qureg);
+}
+
+void rotateAroundAxis(Qureg qureg, const int rotQubit, qreal angle,
+                      Vector axis) {
+    BVOID("rotateAroundAxis", "(lidddd)", qh(qureg), rotQubit, (double)angle,
+          (double)axis.x, (double)axis.y, (double)axis.z);
+    mirror(qureg);
+}
+
+void controlledRotateAroundAxis(Qureg qureg, const int controlQubit,
+                                const int targetQubit, qreal angle,
+                                Vector axis) {
+    BVOID("controlledRotateAroundAxis", "(liidddd)", qh(qureg), controlQubit,
+          targetQubit, (double)angle, (double)axis.x, (double)axis.y,
+          (double)axis.z);
+    mirror(qureg);
+}
+
+/* ---- calculations --------------------------------------------------- */
+
+qreal calcTotalProb(Qureg qureg) {
+    return (qreal)as_double(bcall("calcTotalProb", "(l)", qh(qureg)),
+                            "calcTotalProb");
+}
+
+qreal calcProbOfOutcome(Qureg qureg, const int measureQubit, int outcome) {
+    return (qreal)as_double(bcall("calcProbOfOutcome", "(lii)", qh(qureg),
+                                  measureQubit, outcome),
+                            "calcProbOfOutcome");
+}
+
+Complex calcInnerProduct(Qureg bra, Qureg ket) {
+    return as_complex(bcall("calcInnerProduct", "(ll)", qh(bra), qh(ket)),
+                      "calcInnerProduct");
+}
+
+qreal calcPurity(Qureg qureg) {
+    return (qreal)as_double(bcall("calcPurity", "(l)", qh(qureg)),
+                            "calcPurity");
+}
+
+qreal calcFidelity(Qureg qureg, Qureg pureState) {
+    return (qreal)as_double(bcall("calcFidelity", "(ll)", qh(qureg),
+                                  qh(pureState)),
+                            "calcFidelity");
+}
+
+/* ---- measurement ---------------------------------------------------- */
+
+qreal collapseToOutcome(Qureg qureg, const int measureQubit, int outcome) {
+    double p = as_double(bcall("collapseToOutcome", "(lii)", qh(qureg),
+                               measureQubit, outcome),
+                         "collapseToOutcome");
+    mirror(qureg);
+    return (qreal)p;
+}
+
+int measure(Qureg qureg, int measureQubit) {
+    int out = (int)as_longlong(bcall("measure", "(li)", qh(qureg),
+                                     measureQubit),
+                               "measure");
+    mirror(qureg);
+    return out;
+}
+
+int measureWithStats(Qureg qureg, int measureQubit, qreal *outcomeProb) {
+    PyObject *r = bcall("measureWithStats", "(li)", qh(qureg), measureQubit);
+    int out;
+    double prob;
+    PyGILState_STATE g = PyGILState_Ensure();
+    if (!PyArg_ParseTuple(r, "id", &out, &prob))
+        fatal("measureWithStats");
+    Py_DECREF(r);
+    PyGILState_Release(g);
+    if (outcomeProb)
+        *outcomeProb = (qreal)prob;
+    mirror(qureg);
+    return out;
+}
+
+/* ---- decoherence ----------------------------------------------------- */
+
+#define NOISE_TP(cname)                                                       \
+    void cname(Qureg qureg, const int targetQubit, qreal prob) {              \
+        BVOID(#cname, "(lid)", qh(qureg), targetQubit, (double)prob);         \
+        mirror(qureg);                                                        \
+    }
+
+NOISE_TP(applyOneQubitDephaseError)
+NOISE_TP(applyOneQubitDepolariseError)
+NOISE_TP(applyOneQubitDampingError)
+
+#define NOISE_TTP(cname)                                                      \
+    void cname(Qureg qureg, const int qubit1, const int qubit2, qreal prob) { \
+        BVOID(#cname, "(liid)", qh(qureg), qubit1, qubit2, (double)prob);     \
+        mirror(qureg);                                                        \
+    }
+
+NOISE_TTP(applyTwoQubitDephaseError)
+NOISE_TTP(applyTwoQubitDepolariseError)
+
+void addDensityMatrix(Qureg combineQureg, qreal prob, Qureg otherQureg) {
+    BVOID("addDensityMatrix", "(ldl)", qh(combineQureg), (double)prob,
+          qh(otherQureg));
+    mirror(combineQureg);
+}
+
+/* ---- QASM ------------------------------------------------------------ */
+
+#define QASM0(cname)                                                          \
+    void cname(Qureg qureg) { BVOID(#cname, "(l)", qh(qureg)); }
+
+QASM0(startRecordingQASM)
+QASM0(stopRecordingQASM)
+QASM0(clearRecordedQASM)
+QASM0(printRecordedQASM)
+
+void writeRecordedQASMToFile(Qureg qureg, char *filename) {
+    BVOID("writeRecordedQASMToFile", "(ls)", qh(qureg), filename);
+}

@@ -1,0 +1,533 @@
+"""Python side of the C ABI shim (``capi/``).
+
+``libQuEST.so`` (capi/src/quest_capi.c) embeds a CPython interpreter,
+imports this module, and forwards every QuEST API call here.  Registers
+cross the boundary as integer handles — the C side stows the handle in
+``Qureg.deviceStateVec.real``, a field the TPU backend has no other use
+for (the reference's GPU backend used it for the CUDA device pointer,
+QuEST/src/GPU/QuEST_gpu.cu statevec_createQureg) — and array arguments
+cross as raw addresses viewed through ctypes without copies.
+
+Function names here match the C API's camelCase exactly so the shim can
+dispatch by symbol name.  Everything routes through the public
+``quest_tpu`` API, so QASM recording, validation, and measurement-RNG
+parity behave identically to the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_qt = None            # the quest_tpu package, imported in init()
+_env = None           # the process-wide QuESTEnv
+_quregs: dict[int, object] = {}
+_next_handle = 1
+_qreal = ctypes.c_double
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+
+
+def init(precision_code: int) -> int:
+    """One-time setup, called right after the interpreter is embedded.
+
+    ``precision_code`` is the shim's compiled QuEST_PREC (1=float,
+    2=double — reference: QuEST_precision.h).  The C side exports env
+    vars (JAX_PLATFORMS, JAX_ENABLE_X64) before Py_Initialize, so jax
+    configures itself correctly on import here.
+    """
+    global _qt, _env, _qreal, _npreal
+    if _qt is not None:
+        return 0
+    # The machine's TPU plugin can override the JAX_PLATFORMS env var the
+    # C side exported; the programmatic config is authoritative, so apply
+    # the requested platform (default cpu) before any backend initialises.
+    import jax
+
+    try:
+        jax.config.update("jax_platforms",
+                          os.environ.get("JAX_PLATFORMS", "cpu"))
+    except RuntimeError:
+        # Loaded into an already-running interpreter whose JAX backends are
+        # live (ctypes-in-process case): the host process owns the platform.
+        pass
+    if precision_code == 2:
+        jax.config.update("jax_enable_x64", True)
+        if not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "libQuEST.so was built with QuEST_PREC=2 (double) but x64 "
+                "mode could not be enabled in the host interpreter; rebuild "
+                "with QuEST_PREC=1 or enable jax x64 in the host process"
+            )
+    import quest_tpu as qt
+
+    _qt = qt
+    qt.set_default_precision("double" if precision_code == 2 else "single")
+    _qreal = ctypes.c_double if precision_code == 2 else ctypes.c_float
+    # Single device by default (the reference's local backend semantics);
+    # QUEST_CAPI_DEVICES=N shards registers over an N-device mesh, and 0
+    # means "all visible devices".
+    ndev = int(os.environ.get("QUEST_CAPI_DEVICES", "1"))
+    _env = qt.create_env(num_devices=ndev if ndev > 0 else None)
+    return 0
+
+
+def _q(handle: int):
+    return _quregs[handle]
+
+
+def _real_view(ptr: int, n: int) -> np.ndarray:
+    return np.ctypeslib.as_array((_qreal * n).from_address(ptr))
+
+
+def _int_view(ptr: int, n: int) -> list[int]:
+    return [int(v) for v in (ctypes.c_int * n).from_address(ptr)]
+
+
+# ---------------------------------------------------------------------------
+# Environment
+# ---------------------------------------------------------------------------
+
+
+def createQuESTEnv() -> int:
+    return 0
+
+
+def destroyQuESTEnv() -> int:
+    _qt.destroy_env(_env)
+    return 0
+
+
+def syncQuESTEnv() -> int:
+    _qt.sync_env(_env)
+    return 0
+
+
+def reportQuESTEnv() -> int:
+    print(_qt.report_env(_env), end="")
+    return 0
+
+
+def getEnvironmentString(h: int) -> str:
+    return _qt.get_environment_string(_env, _q(h))
+
+
+def seedQuESTDefault() -> int:
+    _qt.seed_quest_default()
+    return 0
+
+
+def seedQuEST(ptr: int, num_seeds: int) -> int:
+    seeds = [int(v) for v in (ctypes.c_ulong * num_seeds).from_address(ptr)]
+    _qt.seed_quest(seeds)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Register lifecycle and amplitude access
+# ---------------------------------------------------------------------------
+
+
+def _register(q) -> int:
+    global _next_handle
+    h = _next_handle
+    _next_handle += 1
+    _quregs[h] = q
+    return h
+
+
+def createQureg(num_qubits: int) -> int:
+    return _register(_qt.create_qureg(num_qubits, _env))
+
+
+def createDensityQureg(num_qubits: int) -> int:
+    return _register(_qt.create_density_qureg(num_qubits, _env))
+
+
+def destroyQureg(h: int) -> int:
+    q = _quregs.pop(h)
+    _qt.destroy_qureg(q, _env)
+    return 0
+
+
+def cloneQureg(h_target: int, h_copy: int) -> int:
+    _qt.clone_qureg(_q(h_target), _q(h_copy))
+    return 0
+
+
+def getNumQubits(h: int) -> int:
+    return _qt.get_num_qubits(_q(h))
+
+
+def getNumAmps(h: int) -> int:
+    return _qt.get_num_amps(_q(h))
+
+
+def syncMirror(h: int, re_ptr: int, im_ptr: int, num_amps: int) -> int:
+    """Copy the device state into the C-side host mirror buffers."""
+    q = _q(h)
+    _real_view(re_ptr, num_amps)[:] = np.asarray(q.re).reshape(-1)
+    _real_view(im_ptr, num_amps)[:] = np.asarray(q.im).reshape(-1)
+    return 0
+
+
+def getAmp(h: int, index: int):
+    c = _qt.get_amp(_q(h), index)
+    return (c.real, c.imag)
+
+
+def getRealAmp(h: int, index: int) -> float:
+    return _qt.get_real_amp(_q(h), index)
+
+
+def getImagAmp(h: int, index: int) -> float:
+    return _qt.get_imag_amp(_q(h), index)
+
+
+def getProbAmp(h: int, index: int) -> float:
+    return _qt.get_prob_amp(_q(h), index)
+
+
+def getDensityAmp(h: int, row: int, col: int):
+    c = _qt.get_density_amp(_q(h), row, col)
+    return (c.real, c.imag)
+
+
+# ---------------------------------------------------------------------------
+# Initialisation
+# ---------------------------------------------------------------------------
+
+
+def initZeroState(h: int) -> int:
+    _qt.init_zero_state(_q(h))
+    return 0
+
+
+def initPlusState(h: int) -> int:
+    _qt.init_plus_state(_q(h))
+    return 0
+
+
+def initClassicalState(h: int, state_ind: int) -> int:
+    _qt.init_classical_state(_q(h), state_ind)
+    return 0
+
+
+def initPureState(h: int, h_pure: int) -> int:
+    _qt.init_pure_state(_q(h), _q(h_pure))
+    return 0
+
+
+def initStateFromAmps(h: int, re_ptr: int, im_ptr: int) -> int:
+    q = _q(h)
+    n = q.num_amps
+    _qt.init_state_from_amps(q, _real_view(re_ptr, n).copy(),
+                             _real_view(im_ptr, n).copy())
+    return 0
+
+
+def setAmps(h: int, start_ind: int, re_ptr: int, im_ptr: int,
+            num_amps: int) -> int:
+    _qt.set_amps(_q(h), start_ind, _real_view(re_ptr, num_amps).copy(),
+                 _real_view(im_ptr, num_amps).copy(), num_amps)
+    return 0
+
+
+def setDensityAmps(h: int, re_ptr: int, im_ptr: int) -> int:
+    # reference: setDensityAmps writes the full underlying 2N-qubit vector
+    # (QuEST_debug.h:42-46, QuEST_cpu.c setAmps path)
+    q = _q(h)
+    n = q.num_amps
+    _qt.init_state_from_amps(q, _real_view(re_ptr, n).copy(),
+                             _real_view(im_ptr, n).copy())
+    return 0
+
+
+def initStateDebug(h: int) -> int:
+    _qt.init_state_debug(_q(h))
+    return 0
+
+
+def initStateOfSingleQubit(h: int, qubit: int, outcome: int) -> int:
+    _qt.init_state_of_single_qubit(_q(h), qubit, outcome)
+    return 0
+
+
+def initStateFromSingleFile(h: int, filename: str) -> int:
+    return int(_qt.init_state_from_single_file(_q(h), filename))
+
+
+def compareStates(h1: int, h2: int, precision: float) -> int:
+    return int(_qt.compare_states(_q(h1), _q(h2), precision))
+
+
+# ---------------------------------------------------------------------------
+# Gates
+# ---------------------------------------------------------------------------
+
+
+def hadamard(h: int, t: int) -> int:
+    _qt.hadamard(_q(h), t)
+    return 0
+
+
+def pauliX(h: int, t: int) -> int:
+    _qt.pauli_x(_q(h), t)
+    return 0
+
+
+def pauliY(h: int, t: int) -> int:
+    _qt.pauli_y(_q(h), t)
+    return 0
+
+
+def pauliZ(h: int, t: int) -> int:
+    _qt.pauli_z(_q(h), t)
+    return 0
+
+
+def sGate(h: int, t: int) -> int:
+    _qt.s_gate(_q(h), t)
+    return 0
+
+
+def tGate(h: int, t: int) -> int:
+    _qt.t_gate(_q(h), t)
+    return 0
+
+
+def phaseShift(h: int, t: int, angle: float) -> int:
+    _qt.phase_shift(_q(h), t, angle)
+    return 0
+
+
+def controlledPhaseShift(h: int, q1: int, q2: int, angle: float) -> int:
+    _qt.controlled_phase_shift(_q(h), q1, q2, angle)
+    return 0
+
+
+def multiControlledPhaseShift(h: int, ptr: int, n: int, angle: float) -> int:
+    _qt.multi_controlled_phase_shift(_q(h), _int_view(ptr, n), angle)
+    return 0
+
+
+def controlledPhaseFlip(h: int, q1: int, q2: int) -> int:
+    _qt.controlled_phase_flip(_q(h), q1, q2)
+    return 0
+
+
+def multiControlledPhaseFlip(h: int, ptr: int, n: int) -> int:
+    _qt.multi_controlled_phase_flip(_q(h), _int_view(ptr, n))
+    return 0
+
+
+def compactUnitary(h: int, t: int, ar: float, ai: float, br: float,
+                   bi: float) -> int:
+    _qt.compact_unitary(_q(h), t, complex(ar, ai), complex(br, bi))
+    return 0
+
+
+def _mat2(u8) -> np.ndarray:
+    """Row-major (re, im) octet -> 2x2 complex matrix (the ComplexMatrix2
+    field order, capi/include/QuEST.h)."""
+    return np.array([[complex(u8[0], u8[1]), complex(u8[2], u8[3])],
+                     [complex(u8[4], u8[5]), complex(u8[6], u8[7])]])
+
+
+def unitary(h: int, t: int, *u8) -> int:
+    _qt.unitary(_q(h), t, _mat2(u8))
+    return 0
+
+
+def rotateX(h: int, t: int, angle: float) -> int:
+    _qt.rotate_x(_q(h), t, angle)
+    return 0
+
+
+def rotateY(h: int, t: int, angle: float) -> int:
+    _qt.rotate_y(_q(h), t, angle)
+    return 0
+
+
+def rotateZ(h: int, t: int, angle: float) -> int:
+    _qt.rotate_z(_q(h), t, angle)
+    return 0
+
+
+def rotateAroundAxis(h: int, t: int, angle: float, x: float, y: float,
+                     z: float) -> int:
+    _qt.rotate_around_axis(_q(h), t, angle, (x, y, z))
+    return 0
+
+
+def controlledRotateX(h: int, c: int, t: int, angle: float) -> int:
+    _qt.controlled_rotate_x(_q(h), c, t, angle)
+    return 0
+
+
+def controlledRotateY(h: int, c: int, t: int, angle: float) -> int:
+    _qt.controlled_rotate_y(_q(h), c, t, angle)
+    return 0
+
+
+def controlledRotateZ(h: int, c: int, t: int, angle: float) -> int:
+    _qt.controlled_rotate_z(_q(h), c, t, angle)
+    return 0
+
+
+def controlledRotateAroundAxis(h: int, c: int, t: int, angle: float, x: float,
+                               y: float, z: float) -> int:
+    _qt.controlled_rotate_around_axis(_q(h), c, t, angle, (x, y, z))
+    return 0
+
+
+def controlledCompactUnitary(h: int, c: int, t: int, ar: float, ai: float,
+                             br: float, bi: float) -> int:
+    _qt.controlled_compact_unitary(_q(h), c, t, complex(ar, ai),
+                                   complex(br, bi))
+    return 0
+
+
+def controlledUnitary(h: int, c: int, t: int, *u8) -> int:
+    _qt.controlled_unitary(_q(h), c, t, _mat2(u8))
+    return 0
+
+
+def multiControlledUnitary(h: int, ptr: int, n: int, t: int, *u8) -> int:
+    _qt.multi_controlled_unitary(_q(h), _int_view(ptr, n), t, _mat2(u8))
+    return 0
+
+
+def controlledNot(h: int, c: int, t: int) -> int:
+    _qt.controlled_not(_q(h), c, t)
+    return 0
+
+
+def controlledPauliY(h: int, c: int, t: int) -> int:
+    _qt.controlled_pauli_y(_q(h), c, t)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Calculations and measurement
+# ---------------------------------------------------------------------------
+
+
+def calcTotalProb(h: int) -> float:
+    return _qt.calc_total_prob(_q(h))
+
+
+def calcProbOfOutcome(h: int, t: int, outcome: int) -> float:
+    return _qt.calc_prob_of_outcome(_q(h), t, outcome)
+
+
+def calcInnerProduct(h_bra: int, h_ket: int):
+    c = _qt.calc_inner_product(_q(h_bra), _q(h_ket))
+    return (c.real, c.imag)
+
+
+def calcPurity(h: int) -> float:
+    return _qt.calc_purity(_q(h))
+
+
+def calcFidelity(h: int, h_pure: int) -> float:
+    return _qt.calc_fidelity(_q(h), _q(h_pure))
+
+
+def collapseToOutcome(h: int, t: int, outcome: int) -> float:
+    return _qt.collapse_to_outcome(_q(h), t, outcome)
+
+
+def measure(h: int, t: int) -> int:
+    return _qt.measure(_q(h), t)
+
+
+def measureWithStats(h: int, t: int):
+    outcome, prob = _qt.measure_with_stats(_q(h), t)
+    return (outcome, prob)
+
+
+# ---------------------------------------------------------------------------
+# Decoherence
+# ---------------------------------------------------------------------------
+
+
+def applyOneQubitDephaseError(h: int, t: int, prob: float) -> int:
+    _qt.apply_one_qubit_dephase_error(_q(h), t, prob)
+    return 0
+
+
+def applyTwoQubitDephaseError(h: int, q1: int, q2: int, prob: float) -> int:
+    _qt.apply_two_qubit_dephase_error(_q(h), q1, q2, prob)
+    return 0
+
+
+def applyOneQubitDepolariseError(h: int, t: int, prob: float) -> int:
+    _qt.apply_one_qubit_depolarise_error(_q(h), t, prob)
+    return 0
+
+
+def applyOneQubitDampingError(h: int, t: int, prob: float) -> int:
+    _qt.apply_one_qubit_damping_error(_q(h), t, prob)
+    return 0
+
+
+def applyTwoQubitDepolariseError(h: int, q1: int, q2: int,
+                                 prob: float) -> int:
+    _qt.apply_two_qubit_depolarise_error(_q(h), q1, q2, prob)
+    return 0
+
+
+def addDensityMatrix(h: int, prob: float, h_other: int) -> int:
+    _qt.add_density_matrix(_q(h), prob, _q(h_other))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Reporting and QASM
+# ---------------------------------------------------------------------------
+
+
+def reportState(h: int) -> int:
+    _qt.report_state(_q(h))
+    return 0
+
+
+def reportStateToScreen(h: int, report_rank: int) -> int:
+    _qt.report_state_to_screen(_q(h), _env, report_rank)
+    return 0
+
+
+def reportQuregParams(h: int) -> int:
+    _qt.report_qureg_params(_q(h))
+    return 0
+
+
+def startRecordingQASM(h: int) -> int:
+    _qt.start_recording_qasm(_q(h))
+    return 0
+
+
+def stopRecordingQASM(h: int) -> int:
+    _qt.stop_recording_qasm(_q(h))
+    return 0
+
+
+def clearRecordedQASM(h: int) -> int:
+    _qt.clear_recorded_qasm(_q(h))
+    return 0
+
+
+def printRecordedQASM(h: int) -> int:
+    _qt.print_recorded_qasm(_q(h))
+    return 0
+
+
+def writeRecordedQASMToFile(h: int, filename: str) -> int:
+    _qt.write_recorded_qasm_to_file(_q(h), filename)
+    return 0

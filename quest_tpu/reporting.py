@@ -23,11 +23,13 @@ from .register import Qureg
 def report_qureg_params(qureg: Qureg) -> str:
     """Print (and return) basic register facts (reference:
     reportQuregParams, QuEST_common.c:184-193)."""
+    # same text shape as the reference, with "rank" = mesh device
+    # (reportQuregParams, QuEST_common.c:184-193)
     text = (
         "QUBITS:\n"
         f"Number of qubits is {qureg.num_vec_qubits}.\n"
         f"Number of amps is {qureg.num_amps}.\n"
-        f"Number of amps per device is {qureg.num_amps // (1 if qureg.mesh is None else qureg.mesh.devices.size)}.\n"
+        f"Number of amps per rank is {qureg.num_amps // (1 if qureg.mesh is None else qureg.mesh.devices.size)}.\n"
     )
     print(text, end="")
     return text
@@ -45,9 +47,13 @@ def report_state_to_screen(qureg: Qureg, env: QuESTEnv | None = None,
         return
     re = np.asarray(qureg.re, dtype=np.float64).reshape(-1)
     im = np.asarray(qureg.im, dtype=np.float64).reshape(-1)
-    print("Reporting state on device 0")
+    # reference output shape: header, rows, closing bracket
+    # (statevec_reportStateToScreen, QuEST_cpu.c:1252-1275)
+    print("Reporting state [")
+    print("real, imag")
     for r, i in zip(re, im):
         print(f"{r:.14f}, {i:.14f}")
+    print("]")
 
 
 def get_environment_string(env: QuESTEnv, qureg: Qureg) -> str:

