@@ -31,6 +31,9 @@ void setDensityAmps(Qureg qureg, qreal *reals, qreal *imags);
 /* The compiled QuEST_PREC value (1=float, 2=double). */
 int QuESTPrecision(void);
 
+/* sizeof(qreal)/4 — the value QuESTPy uses to pick its float type. */
+int getQuEST_PREC(void);
+
 #ifdef __cplusplus
 }
 #endif

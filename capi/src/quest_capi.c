@@ -87,7 +87,10 @@ static void ensure_bridge_once(void) {
     bridge = PyImport_ImportModule("quest_tpu.capi_bridge");
     if (!bridge)
         fatal("import quest_tpu.capi_bridge");
-    PyObject *r = PyObject_CallMethod(bridge, "init", "(i)", (int)QuEST_PREC);
+    /* Pass the platform explicitly: in the ctypes-in-process case the
+     * interpreter's os.environ snapshot predates our setenv above. */
+    PyObject *r = PyObject_CallMethod(bridge, "init", "(is)", (int)QuEST_PREC,
+                                      plat ? plat : "cpu");
     if (!r)
         fatal("capi_bridge.init");
     Py_DECREF(r);
@@ -373,6 +376,18 @@ int compareStates(Qureg mq1, Qureg mq2, qreal precision) {
 }
 
 int QuESTPrecision(void) { return (int)QuEST_PREC; }
+
+/* Raw draw from the global measurement RNG; the reference exports the
+ * MT19937 internals and the seedQuEST golden test consumes this symbol
+ * directly to verify the seeded stream.  Returns double regardless of
+ * QuEST_PREC, matching the reference ABI (mt19937ar.h:13). */
+double genrand_real1(void) {
+    return as_double(bcall("genrand_real1", "()"), "genrand_real1");
+}
+
+/* qreal width in 4-byte units; QuESTPy reads this to pick its ctypes
+ * float type (reference: getQuEST_PREC, QuEST.c:724-726). */
+int getQuEST_PREC(void) { return (int)(sizeof(qreal) / 4); }
 
 /* ---- amplitude access ---------------------------------------------- */
 

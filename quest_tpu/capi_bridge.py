@@ -33,25 +33,25 @@ _qreal = ctypes.c_double
 # ---------------------------------------------------------------------------
 
 
-def init(precision_code: int) -> int:
+def init(precision_code: int, platform: str = "cpu") -> int:
     """One-time setup, called right after the interpreter is embedded.
 
     ``precision_code`` is the shim's compiled QuEST_PREC (1=float,
-    2=double — reference: QuEST_precision.h).  The C side exports env
-    vars (JAX_PLATFORMS, JAX_ENABLE_X64) before Py_Initialize, so jax
-    configures itself correctly on import here.
+    2=double — reference: QuEST_precision.h); ``platform`` is the JAX
+    platform the C side resolved (QUEST_CAPI_PLATFORM env, default cpu —
+    passed explicitly because an in-process interpreter's os.environ
+    snapshot predates the shim's setenv).
     """
-    global _qt, _env, _qreal, _npreal
+    global _qt, _env, _qreal
     if _qt is not None:
         return 0
     # The machine's TPU plugin can override the JAX_PLATFORMS env var the
     # C side exported; the programmatic config is authoritative, so apply
-    # the requested platform (default cpu) before any backend initialises.
+    # the requested platform before any backend initialises.
     import jax
 
     try:
-        jax.config.update("jax_platforms",
-                          os.environ.get("JAX_PLATFORMS", "cpu"))
+        jax.config.update("jax_platforms", platform)
     except RuntimeError:
         # Loaded into an already-running interpreter whose JAX backends are
         # live (ctypes-in-process case): the host process owns the platform.
@@ -126,6 +126,14 @@ def seedQuEST(ptr: int, num_seeds: int) -> int:
     seeds = [int(v) for v in (ctypes.c_ulong * num_seeds).from_address(ptr)]
     _qt.seed_quest(seeds)
     return 0
+
+
+def genrand_real1() -> float:
+    """Raw draw from the global measurement RNG (reference symbol:
+    genrand_real1, mt19937ar.c; consumed by the seedQuEST golden test)."""
+    from quest_tpu.env import random_real
+
+    return random_real()
 
 
 # ---------------------------------------------------------------------------

@@ -77,8 +77,7 @@ def lib():
     if not _have_toolchain():
         pytest.skip("no C toolchain")
     r = subprocess.run(["make", "-C", CAPI], capture_output=True, text=True)
-    if r.returncode != 0:
-        pytest.skip(f"capi build failed: {r.stderr[-500:]}")
+    assert r.returncode == 0, f"capi build failed: {r.stderr[-1000:]}"
     L = ct.CDLL(LIB)
     L.createQuESTEnv.restype = QuESTEnv
     L.createQureg.restype = Qureg
