@@ -20,15 +20,28 @@ import sys
 import time
 
 
-def run(num_qubits: int, depth: int, reps: int):
+def run(num_qubits: int, depth: int, reps: int, inner: int):
     import jax
     import jax.numpy as jnp
+    from functools import partial
     from quest_tpu import models
     from quest_tpu.ops.lattice import state_shape
 
     circ = models.random_circuit(num_qubits, depth=depth, seed=123)
-    fn = circ.compile(mesh=None, donate=True)
+    apply = circ.as_fused_fn() if jax.devices()[0].platform != "cpu" \
+        else circ.as_fn(mesh=None)
     shape = state_shape(1 << num_qubits)
+
+    # The dispatch round trip to a remote-attached chip costs ~130 ms —
+    # comparable to a full circuit pass — so the circuit is repeated
+    # ``inner`` times INSIDE one compiled call (lax.fori_loop) and the
+    # per-gate figure divides by inner; this measures sustained on-chip
+    # throughput, not tunnel latency.  The circuit is unitary, so chained
+    # application on the same donated buffers is a valid steady state.
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run_inner(re, im):
+        return jax.lax.fori_loop(
+            0, inner, lambda _, s: apply(*s), (re, im))
 
     def fresh():
         re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
@@ -42,26 +55,25 @@ def run(num_qubits: int, depth: int, reps: int):
         jax.block_until_ready(arrs)
         return float(arrs[0][0, 0])
 
-    # One state set only — at 30 qubits a second (re, im) would not fit —
-    # so timed reps chain on the same donated buffers (the circuit is
-    # unitary; repeated application is a valid steady-state workload).
-    re, im = fn(*fresh())  # compile + warm-up
+    re, im = run_inner(*fresh())  # compile + warm-up
     sync((re, im))
 
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        re, im = fn(re, im)
+        re, im = run_inner(re, im)
         sync((re, im))
         times.append(time.perf_counter() - t0)
     best = min(times)
-    return circ.num_gates / best, circ.num_gates, best
+    n_gates = circ.num_gates * inner
+    return n_gates / best, n_gates, best
 
 
 def main():
     num_qubits = int(os.environ.get("QUEST_BENCH_QUBITS", "30"))
     depth = int(os.environ.get("QUEST_BENCH_DEPTH", "8"))
     reps = int(os.environ.get("QUEST_BENCH_REPS", "3"))
+    inner = int(os.environ.get("QUEST_BENCH_INNER", "8"))
 
     # The fused Pallas executor updates the state strictly in place
     # (input_output_aliases through every segment), so only ONE (re, im)
@@ -78,7 +90,7 @@ def main():
     gates_per_sec = None
     while num_qubits >= 20:
         try:
-            gates_per_sec, ngates, secs = run(num_qubits, depth, reps)
+            gates_per_sec, ngates, secs = run(num_qubits, depth, reps, inner)
             break
         except Exception as e:  # OOM on smaller-HBM chips: shrink
             msg = str(e)

@@ -75,6 +75,10 @@ def expand_phase(lanes: int, sel_mask: int, term) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+#: The Pauli-X matrix in the executor's ((re,im) x4) tuple form.
+_X_MAT = ((0.0, 0.0), (1.0, 0.0), (1.0, 0.0), (0.0, 0.0))
+
+
 def _combine_2x2(r, i, pr, pi, bit, m):
     (ar, ai), (br, bi), (cr, ci), (dr, di) = m
     is0 = bit == 0
@@ -314,6 +318,23 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         nr = jnp.where(sel, phr * r - phi * i, r)
         ni = jnp.where(sel, phr * i + phi * r, i)
         return nr, ni
+    if kind == "diag":
+        # A folded RUN of diagonal phases: accumulate the combined complex
+        # diagonal over broadcast-sized indicator shapes (a single-bit
+        # phase costs one (lanes,)/(c_blk,1)/scalar-sized product, not a
+        # block pass), then touch the state ONCE.  This is where the
+        # reference's phase family (phaseShiftByTerm and the controlled/
+        # multi-controlled variants, QuEST_cpu.c:2666-3010) — half the
+        # gates of a Clifford+T stream — collapses to near-zero cost.
+        _, phases = op
+        dre = jnp.array(1.0, dtype)
+        dim = jnp.array(0.0, dtype)
+        for sel_mask, phr, phi in phases:
+            sel = bf.bits_all_set(sel_mask)
+            fr = jnp.where(sel, jnp.array(phr, dtype), jnp.array(1.0, dtype))
+            fi = jnp.where(sel, jnp.array(phi, dtype), jnp.array(0.0, dtype))
+            dre, dim = dre * fr - dim * fi, dre * fi + dim * fr
+        return r * dre - i * dim, i * dre + r * dim
     if kind == "2x2":
         _, t, m, ctrl_mask, perm_ix = op
         if t < lane_bits:
@@ -340,7 +361,13 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
             sel0 = bit == 0
             pr = jnp.where(sel0, up_r, dn_r)
             pi = jnp.where(sel0, up_i, dn_i)
-        nr, ni = _combine_2x2(r, i, pr, pi, bit, m)
+        if m == _X_MAT:
+            # X / CNOT: the update IS the partner fetch — skip the 8-mul
+            # combine (the reference's dedicated pauliX/controlledNot
+            # kernels, QuEST_cpu.c:2186, :2273).
+            nr, ni = pr, pi
+        else:
+            nr, ni = _combine_2x2(r, i, pr, pi, bit, m)
         if ctrl_mask:
             keep = bf.bits_all_set(ctrl_mask)
             nr = jnp.where(keep, nr, r)

@@ -83,36 +83,92 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
     return segments
 
 
+class _Group:
+    """An open composition group ops may commute-slide backward into.
+
+    ``bar_mix``/``bar_sup`` are the unions of mixing/support bits of every
+    entry placed after this group opened; an op (mix, sup) may join iff
+    ``bar_mix & sup == 0 and mix & bar_sup == 0`` (it then commutes past
+    everything between its original position and the group)."""
+
+    __slots__ = ("kind", "bar_mix", "bar_sup", "items")
+
+    def __init__(self, kind):
+        self.kind = kind
+        self.bar_mix = 0
+        self.bar_sup = 0
+        self.items = []
+
+
+def _fold_groups(seg, lane_bits: int):
+    """Slide ops backward into the earliest compatible composition group.
+
+    Two group kinds: ``D`` collects diagonal phases (one combined-diagonal
+    state pass regardless of count — in a Clifford+T stream half the
+    gates land here), ``L`` collects lane-targeted 2x2 gates with lane
+    controls (one LxL matrix on the MXU).  Everything else is emitted in
+    place and raises the barriers of every earlier group.
+    """
+    lanes = 1 << lane_bits
+    out = []       # ops and _Group entries, in execution order
+    groups = []    # same _Group objects, creation order
+
+    def join(kind, mix, sup, item):
+        for g in groups:
+            if g.kind == kind and not (g.bar_mix & sup) \
+                    and not (mix & g.bar_sup):
+                break
+        else:
+            g = _Group(kind)
+            groups.append(g)
+            out.append(g)
+            # entries after earlier groups now include g's items; account
+            # for this op below like any other placed entry.
+        g.items.append(item)
+        for other in groups:
+            if other is g:
+                break
+            other.bar_mix |= mix
+            other.bar_sup |= sup
+
+    for op in seg:
+        kind, statics, scalars = op
+        if kind == "apply_phase":
+            (mask,) = statics
+            join("D", 0, mask, (mask, scalars[0], scalars[1]))
+            continue
+        target, ctrl_mask = statics
+        mix = 1 << target
+        sup = mix | ctrl_mask
+        if target < lane_bits and ctrl_mask < lanes:
+            join("L", mix, sup, (target, scalars, ctrl_mask))
+            continue
+        out.append(op)
+        for g in groups:
+            g.bar_mix |= mix
+            g.bar_sup |= sup
+    return out
+
+
 def _plan_seg(seg, lane_bits: int):
-    """Convert recorded ops to kernel seg-ops, composing adjacent runs of
-    lane-only ops (targets, controls and phase selections all inside the
-    lane dim) into one LxL complex 'lanemm' matrix."""
+    """Convert recorded ops to kernel seg-ops: phases fold into combined
+    diagonal groups (one state pass each, regardless of count), lane 2x2
+    runs compose into one LxL complex 'lanemm' matrix, and X-matrix gates
+    are tagged for the copy-only kernel path."""
     lanes = 1 << lane_bits
     out = []
-    pending = None  # accumulating lane matrix (left-action)
-
-    def flush():
-        nonlocal pending
-        if pending is not None:
-            out.append(("lanemm", pending.real.copy(), pending.imag.copy()))
-            pending = None
-
-    for kind, statics, scalars in seg:
-        if kind == "apply_phase":
-            (sel_mask,) = statics
-            if sel_mask < lanes:
-                m = expand_phase(lanes, sel_mask, scalars)
-                pending = m if pending is None else m @ pending
-                continue
-            flush()
-            out.append(("phase", sel_mask, tuple(scalars)))
-        else:
-            target, ctrl_mask = statics
-            if target < lane_bits and ctrl_mask < lanes:
-                m = expand_gate(lanes, target, scalars, ctrl_mask)
-                pending = m if pending is None else m @ pending
-                continue
-            flush()
-            out.append(("2x2", target, tuple(scalars), ctrl_mask))
-    flush()
+    for entry in _fold_groups(seg, lane_bits):
+        if isinstance(entry, _Group):
+            if entry.kind == "D":
+                out.append(("diag", tuple(entry.items)))
+            else:
+                m = None
+                for target, scalars, ctrl_mask in entry.items:
+                    g = expand_gate(lanes, target, scalars, ctrl_mask)
+                    m = g if m is None else g @ m
+                out.append(("lanemm", m.real.copy(), m.imag.copy()))
+            continue
+        kind, statics, scalars = entry
+        target, ctrl_mask = statics
+        out.append(("2x2", target, tuple(scalars), ctrl_mask))
     return tuple(out)
