@@ -28,7 +28,9 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
     from quest_tpu.ops.lattice import state_shape
 
     circ = models.random_circuit(num_qubits, depth=depth, seed=123)
-    apply = circ.as_fused_fn() if jax.devices()[0].platform != "cpu" \
+    # The fused Pallas kernels lower natively only on TPU; other
+    # accelerators would need interpret mode, where the XLA path is faster.
+    apply = circ.as_fused_fn() if jax.default_backend() == "tpu" \
         else circ.as_fn(mesh=None)
     shape = state_shape(1 << num_qubits)
 

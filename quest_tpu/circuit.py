@@ -15,13 +15,21 @@ Circuit runs identically on one device or sharded over a mesh.
 
 from __future__ import annotations
 
+import itertools
 import math
 from dataclasses import dataclass, field
+
+#: Process-global op-stream version stamps: every mutation of any Circuit
+#: gets a fresh stamp, so compiled-program memo keys can never collide —
+#: not even between same-length circuits sharing a ``_compiled`` dict via
+#: copy.
+_VERSIONS = itertools.count(1)
 
 import jax
 
 from .ops.lattice import run_kernel
 from .ops import gates as _g
+from . import validation as _v
 
 
 @dataclass
@@ -33,26 +41,35 @@ class Circuit:
     is_density: bool = False
     ops: list = field(default_factory=list)
     _compiled: dict = field(default_factory=dict, repr=False)
+    _version: int = field(default=0, repr=False)
 
     # -- recording helpers ----------------------------------------------
     @property
     def _n(self):
         return self.num_qubits
 
+    def _record(self, op):
+        self.ops.append(op)
+        self._version = next(_VERSIONS)
+
     def _2x2(self, target, m, controls=()):
+        if controls:
+            _v.validate_multi_controls(self, controls, target)
+        else:
+            _v.validate_target(self, target)
         mask = _g._ctrl_mask(controls)
-        self.ops.append(("apply_2x2", (target, mask), m))
+        self._record(("apply_2x2", (target, mask), m))
         if self.is_density:
-            self.ops.append(
+            self._record(
                 ("apply_2x2", (target + self._n, mask << self._n), _g._conj_m(m))
             )
         return self
 
     def _phase(self, sel_mask, term):
-        self.ops.append(("apply_phase", (sel_mask,), term))
+        self._record(("apply_phase", (sel_mask,), term))
         if self.is_density:
             tr, ti = term
-            self.ops.append(("apply_phase", (sel_mask << self._n,), (tr, -ti)))
+            self._record(("apply_phase", (sel_mask << self._n,), (tr, -ti)))
         return self
 
     # -- gate set --------------------------------------------------------
@@ -72,30 +89,38 @@ class Circuit:
     y = pauli_y
 
     def pauli_z(self, t):
+        _v.validate_target(self, t)
         return self._phase(1 << t, (-1.0, 0.0))
 
     z = pauli_z
 
     def s_gate(self, t):
+        _v.validate_target(self, t)
         return self._phase(1 << t, (0.0, 1.0))
 
     def t_gate(self, t):
+        _v.validate_target(self, t)
         return self._phase(1 << t, (_g._INV_SQRT2, _g._INV_SQRT2))
 
     def phase_shift(self, t, angle):
+        _v.validate_target(self, t)
         return self._phase(1 << t, (math.cos(angle), math.sin(angle)))
 
     def controlled_phase_shift(self, c, t, angle):
+        _v.validate_unique_targets(self, c, t)
         return self._phase((1 << c) | (1 << t),
                            (math.cos(angle), math.sin(angle)))
 
     def controlled_phase_flip(self, c, t):
+        _v.validate_unique_targets(self, c, t)
         return self._phase((1 << c) | (1 << t), (-1.0, 0.0))
 
     def multi_controlled_phase_flip(self, qubits):
+        _v.validate_multi_qubits(self, qubits)
         return self._phase(_g._ctrl_mask(qubits), (-1.0, 0.0))
 
     def multi_controlled_phase_shift(self, qubits, angle):
+        _v.validate_multi_qubits(self, qubits)
         return self._phase(_g._ctrl_mask(qubits),
                            (math.cos(angle), math.sin(angle)))
 
@@ -133,6 +158,9 @@ class Circuit:
         return self._2x2(t, _g._mat_to_m(u), controls=(c,))
 
     def multi_controlled_unitary(self, controls, t, u):
+        # empty control lists are invalid here (eager parity:
+        # validate_multi_controls requires >= 1 control)
+        _v.validate_multi_controls(self, tuple(controls), t)
         return self._2x2(t, _g._mat_to_m(u), controls=tuple(controls))
 
     def controlled_rotate_x(self, c, t, angle):
@@ -213,7 +241,7 @@ class Circuit:
             )
         use_pallas = mesh is None and (
             pallas is True or pallas == "auto")
-        key = (mesh, donate, use_pallas, len(self.ops))
+        key = (mesh, donate, use_pallas, self._version)
         fn = self._compiled.get(key)
         if fn is None:
             if use_pallas:

@@ -61,15 +61,6 @@ def expand_gate(lanes: int, target: int, m, ctrl_mask: int = 0) -> np.ndarray:
     return out
 
 
-def expand_phase(lanes: int, sel_mask: int, term) -> np.ndarray:
-    phr, phi = term
-    d = np.ones(lanes, dtype=np.complex128)
-    for i in range(lanes):
-        if (i & sel_mask) == sel_mask:
-            d[i] = phr + 1j * phi
-    return np.diag(d)
-
-
 # ---------------------------------------------------------------------------
 # In-kernel helpers
 # ---------------------------------------------------------------------------
@@ -281,6 +272,11 @@ class _FusedBits:
         raise AssertionError(f"bit {b} beyond state")
 
     def bits_all_set(self, mask: int):
+        if mask == 0:
+            # empty selection = unconditionally selected (matches
+            # Lattice.bits_all_set; reachable via e.g. an uncontrolled
+            # recorded phase folded into a diag group)
+            return jnp.full((1,) * self.ndim, True)
         parts = []
         b = 0
         m = mask
@@ -311,12 +307,6 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         mr, mi = mats[mr_ix], mats[mi_ix]
         nr = lanemul(r, mr) - lanemul(i, mi)
         ni = lanemul(r, mi) + lanemul(i, mr)
-        return nr, ni
-    if kind == "phase":
-        _, sel_mask, (phr, phi) = op
-        sel = bf.bits_all_set(sel_mask)
-        nr = jnp.where(sel, phr * r - phi * i, r)
-        ni = jnp.where(sel, phr * i + phi * r, i)
         return nr, ni
     if kind == "diag":
         # A folded RUN of diagonal phases: accumulate the combined complex

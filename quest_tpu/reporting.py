@@ -47,13 +47,25 @@ def report_state_to_screen(qureg: Qureg, env: QuESTEnv | None = None,
         return
     re = np.asarray(qureg.re, dtype=np.float64).reshape(-1)
     im = np.asarray(qureg.im, dtype=np.float64).reshape(-1)
-    # reference output shape: header, rows, closing bracket
-    # (statevec_reportStateToScreen, QuEST_cpu.c:1252-1275)
-    print("Reporting state [")
-    print("real, imag")
-    for r, i in zip(re, im):
-        print(f"{r:.14f}, {i:.14f}")
-    print("]")
+    # reference output shape: header(s), rows, closing bracket(s); when
+    # reportRank is set each rank prints its own header+chunk+bracket, and
+    # amplitudes use REAL_STRING_FORMAT — %.8f single / %.14f double
+    # (statevec_reportStateToScreen QuEST_cpu.c:1252-1275,
+    # QuEST_precision.h:30/43)
+    digits = 8 if qureg.real_dtype == np.float32 else 14
+    ndev = 1 if qureg.mesh is None else qureg.mesh.devices.size
+    chunk = qureg.num_amps // ndev
+    for rank in range(ndev):
+        if report_rank:
+            print(f"Reporting state from rank {rank} [")
+            print("real, imag")
+        elif rank == 0:
+            print("Reporting state [")
+            print("real, imag")
+        for idx in range(rank * chunk, (rank + 1) * chunk):
+            print(f"{re[idx]:.{digits}f}, {im[idx]:.{digits}f}")
+        if report_rank or rank == ndev - 1:
+            print("]")
 
 
 def get_environment_string(env: QuESTEnv, qureg: Qureg) -> str:

@@ -25,7 +25,6 @@ from .ops.pallas_kernels import (
     MAX_HIGH_BITS,
     _ROW_BUDGET,
     expand_gate,
-    expand_phase,
 )
 
 

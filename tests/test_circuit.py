@@ -1,0 +1,83 @@
+"""Circuit recording/compilation invariants (memoisation, validation).
+
+The reference has no circuit IR — it dispatches gate-at-a-time
+(QuEST/src/QuEST.c) — so these tests cover behaviour specific to the
+recorded-circuit executor: recompilation on mutation and eager-parity
+argument validation at record time.
+"""
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuit import Circuit
+from quest_tpu.validation import QuESTError
+
+from conftest import TOL, random_statevector, load_statevector
+
+
+def test_append_after_compile_recompiles(env1):
+    """Mutating a circuit invalidates the compiled-program memo even when
+    the op count returns to a previously-compiled length."""
+    circ = Circuit(4)
+    circ.hadamard(0)
+    q = qt.create_qureg(4, env1)
+    circ.run(q)
+    one_gate = qt.get_state_vector(q)
+
+    circ.pauli_x(1)
+    qt.init_zero_state(q)
+    circ.run(q)
+    two_gates = qt.get_state_vector(q)
+    assert not np.allclose(one_gate, two_gates)
+
+    # same length as the first compile, different op: must not reuse
+    circ2 = Circuit(4)
+    circ2.pauli_x(0)
+    circ2._compiled = circ._compiled  # worst case: shared memo dict
+    q2 = qt.create_qureg(4, env1)
+    circ2.run(q2)
+    expected = np.zeros(16, complex)
+    expected[1] = 1.0
+    np.testing.assert_allclose(qt.get_state_vector(q2), expected, atol=TOL)
+
+
+def test_circuit_validates_like_eager():
+    circ = Circuit(4)
+    with pytest.raises(QuESTError):
+        circ.multi_controlled_phase_flip([])
+    with pytest.raises(QuESTError):
+        circ.multi_controlled_phase_shift([], 0.3)
+    with pytest.raises(QuESTError):
+        circ.hadamard(4)
+    with pytest.raises(QuESTError):
+        circ.controlled_not(2, 2)
+    with pytest.raises(QuESTError):
+        circ.multi_controlled_unitary([1, 1], 2, np.eye(2))
+    with pytest.raises(QuESTError):
+        circ.multi_controlled_unitary([], 2, np.eye(2))
+    with pytest.raises(QuESTError):
+        circ.controlled_phase_flip(2, 2)
+    with pytest.raises(QuESTError):
+        circ.pauli_z(5)
+    with pytest.raises(QuESTError):
+        circ.phase_shift(-1, 0.3)
+    assert circ.ops == []
+
+
+def test_fused_diag_empty_mask(env1):
+    """A recorded phase with selection mask 0 (global phase) must survive
+    the fused diag path (regression: _FusedBits.bits_all_set(0))."""
+    circ = Circuit(4)
+    circ.hadamard(0)
+    circ._record(("apply_phase", (0,), (0.0, 1.0)))  # global i phase
+    q = qt.create_qureg(4, env1)
+    psi = random_statevector(4, 7)
+    load_statevector(q, psi)
+    circ.run(q, pallas=True)
+
+    q2 = qt.create_qureg(4, env1)
+    load_statevector(q2, psi)
+    circ.run(q2, pallas=False)
+    np.testing.assert_allclose(
+        qt.get_state_vector(q), qt.get_state_vector(q2), atol=TOL)
