@@ -200,11 +200,20 @@ class Circuit:
 
         return fn
 
-    def as_fused_fn(self, interpret: bool = False):
+    def as_fused_fn(self, interpret: bool = False, mesh=None):
         """A pure (re, im) -> (re, im) function applying the circuit as
         scheduled fused Pallas segments — each segment is ONE in-place
-        pass over the state (see quest_tpu.scheduler).  Single-device
-        only; runs in interpreter mode off-TPU."""
+        pass over the state (see quest_tpu.scheduler).  With a mesh, the
+        segments run per-chunk inside shard_map and sharded-qubit gates
+        are handled by half-chunk relayout exchanges
+        (quest_tpu.ops.mesh_exec).  Runs in interpreter mode off-TPU."""
+        if mesh is not None and mesh.devices.size > 1:
+            from .ops.mesh_exec import as_mesh_fused_fn
+
+            nvec = self.num_qubits * (2 if self.is_density else 1)
+            return as_mesh_fused_fn(list(self.ops), nvec, mesh,
+                                    interpret=interpret)
+
         from .ops.pallas_kernels import apply_fused_segment
         from .scheduler import schedule_segments
 
@@ -228,25 +237,19 @@ class Circuit:
         without which a 30-qubit f32 state needs 2x8 GiB).
 
         ``pallas``: True / False / "auto" — the fused-segment Pallas path
-        (single-device only; "auto" enables it when there is no mesh).
-        Off-TPU backends run the same kernels in interpreter mode, so the
-        path is testable on CPU.
+        (per-chunk under shard_map when a mesh is given).  Off-TPU
+        backends run the same kernels in interpreter mode, so both paths
+        are testable on CPU.
 
         Memoised per config: jit caches key on function identity, so a
         fresh closure per call would re-trace and re-compile every time."""
-        if pallas is True and mesh is not None:
-            raise ValueError(
-                "the fused Pallas executor is single-device only; use "
-                'pallas="auto" to fall back to the XLA path under a mesh'
-            )
-        use_pallas = mesh is None and (
-            pallas is True or pallas == "auto")
+        use_pallas = pallas is True or pallas == "auto"
         key = (mesh, donate, use_pallas, self._version)
         fn = self._compiled.get(key)
         if fn is None:
             if use_pallas:
                 interpret = jax.default_backend() != "tpu"
-                raw = self.as_fused_fn(interpret=interpret)
+                raw = self.as_fused_fn(interpret=interpret, mesh=mesh)
             else:
                 raw = self.as_fn(mesh)
             fn = jax.jit(raw, donate_argnums=(0, 1) if donate else ())

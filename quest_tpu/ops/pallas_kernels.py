@@ -146,7 +146,7 @@ def plan_fused_shapes(rows: int, lanes: int, high_row_bits: tuple[int, ...],
 
 def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
                         *, row_budget: int = _ROW_BUDGET,
-                        interpret: bool = False):
+                        interpret: bool = False, dev_flags=None):
     """One in-place pipelined HBM pass applying a run of gates whose 2x2
     targets are lane bits, low row bits (< log2(c_blk)), or one of up to
     three arbitrary ``high_bits`` qubits (phases/controls: any bits).
@@ -157,6 +157,13 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     whole scheduled segment — low runs composed onto the MXU, high qubits
     exposed as block axes — costs a single streamed read+write of the
     state, updated in place.
+
+    ``dev_flags``: optional (1, n_flags) 0/1 array of per-device
+    selection flags (traced; one entry per interned device-bit mask from
+    the scheduler).  Under a mesh, ``re``/``im`` are one device's chunk
+    and an op whose control/phase mask touches device bits applies only
+    when its flag is 1 — the comm-free SPMD form of the reference's
+    global-index control tests (QuEST_cpu.c:1841, :2310).
     """
     rows, lanes = re.shape
     lane_bits = _ilog2(lanes)
@@ -183,13 +190,14 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
             planned.append(("lanemm", add_mat(np.asarray(mr).T),
                             add_mat(np.asarray(mi).T)))
         elif op[0] == "2x2":
-            _, t, m, ctrl_mask = op
+            _, t, m, ctrl_mask, flag_ix = op
             perm_ix = add_mat(_xor_perm(lanes, 1 << t)) \
                 if t < lane_bits else -1
-            planned.append(("2x2", t, m, ctrl_mask, perm_ix))
+            planned.append(("2x2", t, m, ctrl_mask, perm_ix, flag_ix))
         else:
             planned.append(op)
     planned = tuple(planned)
+    n_flags = 0 if dev_flags is None else dev_flags.shape[-1]
 
     vshape = (2,) * k + (c_blk, lanes)
     ndim = len(vshape)
@@ -212,7 +220,12 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
 
     def kern(re_ref, im_ref, *refs):
         mat_refs = refs[:len(mat_inputs)]
-        ro_ref, io_ref = refs[len(mat_inputs):]
+        refs = refs[len(mat_inputs):]
+        if n_flags:
+            flags_ref, (ro_ref, io_ref) = refs[0], refs[1:]
+            flags = flags_ref[:]
+        else:
+            (ro_ref, io_ref), flags = refs, None
         mats = [mr[:] for mr in mat_refs]
         r = re_ref[:].reshape(vshape)
         i = im_ref[:].reshape(vshape)
@@ -222,22 +235,26 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
         bf = _FusedBits(fields, lane_bits, lanes, ndim, c_blk)
         for op in planned:
             r, i = _apply_fused_op(r, i, op, bf, high_axis, lane_bits,
-                                   c_blk, re.dtype, mats)
+                                   c_blk, re.dtype, mats, flags)
         ro_ref[:] = r.reshape(block_shape)
         io_ref[:] = i.reshape(block_shape)
 
     spec = pl.BlockSpec(block_shape, index_map)
     mat_spec = pl.BlockSpec((lanes, lanes),
                             lambda *g: (0,) * 2)
+    flag_inputs, flag_specs = (), []
+    if n_flags:
+        flag_inputs = (jnp.asarray(dev_flags, re.dtype),)
+        flag_specs = [pl.BlockSpec((1, n_flags), lambda *g: (0, 0))]
     out_r, out_i = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[spec, spec] + [mat_spec] * len(mat_inputs),
+        in_specs=[spec, spec] + [mat_spec] * len(mat_inputs) + flag_specs,
         out_specs=[spec, spec],
         out_shape=[jax.ShapeDtypeStruct(dims, re.dtype)] * 2,
         input_output_aliases={0: 0, 1: 1},
         interpret=interpret,
-    )(re.reshape(dims), im.reshape(dims), *mat_inputs)
+    )(re.reshape(dims), im.reshape(dims), *mat_inputs, *flag_inputs)
     return out_r.reshape(re.shape), out_i.reshape(im.shape)
 
 
@@ -292,7 +309,7 @@ class _FusedBits:
 
 
 def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
-                    dtype, mats):
+                    dtype, mats, flags=None):
     kind = op[0]
     hi = lax.Precision.HIGHEST
     shape = r.shape
@@ -319,14 +336,17 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         _, phases = op
         dre = jnp.array(1.0, dtype)
         dim = jnp.array(0.0, dtype)
-        for sel_mask, phr, phi in phases:
+        for sel_mask, phr, phi, flag_ix in phases:
             sel = bf.bits_all_set(sel_mask)
+            if flag_ix >= 0:
+                # device-bit part of the mask, resolved per device
+                sel = jnp.logical_and(sel, flags[0, flag_ix] > 0.5)
             fr = jnp.where(sel, jnp.array(phr, dtype), jnp.array(1.0, dtype))
             fi = jnp.where(sel, jnp.array(phi, dtype), jnp.array(0.0, dtype))
             dre, dim = dre * fr - dim * fi, dre * fi + dim * fr
         return r * dre - i * dim, i * dre + r * dim
     if kind == "2x2":
-        _, t, m, ctrl_mask, perm_ix = op
+        _, t, m, ctrl_mask, perm_ix, flag_ix = op
         if t < lane_bits:
             perm = mats[perm_ix]
             pr, pi = lanemul(r, perm), lanemul(i, perm)
@@ -358,8 +378,10 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
             nr, ni = pr, pi
         else:
             nr, ni = _combine_2x2(r, i, pr, pi, bit, m)
-        if ctrl_mask:
+        if ctrl_mask or flag_ix >= 0:
             keep = bf.bits_all_set(ctrl_mask)
+            if flag_ix >= 0:
+                keep = jnp.logical_and(keep, flags[0, flag_ix] > 0.5)
             nr = jnp.where(keep, nr, r)
             ni = jnp.where(keep, ni, i)
         return nr, ni

@@ -12,14 +12,27 @@ overlapping there is fine.  This greedy reordering packs far more gates
 per pass than program order alone: in a random circuit most gates can
 slide into the current segment.
 
-The reference has no analogue — it executes strictly gate-at-a-time
-(QuEST/src/QuEST.c dispatch; SURVEY §7.3 flags this as the key idiomatic
-departure).
+Mesh scheduling (``schedule_mesh``) adds qubit relabeling on top: a
+logical->physical bit permutation is tracked, and a gate whose mixing
+target sits on a *device* bit (mesh coordinate) triggers a relayout that
+swaps that device bit with a cold local bit — a **half-chunk** ppermute
+exchange, amortised over every subsequent gate on that qubit.  The
+reference instead swaps the ENTIRE chunk on every high-qubit gate
+(exchangeStateVectors, QuEST_cpu_distributed.c:451-479) even though its
+own density path shows the half-exchange idea (:481-512); relabeling
+makes the exchange both half-sized and amortised.  Diagonal gates and
+control bits on device coordinates never communicate at all — they are
+resolved per-device into 0/1 flags (the reference evaluates control bits
+on global indices for the same reason, QuEST_cpu.c:1841, :2310).
+
+The reference has no scheduling analogue — it executes strictly
+gate-at-a-time (QuEST/src/QuEST.c dispatch; SURVEY §7.3 flags this as the
+key idiomatic departure).
 """
 
 from __future__ import annotations
 
-
+import bisect
 
 from .ops.pallas_kernels import (
     MAX_HIGH_BITS,
@@ -47,15 +60,12 @@ def _commutes(a, b) -> bool:
     return not (am & bsup) and not (bm & asup)
 
 
-def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
-                      row_budget: int = _ROW_BUDGET,
-                      max_high: int = MAX_HIGH_BITS):
-    """Partition ``ops`` (recorded Circuit ops) into fused segments.
-
-    Returns a list of (seg_ops, high_bits) where seg_ops is the tuple for
-    ``apply_fused_segment`` and high_bits the exposed high target qubits.
-    """
-    rows_bits = max(num_vec_bits - lane_bits, 0)
+def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
+                    row_budget: int, max_high: int):
+    """Partition ops (2x2 targets all < ``chunk_bits``; masks may include
+    bits >= chunk_bits, which become per-device flags) into fused
+    segments.  Returns a list of (seg_ops, high_bits, dev_masks)."""
+    rows_bits = max(chunk_bits - lane_bits, 0)
     low_row_bits = min(rows_bits, (row_budget >> max_high).bit_length() - 1)
     low_cov = lane_bits + low_row_bits  # 2x2 targets below this are "low"
 
@@ -77,9 +87,125 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
                 seg.append(op)
             else:
                 skipped.append(op)
-        segments.append((_plan_seg(seg, lane_bits), tuple(sorted(high))))
+        seg_ops, dev_masks = _plan_seg(seg, lane_bits, chunk_bits)
+        segments.append((seg_ops, tuple(sorted(high)), dev_masks))
         remaining = skipped
     return segments
+
+
+def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
+                      row_budget: int = _ROW_BUDGET,
+                      max_high: int = MAX_HIGH_BITS):
+    """Single-device scheduling: partition ``ops`` into fused segments.
+
+    Returns a list of (seg_ops, high_bits) where seg_ops is the tuple for
+    ``apply_fused_segment`` and high_bits the exposed high target qubits.
+    """
+    return [
+        (seg_ops, high)
+        for seg_ops, high, _ in _schedule_chunk(
+            ops, num_vec_bits, lane_bits, row_budget, max_high)
+    ]
+
+
+def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
+                  row_budget: int = _ROW_BUDGET,
+                  max_high: int = MAX_HIGH_BITS):
+    """Mesh scheduling with qubit relabeling.
+
+    Returns a plan: a list of
+      ("seg", seg_ops, high_bits, dev_masks) — one fused in-place pass
+        over each device's chunk; ``dev_masks`` are device-bit selection
+        masks resolved per device into the kernel's flag operand;
+      ("swap", phys_a, phys_b) — relayout exchanging global index bits
+        ``phys_a`` and ``phys_b`` (device<->local swaps cost a half-chunk
+        ppermute; local<->local swaps are comm-free).
+
+    The plan ends with relayouts restoring the canonical (identity)
+    layout, so the produced state is bit-compatible with every other
+    kernel and with amplitude access.
+    """
+    chunk_bits = num_vec_bits - dev_bits
+    pos = list(range(num_vec_bits))  # pos[logical qubit] = physical bit
+    inv = list(range(num_vec_bits))  # inv[physical bit] = logical qubit
+
+    # All future op indices where each logical qubit is a mixing target —
+    # victim choice below evicts the local bit with the farthest next use
+    # (Belady).
+    mix_uses: dict[int, list[int]] = {}
+    for i, (kind, statics, _s) in enumerate(ops):
+        if kind == "apply_2x2":
+            mix_uses.setdefault(statics[0], []).append(i)
+
+    def next_mix_use(q: int, i: int) -> int:
+        lst = mix_uses.get(q, ())
+        k = bisect.bisect_right(lst, i)
+        return lst[k] if k < len(lst) else len(ops) + q
+
+    def tr_mask(m: int) -> int:
+        out, q = 0, 0
+        while m:
+            if m & 1:
+                out |= 1 << pos[q]
+            m >>= 1
+            q += 1
+        return out
+
+    plan = []
+    pending = []
+
+    def flush():
+        if pending:
+            for seg in _schedule_chunk(pending, chunk_bits, lane_bits,
+                                       row_budget, max_high):
+                plan.append(("seg",) + seg)
+            pending.clear()
+
+    def do_swap(a: int, b: int):
+        flush()
+        plan.append(("swap", a, b))
+        qa, qb = inv[a], inv[b]
+        inv[a], inv[b] = qb, qa
+        pos[qa], pos[qb] = b, a
+
+    for i, op in enumerate(ops):
+        kind, statics, scalars = op
+        if kind == "apply_2x2" and pos[statics[0]] >= chunk_bits:
+            # bring the target's device bit local; evict the local bit
+            # whose logical qubit mixes farthest in the future (ties:
+            # prefer high row bits, keeping lanes free for matmul runs)
+            victim = max(
+                range(chunk_bits),
+                key=lambda p: (next_mix_use(inv[p], i), p),
+            )
+            do_swap(pos[statics[0]], victim)
+        if kind == "apply_2x2":
+            t, cm = statics
+            pending.append((kind, (pos[t], tr_mask(cm)), scalars))
+        else:
+            (sm,) = statics
+            pending.append((kind, (tr_mask(sm),), scalars))
+    flush()
+
+    # restore canonical layout, cycle by cycle.  Anchoring each cycle on a
+    # local member (when one exists) makes every emitted swap a
+    # device<->local HALF exchange — never a full-chunk device<->device
+    # swap — so an n-cycle costs (n-1)/2 chunk volumes.
+    visited: set[int] = set()
+    for p in range(num_vec_bits):
+        if p in visited or inv[p] == p:
+            continue
+        cyc = []
+        cur = p
+        while cur not in visited:
+            visited.add(cur)
+            cyc.append(cur)
+            cur = inv[cur]
+        local = [c for c in cyc if c < chunk_bits]
+        anchor = local[0] if local else cyc[0]
+        while inv[anchor] != anchor:
+            do_swap(anchor, inv[anchor])
+    return plan
 
 
 class _Group:
@@ -105,8 +231,9 @@ def _fold_groups(seg, lane_bits: int):
     Two group kinds: ``D`` collects diagonal phases (one combined-diagonal
     state pass regardless of count — in a Clifford+T stream half the
     gates land here), ``L`` collects lane-targeted 2x2 gates with lane
-    controls (one LxL matrix on the MXU).  Everything else is emitted in
-    place and raises the barriers of every earlier group.
+    controls and no device-bit participation (one LxL matrix on the MXU).
+    Everything else is emitted in place and raises the barriers of every
+    earlier group.
     """
     lanes = 1 << lane_bits
     out = []       # ops and _Group entries, in execution order
@@ -149,17 +276,35 @@ def _fold_groups(seg, lane_bits: int):
     return out
 
 
-def _plan_seg(seg, lane_bits: int):
+def _plan_seg(seg, lane_bits: int, chunk_bits: int):
     """Convert recorded ops to kernel seg-ops: phases fold into combined
     diagonal groups (one state pass each, regardless of count), lane 2x2
     runs compose into one LxL complex 'lanemm' matrix, and X-matrix gates
-    are tagged for the copy-only kernel path."""
+    are tagged for the copy-only kernel path.
+
+    Masks are split at ``chunk_bits``: the low part is evaluated in-kernel
+    over the chunk's index bits; the device part becomes an index into the
+    per-device flag operand (``dev_masks`` lists the interned masks).
+    Returns (seg_ops, dev_masks)."""
     lanes = 1 << lane_bits
+    chunk_mask = (1 << chunk_bits) - 1
+    dev_masks: list[int] = []
+
+    def flag_ix(mask: int) -> int:
+        dm = mask >> chunk_bits
+        if not dm:
+            return -1
+        if dm not in dev_masks:
+            dev_masks.append(dm)
+        return dev_masks.index(dm)
+
     out = []
     for entry in _fold_groups(seg, lane_bits):
         if isinstance(entry, _Group):
             if entry.kind == "D":
-                out.append(("diag", tuple(entry.items)))
+                out.append(("diag", tuple(
+                    (mask & chunk_mask, phr, phi, flag_ix(mask))
+                    for mask, phr, phi in entry.items)))
             else:
                 m = None
                 for target, scalars, ctrl_mask in entry.items:
@@ -169,5 +314,6 @@ def _plan_seg(seg, lane_bits: int):
             continue
         kind, statics, scalars = entry
         target, ctrl_mask = statics
-        out.append(("2x2", target, tuple(scalars), ctrl_mask))
-    return tuple(out)
+        out.append(("2x2", target, tuple(scalars), ctrl_mask & chunk_mask,
+                    flag_ix(ctrl_mask)))
+    return tuple(out), tuple(dev_masks)
