@@ -88,12 +88,22 @@ def _combine_2x2(r, i, pr, pi, bit, m):
 # ---------------------------------------------------------------------------
 
 #: Max number of arbitrary high qubits a fused segment can expose as
-#: dedicated block axes.
-MAX_HIGH_BITS = 3
+#: dedicated block axes.  Raising this trades contiguous-row block size
+#: (c_blk = _ROW_BUDGET >> k) for more adaptively-chosen high targets per
+#: pass; at 5 the DMA pieces are still 16 KB (c_blk=32 rows x 128 lanes x
+#: 4 B), measured at full stream rate on v5e.
+MAX_HIGH_BITS = 5
 
 #: Per-block row budget (rows x 128 lanes x 4 B x ~8 pipeline buffers
 #: must sit well inside the ~16 MB VMEM).
 _ROW_BUDGET = 1024
+
+#: MXU precision for the composed lane/row matrices.  Measured on v5e:
+#: a fused matmul's marginal cost is DMA/latency-bound, not MXU-pass
+#: bound (HIGHEST 4.2 ms vs DEFAULT 3.9 ms per real 128-dot over a 2^28
+#: state; Mosaic rejects HIGH), so full f32-accurate HIGHEST costs
+#: nothing worth trading away.
+_MAT_PRECISION = lax.Precision.HIGHEST
 
 
 def plan_fused_shapes(rows: int, lanes: int, high_row_bits: tuple[int, ...],
@@ -149,7 +159,8 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
                         interpret: bool = False, dev_flags=None):
     """One in-place pipelined HBM pass applying a run of gates whose 2x2
     targets are lane bits, low row bits (< log2(c_blk)), or one of up to
-    three arbitrary ``high_bits`` qubits (phases/controls: any bits).
+    ``MAX_HIGH_BITS`` arbitrary ``high_bits`` qubits (phases/controls:
+    any bits).
 
     This is the superset of ``apply_segment``: the reference needs one
     full state-vector sweep per gate and a rank-pair exchange per high
@@ -184,11 +195,27 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
         return len(mat_inputs) - 1
 
     planned = []
+
+    def add_mm(kind, mr, mi):
+        """Matmul operands: real-only matrices need 2 real dots; complex
+        ones use the Gauss 3-dot split (t3 = (r+i)(Mr+Mi)) instead of 4."""
+        if not mi.any():
+            return (kind, add_mat(mr), -1, -1)
+        return (kind, add_mat(mr), add_mat(mi), add_mat(mr + mi))
+
     for op in seg_ops:
         if op[0] == "lanemm":
             _, mr, mi = op
-            planned.append(("lanemm", add_mat(np.asarray(mr).T),
-                            add_mat(np.asarray(mi).T)))
+            planned.append(add_mm("lanemm", np.asarray(mr).T,
+                                  np.asarray(mi).T))
+        elif op[0] == "rowmm":
+            _, mr, mi = op
+            planned.append(add_mm("rowmm", np.asarray(mr),
+                                  np.asarray(mi)))
+        elif op[0] == "dtab":
+            _, tr, ti = op
+            planned.append(("dtab", add_mat(np.asarray(tr)),
+                            add_mat(np.asarray(ti))))
         elif op[0] == "2x2":
             _, t, m, ctrl_mask, flag_ix = op
             perm_ix = add_mat(_xor_perm(lanes, 1 << t)) \
@@ -240,8 +267,8 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
         io_ref[:] = i.reshape(block_shape)
 
     spec = pl.BlockSpec(block_shape, index_map)
-    mat_spec = pl.BlockSpec((lanes, lanes),
-                            lambda *g: (0,) * 2)
+    mat_specs = [pl.BlockSpec(m.shape, lambda *g: (0, 0))
+                 for m in mat_inputs]
     flag_inputs, flag_specs = (), []
     if n_flags:
         flag_inputs = (jnp.asarray(dev_flags, re.dtype),)
@@ -249,7 +276,7 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     out_r, out_i = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[spec, spec] + [mat_spec] * len(mat_inputs) + flag_specs,
+        in_specs=[spec, spec] + mat_specs + flag_specs,
         out_specs=[spec, spec],
         out_shape=[jax.ShapeDtypeStruct(dims, re.dtype)] * 2,
         input_output_aliases={0: 0, 1: 1},
@@ -311,7 +338,7 @@ class _FusedBits:
 def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
                     dtype, mats, flags=None):
     kind = op[0]
-    hi = lax.Precision.HIGHEST
+    hi = _MAT_PRECISION
     shape = r.shape
 
     def lanemul(x, m):
@@ -320,11 +347,57 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
                        preferred_element_type=dtype).reshape(shape)
 
     if kind == "lanemm":
-        _, mr_ix, mi_ix = op
-        mr, mi = mats[mr_ix], mats[mi_ix]
-        nr = lanemul(r, mr) - lanemul(i, mi)
-        ni = lanemul(r, mi) + lanemul(i, mr)
-        return nr, ni
+        _, mr_ix, mi_ix, ms_ix = op
+        mr = mats[mr_ix]
+        if mi_ix < 0:
+            return lanemul(r, mr), lanemul(i, mr)
+        t1 = lanemul(r, mr)
+        t2 = lanemul(i, mats[mi_ix])
+        t3 = lanemul(r + i, mats[ms_ix])
+        return t1 - t2, t3 - t1 - t2
+    if kind == "rowmm":
+        # Composed (R, R) complex matrix over the low row bits: one
+        # batched MXU contraction replaces a per-gate roll-select chain —
+        # the reference streams the state once per such gate
+        # (QuEST_cpu.c:1570-1628); here a whole run costs ~one matmul.
+        _, mr_ix, mi_ix, ms_ix = op
+        rr = mats[mr_ix].shape[0]
+        lead = 1
+        for d in shape[:-2]:
+            lead *= d
+        lead *= shape[-2] // rr
+        dn = (((2,), (1,)), ((0,), (0,)))
+
+        def rowmul(v, m_ix):
+            mb = jnp.broadcast_to(mats[m_ix], (lead, rr, rr))
+            w = v.reshape(lead, rr, shape[-1])
+            return lax.dot_general(mb, w, dn, precision=hi,
+                                   preferred_element_type=dtype)
+
+        if mi_ix < 0:
+            nr, ni = rowmul(r, mr_ix), rowmul(i, mr_ix)
+        else:
+            t1 = rowmul(r, mr_ix)
+            t2 = rowmul(i, mi_ix)
+            t3 = rowmul(r + i, ms_ix)
+            nr, ni = t1 - t2, t3 - t1 - t2
+        return nr.reshape(shape), ni.reshape(shape)
+    if kind == "dtab":
+        # Host-folded diagonal table over the (low-row x lane) field: an
+        # arbitrary RUN of diagonal phases whose masks live below the
+        # high/mid bits costs ONE complex elementwise multiply.
+        _, tr_ix, ti_ix = op
+        tr, ti = mats[tr_ix], mats[ti_ix]
+        rt = tr.shape[0]
+        view = shape[:-2] + (shape[-2] // rt, rt, shape[-1])
+        wr = r.reshape(view)
+        wi = i.reshape(view)
+        bshape = (1,) * (len(view) - 2) + (rt, shape[-1])
+        fr = tr.reshape(bshape)
+        fi = ti.reshape(bshape)
+        nr = wr * fr - wi * fi
+        ni = wr * fi + wi * fr
+        return nr.reshape(shape), ni.reshape(shape)
     if kind == "diag":
         # A folded RUN of diagonal phases: accumulate the combined complex
         # diagonal over broadcast-sized indicator shapes (a single-bit
@@ -347,16 +420,83 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         return r * dre - i * dim, i * dre + r * dim
     if kind == "2x2":
         _, t, m, ctrl_mask, perm_ix, flag_ix = op
+        if (t >= lane_bits) and (t - lane_bits) in high_axis:
+            # both halves of the exposed size-2 axis are in-register:
+            # apply the 2x2 directly on the sliced halves (no partner
+            # permutation, no bit select).  Controls that sit on OTHER
+            # exposed axes are handled by slicing those axes at 1 and
+            # rewriting only that subcube — no mask materialisation (the
+            # in-register analogue of the reference's global-index
+            # control tests, QuEST_cpu.c:1841, :2310).
+            axis = high_axis[t - lane_bits]
+            rem_mask = ctrl_mask
+            sl_axes = []
+            for hb, ax in high_axis.items():
+                g = 1 << (hb + lane_bits)
+                if (rem_mask & g) and ax != axis:
+                    sl_axes.append(ax)
+                    rem_mask &= ~g
+
+            def apply_2x2_on(r, i):
+                r0 = lax.index_in_dim(r, 0, axis, keepdims=True)
+                r1 = lax.index_in_dim(r, 1, axis, keepdims=True)
+                i0 = lax.index_in_dim(i, 0, axis, keepdims=True)
+                i1 = lax.index_in_dim(i, 1, axis, keepdims=True)
+                (ar, ai), (br, bi), (cr, ci), (dr, di) = m
+                if m == _X_MAT:
+                    n0r, n0i, n1r, n1i = r1, i1, r0, i0
+                else:
+                    def cmul2(e0r, e0i, e1r, e1i):
+                        """e0*x0 + e1*x1 (complex), skipping zero terms."""
+                        outr = outi = None
+
+                        def acc(o, term):
+                            return term if o is None else o + term
+
+                        if e0r != 0.0:
+                            outr = acc(outr, e0r * r0)
+                            outi = acc(outi, e0r * i0)
+                        if e0i != 0.0:
+                            outr = acc(outr, -e0i * i0)
+                            outi = acc(outi, e0i * r0)
+                        if e1r != 0.0:
+                            outr = acc(outr, e1r * r1)
+                            outi = acc(outi, e1r * i1)
+                        if e1i != 0.0:
+                            outr = acc(outr, -e1i * i1)
+                            outi = acc(outi, e1i * r1)
+                        zero = jnp.zeros_like(r0)
+                        return (zero if outr is None else outr,
+                                zero if outi is None else outi)
+
+                    n0r, n0i = cmul2(ar, ai, br, bi)
+                    n1r, n1i = cmul2(cr, ci, dr, di)
+                nr = jnp.concatenate([n0r, n1r], axis)
+                ni = jnp.concatenate([n0i, n1i], axis)
+                if rem_mask or flag_ix >= 0:
+                    keep = bf.bits_all_set(rem_mask)
+                    if flag_ix >= 0:
+                        keep = jnp.logical_and(keep, flags[0, flag_ix] > 0.5)
+                    nr = jnp.where(keep, nr, r)
+                    ni = jnp.where(keep, ni, i)
+                return nr, ni
+
+            def recurse(r, i, axes):
+                if not axes:
+                    return apply_2x2_on(r, i)
+                ax = axes[0]
+                r0 = lax.index_in_dim(r, 0, ax, keepdims=True)
+                r1 = lax.index_in_dim(r, 1, ax, keepdims=True)
+                i0 = lax.index_in_dim(i, 0, ax, keepdims=True)
+                i1 = lax.index_in_dim(i, 1, ax, keepdims=True)
+                nr1, ni1 = recurse(r1, i1, axes[1:])
+                return (jnp.concatenate([r0, nr1], ax),
+                        jnp.concatenate([i0, ni1], ax))
+
+            return recurse(r, i, sl_axes)
         if t < lane_bits:
             perm = mats[perm_ix]
             pr, pi = lanemul(r, perm), lanemul(i, perm)
-            bit = bf.bit(t)
-        elif (t - lane_bits) in high_axis:
-            # partner across a size-2 exposed axis: flip == roll by 1
-            # (Mosaic has no `rev` lowering)
-            axis = high_axis[t - lane_bits]
-            pr = pltpu.roll(r, 1, axis=axis)
-            pi = pltpu.roll(i, 1, axis=axis)
             bit = bf.bit(t)
         else:
             j = t - lane_bits

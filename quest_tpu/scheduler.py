@@ -34,11 +34,86 @@ from __future__ import annotations
 
 import bisect
 
+import numpy as np
+
 from .ops.pallas_kernels import (
     MAX_HIGH_BITS,
     _ROW_BUDGET,
     expand_gate,
 )
+
+#: Hadamard in the executor's ((re, im) x 4) tuple form (f64-exact).
+_H_M = ((0.7071067811865476, 0.0), (0.7071067811865476, 0.0),
+        (0.7071067811865476, 0.0), (-0.7071067811865476, 0.0))
+
+
+def normalize_diag(ops):
+    """Rewrite diagonal 2x2 gates (Rz, Z/S/T recorded as unitaries, any
+    controlled diagonal) into apply_phase ops.
+
+    diag(a, d) on target t with control mask c == phase a on c, then
+    phase d/a on c|t.  Phases are diagonal, so they fold into combined
+    diagonal groups at near-zero kernel cost and — under a mesh — never
+    trigger a relayout, matching the reference's "diagonal gates never
+    communicate" property (SURVEY §2.2, QuEST_cpu.c:2666-3010).
+    """
+    out = []
+    for op in ops:
+        kind, statics, scalars = op
+        if kind == "apply_2x2":
+            (ar, ai), (br, bi), (cr, ci), (dr, di) = scalars
+            if br == bi == cr == ci == 0.0:
+                t, cm = statics
+                a = complex(ar, ai)
+                d = complex(dr, di)
+                if a == 0.0:
+                    # non-unitary diagonal (e.g. a projector recorded via
+                    # Circuit.unitary, which skips unitarity validation):
+                    # not expressible as phases — keep the generic 2x2.
+                    out.append(op)
+                    continue
+                if a != 1.0:
+                    out.append(("apply_phase", (cm,), (ar, ai)))
+                rel = d / a
+                out.append(("apply_phase", (cm | (1 << t),),
+                            (rel.real, rel.imag)))
+                continue
+        out.append(op)
+    return out
+
+
+def _normalize_cx(ops, lane_bits: int, low_row_bits: int):
+    """Rewrite controlled-X with a low (lane/row-field) target and a
+    CROSS-field control as H . CZ . H: the H's are uncontrolled and fold
+    into the composed lane/row matrices, and CZ is a free diagonal — so
+    such a CNOT no longer needs the per-gate elementwise fallback.
+
+    Same-field-controlled X (control and target both lane, or both low
+    row) folds whole into its field matrix and is kept as-is; so are
+    high-target CNOTs, which keep the X partner-copy fast path (the
+    analogue of the reference's dedicated controlledNot kernel,
+    QuEST_cpu.c:2273)."""
+    lanes = 1 << lane_bits
+    row_field = ((1 << low_row_bits) - 1) << lane_bits
+    low_cov = lane_bits + low_row_bits
+    out = []
+    for op in ops:
+        kind, statics, scalars = op
+        if kind == "apply_2x2":
+            t, cm = statics
+            (ar, ai), (br, bi), (cr, ci), (dr, di) = scalars
+            in_field = (cm < lanes) if t < lane_bits \
+                else (cm & ~row_field) == 0
+            if (cm and t < low_cov and not in_field
+                    and ar == ai == dr == di == 0.0
+                    and br == 1.0 and bi == 0.0
+                    and cr == 1.0 and ci == 0.0):
+                out.append(("apply_2x2", (t, 0), _H_M))
+                out.append(("apply_phase", (cm | (1 << t),), (-1.0, 0.0)))
+                out.append(("apply_2x2", (t, 0), _H_M))
+                continue
+        out.append(op)
+    return out
 
 
 def _op_sets(op):
@@ -69,7 +144,7 @@ def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
     low_row_bits = min(rows_bits, (row_budget >> max_high).bit_length() - 1)
     low_cov = lane_bits + low_row_bits  # 2x2 targets below this are "low"
 
-    remaining = list(ops)
+    remaining = _normalize_cx(ops, lane_bits, low_row_bits)
     segments = []
     while remaining:
         seg, high, skipped = [], [], []
@@ -87,7 +162,8 @@ def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
                 seg.append(op)
             else:
                 skipped.append(op)
-        seg_ops, dev_masks = _plan_seg(seg, lane_bits, chunk_bits)
+        seg_ops, dev_masks = _plan_seg(seg, lane_bits, chunk_bits,
+                                       low_row_bits)
         segments.append((seg_ops, tuple(sorted(high)), dev_masks))
         remaining = skipped
     return segments
@@ -104,7 +180,8 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
     return [
         (seg_ops, high)
         for seg_ops, high, _ in _schedule_chunk(
-            ops, num_vec_bits, lane_bits, row_budget, max_high)
+            normalize_diag(ops), num_vec_bits, lane_bits, row_budget,
+            max_high)
     ]
 
 
@@ -125,6 +202,7 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     layout, so the produced state is bit-compatible with every other
     kernel and with amplitude access.
     """
+    ops = normalize_diag(ops)
     chunk_bits = num_vec_bits - dev_bits
     pos = list(range(num_vec_bits))  # pos[logical qubit] = physical bit
     inv = list(range(num_vec_bits))  # inv[physical bit] = logical qubit
@@ -225,17 +303,19 @@ class _Group:
         self.items = []
 
 
-def _fold_groups(seg, lane_bits: int):
+def _fold_groups(seg, lane_bits: int, low_row_bits: int):
     """Slide ops backward into the earliest compatible composition group.
 
-    Two group kinds: ``D`` collects diagonal phases (one combined-diagonal
-    state pass regardless of count — in a Clifford+T stream half the
-    gates land here), ``L`` collects lane-targeted 2x2 gates with lane
-    controls and no device-bit participation (one LxL matrix on the MXU).
-    Everything else is emitted in place and raises the barriers of every
-    earlier group.
+    Three group kinds: ``D`` collects diagonal phases (one combined-
+    diagonal state pass regardless of count — in a Clifford+T stream half
+    the gates land here), ``L`` collects lane-targeted 2x2 gates with
+    lane controls (one LxL matrix on the MXU), ``R`` collects low-row-
+    targeted 2x2 gates with low-row controls (one RxR matrix contracted
+    over the row axis).  Everything else is emitted in place and raises
+    the barriers of every earlier group.
     """
     lanes = 1 << lane_bits
+    row_field = ((1 << low_row_bits) - 1) << lane_bits
     out = []       # ops and _Group entries, in execution order
     groups = []    # same _Group objects, creation order
 
@@ -269,6 +349,10 @@ def _fold_groups(seg, lane_bits: int):
         if target < lane_bits and ctrl_mask < lanes:
             join("L", mix, sup, (target, scalars, ctrl_mask))
             continue
+        if (mix & row_field) and (ctrl_mask & ~row_field) == 0:
+            join("R", mix, sup,
+                 (target - lane_bits, scalars, ctrl_mask >> lane_bits))
+            continue
         out.append(op)
         for g in groups:
             g.bar_mix |= mix
@@ -276,17 +360,34 @@ def _fold_groups(seg, lane_bits: int):
     return out
 
 
-def _plan_seg(seg, lane_bits: int, chunk_bits: int):
+def _compose(items, dim: int):
+    """Dense (dim, dim) complex matrix of a gate run, in program order."""
+    m = None
+    for target, scalars, ctrl_mask in items:
+        g = expand_gate(dim, target, scalars, ctrl_mask)
+        m = g if m is None else g @ m
+    return m
+
+
+def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int):
     """Convert recorded ops to kernel seg-ops: phases fold into combined
-    diagonal groups (one state pass each, regardless of count), lane 2x2
-    runs compose into one LxL complex 'lanemm' matrix, and X-matrix gates
-    are tagged for the copy-only kernel path.
+    diagonal groups, lane/low-row 2x2 runs compose into one LxL / RxR
+    complex matrix ('lanemm' / 'rowmm'), and X-matrix gates are tagged
+    for the copy-only kernel path.
+
+    A diagonal group's entries whose masks sit entirely inside the
+    (low-row x lane) field are further folded ON THE HOST into one
+    (R, lanes) complex table ('dtab') — an arbitrary run of Z/S/T/Rz/
+    controlled-phase gates then costs a single elementwise multiply.
+    Entries touching mid/high/device bits stay per-entry in a 'diag' op.
 
     Masks are split at ``chunk_bits``: the low part is evaluated in-kernel
     over the chunk's index bits; the device part becomes an index into the
     per-device flag operand (``dev_masks`` lists the interned masks).
     Returns (seg_ops, dev_masks)."""
     lanes = 1 << lane_bits
+    nrow = 1 << low_row_bits
+    low_mask = lanes * nrow - 1
     chunk_mask = (1 << chunk_bits) - 1
     dev_masks: list[int] = []
 
@@ -299,18 +400,48 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int):
         return dev_masks.index(dm)
 
     out = []
-    for entry in _fold_groups(seg, lane_bits):
+    for entry in _fold_groups(seg, lane_bits, low_row_bits):
         if isinstance(entry, _Group):
             if entry.kind == "D":
-                out.append(("diag", tuple(
-                    (mask & chunk_mask, phr, phi, flag_ix(mask))
-                    for mask, phr, phi in entry.items)))
-            else:
-                m = None
-                for target, scalars, ctrl_mask in entry.items:
-                    g = expand_gate(lanes, target, scalars, ctrl_mask)
-                    m = g if m is None else g @ m
+                folded = [it for it in entry.items
+                          if (it[0] & ~low_mask) == 0]
+                rest = [it for it in entry.items
+                        if (it[0] & ~low_mask) != 0]
+                if folded:
+                    tab = np.ones((nrow, lanes), dtype=np.complex128)
+                    lane_ix = np.arange(lanes)
+                    row_ix = np.arange(nrow)
+                    for mask, phr, phi in folded:
+                        lm = mask & (lanes - 1)
+                        rm = mask >> lane_bits
+                        lsel = (lane_ix & lm) == lm
+                        rsel = (row_ix & rm) == rm
+                        tab[np.ix_(rsel, lsel)] *= complex(phr, phi)
+                    out.append(("dtab", tab.real.copy(), tab.imag.copy()))
+                if rest:
+                    out.append(("diag", tuple(
+                        (mask & chunk_mask, phr, phi, flag_ix(mask))
+                        for mask, phr, phi in rest)))
+            elif entry.kind == "L":
+                if len(entry.items) == 1:
+                    # a lone lane gate is cheaper as the per-gate
+                    # xor-permutation path than a composed 4-dot matmul
+                    target, scalars, ctrl_mask = entry.items[0]
+                    out.append(("2x2", target, tuple(scalars), ctrl_mask,
+                                -1))
+                    continue
+                m = _compose(entry.items, lanes)
                 out.append(("lanemm", m.real.copy(), m.imag.copy()))
+            else:  # "R"
+                if len(entry.items) <= 2:
+                    # small row runs: per-gate roll-select beats the
+                    # batched K=R matmul (measured ~1 ms vs ~7 ms on v5e)
+                    for rt, scalars, rcm in entry.items:
+                        out.append(("2x2", rt + lane_bits, tuple(scalars),
+                                    rcm << lane_bits, -1))
+                    continue
+                m = _compose(entry.items, nrow)
+                out.append(("rowmm", m.real.copy(), m.imag.copy()))
             continue
         kind, statics, scalars = entry
         target, ctrl_mask = statics

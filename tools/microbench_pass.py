@@ -1,0 +1,94 @@
+"""Per-pass cost breakdown of the fused Pallas executor on the real chip.
+
+Times a single apply_fused_segment pass with controlled content at the
+bench size (default 28 qubits to keep runs quick; 30 for the real thing)
+to locate where time goes: HBM stream floor, diag groups, lane matmuls at
+each precision, row-bit roll-selects, exposed-high-axis ops.
+"""
+
+import os
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from quest_tpu.ops.lattice import state_shape
+from quest_tpu.scheduler import schedule_segments
+from quest_tpu import models
+
+N = int(os.environ.get("MB_QUBITS", "28"))
+INNER = int(os.environ.get("MB_INNER", "4"))
+REPS = 3
+
+
+def timed(label, seg_ops, high=(), extra_fn=None):
+    shape = state_shape(1 << N)
+
+    def body(re, im):
+        if extra_fn is not None:
+            return extra_fn(re, im)
+        return apply_fused_segment(re, im, seg_ops, high)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, INNER, lambda _, s: body(*s), (re, im))
+
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    re, im = run(re, im)
+    jax.block_until_ready((re, im))
+    float(re[0, 0])
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times.append((time.perf_counter() - t0) / INNER)
+    best = min(times)
+    gib = 2 * (1 << N) * 4 / 2**30
+    print(f"{label:36s} {best*1e3:8.2f} ms/pass   {2*gib/best:7.1f} GB/s-equiv")
+    return best
+
+
+H = ((0.7071067811865476, 0.0), (0.7071067811865476, 0.0),
+     (0.7071067811865476, 0.0), (-0.7071067811865476, 0.0))
+X = ((0.0, 0.0), (1.0, 0.0), (1.0, 0.0), (0.0, 0.0))
+
+lanes = 128
+
+
+def lanemm_op():
+    from quest_tpu.ops.pallas_kernels import expand_gate
+    m = None
+    for t in range(7):
+        g = expand_gate(lanes, t, H, 0)
+        m = g if m is None else g @ m
+    return ("lanemm", m.real.copy(), m.imag.copy())
+
+
+print(f"n={N} f32, state {2*(1<<N)*4/2**30:.1f} GiB, backend={jax.default_backend()}")
+
+timed("empty (HBM floor)", ())
+timed("1 diag entry", (("diag", ((1 << 3, 0.9, 0.1, -1),)),))
+timed("8 diag entries", (("diag", tuple((1 << k, 0.9, 0.1, -1) for k in range(8)),),))
+timed("1 lanemm (7 H composed)", (lanemm_op(),))
+timed("1 lane 2x2 (xor-perm matmul)", (("2x2", 3, H, 0, -1),))
+timed("1 row 2x2 (roll-select)", (("2x2", 10, H, 0, -1),))
+timed("4 row 2x2", tuple(("2x2", 8 + k, H, 0, -1) for k in range(4)))
+timed("1 row CNOT (X fast path)", (("2x2", 10, X, 1 << 2, -1),))
+timed("1 high 2x2 (exposed axis)", (("2x2", N - 1, H, 0, -1),), high=(N - 1,))
+timed("3 high 2x2", tuple(("2x2", N - 1 - k, H, 0, -1) for k in range(3)),
+      high=(N - 3, N - 2, N - 1))
+
+# the real bench segments
+circ = models.random_circuit(N, depth=8, seed=123)
+segs = schedule_segments(list(circ.ops), N, lane_bits=7)
+tot = 0.0
+for i, (seg_ops, high) in enumerate(segs):
+    tot += timed(f"bench seg {i} ({len(seg_ops)} ops)", seg_ops, high)
+print(f"total {tot*1e3:.1f} ms for {circ.num_gates} gates "
+      f"-> {circ.num_gates/tot:.1f} gates/s")

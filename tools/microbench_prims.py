@@ -1,0 +1,230 @@
+"""Probe Mosaic lowering/cost of candidate kernel primitives at 28q.
+
+Each variant runs as a single pallas_call over the full state inside an
+INNER-times chained jit (overhead-corrected), printing ms/pass deltas vs
+the empty pass.
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 28
+ROWS = (1 << N) // 128
+GIB = 2 * (1 << N) * 4 / 2**30
+INNER = 16
+K = 5                 # exposed hi bits
+C_BLK = 1024 >> K     # 32 rows
+HI = 1 << K
+
+# value shape in kernel: (HI, C_BLK, 128) == block (2,)*K + (C_BLK, 128)
+DIMS = (2,) * K + (ROWS // (HI * C_BLK) * C_BLK, 128)
+# simple: expose TOP k bits; low field = rest
+LOW = ROWS // HI  # rows in low field
+BLOCK = (2,) * K + (C_BLK, 128)
+GRID = (LOW // C_BLK,)
+
+
+def run_kernel(label, kern, extra_inputs=(), extra_specs=()):
+    spec = pl.BlockSpec(BLOCK, lambda i: (0,) * K + (i, 0))
+
+    def body(re, im):
+        r = pl.pallas_call(
+            kern,
+            grid=GRID,
+            in_specs=[spec, spec] + list(extra_specs),
+            out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((2,) * K + (LOW, 128),
+                                            jnp.float32)] * 2,
+            input_output_aliases={0: 0, 1: 1},
+        )(re.reshape((2,) * K + (LOW, 128)),
+          im.reshape((2,) * K + (LOW, 128)), *extra_inputs)
+        return r[0].reshape(ROWS, 128), r[1].reshape(ROWS, 128)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, INNER, lambda _, s: body(*s), (re, im))
+
+    try:
+        re = jnp.zeros((ROWS, 128), jnp.float32).at[0, 0].set(1.0)
+        im = jnp.zeros((ROWS, 128), jnp.float32)
+        re, im = run(re, im)
+        float(jnp.sum(re[:1]))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            re, im = run(re, im)
+            float(jnp.sum(re[:1]))
+            ts.append(time.perf_counter() - t0)
+        best = (min(ts) * 1e3 - 90) / INNER
+        print(f"{label:52s} {best:7.2f} ms/pass")
+    except Exception as e:
+        print(f"{label:52s} FAILED: {type(e).__name__}: {str(e)[:140]}")
+
+
+def k_empty(re_ref, im_ref, ro, io):
+    ro[:] = re_ref[:] * 1.0000001
+    io[:] = im_ref[:] * 1.0000001
+
+
+run_kernel("empty", k_empty)
+
+VS = (HI, C_BLK, 128)
+
+
+def k_slice_hi(re_ref, im_ref, ro, io):
+    """One uncontrolled H on the top hi bit via leading-axis halves."""
+    r = re_ref[:].reshape(VS)
+    i = im_ref[:].reshape(VS)
+    h = HI // 2
+    s = 0.70710678
+    r0, r1 = r[:h], r[h:]
+    i0, i1 = i[:h], i[h:]
+    nr = jnp.concatenate([s * (r0 + r1), s * (r0 - r1)], axis=0)
+    ni = jnp.concatenate([s * (i0 + i1), s * (i0 - i1)], axis=0)
+    ro[:] = nr.reshape(BLOCK)
+    io[:] = ni.reshape(BLOCK)
+
+
+run_kernel("1 hi H via leading-slice concat", k_slice_hi)
+
+
+def k_slice_hi5(re_ref, im_ref, ro, io):
+    """5 uncontrolled H's, one per hi bit, sequential slice-combine."""
+    r = re_ref[:].reshape(VS)
+    i = im_ref[:].reshape(VS)
+    s = 0.70710678
+    for b in range(K):
+        sh = (HI >> (b + 1), 2, (1 << b) * C_BLK, 128)
+        r2 = r.reshape(sh)
+        i2 = i.reshape(sh)
+        r0 = r2[:, 0]
+        r1 = r2[:, 1]
+        i0 = i2[:, 0]
+        i1 = i2[:, 1]
+        r = jnp.stack([s * (r0 + r1), s * (r0 - r1)], axis=1).reshape(VS)
+        i = jnp.stack([s * (i0 + i1), s * (i0 - i1)], axis=1).reshape(VS)
+    ro[:] = r.reshape(BLOCK)
+    io[:] = i.reshape(BLOCK)
+
+
+run_kernel("5 hi H via per-bit slice/stack", k_slice_hi5)
+
+# rowmm variants: composed (C_BLK x C_BLK) complex matrix over the row axis
+rng = np.random.RandomState(0)
+Mr = jnp.asarray(rng.randn(C_BLK, C_BLK).astype(np.float32))
+Mi = jnp.asarray(rng.randn(C_BLK, C_BLK).astype(np.float32))
+mspec = pl.BlockSpec((C_BLK, C_BLK), lambda i: (0, 0))
+
+
+def k_rowmm_batched(re_ref, im_ref, mr_ref, mi_ref, ro, io):
+    r = re_ref[:].reshape(VS)
+    i = im_ref[:].reshape(VS)
+    mr, mi = mr_ref[:], mi_ref[:]
+    mrb = jnp.broadcast_to(mr, (HI, C_BLK, C_BLK))
+    mib = jnp.broadcast_to(mi, (HI, C_BLK, C_BLK))
+    dn = (((2,), (1,)), ((0,), (0,)))
+    hi = jax.lax.Precision.HIGHEST
+
+    def bmm(m, v):
+        return jax.lax.dot_general(m, v, dn, precision=hi,
+                                   preferred_element_type=jnp.float32)
+
+    nr = bmm(mrb, r) - bmm(mib, i)
+    ni = bmm(mrb, i) + bmm(mib, r)
+    ro[:] = nr.reshape(BLOCK)
+    io[:] = ni.reshape(BLOCK)
+
+
+run_kernel("rowmm batched dot_general (HIGHEST)", k_rowmm_batched,
+           (Mr, Mi), (mspec, mspec))
+
+
+def k_rowmm_unrolled(re_ref, im_ref, mr_ref, mi_ref, ro, io):
+    r = re_ref[:].reshape(VS)
+    i = im_ref[:].reshape(VS)
+    mr, mi = mr_ref[:], mi_ref[:]
+    hi = jax.lax.Precision.HIGHEST
+
+    def mm(m, v):
+        return jnp.dot(m, v, precision=hi,
+                       preferred_element_type=jnp.float32)
+
+    nrs, nis = [], []
+    for h in range(HI):
+        nrs.append(mm(mr, r[h]) - mm(mi, i[h]))
+        nis.append(mm(mr, i[h]) + mm(mi, r[h]))
+    nr = jnp.stack(nrs, axis=0)
+    ni = jnp.stack(nis, axis=0)
+    ro[:] = nr.reshape(BLOCK)
+    io[:] = ni.reshape(BLOCK)
+
+
+run_kernel("rowmm 32 unrolled 2D dots (HIGHEST)", k_rowmm_unrolled,
+           (Mr, Mi), (mspec, mspec))
+
+# diag tables
+tl = jnp.asarray(rng.randn(1, 128).astype(np.float32))
+tr_ = jnp.asarray(rng.randn(C_BLK, 1).astype(np.float32))
+tlspec = pl.BlockSpec((1, 128), lambda i: (0, 0))
+trspec = pl.BlockSpec((C_BLK, 1), lambda i: (0, 0))
+
+
+def k_diag_tables(re_ref, im_ref, tl_ref, tr_ref, ro, io):
+    r = re_ref[:].reshape(VS)
+    i = im_ref[:].reshape(VS)
+    fl = tl_ref[:].reshape(1, 1, 128)
+    fr = tr_ref[:].reshape(1, C_BLK, 1)
+    # complex-ish: two real table mults each on re and im (4 mults)
+    ro[:] = (r * fl * fr).reshape(BLOCK)
+    io[:] = (i * fl * fr).reshape(BLOCK)
+
+
+run_kernel("lane+row diag tables", k_diag_tables,
+           (tl, tr_), (tlspec, trspec))
+
+# current-style roll-select row gate for comparison, at this block shape
+
+
+def k_roll_row(re_ref, im_ref, ro, io):
+    r = re_ref[:].reshape(VS)
+    i = im_ref[:].reshape(VS)
+    s = 8
+    up_r = pltpu.roll(r, C_BLK - s, axis=1)
+    dn_r = pltpu.roll(r, s, axis=1)
+    up_i = pltpu.roll(i, C_BLK - s, axis=1)
+    dn_i = pltpu.roll(i, s, axis=1)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, C_BLK, 1), 1)
+    bit = (iota >> 3) & 1
+    sel0 = bit == 0
+    pr = jnp.where(sel0, up_r, dn_r)
+    pi = jnp.where(sel0, up_i, dn_i)
+    c = 0.70710678
+    nr = c * jnp.where(sel0, r + pr, pr - r)
+    ni = c * jnp.where(sel0, i + pi, pi - i)
+    ro[:] = nr.reshape(BLOCK)
+    io[:] = ni.reshape(BLOCK)
+
+
+run_kernel("1 row H via roll-select (current style)", k_roll_row)
+
+
+def k_slice_row(re_ref, im_ref, ro, io):
+    """Row-bit H via sublane-dim slice (s=8 -> aligned)."""
+    r = re_ref[:].reshape(HI, C_BLK // 16, 2, 8, 128)
+    i = im_ref[:].reshape(HI, C_BLK // 16, 2, 8, 128)
+    s = 0.70710678
+    r0, r1 = r[:, :, 0], r[:, :, 1]
+    i0, i1 = i[:, :, 0], i[:, :, 1]
+    nr = jnp.stack([s * (r0 + r1), s * (r0 - r1)], axis=2)
+    ni = jnp.stack([s * (i0 + i1), s * (i0 - i1)], axis=2)
+    ro[:] = nr.reshape(BLOCK)
+    io[:] = ni.reshape(BLOCK)
+
+
+run_kernel("1 row H via sublane slice/stack (s=8)", k_slice_row)

@@ -1,0 +1,126 @@
+"""Raw HBM streaming probes: where does the 10x bandwidth gap come from?
+
+Compares XLA-native elementwise copy against pallas_call variants: block
+size, grid dimensionality, dimension_semantics, aliasing.
+"""
+
+import os
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = int(os.environ.get("MB_QUBITS", "28"))
+INNER = int(os.environ.get("MB_INNER", "4"))
+ROWS = (1 << N) // 128
+GIB = 2 * (1 << N) * 4 / 2**30  # re+im
+
+
+def timed(label, body):
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, INNER, lambda _, s: body(*s), (re, im))
+
+    re = jnp.zeros((ROWS, 128), jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros((ROWS, 128), jnp.float32)
+    re, im = run(re, im)
+    jax.block_until_ready((re, im))
+    float(re[0, 0])
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times.append((time.perf_counter() - t0) / INNER)
+    best = min(times)
+    print(f"{label:44s} {best*1e3:8.2f} ms/pass  {2*GIB/best:7.1f} GB/s")
+
+
+print(f"n={N}, {GIB:.1f} GiB state, backend={jax.default_backend()}")
+
+# XLA native elementwise (read+write both arrays)
+timed("xla: re,im = re*1.0000001, im*1.0000001",
+      lambda re, im: (re * 1.0000001, im * 1.0000001))
+
+
+def pallas_stream(block_rows, semantics=None, alias=True, scale=1.0000001):
+    def kern(re_ref, im_ref, ro_ref, io_ref):
+        ro_ref[:] = re_ref[:] * scale
+        io_ref[:] = im_ref[:] * scale
+
+    grid = (ROWS // block_rows,)
+    spec = pl.BlockSpec((block_rows, 128), lambda i: (i, 0))
+    kwargs = {}
+    if semantics is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=semantics)
+
+    def body(re, im):
+        return pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[spec, spec],
+            out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((ROWS, 128), jnp.float32)] * 2,
+            input_output_aliases={0: 0, 1: 1} if alias else {},
+            **kwargs,
+        )(re, im)
+
+    return body
+
+
+for br in (1024, 4096, 16384):
+    timed(f"pallas 1D grid, block {br} rows, aliased", pallas_stream(br))
+timed("pallas 1D grid, block 4096, parallel sem",
+      pallas_stream(4096, semantics=("parallel",)))
+timed("pallas 1D grid, block 4096, arbitrary sem",
+      pallas_stream(4096, semantics=("arbitrary",)))
+timed("pallas 1D grid, block 4096, NO alias", pallas_stream(4096, alias=False))
+
+
+def pallas_multidim(k, block_rows=128):
+    """Mimic the fused executor's shape: k exposed size-2 axes at high bits."""
+    row_bits = N - 7
+    dims = []
+    block_shape = []
+    # top fields: bit (row_bits-1) down: expose top k bits as size-2
+    dims_grid = []
+    for _ in range(k):
+        dims.append(2)
+        block_shape.append(2)
+    rest = ROWS >> k
+    dims.append(rest)
+    block_shape.append(block_rows)
+    dims.append(128)
+    block_shape.append(128)
+    grid = (rest // block_rows,)
+
+    def index_map(i):
+        return (0,) * k + (i, 0)
+
+    def kern(re_ref, im_ref, ro_ref, io_ref):
+        ro_ref[:] = re_ref[:] * 1.0000001
+        io_ref[:] = im_ref[:] * 1.0000001
+
+    spec = pl.BlockSpec(tuple(block_shape), index_map)
+
+    def body(re, im):
+        r = pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[spec, spec],
+            out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct(tuple(dims), jnp.float32)] * 2,
+            input_output_aliases={0: 0, 1: 1},
+        )(re.reshape(dims), im.reshape(dims))
+        return r[0].reshape(ROWS, 128), r[1].reshape(ROWS, 128)
+
+    return body
+
+
+timed("pallas k=3 size-2 axes in block, 128 rows", pallas_multidim(3, 128))
+timed("pallas k=3 size-2 axes in block, 512 rows", pallas_multidim(3, 512))

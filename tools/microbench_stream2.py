@@ -1,0 +1,72 @@
+"""Isolate the bandwidth limiter: reads vs writes vs aliasing vs loop."""
+
+import os
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+N = int(os.environ.get("MB_QUBITS", "28"))
+ROWS = (1 << N) // 128
+GIB1 = (1 << N) * 4 / 2**30  # one array
+
+dev = jax.devices()[0]
+print(dev, dev.device_kind, getattr(dev, "memory_stats", lambda: {})())
+
+
+def bench(label, fn, *args, gib_moved=1.0, reps=5, donate=()):
+    jfn = jax.jit(fn, donate_argnums=donate)
+    out = jfn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        # when donating, refresh args each reps iteration is impossible;
+        # instead donate-free by default
+        t0 = time.perf_counter()
+        out = jfn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    print(f"{label:46s} {best*1e3:8.2f} ms  {gib_moved/best:7.1f} GB/s")
+
+
+re = jnp.zeros((ROWS, 128), jnp.float32).at[0, 0].set(1.0)
+im = jnp.zeros((ROWS, 128), jnp.float32)
+
+bench("read-only: jnp.sum(re)", lambda x: jnp.sum(x), re, gib_moved=GIB1)
+bench("read-only: sum(re)+sum(im)", lambda x, y: jnp.sum(x) + jnp.sum(y),
+      re, im, gib_moved=2 * GIB1)
+bench("write-mostly: broadcast fill",
+      lambda: jnp.full((ROWS, 128), 1.5, jnp.float32), gib_moved=GIB1)
+bench("copy: re*1.0000001 (no donate)", lambda x: x * 1.0000001, re,
+      gib_moved=2 * GIB1)
+
+# single pass without fori_loop, with donation
+
+
+def one_pass():
+    @partial(jax.jit, donate_argnums=(0,))
+    def f(x):
+        return x * 1.0000001
+
+    x = jnp.zeros((ROWS, 128), jnp.float32)
+    x = f(x)
+    jax.block_until_ready(x)
+    times = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        x = f(x)
+        jax.block_until_ready(x)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    print(f"{'donated single-array copy':46s} {best*1e3:8.2f} ms  "
+          f"{2*GIB1/best:7.1f} GB/s")
+
+
+one_pass()
+
+# bf16 variant: halves bytes
+reb = re.astype(jnp.bfloat16)
+bench("bf16 copy (no donate)", lambda x: x * jnp.bfloat16(1.0),
+      reb, gib_moved=GIB1)
