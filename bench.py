@@ -5,10 +5,20 @@ Clifford+rotation circuit (shape of /root/reference/tutorial_example.c:
 667 gates, "estimated time: 3783.93 s" in the file header, :1-3) — run as
 one fused XLA program in f32.
 
-Prints ONE JSON line: gate-ops/sec at the benchmark qubit count.
-``vs_baseline`` is measured throughput over the reference driver's own
-in-repo number (667 gates / 3783.93 s = 0.1763 gates/s — the only
-performance figure the reference ships; see BASELINE.md).
+Prints ONE JSON line.  Headline value is gate-ops/sec; the auditable
+context fields (BASELINE.md targets) are:
+
+- ``gates_per_pass``: scheduled fused-segment density (the reference
+  streams the whole state once per gate; here once per segment).
+- ``hbm_gbps`` / ``roofline_frac``: achieved HBM stream rate over the
+  per-pass read+write traffic, against the chip's spec bandwidth.
+- ``a100_equiv_gates_per_sec`` / ``vs_a100``: what gate-at-a-time
+  QuEST-GPU could do at best on a single A100 (HBM-roofline bound:
+  every gate streams the full state once, read+write), and our
+  multiple of it.  BASELINE.md's target is >= 1.5x.
+- ``vs_baseline``: measured throughput over the reference driver's own
+  in-repo number (667 gates / 3783.93 s = 0.1763 gates/s — the only
+  performance figure the reference ships; see BASELINE.md).
 
 Env overrides: QUEST_BENCH_QUBITS (default 30, auto-falls back on OOM),
 QUEST_BENCH_DEPTH (default 8 layers -> 8*n gates), QUEST_BENCH_REPS.
@@ -18,6 +28,22 @@ import json
 import os
 import sys
 import time
+
+#: Spec HBM bandwidth (bytes/s) by device kind; conservative fall-back
+#: for unknown kinds.  v5e ("TPU v5 lite"): 819 GB/s.  Matched by the
+#: longest prefix, so "TPU v5p" wins over "TPU v5".
+_HBM_SPEC = {
+    "TPU v5 lite": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v5": 1228e9,
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,
+}
+
+#: A100-80GB HBM bandwidth: the per-chip comparison target in
+#: BASELINE.md (QuEST-GPU is gate-at-a-time, so its throughput ceiling
+#: is one full-state read+write per gate at this rate).
+_A100_BW = 2039e9
 
 
 def run(num_qubits: int, depth: int, reps: int, inner: int):
@@ -30,11 +56,18 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
     circ = models.random_circuit(num_qubits, depth=depth, seed=123)
     # The fused Pallas kernels lower natively only on TPU; other
     # accelerators would need interpret mode, where the XLA path is faster.
-    apply = circ.as_fused_fn() if jax.default_backend() == "tpu" \
-        else circ.as_fn(mesh=None)
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        from quest_tpu.scheduler import schedule_segments
+
+        apply = circ.as_fused_fn()
+        n_passes = len(schedule_segments(list(circ.ops), num_qubits))
+    else:
+        apply = circ.as_fn(mesh=None)
+        n_passes = circ.num_gates  # gate-at-a-time XLA path
     shape = state_shape(1 << num_qubits)
 
-    # The dispatch round trip to a remote-attached chip costs ~130 ms —
+    # The dispatch round trip to a remote-attached chip costs ~90 ms —
     # comparable to a full circuit pass — so the circuit is repeated
     # ``inner`` times INSIDE one compiled call (lax.fori_loop) and the
     # per-gate figure divides by inner; this measures sustained on-chip
@@ -68,7 +101,7 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
         times.append(time.perf_counter() - t0)
     best = min(times)
     n_gates = circ.num_gates * inner
-    return n_gates / best, n_gates, best
+    return n_gates / best, n_gates, best, n_passes * inner
 
 
 def main():
@@ -80,10 +113,13 @@ def main():
     # The fused Pallas executor updates the state strictly in place
     # (input_output_aliases through every segment), so only ONE (re, im)
     # buffer set lives in HBM: 2 * 2^n * 4 bytes.  30 qubits f32 = 8 GiB.
+    dev_kind = ""
     try:
         import jax
 
-        hbm = jax.devices()[0].memory_stats().get("bytes_limit", 16 << 30)
+        dev = jax.devices()[0]
+        dev_kind = dev.device_kind
+        hbm = dev.memory_stats().get("bytes_limit", 16 << 30)
     except Exception:
         hbm = 16 << 30
     while num_qubits > 20 and 2 * (1 << num_qubits) * 4 > 0.92 * hbm:
@@ -92,7 +128,8 @@ def main():
     gates_per_sec = None
     while num_qubits >= 20:
         try:
-            gates_per_sec, ngates, secs = run(num_qubits, depth, reps, inner)
+            gates_per_sec, ngates, secs, npasses = run(
+                num_qubits, depth, reps, inner)
             break
         except Exception as e:  # OOM on smaller-HBM chips: shrink
             msg = str(e)
@@ -108,6 +145,17 @@ def main():
                           "error": "could not fit benchmark state"}))
         sys.exit(1)
 
+    state_bytes = 2 * (1 << num_qubits) * 4        # re+im, f32
+    pass_traffic = 2 * state_bytes                 # read + write, in place
+    hbm_gbps = npasses * pass_traffic / secs / 1e9
+    matches = [(len(kind), bw) for kind, bw in _HBM_SPEC.items()
+               if dev_kind.startswith(kind)]
+    spec_bw = max(matches)[1] if matches else 819e9
+    # QuEST-GPU's per-chip ceiling on an A100: gate-at-a-time, one full
+    # state read+write per gate, f64 as the reference defaults to
+    # (QuEST_precision.h:38-47).
+    a100_equiv = _A100_BW / (2 * 2 * (1 << num_qubits) * 8)
+
     # Reference's only in-repo figure: 667 gates in 3783.93 s (30 qubits).
     baseline = 667.0 / 3783.93
     print(json.dumps({
@@ -117,6 +165,12 @@ def main():
         "vs_baseline": round(gates_per_sec / baseline, 1),
         "gates": ngates,
         "seconds": round(secs, 4),
+        "gates_per_pass": round(ngates / npasses, 2),
+        "hbm_gbps": round(hbm_gbps, 1),
+        "roofline_frac": round(hbm_gbps * 1e9 / spec_bw, 3),
+        "a100_equiv_gates_per_sec": round(a100_equiv, 1),
+        "vs_a100": round(gates_per_sec / a100_equiv, 2),
+        "device": dev_kind,
     }))
 
 

@@ -28,7 +28,6 @@ from ..validation import (
     validate_unitary_matrix,
     validate_unit_vector,
 )
-from .lattice import run_kernel
 
 _INV_SQRT2 = 1.0 / math.sqrt(2.0)
 
@@ -80,10 +79,11 @@ def _ctrl_mask(controls) -> int:
 
 
 def _apply_2x2_raw(q: Qureg, target: int, m, ctrl_mask: int) -> None:
-    re, im = run_kernel(
-        (q.re, q.im), m, kind="apply_2x2", statics=(target, ctrl_mask), mesh=q.mesh
-    )
-    q._set(re, im)
+    # Deferred: queued on the register and flushed as one fused program
+    # at the next state read (see Qureg._flush).  Matrix scalars must be
+    # concrete floats here so the scheduler can compose them on the host.
+    q._defer(("apply_2x2", (target, ctrl_mask),
+              tuple((float(a), float(b)) for a, b in m)))
 
 
 def _apply_2x2(q: Qureg, target: int, m, controls=()) -> None:
@@ -95,10 +95,8 @@ def _apply_2x2(q: Qureg, target: int, m, controls=()) -> None:
 
 
 def _apply_phase_raw(q: Qureg, sel_mask: int, term) -> None:
-    re, im = run_kernel(
-        (q.re, q.im), term, kind="apply_phase", statics=(sel_mask,), mesh=q.mesh
-    )
-    q._set(re, im)
+    q._defer(("apply_phase", (sel_mask,),
+              (float(term[0]), float(term[1]))))
 
 
 def _apply_phase(q: Qureg, sel_mask: int, term) -> None:

@@ -204,12 +204,8 @@ class Lattice:
         return lax.all_gather(x, self.axis, tiled=True)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("kind", "statics", "mesh", "out_kind"),
-)
-def run_kernel(arrays, scalars, *, kind: str, statics: tuple = (),
-               mesh: Mesh | None = None, out_kind: str = "arrays"):
+def _run_kernel_impl(arrays, scalars, *, kind: str, statics: tuple = (),
+                     mesh: Mesh | None = None, out_kind: str = "arrays"):
     """Run kernel body ``kind`` over ``arrays`` (tuple of (S, L) arrays).
 
     ``arrays`` are global views; with a mesh they must be sharded over the
@@ -237,6 +233,19 @@ def run_kernel(arrays, scalars, *, kind: str, statics: tuple = (),
         in_specs=(P(axis), P()),
         out_specs=out_specs,
     )(arrays, scalars)
+
+
+_STATIC_NAMES = ("kind", "statics", "mesh", "out_kind")
+
+#: General entry point: inputs stay live (callers may keep aliases).
+run_kernel = jax.jit(_run_kernel_impl, static_argnames=_STATIC_NAMES)
+
+#: Buffer-consuming variant for owned-state pipelines (Qureg._flush's
+#: per-gate fallback): donates ``arrays`` so a 30-qubit f32 register
+#: updates in place instead of holding 2x state in HBM.
+run_kernel_donated = jax.jit(
+    _run_kernel_impl, static_argnames=_STATIC_NAMES, donate_argnums=(0,)
+)
 
 
 def amp_sharding(mesh: Mesh | None):

@@ -42,17 +42,100 @@ class Qureg:
 
     Mirrors the reference ``Qureg`` (QuEST/include/QuEST.h:81-112) minus
     the chunk bookkeeping, which the sharded arrays carry natively.
+
+    Gate calls DEFER: the eager API appends kernel ops to ``_pending``
+    and any state read (the ``re``/``im`` properties, which every
+    calculation, measurement, report, and the C ABI bridge go through)
+    flushes the queued run as one program — on TPU as fused Pallas
+    segments with donated buffers, so a gate stream costs segment passes
+    instead of per-gate dispatches (the C bridge gets this for free,
+    closing the reference driver's per-gate-call gap; the reference
+    dispatches one C call per gate, QuEST/src/QuEST.c).
     """
 
-    __slots__ = ("re", "im", "num_qubits", "is_density", "mesh", "qasm")
+    __slots__ = ("_re", "_im", "num_qubits", "is_density", "mesh", "qasm",
+                 "_pending")
 
     def __init__(self, re, im, num_qubits: int, is_density: bool, mesh):
-        self.re = re
-        self.im = im
+        self._re = re
+        self._im = im
         self.num_qubits = num_qubits
         self.is_density = is_density
         self.mesh = mesh
         self.qasm = None  # attached by quest_tpu.qasm on creation
+        self._pending = []
+
+    # -- deferred gate stream -------------------------------------------
+    @property
+    def re(self):
+        if self._pending:
+            self._flush()
+        return self._re
+
+    @re.setter
+    def re(self, value):
+        self._re = value
+        self._pending.clear()
+
+    @property
+    def im(self):
+        if self._pending:
+            self._flush()
+        return self._im
+
+    @im.setter
+    def im(self, value):
+        self._im = value
+        self._pending.clear()
+
+    def _defer(self, op) -> None:
+        """Queue a (kind, statics, scalars) kernel op."""
+        self._pending.append(op)
+
+    def _flush(self) -> None:
+        import jax
+
+        # Fused Pallas needs tile-aligned (>= (8, 128)) chunks and f32
+        # (Mosaic has no f64 dot lowering); below/besides that the
+        # per-gate XLA path is the right one anyway (tiny states are
+        # trivially cheap, f64 on TPU is emulated in XLA).  Scalars are
+        # burned into fused programs, so a parameter SWEEP (same gate
+        # structure, fresh angles every flush) would recompile per angle
+        # — detected via structure history and routed to the per-gate
+        # path, whose compile cache is angle-independent.
+        use_fused = (jax.default_backend() == "tpu"
+                     and self.num_amps >= (1 << 13)
+                     and self._re.dtype == jnp.float32
+                     and not _is_sweep(self._pending, self.num_vec_qubits,
+                                       self.mesh))
+        if use_fused:
+            ops = tuple(self._pending)
+            self._pending = []
+            try:
+                # One fused program per unique stream, buffers donated —
+                # the state is updated strictly in place (a 30q f32
+                # register needs one 8 GiB buffer pair, not two).
+                fn = _stream_fn(ops, self.num_vec_qubits, self.mesh)
+                self._re, self._im = fn(self._re, self._im)
+            except Exception:
+                # Requeue so the gates aren't silently dropped: a retry
+                # either succeeds or raises jax's deleted-donated-buffer
+                # error, never silently yields the pre-gate state.
+                self._pending = list(ops) + self._pending
+                raise
+        else:
+            # Per-gate jitted kernels with traced scalars; buffers are
+            # donated through the chain (the flush owns them).  Each op
+            # is popped only after its kernel ran, so a failure requeues
+            # exactly the unapplied tail.
+            from .ops.lattice import run_kernel_donated
+
+            while self._pending:
+                kind, statics, scalars = self._pending[0]
+                self._re, self._im = run_kernel_donated(
+                    (self._re, self._im), scalars, kind=kind,
+                    statics=statics, mesh=self.mesh)
+                del self._pending[0]
 
     # -- shape bookkeeping ----------------------------------------------
     @property
@@ -67,25 +150,86 @@ class Qureg:
 
     @property
     def real_dtype(self):
-        return self.re.dtype
+        # _re directly: dtype is invariant under pending gates, and this
+        # is read on gate-validation paths that must not force a flush.
+        return self._re.dtype
 
     @property
     def state_shape(self) -> tuple[int, int]:
         """Stored 2-D (rows, lanes) shape — tile-aligned for TPU; flat
         index = row * lanes + lane (see quest_tpu.ops.lattice)."""
-        return self.re.shape
+        return self._re.shape
 
     def _set(self, re, im) -> None:
-        """Install a new functional state (in-place mutation facade)."""
-        self.re = re
-        self.im = im
+        """Install a new functional state (in-place mutation facade).
+
+        Discards any still-deferred gates: callers either read the state
+        first (which flushes) or are replacing it wholesale (inits)."""
+        self._re = re
+        self._im = im
+        self._pending.clear()
 
     def __repr__(self):
         kind = "density-matrix" if self.is_density else "state-vector"
         return (
             f"Qureg({kind}, {self.num_qubits} qubits, {self.num_amps} amps, "
-            f"{self.re.dtype.name}, mesh={None if self.mesh is None else self.mesh.shape})"
+            f"{self._re.dtype.name}, "
+            f"mesh={None if self.mesh is None else self.mesh.shape})"
         )
+
+
+#: Compiled flush programs, keyed by the exact op stream (LRU-bounded:
+#: scalars are burned into fused programs, so an unbounded cache would
+#: leak under angle sweeps).
+_STREAM_CACHE: "OrderedDict" = None  # initialised below
+_STREAM_CACHE_MAX = 64
+
+#: Sweep detection: structure key (kinds + statics, no scalars) -> the
+#: scalars that structure was last flushed with.  LRU-bounded.
+_STRUCT_HISTORY: "OrderedDict" = None
+_STRUCT_HISTORY_MAX = 256
+_MISSING = object()
+
+
+def _is_sweep(ops, num_vec_qubits: int, mesh) -> bool:
+    """True when this op stream's *structure* was flushed before with
+    different scalar values — i.e. the caller is sweeping gate parameters
+    (e.g. the reference's rotate_benchmark.test, 20 trials x 29 targets).
+    Such streams would recompile the fused executor per angle; the
+    per-gate path's angle-traced compile cache serves them instead."""
+    global _STRUCT_HISTORY
+    if _STRUCT_HISTORY is None:
+        from collections import OrderedDict
+
+        _STRUCT_HISTORY = OrderedDict()
+    struct = (tuple((kind, statics) for kind, statics, _ in ops),
+              num_vec_qubits, mesh)
+    scalars = tuple(s for _, _, s in ops)
+    prev = _STRUCT_HISTORY.pop(struct, _MISSING)
+    _STRUCT_HISTORY[struct] = scalars
+    while len(_STRUCT_HISTORY) > _STRUCT_HISTORY_MAX:
+        _STRUCT_HISTORY.popitem(last=False)
+    return prev is not _MISSING and prev != scalars
+
+
+def _stream_fn(ops: tuple, num_vec_qubits: int, mesh):
+    global _STREAM_CACHE
+    if _STREAM_CACHE is None:
+        from collections import OrderedDict
+
+        _STREAM_CACHE = OrderedDict()
+    key = (ops, num_vec_qubits, mesh)
+    fn = _STREAM_CACHE.pop(key, None)
+    if fn is None:
+        from .circuit import Circuit  # deferred: avoids import cycle
+
+        c = Circuit(num_vec_qubits)
+        c.ops = list(ops)
+        fn = c.compile(mesh=mesh, donate=True, pallas=True)
+        while len(_STREAM_CACHE) >= _STREAM_CACHE_MAX:
+            _STREAM_CACHE.popitem(last=False)
+    _STREAM_CACHE[key] = fn
+    return fn
 
 
 # ---------------------------------------------------------------------------
@@ -149,70 +293,138 @@ def get_num_amps(qureg: Qureg) -> int:
 # ---------------------------------------------------------------------------
 
 
-@lru_cache(maxsize=None)
-def _init_builder(kind: str, shape: tuple[int, int], dtype, mesh):
-    """Jitted initial-state builders, cached per (kind, shape, dtype, mesh).
+def _init_body(kind: str, shape: tuple[int, int], dtype):
+    """Initial-state builder body factory for ``kind``.
 
-    All builders produce the (S, L) state from sharded iotas (or a scatter
-    into sharded zeros), so no full-size host array is ever materialised —
-    each device fills only its own chunk.  Bit values of the flat index
+    Returns ``make(zeros)`` where ``zeros`` supplies the base (re, im)
+    zero arrays: fresh ``jnp.zeros`` at creation, or ``old * 0`` for
+    in-place re-initialisation (the dataflow through the old buffers is
+    what lets XLA recycle the donated allocation — a donated-but-unused
+    argument is NOT recycled on the TPU runtime, measured: re-init of a
+    30q f32 register OOMs without it).
+
+    All builders produce the (S, L) state from sharded iotas over the
+    zero base, so no full-size host array is ever materialised — each
+    device fills only its own chunk.  Bit values of the flat index
     (= row * L + lane) are derived from row/lane iotas separately, so no
     64-bit global iota is needed at any register size.
     """
-    sh = amp_sharding(mesh)
     rows, lanes = shape
     lane_bits = (lanes - 1).bit_length()
-
-    def zeros():
-        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
     if kind == "classical":
         # reference: statevec_initClassicalState (QuEST_cpu.c:1352) /
         # densmatr_initClassicalState (:1038): one unit amplitude.
-        def build(ind):
-            re, im = zeros()
-            return re.at[ind // lanes, ind % lanes].set(1), im
+        def make(zeros):
+            def build(ind):
+                re, im = zeros()
+                return re.at[ind // lanes, ind % lanes].set(1), im
+            return build
 
     elif kind == "plus":
         # reference: statevec_initPlusState (QuEST_cpu.c:1320) /
         # densmatr_initPlusState (:1077): uniform fill.
-        def build(norm):
-            return jnp.full(shape, norm, dtype), jnp.zeros(shape, dtype)
+        def make(zeros):
+            def build(norm):
+                re, im = zeros()
+                return re + jnp.asarray(norm, dtype), im
+            return build
 
     elif kind == "debug":
         # reference: statevec_initStateDebug (QuEST_cpu.c:1473):
         # amp[k] = (2k)/10 + i(2k+1)/10.
-        def build():
-            k = (jax.lax.broadcasted_iota(dtype, shape, 0) * lanes
-                 + jax.lax.broadcasted_iota(dtype, shape, 1))
-            return 0.2 * k, 0.2 * k + 0.1
+        def make(zeros):
+            def build():
+                re, im = zeros()
+                k = (jax.lax.broadcasted_iota(dtype, shape, 0) * lanes
+                     + jax.lax.broadcasted_iota(dtype, shape, 1))
+                return re + 0.2 * k, im + 0.2 * k + 0.1
+            return build
 
     elif kind == "single_qubit":
         # reference: statevec_initStateOfSingleQubit (QuEST_cpu.c:1427):
         # uniform over basis states whose `qubit` bit equals `outcome`.
-        def build(qubit, outcome, norm):
-            lane_i = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-            row_i = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
-            bit = jnp.where(
-                qubit < lane_bits,
-                (lane_i >> qubit) & 1,
-                (row_i >> jnp.maximum(qubit - lane_bits, 0)) & 1,
-            )
-            re = jnp.where(bit == outcome, jnp.asarray(norm, dtype), 0)
-            return re, jnp.zeros(shape, dtype)
+        def make(zeros):
+            def build(qubit, outcome, norm):
+                re, im = zeros()
+                lane_i = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+                row_i = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+                bit = jnp.where(
+                    qubit < lane_bits,
+                    (lane_i >> qubit) & 1,
+                    (row_i >> jnp.maximum(qubit - lane_bits, 0)) & 1,
+                )
+                re = re + jnp.where(bit == outcome,
+                                    jnp.asarray(norm, dtype), 0)
+                return re, im
+            return build
 
     else:  # pragma: no cover
         raise ValueError(kind)
 
+    return make
+
+
+@lru_cache(maxsize=None)
+def _init_builder(kind: str, shape: tuple[int, int], dtype, mesh):
+    """Jitted fresh-allocation builder, cached per (kind, shape, dtype,
+    mesh) — used at register creation, when no old buffers exist."""
+    sh = amp_sharding(mesh)
+    make = _init_body(kind, shape, dtype)
+
+    def zeros():
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
     kw = {} if sh is None else {"out_shardings": (sh, sh)}
-    return jax.jit(build, **kw)
+    return jax.jit(make(zeros), **kw)
+
+
+@lru_cache(maxsize=None)
+def _reinit_builder(kind: str, shape: tuple[int, int], dtype, mesh):
+    """Jitted re-initialisation builder that DONATES the register's old
+    buffers and derives the zero base from them (``old * 0``), so the
+    new state is written in place.  Without this, re-initialising a
+    30-qubit f32 register transiently needs 2 x 8 GiB (old state live
+    while the new one materialises) — over the v5e HBM budget (the
+    reference's initZeroState likewise overwrites its existing
+    allocation, QuEST_cpu.c:1284-1318)."""
+    sh = amp_sharding(mesh)
+    make = _init_body(kind, shape, dtype)
+
+    def rebuild(old_re, old_im, *args):
+        # where(isfinite) rather than plain `old * 0`: NaN/Inf amplitudes
+        # (f32 overflow, collapse at prob 0) would otherwise poison the
+        # fresh state, while the dataflow through the donated buffers is
+        # what lets XLA recycle the allocation in place.
+        def zeros():
+            return (jnp.where(jnp.isfinite(old_re), old_re, 0) * 0,
+                    jnp.where(jnp.isfinite(old_im), old_im, 0) * 0)
+        return make(zeros)(*args)
+
+    kw = {} if sh is None else {"out_shardings": (sh, sh)}
+    return jax.jit(rebuild, donate_argnums=(0, 1), **kw)
+
+
+def _reinit(qureg: "Qureg", kind: str, *args) -> None:
+    """Overwrite ``qureg``'s state in place with builder ``kind``."""
+    build = _reinit_builder(kind, qureg.state_shape, qureg.real_dtype,
+                            qureg.mesh)
+    old_re, old_im = qureg._re, qureg._im
+    qureg._re = qureg._im = None  # drop our refs so donation can recycle
+    qureg._pending.clear()
+    try:
+        qureg._set(*build(old_re, old_im, *args))
+    except Exception:
+        # Restore the old refs so a failed (re)compile doesn't brick the
+        # register; if execution consumed the donated buffers, later use
+        # raises jax's deleted-buffer error rather than AttributeError.
+        qureg._re, qureg._im = old_re, old_im
+        raise
 
 
 def init_zero_state(qureg: Qureg) -> None:
     """|0...0> or |0><0| (reference: initZeroState, QuEST.c:83-92)."""
-    build = _init_builder("classical", qureg.state_shape, qureg.real_dtype,
-                          qureg.mesh)
-    qureg._set(*build(0))
+    _reinit(qureg, "classical", 0)
     qasm.record_init(qureg, "zero")
 
 
@@ -224,9 +436,7 @@ def init_plus_state(qureg: Qureg) -> None:
         norm = 1.0 / (1 << qureg.num_qubits)
     else:
         norm = 1.0 / np.sqrt(1 << qureg.num_qubits)
-    build = _init_builder("plus", qureg.state_shape, qureg.real_dtype,
-                          qureg.mesh)
-    qureg._set(*build(norm))
+    _reinit(qureg, "plus", norm)
     qasm.record_init(qureg, "plus")
 
 
@@ -239,18 +449,14 @@ def init_classical_state(qureg: Qureg, state_ind: int) -> None:
         # diagonal element (ind, ind) of the flattened matrix
         # (reference: densmatr_initClassicalState, QuEST_cpu.c:1038-1075)
         flat_ind = state_ind * (1 << qureg.num_qubits) + state_ind
-    build = _init_builder("classical", qureg.state_shape, qureg.real_dtype,
-                          qureg.mesh)
-    qureg._set(*build(flat_ind))
+    _reinit(qureg, "classical", flat_ind)
     qasm.record_init(qureg, "classical", state_ind)
 
 
 def init_state_debug(qureg: Qureg) -> None:
     """Deterministic unphysical debug state (reference: initStateDebug,
     QuEST_debug.h:17-23, QuEST_cpu.c:1473-1505)."""
-    build = _init_builder("debug", qureg.state_shape, qureg.real_dtype,
-                          qureg.mesh)
-    qureg._set(*build())
+    _reinit(qureg, "debug")
 
 
 def init_state_of_single_qubit(qureg: Qureg, qubit: int, outcome: int) -> None:
@@ -262,9 +468,7 @@ def init_state_of_single_qubit(qureg: Qureg, qubit: int, outcome: int) -> None:
     validate_target(qureg, qubit)
     validate_outcome(outcome)
     norm = 1.0 / np.sqrt(qureg.num_amps / 2.0)
-    build = _init_builder("single_qubit", qureg.state_shape, qureg.real_dtype,
-                          qureg.mesh)
-    qureg._set(*build(qubit, outcome, norm))
+    _reinit(qureg, "single_qubit", qubit, outcome, norm)
 
 
 def init_pure_state(qureg: Qureg, pure: Qureg) -> None:
@@ -283,7 +487,10 @@ def init_pure_state(qureg: Qureg, pure: Qureg) -> None:
         raise QuESTError("second argument of initPureState must be a state-vector")
     validate_matching_dims(qureg, pure)
     if not qureg.is_density:
-        qureg._set(pure.re, pure.im)
+        # Fresh buffers, not shared references: a later flush donates the
+        # target's arrays in place, which must never invalidate ``pure``
+        # (the reference copies amplitudes here too, QuEST_cpu.c:1107).
+        qureg._set(pure.re + 0, pure.im + 0)
         return
     from .ops.lattice import run_kernel  # deferred to avoid import cycle
 
@@ -332,11 +539,14 @@ def set_amps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
 
 
 def clone_qureg(target: Qureg, copy: Qureg) -> None:
-    """target := copy (reference: cloneQureg, QuEST.c:73-81)."""
+    """target := copy (reference: cloneQureg, QuEST.c:73-81).
+
+    Copies the buffers (as the reference does): sharing them would let a
+    later donated flush on one register invalidate the other."""
     if target.is_density != copy.is_density:
         raise QuESTError("cloneQureg requires registers of the same kind")
     validate_matching_dims(target, copy)
-    target._set(copy.re, copy.im)
+    target._set(copy.re + 0, copy.im + 0)
 
 
 # ---------------------------------------------------------------------------
