@@ -1,0 +1,60 @@
+/* quest_tpu C ABI — native complex convenience type.
+ *
+ * Interface-compatible with the reference's QuEST_complex.h (reference:
+ * QuEST/src/QuEST_complex.h:28-58): defines `qcomp`, a precision-agnostic
+ * complex number that resolves to the language-native complex type — C99
+ * `_Complex` via <complex.h> or C++ `std::complex<T>` — at the width
+ * selected by QuEST_PREC, together with the toComplex/fromComplex
+ * converters to the API's plain `Complex` struct.  Including this header
+ * lets user programs do natural complex arithmetic (operators, creal/
+ * cimag and friends in both languages) before handing values to the API.
+ */
+#ifndef QUEST_COMPLEX_H
+#define QUEST_COMPLEX_H
+
+#ifdef __cplusplus
+
+#include <cmath>
+#include <complex>
+
+using namespace std;
+
+typedef complex<float> float_complex;
+typedef complex<double> double_complex;
+typedef complex<long double> long_double_complex;
+
+/* Make the C spelling of the component accessors work in C++ too. */
+#define creal(x) real(x)
+#define cimag(x) imag(x)
+#define carg(x) arg(x)
+#define cabs(x) abs(x)
+
+#else /* C99 */
+
+#include <tgmath.h> /* pulls in <math.h> and <complex.h> */
+
+typedef float complex float_complex;
+typedef double complex double_complex;
+typedef long double complex long_double_complex;
+
+/* Constructor spelling shared with C++: qcomp(re, im). */
+#define float_complex(r, i) ((float)(r) + ((float)(i)) * I)
+#define double_complex(r, i) ((double)(r) + ((double)(i)) * I)
+#define long_double_complex(r, i) ((long double)(r) + ((long double)(i)) * I)
+
+#endif /* __cplusplus */
+
+#if QuEST_PREC == 1
+#define qcomp float_complex
+#elif QuEST_PREC == 2
+#define qcomp double_complex
+#elif QuEST_PREC == 4
+#define qcomp long_double_complex
+#endif
+
+/* To/from the API's struct type (QuEST.h `Complex`). */
+#define toComplex(scalar) \
+    ((Complex){.real = creal(scalar), .imag = cimag(scalar)})
+#define fromComplex(comp) qcomp(comp.real, comp.imag)
+
+#endif /* QUEST_COMPLEX_H */

@@ -95,14 +95,38 @@ def record_measurement(qureg, target: int) -> None:
     log.lines.append(f"{MEASURE_LABEL} q[{target}] -> c[{target}];")
 
 
-def record_init(qureg, kind: str, *params) -> None:
-    """Record state initialisation as comments + reset (reference records
-    inits as reset plus explicit gates, QuEST_qasm.c:382-442)."""
+def record_comment(qureg, comment: str) -> None:
+    # reference: qasm_recordComment (QuEST_qasm.c:115-123)
     log = qureg.qasm
     if log is None or not log.recording:
         return
-    log.lines.append(f"reset q;  // init {kind}"
-                     + (f" {params}" if params else ""))
+    log.lines.append(f"// {comment}")
+
+
+def record_init(qureg, kind: str, *params) -> None:
+    """Record state initialisation as reset plus explicit gates
+    (reference: qasm_recordInitZero/Plus/Classical, QuEST_qasm.c:382-442:
+    |+> = reset + whole-register h; |ind> = reset + x on set bits)."""
+    log = qureg.qasm
+    if log is None or not log.recording:
+        return
+    if kind == "zero":
+        log.lines.append("reset q;")
+    elif kind == "plus":
+        record_comment(qureg, "Initialising state |+>")
+        log.lines.append("reset q;")
+        log.lines.append("h q;")
+    elif kind == "classical":
+        (state_ind,) = params
+        record_comment(qureg, f"Initialising state |{state_ind}>")
+        log.lines.append("reset q;")
+        for q in range(qureg.num_qubits):
+            if (state_ind >> q) & 1:
+                record_gate(qureg, "x", targets=(q,))
+    else:  # unrepresentable init (pure state, raw amps): comment only,
+        # as the reference does for qasm_recordInitPureState-style cases
+        record_comment(qureg, f"Initialising state: {kind}"
+                       + (f" {params}" if params else ""))
 
 
 def _zyz(alpha: complex, beta: complex) -> tuple[float, float, float]:

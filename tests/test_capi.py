@@ -306,6 +306,53 @@ def test_reference_bv_example(lib, tmp_path):
     assert "solution reached with probability 1" in out
 
 
+_QCOMP_SRC = r"""
+#include <stdio.h>
+#include "QuEST.h"
+#include "QuEST_complex.h"
+
+int main() {
+    QuESTEnv env = createQuESTEnv();
+    Qureg q = createQureg(1, env);
+    initZeroState(q);
+
+    /* natural complex arithmetic via qcomp, then into the API */
+    qcomp a = fromComplex(((Complex){.real = 0.6, .imag = 0.0}));
+    qcomp b = qcomp(0.0, 0.8);
+    b *= 1.0;  /* operator support */
+    Complex alpha = toComplex(a), beta = toComplex(b);
+    compactUnitary(q, 0, alpha, beta);
+
+    Complex amp1 = getAmp(q, 1);
+    printf("amp1 = %.6f %.6f\n", (double)amp1.real, (double)amp1.imag);
+    printf("norm0 = %.6f\n", (double)creal(a * conj(a)));
+    destroyQureg(q, env);
+    destroyQuESTEnv(env);
+    return 0;
+}
+"""
+
+
+@pytest.mark.parametrize("compiler", ["cc", "c++"])
+def test_qcomp_header(lib, tmp_path, compiler):
+    """A user program doing complex arithmetic through QuEST_complex.h
+    compiles (as both C99 and C++) and runs against libQuEST.so
+    (reference surface: QuEST/src/QuEST_complex.h:28-58)."""
+    ext = ".c" if compiler == "cc" else ".cpp"
+    src = tmp_path / ("qcomp_prog" + ext)
+    src.write_text(_QCOMP_SRC)
+    exe = str(tmp_path / "qcomp_prog")
+    cmd = [compiler, f"-I{CAPI}/include", str(src), "-o", exe,
+           f"-L{CAPI}", "-lQuEST", f"-Wl,-rpath,{CAPI}", "-lm"]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=600,
+                       cwd=tmp_path)
+    assert r.returncode == 0, r.stderr[-1000:]
+    # compactUnitary: amp1 = beta = 0.8i; |a|^2 = 0.36
+    assert "amp1 = 0.000000 0.800000" in r.stdout
+    assert "norm0 = 0.360000" in r.stdout
+
+
 @pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
 def test_reference_damping_example(lib, tmp_path):
     out = _compile_and_run(tmp_path, f"{REF}/examples/damping_example.c")
@@ -314,3 +361,40 @@ def test_reference_damping_example(lib, tmp_path):
     assert len(rows) == 4 * 11  # initial + 10 damping reports, 4 amps each
     last_rho00 = float(rows[-4].split(",")[0])
     assert last_rho00 > 0.8
+
+
+def test_qasm_init_states(lib, cenv, tmp_path):
+    """Init states are recorded as reset + explicit gates (reference:
+    qasm_recordInitPlus/Classical, QuEST_qasm.c:397-442)."""
+    q = lib.createQureg(3, cenv)
+    lib.startRecordingQASM(q)
+    lib.initPlusState(q)
+    lib.initClassicalState(q, 5)
+    out = tmp_path / "init.qasm"
+    lib.writeRecordedQASMToFile(q, str(out).encode())
+    lines = [l for l in out.read_text().splitlines()
+             if l and not l.startswith("//")]
+    i = lines.index("reset q;")
+    assert lines[i + 1] == "h q;"
+    j = lines.index("reset q;", i + 1)
+    assert lines[j + 1:j + 3] == ["x q[0];", "x q[2];"]
+    lib.destroyQureg(q, cenv)
+
+
+@pytest.mark.skipif(not shutil.which("cmake"), reason="no cmake")
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_cmake_user_source_build(tmp_path):
+    """The reference's CMake workflow — configure with USER_SOURCE, build,
+    run the produced exe (reference interface: CMakeLists.txt:11-45)."""
+    build = tmp_path / "build"
+    subprocess.run(
+        ["cmake", "-S", CAPI, "-B", str(build),
+         f"-DUSER_SOURCE={REF}/examples/tutorial_example.c",
+         "-DOUTPUT_EXE=demo"],
+        check=True, capture_output=True, text=True)
+    subprocess.run(["cmake", "--build", str(build)], check=True,
+                   capture_output=True, text=True)
+    r = subprocess.run([str(build / "demo")], capture_output=True, text=True,
+                       timeout=600, cwd=tmp_path)
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert "Probability amplitude of |111>: 0.498751" in r.stdout
