@@ -1,0 +1,226 @@
+"""Record the BASELINE.json "QFT 34 qubits, distributed" config artifact.
+
+The bench host exposes ONE real TPU chip (15.75 GiB HBM) and one CPU
+core, so the pod-scale 34-qubit run (128 GiB of f32 amplitudes, 16+
+chips) cannot execute here.  This tool records the strongest honest
+evidence available on the host, writing ``QFT_r{N}.json``:
+
+1. **Real-chip run** — the largest QFT that fits HBM (30 qubits) on the
+   TPU, executed through the production fused Pallas path, with analytic
+   amplitude checks: QFT|x> has every |amp| = 2^{-n/2} and phase
+   2*pi*x*k/2^n, so correctness is verified against closed form, not a
+   golden file (the reference's QFT.test compares golden files,
+   tests/algor/QFT.test:1-37).
+2. **Sharded virtual-mesh run** — the same circuit on an 8-device CPU
+   mesh (sized down: one physical core time-slices all 8 device
+   threads; XLA's 40 s collective rendezvous bounds the feasible chunk)
+   through the mesh scheduler's relabeling half-exchange plan, same
+   analytic check, plus the plan's measured ICI exchange volume vs the
+   reference's full-chunk exchange scheme.
+3. **Pod memory model** — the 34-qubit layout on v5e chips: amplitudes
+   per chip, exchange volume per relayout, so the scaling claim is
+   auditable (reference chunking: QuEST_cpu_distributed.c:231-365).
+
+Usage: python tools/qft_dist.py [round_number]
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _analytic_check(get_amp, n: int, x: int, k_samples) -> float:
+    """Max |amp_k - analytic| over sampled k for QFT|x> on n qubits."""
+    norm = 2.0 ** (-n / 2.0)
+    err = 0.0
+    for k in k_samples:
+        expect = norm * complex(
+            math.cos(2 * math.pi * x * k / (1 << n)),
+            math.sin(2 * math.pi * x * k / (1 << n)),
+        )
+        err = max(err, abs(get_amp(k) - expect))
+    return err
+
+
+def run_real_chip(max_qubits: int = 30):
+    """QFT at the largest size fitting the local accelerator, fused path."""
+    import jax
+    import jax.numpy as jnp
+
+    from quest_tpu import models
+    from quest_tpu.ops.lattice import state_shape
+
+    dev = jax.devices()[0]
+    hbm = 16 << 30
+    try:
+        hbm = dev.memory_stats().get("bytes_limit", hbm)
+    except Exception:
+        pass
+    n = max_qubits
+    while n > 20 and 2 * (1 << n) * 4 > 0.92 * hbm:
+        n -= 1
+
+    circ = models.qft(n)
+    # compile() jits with donated buffers: one (re, im) pair in HBM.
+    fn = circ.compile(mesh=None, donate=True)
+
+    x = (0b1011 << (n - 8)) | 0b1101  # non-trivial input basis state
+    shape = state_shape(1 << n)
+    lanes = shape[1]
+
+    def fresh():
+        re = jnp.zeros(shape, jnp.float32).at[x // lanes, x % lanes].set(1.0)
+        return re, jnp.zeros(shape, jnp.float32)
+
+    re, im = fresh()
+    t0 = time.perf_counter()
+    re, im = fn(re, im)
+    _ = float(re[0, 0])  # host read = real sync under the axon tunnel
+    compile_s = time.perf_counter() - t0
+
+    # Warm timing: re-apply on the same donated buffers (same compiled
+    # program; input state is irrelevant to gate timing) so only ONE
+    # (re, im) pair ever lives in HBM.
+    t0 = time.perf_counter()
+    re, im = fn(re, im)
+    _ = float(re[0, 0])
+    run_s = time.perf_counter() - t0
+
+    # Fresh pass for the analytic amplitude check.
+    del re, im
+    re, im = fn(*fresh())
+
+    def get_amp(k):
+        return complex(float(re[k // lanes, k % lanes]),
+                       float(im[k // lanes, k % lanes]))
+
+    err = _analytic_check(get_amp, n, x, [0, 1, 5, (1 << n) - 1,
+                                          (1 << (n - 1)) + 3])
+    return {
+        "qubits": n,
+        "gates": circ.num_gates,
+        "device": dev.device_kind,
+        "compile_plus_run_seconds": round(compile_s, 3),
+        "run_seconds": round(run_s, 3),
+        "gates_per_sec": round(circ.num_gates / run_s, 1),
+        "max_amp_error_vs_analytic": err,
+    }
+
+
+def run_virtual_mesh(n: int = 22, ndev: int = 8):
+    """Sharded QFT on a virtual CPU mesh, in a subprocess so the CPU
+    platform config never touches this process's real-TPU backend."""
+    code = f"""
+import json, math, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", {ndev})
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from quest_tpu import models
+from quest_tpu.env import AMP_AXIS
+from quest_tpu.ops.lattice import state_shape
+from quest_tpu.scheduler import schedule_mesh
+
+n, ndev = {n}, {ndev}
+dev_bits = (ndev - 1).bit_length()
+mesh = Mesh(np.array(jax.devices()[:ndev]), (AMP_AXIS,))
+sh = NamedSharding(mesh, P(AMP_AXIS))
+circ = models.qft(n)
+fn = circ.as_fused_fn(mesh=mesh, interpret=True)
+shape = state_shape(1 << n, ndev)
+lanes = shape[1]
+x = (0b1011 << (n - 8)) | 0b1101
+re = jax.device_put(jnp.zeros(shape, jnp.float32).at[x // lanes, x % lanes]
+                    .set(1.0), sh)
+im = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
+t0 = time.perf_counter()
+re, im = fn(re, im)
+jax.block_until_ready((re, im))
+secs = time.perf_counter() - t0
+
+norm = 2.0 ** (-n / 2.0)
+err = 0.0
+for k in (0, 1, 5, (1 << n) - 1, (1 << (n - 1)) + 3):
+    expect = norm * complex(math.cos(2 * math.pi * x * k / (1 << n)),
+                            math.sin(2 * math.pi * x * k / (1 << n)))
+    got = complex(float(re[k // lanes, k % lanes]),
+                  float(im[k // lanes, k % lanes]))
+    err = max(err, abs(got - expect))
+
+# comm volume of the mesh plan vs reference full-chunk exchanges
+lane_bits = (lanes - 1).bit_length()
+plan = schedule_mesh(list(circ.ops), n, dev_bits, lane_bits)
+half_exchanges = sum(1 for step in plan if step[0] == "swap"
+                     and max(step[1], step[2]) >= n - dev_bits)
+ref_exchanges = sum(1 for kind, statics, _ in circ.ops
+                    if kind == "apply_2x2" and statics[0] >= n - dev_bits)
+print("RESULT " + json.dumps({{
+    "qubits": n, "devices": ndev, "gates": circ.num_gates,
+    "seconds": round(secs, 3),
+    "max_amp_error_vs_analytic": err,
+    "relayout_half_exchanges": half_exchanges,
+    "chunk_volumes_moved": half_exchanges / 2.0,
+    "reference_full_chunk_exchanges": ref_exchanges,
+}}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=3600)
+    for line in res.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(
+        f"virtual-mesh run failed (rc={res.returncode})\n"
+        f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-2000:]}")
+
+
+def pod_memory_model(n: int = 34):
+    """Auditable layout numbers for the named pod config."""
+    state_bytes = 2 * (1 << n) * 4  # re+im f32
+    per_chip_hbm = 16 << 30
+    chips = 1
+    while state_bytes / chips > 0.8 * per_chip_hbm:
+        chips *= 2
+    return {
+        "qubits": n,
+        "state_bytes_f32": state_bytes,
+        "min_v5e_chips": chips,
+        "bytes_per_chip": state_bytes // chips,
+        "halfexchange_bytes_per_relayout_per_chip": state_bytes // chips // 2,
+        "note": ("34-qubit f32 state = 128 GiB; fits 16+ v5e chips at "
+                 "8 GiB/chip. Relabeling scheduler pays one half-chunk "
+                 "ppermute (4 GiB/chip over ICI) per device-bit relayout, "
+                 "amortised across all gates on that qubit; the "
+                 "reference exchanges the FULL chunk per high-qubit gate "
+                 "(exchangeStateVectors, QuEST_cpu_distributed.c:451-479)."),
+    }
+
+
+def main():
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    art = {"config": "QFT 34 qubits, distributed state-vector sharded "
+                     "across pod (BASELINE.json configs[4])"}
+    art["real_chip"] = run_real_chip()
+    art["virtual_mesh_sharded"] = run_virtual_mesh()
+    art["pod_model_34q"] = pod_memory_model()
+    out = os.path.join(REPO, f"QFT_r{rnd:02d}.json")
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art, indent=1))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
