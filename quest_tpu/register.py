@@ -554,6 +554,14 @@ def clone_qureg(target: Qureg, copy: Qureg) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _amp_at(qureg: Qureg, index: int):
+    """One element by (row, lane) — never materialises a flat copy (a
+    reshape(-1) of a 30-qubit array would allocate 4 GiB on-device)."""
+    lanes = qureg.state_shape[1]
+    return qureg.re[index // lanes, index % lanes], \
+        qureg.im[index // lanes, index % lanes]
+
+
 def get_real_amp(qureg: Qureg, index: int) -> float:
     """(reference: getRealAmp, QuEST.c:497-503; distributed broadcast
     statevec_getRealAmp QuEST_cpu_distributed.c:202-210 — the cross-device
@@ -561,14 +569,14 @@ def get_real_amp(qureg: Qureg, index: int) -> float:
     if qureg.is_density:
         raise QuESTError("getRealAmp requires a state-vector")
     validate_state_index(qureg, index)
-    return float(qureg.re.reshape(-1)[index])
+    return float(_amp_at(qureg, index)[0])
 
 
 def get_imag_amp(qureg: Qureg, index: int) -> float:
     if qureg.is_density:
         raise QuESTError("getImagAmp requires a state-vector")
     validate_state_index(qureg, index)
-    return float(qureg.im.reshape(-1)[index])
+    return float(_amp_at(qureg, index)[1])
 
 
 def get_amp(qureg: Qureg, index: int) -> complex:
@@ -576,8 +584,8 @@ def get_amp(qureg: Qureg, index: int) -> complex:
     if qureg.is_density:
         raise QuESTError("getAmp requires a state-vector")
     validate_state_index(qureg, index)
-    return complex(float(qureg.re.reshape(-1)[index]),
-                   float(qureg.im.reshape(-1)[index]))
+    re, im = _amp_at(qureg, index)
+    return complex(float(re), float(im))
 
 
 def get_prob_amp(qureg: Qureg, index: int) -> float:
@@ -594,8 +602,8 @@ def get_density_amp(qureg: Qureg, row: int, col: int) -> complex:
     validate_state_index(qureg, row)
     validate_state_index(qureg, col)
     ind = row + col * (1 << qureg.num_qubits)
-    return complex(float(qureg.re.reshape(-1)[ind]),
-                   float(qureg.im.reshape(-1)[ind]))
+    re, im = _amp_at(qureg, ind)
+    return complex(float(re), float(im))
 
 
 def get_state_vector(qureg: Qureg) -> np.ndarray:
