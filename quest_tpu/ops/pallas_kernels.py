@@ -90,9 +90,16 @@ def _combine_2x2(r, i, pr, pi, bit, m):
 #: Max number of arbitrary high qubits a fused segment can expose as
 #: dedicated block axes.  Raising this trades contiguous-row block size
 #: (c_blk = _ROW_BUDGET >> k) for more adaptively-chosen high targets per
-#: pass; at 5 the DMA pieces are still 16 KB (c_blk=32 rows x 128 lanes x
-#: 4 B), measured at full stream rate on v5e.
-MAX_HIGH_BITS = 5
+#: pass.  Measured on v5e (random depth-8 circuit, donated fori_loop):
+#: k=7 wins below 30 qubits (2725 vs 2020 gates/s at 28q) but the 4 KB
+#: DMA pieces cost at 30q, where k=6 is best (582 vs 517 gates/s) — the
+#: scheduler picks per register size via ``default_max_high``.
+MAX_HIGH_BITS = 7
+
+
+def default_max_high(num_vec_bits: int) -> int:
+    """Empirically-best exposed-high-bit budget for a state size."""
+    return 7 if num_vec_bits <= 29 else 6
 
 #: Per-block row budget (rows x 128 lanes x 4 B x ~8 pipeline buffers
 #: must sit well inside the ~16 MB VMEM).

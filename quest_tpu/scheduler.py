@@ -37,8 +37,8 @@ import bisect
 import numpy as np
 
 from .ops.pallas_kernels import (
-    MAX_HIGH_BITS,
     _ROW_BUDGET,
+    default_max_high,
     expand_gate,
 )
 
@@ -171,12 +171,14 @@ def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
 
 def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
                       row_budget: int = _ROW_BUDGET,
-                      max_high: int = MAX_HIGH_BITS):
+                      max_high: int | None = None):
     """Single-device scheduling: partition ``ops`` into fused segments.
 
     Returns a list of (seg_ops, high_bits) where seg_ops is the tuple for
     ``apply_fused_segment`` and high_bits the exposed high target qubits.
     """
+    if max_high is None:
+        max_high = default_max_high(num_vec_bits)
     return [
         (seg_ops, high)
         for seg_ops, high, _ in _schedule_chunk(
@@ -187,7 +189,7 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
 
 def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
                   row_budget: int = _ROW_BUDGET,
-                  max_high: int = MAX_HIGH_BITS):
+                  max_high: int | None = None):
     """Mesh scheduling with qubit relabeling.
 
     Returns a plan: a list of
@@ -204,6 +206,8 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     """
     ops = normalize_diag(ops)
     chunk_bits = num_vec_bits - dev_bits
+    if max_high is None:
+        max_high = default_max_high(chunk_bits)
     pos = list(range(num_vec_bits))  # pos[logical qubit] = physical bit
     inv = list(range(num_vec_bits))  # inv[physical bit] = logical qubit
 

@@ -125,19 +125,20 @@ def test_scheduler_packs_low_gates():
 
 
 def test_scheduler_reorders_and_caps_high_bits():
-    """More than MAX_HIGH_BITS distinct high targets forces a new segment;
-    commuting low gates slide forward into the earlier segment."""
-    from quest_tpu.ops.pallas_kernels import MAX_HIGH_BITS
+    """More than the high-bit budget of distinct high targets forces a new
+    segment; commuting low gates slide forward into the earlier segment."""
+    from quest_tpu.ops.pallas_kernels import default_max_high
 
+    budget = default_max_high(24)
     c = Circuit(24)
-    for t in range(18, 18 + MAX_HIGH_BITS + 1):
+    for t in range(16, 16 + budget + 1):
         c.hadamard(t)
     c.hadamard(0)
     segs = schedule_segments(c.ops, 24)
     assert len(segs) == 2
     (seg1, high1), (seg2, high2) = segs
-    assert len(high1) == MAX_HIGH_BITS
-    assert high2 == (18 + MAX_HIGH_BITS,)
+    assert len(high1) == budget
+    assert high2 == (16 + budget,)
     # the low H(0) commutes with everything and lands in segment 1
     assert any(op[0] in ("lanemm", "2x2") for op in seg1)
     assert len(seg2) == 1

@@ -10,6 +10,8 @@ import os
 import time
 from functools import partial
 
+import sys
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
 import jax
 import jax.numpy as jnp
 import numpy as np

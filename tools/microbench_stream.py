@@ -8,6 +8,8 @@ import os
 import time
 from functools import partial
 
+import sys
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
