@@ -83,10 +83,19 @@ def normalize_diag(ops):
 
 
 def _normalize_cx(ops, lane_bits: int, low_row_bits: int):
-    """Rewrite controlled-X with a low (lane/row-field) target and a
-    CROSS-field control as H . CZ . H: the H's are uncontrolled and fold
-    into the composed lane/row matrices, and CZ is a free diagonal — so
-    such a CNOT no longer needs the per-gate elementwise fallback.
+    """Low-target rewrites that keep composed field matrices cheap.
+
+    1. Controlled-X with a low (lane/row-field) target and a CROSS-field
+       control becomes H . CZ . H: the H's are uncontrolled and fold into
+       the composed lane/row matrices, and CZ is a free diagonal — so
+       such a CNOT no longer needs the per-gate elementwise fallback.
+    2. Any low-target gate of the form a*I + b*X with a complex entry
+       (e.g. rotateX: cos - i sin X) becomes H . diag(a+b, a-b) . H —
+       algebraically exact, controls carried by the diagonal alone (at
+       control 0 the uncontrolled H's cancel).  This keeps every composed
+       lane/row matrix REAL: a real matmul costs 2 MXU dots where a
+       complex one costs 3 (Gauss), and on v5e the MXU dots are exactly
+       what dense fused segments are bound by.
 
     Same-field-controlled X (control and target both lane, or both low
     row) folds whole into its field matrix and is kept as-is; so are
@@ -112,6 +121,20 @@ def _normalize_cx(ops, lane_bits: int, low_row_bits: int):
                 out.append(("apply_phase", (cm | (1 << t),), (-1.0, 0.0)))
                 out.append(("apply_2x2", (t, 0), _H_M))
                 continue
+            if (t < low_cov and (ar, ai) == (dr, di)
+                    and (br, bi) == (cr, ci) and (ai != 0.0 or bi != 0.0)):
+                a = complex(ar, ai)
+                b = complex(br, bi)
+                lo = a + b
+                if lo != 0.0:
+                    out.append(("apply_2x2", (t, 0), _H_M))
+                    if lo != 1.0:
+                        out.append(("apply_phase", (cm,), (lo.real, lo.imag)))
+                    rel = (a - b) / lo
+                    out.append(("apply_phase", (cm | (1 << t),),
+                                (rel.real, rel.imag)))
+                    out.append(("apply_2x2", (t, 0), _H_M))
+                    continue
         out.append(op)
     return out
 

@@ -142,3 +142,21 @@ def test_scheduler_reorders_and_caps_high_bits():
     # the low H(0) commutes with everything and lands in segment 1
     assert any(op[0] in ("lanemm", "2x2") for op in seg1)
     assert len(seg2) == 1
+
+
+def test_rx_rewrite_keeps_matrices_real(env1):
+    """Low-target aI+bX gates (rotateX) are rewritten H.diag.H at
+    schedule time so every composed lane/row matrix stays real (2 MXU
+    dots, not 3); results must stay bit-compatible with the eager path,
+    including controlled variants crossing bit fields."""
+    circ = Circuit(N_HIGH)
+    circ.rotate_x(2, 0.7)                      # lane target, uncontrolled
+    circ.controlled_rotate_x(14, 3, 0.4)       # high control, lane target
+    circ.rotate_x(8, 1.1)                      # low-row target
+    circ.hadamard(2).rotate_x(2, 0.3)          # composes into a lane run
+    segs = schedule_segments(circ.ops, N_HIGH)
+    for seg_ops, _high in segs:
+        for op in seg_ops:
+            if op[0] in ("lanemm", "rowmm"):
+                assert not np.asarray(op[2]).any(), "complex matrix leaked"
+    _compare(env1, circ, n=N_HIGH, seed=71)
