@@ -206,9 +206,9 @@ class Circuit:
         pass over the state (see quest_tpu.scheduler).  With a mesh, the
         segments run per-chunk inside shard_map and sharded-qubit gates
         are handled by half-chunk relayout exchanges
-        (quest_tpu.ops.mesh_exec).  Runs in interpreter mode off-TPU."""
+        (quest_tpu.parallel.mesh_exec).  Runs in interpreter mode off-TPU."""
         if mesh is not None and mesh.devices.size > 1:
-            from .ops.mesh_exec import as_mesh_fused_fn
+            from .parallel.mesh_exec import as_mesh_fused_fn
 
             nvec = self.num_qubits * (2 if self.is_density else 1)
             return as_mesh_fused_fn(list(self.ops), nvec, mesh,

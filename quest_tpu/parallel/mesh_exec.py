@@ -29,8 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .lattice import Lattice, state_shape, _ilog2
-from .pallas_kernels import apply_fused_segment
+from ..ops.lattice import Lattice, state_shape, _ilog2
+from ..ops.pallas_kernels import apply_fused_segment
 
 
 def _isolate_bit(x, bit: int, lane_bits: int):

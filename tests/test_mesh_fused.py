@@ -15,7 +15,7 @@ import quest_tpu as qt
 from quest_tpu import models
 from quest_tpu.circuit import Circuit
 from quest_tpu.scheduler import schedule_mesh
-from quest_tpu.ops.mesh_exec import plan_comm_stats
+from quest_tpu.parallel.mesh_exec import plan_comm_stats
 from quest_tpu.ops.lattice import state_shape, _ilog2
 
 from conftest import (
