@@ -24,6 +24,7 @@ from .env import (
     QuESTEnv,
     create_env,
     destroy_env,
+    init_distributed,
     sync_env,
     report_env,
     seed_quest,

@@ -45,6 +45,25 @@ class QuESTEnv:
         return (self.num_devices - 1).bit_length()
 
 
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Join a multi-host run (reference analogue: MPI_Init,
+    QuEST_cpu_distributed.c:135-164).
+
+    On Cloud TPU pods all arguments auto-discover; elsewhere pass the
+    coordinator's ``host:port`` plus this process's id.  After this,
+    ``jax.devices()`` is the GLOBAL device list, ``create_env()`` builds
+    the pod-wide amplitude mesh unchanged (XLA collectives ride ICI
+    within a host slice and DCN across), and the measurement RNG seed is
+    agreed across processes exactly as the reference broadcasts its seed
+    (QuEST_cpu_distributed.c:1294-1305).
+    """
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id)
+    seed_quest_default()  # re-seed now that the broadcast path is up
+
+
 def create_env(num_devices: int | None = None, devices=None) -> QuESTEnv:
     """Discover topology and build the amplitude mesh
     (reference: createQuESTEnv, QuEST_cpu_distributed.c:135-164).
@@ -52,6 +71,11 @@ def create_env(num_devices: int | None = None, devices=None) -> QuESTEnv:
     By default all visible devices are used (like an MPI world); a mesh is
     only created when more than one device participates.  ``num_devices``
     must be a power of two so that device index bits are qubit bits.
+
+    Multi-host: call :func:`init_distributed` first (or launch through an
+    environment that already called ``jax.distributed.initialize``);
+    ``jax.devices()`` then spans every process and the same 1-D mesh
+    construction shards registers pod-wide.
     """
     if devices is None:
         devices = jax.devices()
@@ -108,17 +132,44 @@ from .rng import MT19937
 _rng = MT19937()
 
 
+def _agree_across_processes(key: list[int]) -> list[int]:
+    """Make every process use process 0's seed key — the reference
+    broadcasts the seed so all ranks draw identical measurement outcomes
+    (QuEST_cpu_distributed.c:1294-1305).  Single-process: identity."""
+    try:
+        # Probe the distributed runtime WITHOUT touching jax.devices():
+        # this runs at import time, before hosts (the C bridge, tests)
+        # have configured their platform, and must not initialise a
+        # backend as a side effect.
+        from jax._src import distributed
+
+        if distributed.global_state.client is None:
+            return key
+        if jax.process_count() <= 1:
+            return key
+        from jax.experimental import multihost_utils
+
+        agreed = multihost_utils.broadcast_one_to_all(
+            np.asarray(key, dtype=np.uint32))
+        return [int(x) for x in np.asarray(agreed)]
+    except Exception:
+        return key
+
+
 def seed_quest(seeds) -> None:
     """Seed the global measurement RNG (reference: seedQuEST,
     QuEST_common.c:273-279; seeding algorithm init_by_array,
     mt19937ar.c)."""
-    _rng.init_by_array([int(s) for s in np.atleast_1d(np.asarray(seeds, dtype=np.uint64))])
+    key = [int(s) for s in np.atleast_1d(np.asarray(seeds, dtype=np.uint64))]
+    _rng.init_by_array(_agree_across_processes(key))
 
 
 def seed_quest_default() -> None:
-    """Default-seed from time and pid (reference: getQuESTDefaultSeedKey,
-    QuEST_common.c:133-148)."""
-    _rng.init_by_array([int(time.time() * 1000) & 0xFFFFFFFF, os.getpid()])
+    """Default-seed from time and pid, agreed across processes
+    (reference: getQuESTDefaultSeedKey, QuEST_common.c:133-148 +
+    MPI_Bcast, QuEST_cpu_distributed.c:1294-1305)."""
+    key = [int(time.time() * 1000) & 0xFFFFFFFF, os.getpid()]
+    _rng.init_by_array(_agree_across_processes(key))
 
 
 def random_real() -> float:
