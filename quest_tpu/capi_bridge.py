@@ -86,6 +86,21 @@ def init(precision_code: int, platform: str = "cpu") -> int:
     _qt = qt
     qt.set_default_precision("double" if precision_code == 2 else "single")
     _qreal = ctypes.c_double if precision_code == 2 else ctypes.c_float
+    # Multi-host: the reference's `mpirun ./prog` flow maps to launching
+    # the C program once per host with QUEST_CAPI_COORDINATOR=<host:port>,
+    # QUEST_CAPI_NUM_PROCESSES and QUEST_CAPI_PROCESS_ID set (all three
+    # auto-discover on Cloud TPU pods when only COORDINATOR=auto is
+    # given).  jax.devices() then spans every process and registers shard
+    # pod-wide.
+    coord = os.environ.get("QUEST_CAPI_COORDINATOR")
+    if coord:
+        nproc = os.environ.get("QUEST_CAPI_NUM_PROCESSES")
+        procid = os.environ.get("QUEST_CAPI_PROCESS_ID")
+        qt.init_distributed(
+            None if coord == "auto" else coord,
+            int(nproc) if nproc else None,
+            int(procid) if procid else None,
+        )
     # Single device by default (the reference's local backend semantics);
     # QUEST_CAPI_DEVICES=N shards registers over an N-device mesh, and 0
     # means "all visible devices".
@@ -196,8 +211,9 @@ def getNumAmps(h: int) -> int:
 def syncMirror(h: int, re_ptr: int, im_ptr: int, num_amps: int) -> int:
     """Copy the device state into the C-side host mirror buffers."""
     q = _q(h)
-    _real_view(re_ptr, num_amps)[:] = np.asarray(q.re).reshape(-1)
-    _real_view(im_ptr, num_amps)[:] = np.asarray(q.im).reshape(-1)
+    from .parallel import to_host
+    _real_view(re_ptr, num_amps)[:] = to_host(q.re).reshape(-1)
+    _real_view(im_ptr, num_amps)[:] = to_host(q.im).reshape(-1)
     return 0
 
 

@@ -33,3 +33,19 @@ def shard_state(re, im, mesh: Mesh):
     """Move flat amplitude arrays onto the mesh's amplitude sharding."""
     sh = amp_sharding(mesh)
     return jax.device_put(re, sh), jax.device_put(im, sh)
+
+
+def to_host(x) -> np.ndarray:
+    """Fetch an amplitude array to host memory, multi-process safe.
+
+    Single-process (even sharded over local devices): plain np.asarray.
+    Multi-process: the global array spans non-addressable devices, so
+    gather it — every process receives the FULL array, the analogue of
+    the reference's full-state replication bcast
+    (copyVecIntoMatrixPairState, QuEST_cpu_distributed.c:373-405).
+    """
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -645,8 +645,10 @@ def get_density_amp(qureg: Qureg, row: int, col: int) -> complex:
 
 def get_state_vector(qureg: Qureg) -> np.ndarray:
     """Full state as a flat host complex array (testing/debug convenience)."""
-    re = np.asarray(qureg.re).reshape(-1)
-    im = np.asarray(qureg.im).reshape(-1)
+    from .parallel import to_host
+
+    re = to_host(qureg.re).reshape(-1)
+    im = to_host(qureg.im).reshape(-1)
     return re.astype(np.complex128) + 1j * im
 
 

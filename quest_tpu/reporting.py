@@ -45,8 +45,10 @@ def report_state_to_screen(qureg: Qureg, env: QuESTEnv | None = None,
         print("Error: reportStateToScreen will not print output for "
               "systems of more than 5 qubits.")
         return
-    re = np.asarray(qureg.re, dtype=np.float64).reshape(-1)
-    im = np.asarray(qureg.im, dtype=np.float64).reshape(-1)
+    from .parallel import to_host
+
+    re = to_host(qureg.re).astype(np.float64).reshape(-1)
+    im = to_host(qureg.im).astype(np.float64).reshape(-1)
     # reference output shape: header(s), rows, closing bracket(s); when
     # reportRank is set each rank prints its own header+chunk+bracket, and
     # amplitudes use REAL_STRING_FORMAT — %.8f single / %.14f double

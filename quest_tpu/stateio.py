@@ -43,8 +43,10 @@ def report_state(qureg: Qureg, directory: str = ".") -> str:
     with a ``real, imag`` header and %.12f rows (reference: reportState,
     QuEST_common.c:166-182).  Returns the file path."""
     path = os.path.join(directory, "state_rank_0.csv")
-    re = np.asarray(qureg.re, dtype=np.float64).reshape(-1)
-    im = np.asarray(qureg.im, dtype=np.float64).reshape(-1)
+    from .parallel import to_host
+
+    re = to_host(qureg.re).astype(np.float64).reshape(-1)
+    im = to_host(qureg.im).astype(np.float64).reshape(-1)
     with open(path, "w") as f:
         f.write("real, imag\n")
         np.savetxt(f, np.column_stack([re, im]), fmt="%.12f, %.12f")

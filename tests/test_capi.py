@@ -398,3 +398,32 @@ def test_cmake_user_source_build(tmp_path):
                        timeout=600, cwd=tmp_path)
     assert r.returncode == 0, r.stderr[-1000:]
     assert "Probability amplitude of |111>: 0.498751" in r.stdout
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_c_program_multiprocess(lib, tmp_path):
+    """The reference's mpirun flow, TPU-style: the unmodified BV example
+    launched as two coordinated processes (QUEST_CAPI_COORDINATOR) with
+    the register sharded across both (reference: MPI backend,
+    QuEST_cpu_distributed.c:135-164)."""
+    exe = str(tmp_path / "bv")
+    subprocess.run(
+        ["cc", f"-I{CAPI}/include",
+         f"{REF}/examples/bernstein_vazirani_circuit.c", "-o", exe,
+         f"-L{CAPI}", "-lQuEST", f"-Wl,-rpath,{CAPI}"],
+        check=True, capture_output=True, text=True)
+    port = 19500 + (os.getpid() % 200)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(QUEST_CAPI_COORDINATOR=f"localhost:{port}",
+                   QUEST_CAPI_NUM_PROCESSES="2",
+                   QUEST_CAPI_PROCESS_ID=str(pid),
+                   QUEST_CAPI_DEVICES="0")
+        procs.append(subprocess.Popen(
+            [exe], stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=tmp_path))
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        assert p.returncode == 0, out[-2000:]
+        assert "solution reached with probability 1" in out
