@@ -1,0 +1,22 @@
+"""Bernstein-Vazirani, natively (C original:
+/root/reference/examples/bernstein_vazirani_circuit.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import quest_tpu as qt
+from quest_tpu import models
+
+NUM_QUBITS = 15
+SECRET = 0b101011101
+
+env = qt.create_env()
+q = qt.create_qureg(NUM_QUBITS, env)
+qt.init_zero_state(q)
+models.bernstein_vazirani(NUM_QUBITS, SECRET).run(q)
+
+prob = qt.get_prob_amp(q, SECRET)
+print(f"solution reached with probability {prob:f}")
+assert abs(prob - 1.0) < 1e-5

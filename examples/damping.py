@@ -1,0 +1,24 @@
+"""Single-qubit amplitude damping on a density matrix (C original:
+/root/reference/examples/damping_example.c)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import quest_tpu as qt
+
+env = qt.create_env()
+rho = qt.create_density_qureg(1, env)
+qt.init_plus_state(rho)
+
+print("rho00, rho01, rho10, rho11 after each damping round:")
+for step in range(11):
+    for r in range(2):
+        for c in range(2):
+            a = qt.get_density_amp(rho, r, c)
+            print(f"{a.real:.6f}{a.imag:+.6f}i", end="  ")
+    print()
+    qt.apply_one_qubit_damping_error(rho, 0, 0.1)
+
+qt.destroy_qureg(rho, env)

@@ -1,0 +1,27 @@
+"""Sharded QFT over every visible device; on a multi-host pod, run one
+process per host with quest_tpu.init_distributed (see
+examples/pod_launch.sh).  Single host: shards over local devices."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import math
+
+import quest_tpu as qt
+from quest_tpu import models
+
+env = qt.create_env()          # all visible devices
+n = 24 if env.num_devices > 1 else 20
+q = qt.create_qureg(n, env)
+qt.init_classical_state(q, 0b1011)
+models.qft(n).run(q)
+
+# QFT|x> has |amp_k| = 2^{-n/2} everywhere
+expect = 2.0 ** (-n / 2)
+amp = qt.get_amp(q, 3)
+print(f"devices={env.num_devices} n={n} |amp_3|={abs(amp):.3e} "
+      f"expect {expect:.3e}")
+assert abs(abs(amp) - expect) < 1e-6 * expect + 1e-9
+print("ok")

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Launch a quest_tpu program across a TPU pod slice (the analogue of the
+# reference's examples/submissionScripts/mpi_SLURM_example.sh).
+#
+# On Cloud TPU, one process per host; jax.distributed auto-discovers the
+# coordinator, so programs only need quest_tpu.init_distributed() —
+# or, for unmodified C programs linked against capi/libQuEST.so, set
+# QUEST_CAPI_COORDINATOR=auto QUEST_CAPI_DEVICES=0.
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME to the pod slice name}"
+PROGRAM=${1:-examples/distributed_qft.py}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --worker=all \
+    --command="cd $(pwd) && python ${PROGRAM}"

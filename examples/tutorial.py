@@ -1,0 +1,55 @@
+"""The reference tutorial circuit, natively (C original:
+/root/reference/examples/tutorial_example.c — which also compiles
+unmodified against capi/libQuEST.so; this is the same program through
+the Python API)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+import quest_tpu as qt
+
+env = qt.create_env()
+
+print("-" * 55)
+print("Running QuEST tutorial:\n\t Basic circuit involving a system of 3 qubits.")
+print("-" * 55)
+
+qubits = qt.create_qureg(3, env)
+qt.init_zero_state(qubits)
+
+print("\nThis is our environment:")
+qt.report_qureg_params(qubits)
+print(qt.report_env(env), end="")
+
+qt.hadamard(qubits, 0)
+qt.controlled_not(qubits, 0, 1)
+qt.rotate_y(qubits, 2, 0.1)
+qt.multi_controlled_phase_flip(qubits, [0, 1, 2])
+
+u = np.array([[0.5 + 0.5j, 0.5 - 0.5j],
+              [0.5 - 0.5j, 0.5 + 0.5j]])
+qt.unitary(qubits, 0, u)
+
+a, b = 0.5 + 0.5j, 0.5 - 0.5j
+qt.compact_unitary(qubits, 1, a, b)
+qt.rotate_around_axis(qubits, 2, 3.14 / 2, (1, 0, 0))
+qt.controlled_compact_unitary(qubits, 0, 1, a, b)
+qt.multi_controlled_unitary(qubits, [0, 1], 2, u)
+
+print("\nCircuit output:")
+amp = qt.get_prob_amp(qubits, 7)
+print(f"Probability amplitude of |111>: {amp:f}")
+prob = qt.calc_prob_of_outcome(qubits, 2, 1)
+print(f"Probability of qubit 2 being in state 1: {prob:f}")
+outcome = qt.measure(qubits, 0)
+print(f"Qubit 0 was measured in state {outcome}")
+prob_holder = qt.measure_with_stats(qubits, 2)
+print(f"Qubit 2 collapsed to {prob_holder[0]} with probability "
+      f"{prob_holder[1]:f}")
+
+qt.destroy_qureg(qubits, env)
+qt.destroy_env(env)

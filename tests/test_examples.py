@@ -1,0 +1,27 @@
+"""The native example programs run end-to-end (mirrors the reference's
+examples/ directory, SURVEY §2.5)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+@pytest.mark.parametrize("name,expect", [
+    ("tutorial.py", "Probability amplitude of |111>: 0.498751"),
+    ("bernstein_vazirani.py", "solution reached with probability 1.000000"),
+    ("damping.py", "rho00"),
+    ("distributed_qft.py", "ok"),
+])
+def test_example_runs(name, expect):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=EXAMPLES)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert expect in r.stdout
