@@ -108,7 +108,7 @@ def main():
     num_qubits = int(os.environ.get("QUEST_BENCH_QUBITS", "30"))
     depth = int(os.environ.get("QUEST_BENCH_DEPTH", "8"))
     reps = int(os.environ.get("QUEST_BENCH_REPS", "3"))
-    inner = int(os.environ.get("QUEST_BENCH_INNER", "8"))
+    inner = int(os.environ.get("QUEST_BENCH_INNER", "16"))
 
     # The fused Pallas executor updates the state strictly in place
     # (input_output_aliases through every segment), so only ONE (re, im)
