@@ -15,15 +15,8 @@ Circuit runs identically on one device or sharded over a mesh.
 
 from __future__ import annotations
 
-import itertools
 import math
 from dataclasses import dataclass, field
-
-#: Process-global op-stream version stamps: every mutation of any Circuit
-#: gets a fresh stamp, so compiled-program memo keys can never collide —
-#: not even between same-length circuits sharing a ``_compiled`` dict via
-#: copy.
-_VERSIONS = itertools.count(1)
 
 import jax
 
@@ -41,7 +34,6 @@ class Circuit:
     is_density: bool = False
     ops: list = field(default_factory=list)
     _compiled: dict = field(default_factory=dict, repr=False)
-    _version: int = field(default=0, repr=False)
 
     # -- recording helpers ----------------------------------------------
     @property
@@ -50,7 +42,6 @@ class Circuit:
 
     def _record(self, op):
         self.ops.append(op)
-        self._version = next(_VERSIONS)
 
     def _2x2(self, target, m, controls=()):
         if controls:
@@ -242,9 +233,12 @@ class Circuit:
         are testable on CPU.
 
         Memoised per config: jit caches key on function identity, so a
-        fresh closure per call would re-trace and re-compile every time."""
+        fresh closure per call would re-trace and re-compile every time.
+        Keyed on the op-stream CONTENT (ops are hashable tuples, and
+        hashing them is microseconds against a compile), so any mutation
+        — recorded or direct ``ops`` manipulation — recompiles."""
         use_pallas = pallas is True or pallas == "auto"
-        key = (mesh, donate, use_pallas, self._version)
+        key = (mesh, donate, use_pallas, tuple(self.ops))
         fn = self._compiled.get(key)
         if fn is None:
             if use_pallas:

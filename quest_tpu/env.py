@@ -143,17 +143,19 @@ def _agree_across_processes(key: list[int]) -> list[int]:
         # backend as a side effect.
         from jax._src import distributed
 
-        if distributed.global_state.client is None:
-            return key
-        if jax.process_count() <= 1:
-            return key
-        from jax.experimental import multihost_utils
-
-        agreed = multihost_utils.broadcast_one_to_all(
-            np.asarray(key, dtype=np.uint32))
-        return [int(x) for x in np.asarray(agreed)]
+        multi = distributed.global_state.client is not None
     except Exception:
+        multi = False
+    if not multi or jax.process_count() <= 1:
         return key
+    # Genuinely multi-process: a failed broadcast must PROPAGATE — a
+    # silent per-rank fallback would desynchronise measurement outcomes
+    # and corrupt the sharded state with no error.
+    from jax.experimental import multihost_utils
+
+    agreed = multihost_utils.broadcast_one_to_all(
+        np.asarray(key, dtype=np.uint32))
+    return [int(x) for x in np.asarray(agreed)]
 
 
 def seed_quest(seeds) -> None:

@@ -106,8 +106,7 @@ class Qureg:
         use_fused = (jax.default_backend() == "tpu"
                      and self.num_amps >= (1 << 13)
                      and self._re.dtype == jnp.float32
-                     and not _is_sweep(self._pending, self.num_vec_qubits,
-                                       self.mesh))
+                     and not _is_sweep(self, self._pending))
         if use_fused:
             ops = tuple(self._pending)
             self._pending = []
@@ -191,19 +190,21 @@ _STRUCT_HISTORY_MAX = 256
 _MISSING = object()
 
 
-def _is_sweep(ops, num_vec_qubits: int, mesh) -> bool:
-    """True when this op stream's *structure* was flushed before with
-    different scalar values — i.e. the caller is sweeping gate parameters
-    (e.g. the reference's rotate_benchmark.test, 20 trials x 29 targets).
-    Such streams would recompile the fused executor per angle; the
-    per-gate path's angle-traced compile cache serves them instead."""
+def _is_sweep(qureg, ops) -> bool:
+    """True when THIS register flushed this op-stream *structure* before
+    with different scalar values — i.e. the caller is sweeping gate
+    parameters (e.g. the reference's rotate_benchmark.test, 20 trials x
+    29 targets).  Such streams would recompile the fused executor per
+    angle; the per-gate path's angle-traced compile cache serves them
+    instead.  Keyed per register (id) so two registers running fixed-
+    angle circuits of the same shape never misclassify each other."""
     global _STRUCT_HISTORY
     if _STRUCT_HISTORY is None:
         from collections import OrderedDict
 
         _STRUCT_HISTORY = OrderedDict()
-    struct = (tuple((kind, statics) for kind, statics, _ in ops),
-              num_vec_qubits, mesh)
+    struct = (id(qureg), tuple((kind, statics) for kind, statics, _ in ops),
+              qureg.num_vec_qubits, qureg.mesh)
     scalars = tuple(s for _, _, s in ops)
     prev = _STRUCT_HISTORY.pop(struct, _MISSING)
     _STRUCT_HISTORY[struct] = scalars
