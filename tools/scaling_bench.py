@@ -1,0 +1,101 @@
+"""Qubit-count scaling curve on the local chip: sustained fused-executor
+throughput for the depth-8 random benchmark circuit at each size from
+``lo`` to the largest fitting HBM.  The reference's scaling axis is
+qubit count (SURVEY §5.7); this records how gate throughput degrades as
+the state grows HBM-bound.
+
+Writes ``SCALING_r{N}.json``.  Usage: python tools/scaling_bench.py [round]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+LO = int(os.environ.get("SCALING_LO", "20"))
+DEPTH = 8
+REPS = 3
+
+
+def measure(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    from quest_tpu import models
+    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.scheduler import schedule_segments
+
+    circ = models.random_circuit(n, depth=DEPTH, seed=123)
+    on_tpu = jax.default_backend() == "tpu"
+    apply = circ.as_fused_fn() if on_tpu else circ.as_fn(mesh=None)
+    n_passes = len(schedule_segments(list(circ.ops), n)) if on_tpu \
+        else circ.num_gates
+    # Keep each timed call ~1s: more inner reps for small, fast states.
+    inner = max(4, min(256, (1 << 30) // (1 << n) * 2))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, inner, lambda _, s: apply(*s), (re, im))
+
+    shape = state_shape(1 << n)
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    re, im = run(re, im)
+    _ = float(re[0, 0])
+    times = []
+    for _r in range(REPS):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        _ = float(re[0, 0])
+        times.append((time.perf_counter() - t0) / inner)
+    best = min(times)
+    state_gb = 2 * (1 << n) * 4 / 1e9
+    return {
+        "qubits": n,
+        "gates": circ.num_gates,
+        "passes": n_passes,
+        "gates_per_sec": round(circ.num_gates / best, 1),
+        "ms_per_pass": round(best / n_passes * 1e3, 3),
+        "hbm_gbps": round(n_passes * 2 * state_gb / best, 1),
+    }
+
+
+def main():
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    import jax
+
+    dev = jax.devices()[0]
+    hbm = 16 << 30
+    try:
+        hbm = dev.memory_stats().get("bytes_limit", hbm)
+    except Exception:
+        pass
+    hi = LO
+    while hi < 34 and 2 * (1 << (hi + 1)) * 4 <= 0.92 * hbm:
+        hi += 1
+
+    rows = []
+    for n in range(LO, hi + 1):
+        rows.append(measure(n))
+        print(rows[-1])
+    art = {
+        "config": f"depth-{DEPTH} random circuit, fused executor, "
+                  f"{LO}..{hi} qubits f32",
+        "device": dev.device_kind,
+        "rows": rows,
+    }
+    out = os.path.join(REPO, f"SCALING_r{rnd:02d}.json")
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
