@@ -168,22 +168,22 @@ def phase_shift(qureg: Qureg, target: int, angle: float) -> None:
     QuEST_common.c:195-200.)"""
     validate_target(qureg, target, "phaseShift")
     _apply_phase(qureg, 1 << target, (math.cos(angle), math.sin(angle)))
-    qasm.record_gate(qureg, "phase", targets=(target,), params=(angle,))
+    qasm.record_phase_shift(qureg, target, angle)
 
 
 def controlled_phase_shift(qureg: Qureg, q1: int, q2: int, angle: float) -> None:
     """(reference: controlledPhaseShift, QuEST.c; kernel QuEST_cpu.c:2706.)"""
     validate_unique_targets(qureg, q1, q2, "controlledPhaseShift")
     _apply_phase(qureg, (1 << q1) | (1 << q2), (math.cos(angle), math.sin(angle)))
-    qasm.record_gate(qureg, "phase", targets=(q2,), controls=(q1,), params=(angle,))
+    qasm.record_phase_shift(qureg, q2, angle, controls=(q1,))
 
 
 def multi_controlled_phase_shift(qureg: Qureg, qubits, angle: float) -> None:
     """(reference: multiControlledPhaseShift; kernel QuEST_cpu.c:2745.)"""
     validate_multi_qubits(qureg, qubits, "multiControlledPhaseShift")
     _apply_phase(qureg, _ctrl_mask(qubits), (math.cos(angle), math.sin(angle)))
-    qasm.record_gate(qureg, "phase", targets=(qubits[-1],),
-                     controls=tuple(qubits[:-1]), params=(angle,))
+    qasm.record_phase_shift(qureg, qubits[-1], angle,
+                            controls=tuple(qubits[:-1]))
 
 
 def controlled_phase_flip(qureg: Qureg, q1: int, q2: int) -> None:

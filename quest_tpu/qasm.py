@@ -71,7 +71,8 @@ def write_recorded_qasm_to_file(qureg, filename: str) -> None:
 
 
 def _fmt(x: float) -> str:
-    return f"{x:.15g}"
+    # the reference prints QASM params to 14 significant digits
+    return f"{x:.14g}"
 
 
 def record_gate(qureg, name: str, targets=(), controls=(), params=()) -> None:
@@ -145,8 +146,10 @@ def record_compact_unitary(qureg, alpha: complex, beta: complex, target: int,
     if log is None or not log.recording:
         return
     rz2, ry, rz1 = _zyz(alpha, beta)
+    # reference parameter order: (rz2, ry, rz1) —
+    # qasm_recordCompactUnitary, QuEST_qasm.c:251-262
     record_gate(qureg, "U", targets=(target,), controls=controls,
-                params=(ry, rz2, rz1))
+                params=(rz2, ry, rz1))
 
 
 def record_unitary(qureg, u, target: int, controls=()) -> None:
@@ -163,15 +166,35 @@ def record_unitary(qureg, u, target: int, controls=()) -> None:
     alpha, beta = r0c0 * rot, r1c0 * rot
     rz2, ry, rz1 = _zyz(alpha, beta)
     record_gate(qureg, "U", targets=(target,), controls=controls,
-                params=(ry, rz2, rz1))
-    if controls and abs(phase) > 1e-15:
-        # The stripped determinant phase e^{i phi} is physical once
-        # controlled: c-U = c-(e^{i phi} V) needs an extra e^{i phi} on
-        # exactly the all-controls-1 branch, i.e. a (multi-controlled)
-        # phase shift over the control set (reference phase-fix pattern:
-        # QuEST_qasm.c:327-346).
-        record_gate(qureg, "phase", targets=(controls[-1],),
-                    controls=tuple(controls[:-1]), params=(phase,))
+                params=(rz2, ry, rz1))
+    if controls:
+        # The reference "restores the discarded global phase" of a
+        # controlled U with an uncontrolled Rz on the target — a comment
+        # plus Rz(globalPhase) for one control (QuEST_qasm.c:265-287),
+        # the bare Rz for the multi-controlled form (:327-346).
+        if len(controls) == 1:
+            record_comment(qureg, "Restoring the discarded global phase "
+                                  "of the previous controlled unitary")
+        record_gate(qureg, "Rz", targets=(target,), params=(phase,))
+
+
+def record_phase_shift(qureg, target: int, angle: float,
+                       controls=()) -> None:
+    """Phase shift, labelled Rz like the reference (qasmGateLabels
+    GATE_PHASE_SHIFT, QuEST_qasm.c:34-46); controlled variants append
+    the reference's global-phase fix Rz(angle/2) on the target
+    (qasm_recordControlledParamGate :234-249, multi-controlled
+    :312-326)."""
+    log = qureg.qasm
+    if log is None or not log.recording:
+        return
+    record_gate(qureg, "Rz", targets=(target,), controls=controls,
+                params=(angle,))
+    if controls:
+        kind = "controlled" if len(controls) == 1 else "multicontrolled"
+        record_comment(qureg, "Restoring the discarded global phase of "
+                              f"the previous {kind} phase gate")
+        record_gate(qureg, "Rz", targets=(target,), params=(angle / 2.0,))
 
 
 def record_axis_rotation(qureg, angle: float, axis, target: int,
