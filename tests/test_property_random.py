@@ -95,3 +95,73 @@ def test_random_interleaving_matches_oracle(env, seed):
     got = qt.get_state_vector(q)
     np.testing.assert_allclose(got, psi, atol=TOL)
     assert abs(qt.calc_total_prob(q) - 1.0) < TOL
+
+
+def _random_dm_op(rng, n):
+    kind = rng.randint(8)
+    t = rng.randint(n)
+    others = [q for q in range(n) if q != t]
+    c = others[rng.randint(len(others))]
+    p = float(rng.uniform(0, 0.4))
+    if kind == 0:
+        return ("h", t)
+    if kind == 1:
+        return ("cnot", c, t)
+    if kind == 2:
+        return ("t", t)
+    if kind == 3:
+        return ("dephase", t, min(p, 0.49))
+    if kind == 4:
+        return ("depolarise", t, min(p, 0.74))
+    if kind == 5:
+        return ("damping", t, p)
+    if kind == 6:
+        return ("dephase2", c, t, min(p, 0.74))
+    return ("read", t)
+
+
+def _apply_dm(q, rho, n, op):
+    kind = op[0]
+    if kind == "h":
+        qt.hadamard(q, op[1])
+        rho = oracle.apply_dm(rho, n, op[1], oracle.H)
+    elif kind == "cnot":
+        qt.controlled_not(q, op[1], op[2])
+        rho = oracle.apply_dm(rho, n, op[2], oracle.X, controls=(op[1],))
+    elif kind == "t":
+        qt.t_gate(q, op[1])
+        rho = oracle.apply_dm(rho, n, op[1], oracle.T)
+    elif kind == "dephase":
+        qt.apply_one_qubit_dephase_error(q, op[1], op[2])
+        rho = oracle.dephase1(rho, n, op[1], op[2])
+    elif kind == "depolarise":
+        qt.apply_one_qubit_depolarise_error(q, op[1], op[2])
+        rho = oracle.depolarise1(rho, n, op[1], op[2])
+    elif kind == "damping":
+        qt.apply_one_qubit_damping_error(q, op[1], op[2])
+        rho = oracle.damping(rho, n, op[1], op[2])
+    elif kind == "dephase2":
+        qt.apply_two_qubit_dephase_error(q, op[1], op[2], op[3])
+        rho = oracle.dephase2(rho, n, op[1], op[2], op[3])
+    elif kind == "read":
+        got = qt.get_density_amp(q, op[1], op[1])
+        want = complex(rho[op[1], op[1]])
+        assert abs(got - want) < 1e-4
+    return rho
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_random_dm_interleaving_matches_oracle(env, seed):
+    """Gates + noise channels + mid-stream reads on a density matrix,
+    against the dense Kraus oracle — the interleaving coverage for the
+    trickiest kernels (two-qubit dephase, damping, depolarise)."""
+    n = 3
+    rng = np.random.RandomState(seed)
+    q = qt.create_density_qureg(n, env)
+    rho = np.zeros((1 << n, 1 << n), dtype=np.complex128)
+    rho[0, 0] = 1.0
+    for _ in range(80):
+        rho = _apply_dm(q, rho, n, _random_dm_op(rng, n))
+    got = qt.get_state_vector(q).reshape(1 << n, 1 << n, order="F")
+    np.testing.assert_allclose(got, rho, atol=TOL)
+    assert abs(qt.calc_total_prob(q) - 1.0) < TOL
