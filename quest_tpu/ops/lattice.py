@@ -184,6 +184,14 @@ class Lattice:
                     x = jnp.flip(
                         x.reshape(-1, 2, s, self.lanes), axis=1
                     ).reshape(x.shape)
+                    # XLA:TPU miscompiles when two of these flip chains
+                    # fuse into one elementwise consumer sharing a traced
+                    # scalar (observed: depolarise at 24+ vector qubits
+                    # scaled half the diagonal by a value NEITHER branch
+                    # computes).  The barrier pins the flipped copy as a
+                    # real buffer; the flip materialises anyway, so this
+                    # costs nothing measurable.
+                    x = lax.optimization_barrier(x)
             row_m >>= 1
             j += 1
         dev_m = mask >> self.chunk_bits

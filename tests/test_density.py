@@ -168,3 +168,18 @@ def test_purity_decreases_under_noise(env):
     qt.apply_one_qubit_depolarise_error(d, 0, 0.5)
     after = qt.calc_purity(d)
     assert after < before
+
+
+def test_depolarise_trace_at_flip_path_scale(env1):
+    """Regression: XLA:TPU miscompiled two fused reshape-flip partner
+    fetches sharing a traced scalar (dm_depolarise1's re+im update),
+    scaling half the diagonal by a value neither branch computes — only
+    at 24+ vector qubits, far above unit-test sizes.  xor_shift now pins
+    the flipped copy behind an optimization_barrier; this runs the exact
+    failing geometry (N=12 density, target 1) and checks the channel is
+    trace-preserving."""
+    rho = qt.create_density_qureg(12, env1)
+    qt.init_plus_state(rho)
+    qt.apply_one_qubit_depolarise_error(rho, 1, 0.3)
+    assert abs(qt.calc_total_prob(rho) - 1.0) < 1e-5
+    qt.destroy_qureg(rho, env1)
