@@ -1,0 +1,138 @@
+"""Large-scale kernel smoke: every kernel path at real-chip sizes.
+
+Unit tests run at sizes where all qubits are lane/low-row bits; the
+XLA:TPU flip-path miscompile (see quest_tpu/ops/lattice.py xor_shift)
+showed that codegen bugs can live exclusively at large-state geometry.
+This sweeps EVERY kernel across target bit classes at 26 vector qubits
+(state-vector) / 13 density qubits, checking physical invariants:
+
+* gates preserve the 2-norm;
+* probabilities are correct on analytically-known states;
+* every noise channel preserves trace;
+* collapse renormalises; reductions match closed forms.
+
+Prints one PASS/FAIL line per check and writes ``SCALESMOKE_r{N}.json``.
+Usage: python tools/scale_smoke.py [round]
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SV_QUBITS = int(os.environ.get("SCALE_SMOKE_SV", "26"))
+DM_QUBITS = int(os.environ.get("SCALE_SMOKE_DM", "13"))
+TOL = 2e-3  # f32 across 2^26 amplitudes
+
+results = []
+
+
+def check(name: str, err: float):
+    ok = err < TOL
+    results.append({"check": name, "err": float(err), "ok": bool(ok)})
+    print(f"{'PASS' if ok else 'FAIL'} {name:48s} err={err:.2e}")
+
+
+def sv_checks(qt, env):
+    n = SV_QUBITS
+    # targets spanning lane (2), sublane-roll row (8), flip-path row
+    # (12, 16), and top (n-1) bit classes
+    targets = [2, 8, 12, 16, n - 1]
+    for t in targets:
+        q = qt.create_qureg(n, env)
+        qt.init_plus_state(q)
+        qt.rotate_y(q, t, 0.77)          # eager fused path
+        check(f"sv rotateY norm (t={t})", abs(qt.calc_total_prob(q) - 1))
+        qt.destroy_qureg(q, env)
+    for t in targets:
+        # per-gate XLA path (the sweep route): two flushes of the same
+        # structure with different angles force it
+        q = qt.create_qureg(n, env)
+        qt.init_plus_state(q)
+        qt.rotate_y(q, t, 0.3)
+        _ = qt.calc_total_prob(q)
+        qt.rotate_y(q, t, 0.4)
+        check(f"sv rotateY norm xla-path (t={t})",
+              abs(qt.calc_total_prob(q) - 1))
+        qt.destroy_qureg(q, env)
+    # controlled gate across classes + prob of outcome on |+>
+    q = qt.create_qureg(n, env)
+    qt.init_plus_state(q)
+    qt.controlled_not(q, 2, 16)
+    qt.controlled_not(q, 16, 2)
+    check("sv cnot cross-class norm", abs(qt.calc_total_prob(q) - 1))
+    check("sv probOfOutcome(+)", abs(qt.calc_prob_of_outcome(q, 12, 1) - 0.5))
+    # collapse renormalises
+    qt.collapse_to_outcome(q, 16, 1)
+    check("sv collapse renorm", abs(qt.calc_total_prob(q) - 1))
+    qt.destroy_qureg(q, env)
+    # inner product of |+> with itself = 1
+    a = qt.create_qureg(n, env)
+    b = qt.create_qureg(n, env)
+    qt.init_plus_state(a)
+    qt.init_plus_state(b)
+    ip = qt.calc_inner_product(a, b)
+    check("sv innerProduct(+,+)", abs(ip - 1))
+    qt.destroy_qureg(a, env)
+    qt.destroy_qureg(b, env)
+
+
+def dm_checks(qt, env):
+    n = DM_QUBITS
+    channels = [
+        ("dephase1", lambda q, t: qt.apply_one_qubit_dephase_error(q, t, 0.3)),
+        ("depol1", lambda q, t: qt.apply_one_qubit_depolarise_error(q, t, 0.3)),
+        ("damping", lambda q, t: qt.apply_one_qubit_damping_error(q, t, 0.3)),
+        ("dephase2", lambda q, t: qt.apply_two_qubit_dephase_error(
+            q, t, (t + 3) % n, 0.3)),
+        ("depol2", lambda q, t: qt.apply_two_qubit_depolarise_error(
+            q, t, (t + 3) % n, 0.3)),
+    ]
+    for name, fn in channels:
+        for t in (1, 4, 8, n - 1):  # inner lane/row x outer row classes
+            q = qt.create_density_qureg(n, env)
+            qt.init_plus_state(q)
+            qt.hadamard(q, (t + 1) % n)
+            fn(q, t)
+            check(f"dm {name} trace (t={t})", abs(qt.calc_total_prob(q) - 1))
+            qt.destroy_qureg(q, env)
+    # purity/fidelity closed forms on known states
+    rho = qt.create_density_qureg(n, env)
+    psi = qt.create_qureg(n, env)
+    qt.init_plus_state(rho)
+    qt.init_plus_state(psi)
+    check("dm purity(pure +)", abs(qt.calc_purity(rho) - 1))
+    check("dm fidelity(+,+)", abs(qt.calc_fidelity(rho, psi) - 1))
+    qt.apply_one_qubit_depolarise_error(rho, 2, 0.75)
+    check("dm collapse trace", abs(
+        qt.collapse_to_outcome(rho, 4, 0) * 2 - 1.0))
+    check("dm post-collapse trace", abs(qt.calc_total_prob(rho) - 1))
+    qt.destroy_qureg(rho, env)
+    qt.destroy_qureg(psi, env)
+
+
+def main():
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    import quest_tpu as qt
+
+    env = qt.create_env()
+    sv_checks(qt, env)
+    dm_checks(qt, env)
+    n_fail = sum(1 for r in results if not r["ok"])
+    art = {"sv_qubits": SV_QUBITS, "dm_qubits": DM_QUBITS,
+           "checks": results, "failures": n_fail}
+    out = os.path.join(REPO, f"SCALESMOKE_r{rnd:02d}.json")
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"{len(results)} checks, {n_fail} failures -> {out}")
+    sys.exit(1 if n_fail else 0)
+
+
+if __name__ == "__main__":
+    main()
