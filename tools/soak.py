@@ -1,0 +1,120 @@
+"""On-chip numerics soak: long random API streams at TPU geometry,
+checked densely against the numpy oracle.
+
+The pytest property tests run this shape at 6 qubits on CPU; here the
+same oracle-checked interleavings run at 20 qubits on the real chip —
+through the production fused executor, the sweep-detection route, and
+mid-stream flushes — so scheduler/kernel/geometry interactions get
+exact end-to-end coverage where the flip-path-class bugs live.
+
+Usage: python tools/soak.py [n_streams] [ops_per_stream]
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import numpy as np
+
+N = int(os.environ.get("SOAK_QUBITS", "20"))
+
+
+def np_apply(psi: np.ndarray, n: int, t: int, u2: np.ndarray,
+             controls=()) -> np.ndarray:
+    """Apply a (controlled) 2x2 to a flat 2^n vector without ever
+    materialising a dense operator (the tests/oracle.py full_gate form
+    is 16 TiB at 20 qubits)."""
+    v = psi.reshape([2] * n)  # axis k = qubit n-1-k
+    ax = n - 1 - t
+    idx0 = [slice(None)] * n
+    for c in controls:
+        idx0[n - 1 - c] = 1
+    i0, i1 = list(idx0), list(idx0)
+    i0[ax] = 0
+    i1[ax] = 1
+    a0 = v[tuple(i0)].copy()
+    a1 = v[tuple(i1)].copy()
+    v = v.copy()
+    v[tuple(i0)] = u2[0, 0] * a0 + u2[0, 1] * a1
+    v[tuple(i1)] = u2[1, 0] * a0 + u2[1, 1] * a1
+    return v.reshape(-1)
+
+
+def run_stream(qt, oracle, env, seed: int, n_ops: int) -> float:
+    rng = np.random.RandomState(seed)
+    q = qt.create_qureg(N, env)
+    psi = np.zeros(1 << N, dtype=np.complex128)
+    psi[0] = 1.0
+    for k in range(n_ops):
+        kind = rng.randint(9)
+        t = int(rng.randint(N))
+        angle = float(rng.uniform(0, 2 * math.pi))
+        others = [x for x in range(N) if x != t]
+        c = int(others[rng.randint(len(others))])
+        if kind == 0:
+            qt.hadamard(q, t)
+            psi = np_apply(psi, N, t, oracle.H)
+        elif kind == 1:
+            qt.rotate_x(q, t, angle)
+            psi = np_apply(psi, N, t, oracle.rot(angle, (1, 0, 0)))
+        elif kind == 2:
+            qt.rotate_z(q, t, angle)
+            psi = np_apply(psi, N, t, oracle.rot(angle, (0, 0, 1)))
+        elif kind == 3:
+            qt.controlled_not(q, c, t)
+            psi = np_apply(psi, N, t, oracle.X, controls=(c,))
+        elif kind == 4:
+            qt.t_gate(q, t)
+            psi = np_apply(psi, N, t, oracle.T)
+        elif kind == 5:
+            qt.controlled_phase_shift(q, c, t, angle)
+            m = oracle.phase_m(complex(math.cos(angle), math.sin(angle)))
+            psi = np_apply(psi, N, t, m, controls=(c,))
+        elif kind == 6:
+            u = oracle.random_unitary(int(rng.randint(1 << 30)))
+            qt.unitary(q, t, u)
+            psi = np_apply(psi, N, t, u)
+        elif kind == 7:
+            u = oracle.random_unitary(int(rng.randint(1 << 30)))
+            qt.controlled_unitary(q, c, t, u)
+            psi = np_apply(psi, N, t, u, controls=(c,))
+        else:
+            ind = int(rng.randint(1 << N))
+            got = qt.get_amp(q, ind)  # mid-stream flush
+            want = complex(psi[ind])
+            assert abs(got - want) < 5e-4, (seed, k, ind, got, want)
+    got = qt.get_state_vector(q)
+    err = float(np.max(np.abs(got - psi)))
+    qt.destroy_qureg(q, env)
+    return err
+
+
+def main():
+    n_streams = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    n_ops = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    import quest_tpu as qt
+    import oracle
+
+    env = qt.create_env()
+    worst = 0.0
+    t0 = time.time()
+    for s in range(n_streams):
+        err = run_stream(qt, oracle, env, 1000 + s, n_ops)
+        worst = max(worst, err)
+        print(f"stream {s}: max|amp err| = {err:.2e}  "
+              f"({time.time() - t0:.0f}s elapsed)", flush=True)
+    print(f"SOAK OK: {n_streams} x {n_ops} ops at {N}q, "
+          f"worst amplitude error {worst:.2e}")
+    assert worst < 5e-4
+
+
+if __name__ == "__main__":
+    main()
