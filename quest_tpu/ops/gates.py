@@ -183,7 +183,7 @@ def multi_controlled_phase_shift(qureg: Qureg, qubits, angle: float) -> None:
     validate_multi_qubits(qureg, qubits, "multiControlledPhaseShift")
     _apply_phase(qureg, _ctrl_mask(qubits), (math.cos(angle), math.sin(angle)))
     qasm.record_phase_shift(qureg, qubits[-1], angle,
-                            controls=tuple(qubits[:-1]))
+                            controls=tuple(qubits[:-1]), multi=True)
 
 
 def controlled_phase_flip(qureg: Qureg, q1: int, q2: int) -> None:

@@ -224,8 +224,7 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
             planned.append(("dtab", add_mat(np.asarray(tr)),
                             add_mat(np.asarray(ti))))
         elif op[0] == "2x2":
-            _, t, m, ctrl_mask, flag_ix = op
-            planned.append(("2x2", t, m, ctrl_mask, -1, flag_ix))
+            planned.append(op)
         else:
             planned.append(op)
     planned = tuple(planned)
@@ -424,7 +423,7 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
             dre, dim = dre * fr - dim * fi, dre * fi + dim * fr
         return r * dre - i * dim, i * dre + r * dim
     if kind == "2x2":
-        _, t, m, ctrl_mask, perm_ix, flag_ix = op
+        _, t, m, ctrl_mask, flag_ix = op
         if (t >= lane_bits) and (t - lane_bits) in high_axis:
             # both halves of the exposed size-2 axis are in-register:
             # apply the 2x2 directly on the sliced halves (no partner

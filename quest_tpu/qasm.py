@@ -179,19 +179,21 @@ def record_unitary(qureg, u, target: int, controls=()) -> None:
 
 
 def record_phase_shift(qureg, target: int, angle: float,
-                       controls=()) -> None:
+                       controls=(), multi: bool = False) -> None:
     """Phase shift, labelled Rz like the reference (qasmGateLabels
     GATE_PHASE_SHIFT, QuEST_qasm.c:34-46); controlled variants append
     the reference's global-phase fix Rz(angle/2) on the target
     (qasm_recordControlledParamGate :234-249, multi-controlled
-    :312-326)."""
+    :312-326).  ``multi`` marks the multiControlled API entry, whose fix
+    lines the reference emits even when the qubit list leaves zero
+    controls (a single-element list is accepted input)."""
     log = qureg.qasm
     if log is None or not log.recording:
         return
     record_gate(qureg, "Rz", targets=(target,), controls=controls,
                 params=(angle,))
-    if controls:
-        kind = "controlled" if len(controls) == 1 else "multicontrolled"
+    if controls or multi:
+        kind = "multicontrolled" if multi else "controlled"
         record_comment(qureg, "Restoring the discarded global phase of "
                               f"the previous {kind} phase gate")
         record_gate(qureg, "Rz", targets=(target,), params=(angle / 2.0,))

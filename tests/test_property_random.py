@@ -162,6 +162,6 @@ def test_random_dm_interleaving_matches_oracle(env, seed):
     rho[0, 0] = 1.0
     for _ in range(80):
         rho = _apply_dm(q, rho, n, _random_dm_op(rng, n))
-    got = qt.get_state_vector(q).reshape(1 << n, 1 << n, order="F")
+    got = qt.get_density_matrix(q)
     np.testing.assert_allclose(got, rho, atol=TOL)
     assert abs(qt.calc_total_prob(q) - 1.0) < TOL
