@@ -50,7 +50,7 @@ of any op — the reference's split-by-target branching
 from __future__ import annotations
 
 import math
-from functools import partial, lru_cache
+from functools import lru_cache
 
 import numpy as np
 import jax
