@@ -180,6 +180,10 @@ class Lattice:
                     down = jnp.roll(x, s, axis=0)
                     rb = (self._row_iota() >> j) & 1
                     x = jnp.where(rb == 0, up, down)
+                    # same prophylactic barrier as the flip branch below:
+                    # the roll+select chain has the identical fusion shape
+                    # that XLA:TPU miscompiled there.
+                    x = lax.optimization_barrier(x)
                 else:
                     x = jnp.flip(
                         x.reshape(-1, 2, s, self.lanes), axis=1
