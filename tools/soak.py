@@ -113,6 +113,15 @@ def main():
               f"({time.time() - t0:.0f}s elapsed)", flush=True)
     print(f"SOAK OK: {n_streams} x {n_ops} ops at {N}q, "
           f"worst amplitude error {worst:.2e}")
+    rnd = int(os.environ.get("SOAK_ROUND", "0"))
+    if rnd:
+        import json
+
+        out = os.path.join(REPO, f"SOAK_r{rnd:02d}.json")
+        json.dump({"config": f"oracle-checked random API streams, {N}q f32",
+                   "streams": n_streams, "ops_per_stream": n_ops,
+                   "worst_amp_error": worst}, open(out, "w"), indent=1)
+        print(f"wrote {out}")
     assert worst < 5e-4
 
 
