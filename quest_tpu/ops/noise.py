@@ -28,9 +28,11 @@ from .lattice import run_kernel
 
 
 def _run(qureg: Qureg, kind: str, scalars, statics) -> None:
-    re, im = run_kernel((qureg.re, qureg.im), scalars, kind=kind,
-                        statics=statics, mesh=qureg.mesh)
-    qureg._set(re, im)
+    # Deferred like gates: the flush runs channels through donated
+    # kernels in submission order, so a gate+channel sequence dispatches
+    # asynchronously (one host sync per state READ, not per call) and
+    # never holds two full state copies.
+    qureg._defer((kind, statics, tuple(scalars)))
 
 
 def apply_one_qubit_dephase_error(qureg: Qureg, target: int, prob: float) -> None:

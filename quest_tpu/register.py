@@ -95,6 +95,26 @@ class Qureg:
     def _flush(self) -> None:
         import jax
 
+        from .ops.lattice import run_kernel_donated
+
+        while self._pending:
+            # Maximal prefix of fusable GATE ops; the stream may also
+            # carry other single-register kernels (the noise channels
+            # defer too, so a density workload's channel sequence
+            # dispatches asynchronously instead of syncing per call).
+            run = []
+            while self._pending and self._pending[0][0] in _GATE_KINDS:
+                run.append(self._pending.pop(0))
+            if run:
+                self._run_gates(jax, run, run_kernel_donated)
+            if self._pending:  # a non-gate kernel op at the head
+                kind, statics, scalars = self._pending[0]
+                self._re, self._im = run_kernel_donated(
+                    (self._re, self._im), scalars, kind=kind,
+                    statics=statics, mesh=self.mesh)
+                del self._pending[0]
+
+    def _run_gates(self, jax, run, run_kernel_donated) -> None:
         # Fused Pallas needs tile-aligned (>= (8, 128)) chunks and f32
         # (Mosaic has no f64 dot lowering); below/besides that the
         # per-gate XLA path is the right one anyway (tiny states are
@@ -106,10 +126,9 @@ class Qureg:
         use_fused = (jax.default_backend() == "tpu"
                      and self.num_amps >= (1 << 13)
                      and self._re.dtype == jnp.float32
-                     and not _is_sweep(self, self._pending))
+                     and not _is_sweep(self, run))
         if use_fused:
-            ops = tuple(self._pending)
-            self._pending = []
+            ops = tuple(run)
             try:
                 # One fused program per unique stream, buffers donated —
                 # the state is updated strictly in place (a 30q f32
@@ -126,15 +145,17 @@ class Qureg:
             # Per-gate jitted kernels with traced scalars; buffers are
             # donated through the chain (the flush owns them).  Each op
             # is popped only after its kernel ran, so a failure requeues
-            # exactly the unapplied tail.
-            from .ops.lattice import run_kernel_donated
-
-            while self._pending:
-                kind, statics, scalars = self._pending[0]
-                self._re, self._im = run_kernel_donated(
-                    (self._re, self._im), scalars, kind=kind,
-                    statics=statics, mesh=self.mesh)
-                del self._pending[0]
+            # exactly the unapplied tail (plus whatever remains queued).
+            while run:
+                kind, statics, scalars = run[0]
+                try:
+                    self._re, self._im = run_kernel_donated(
+                        (self._re, self._im), scalars, kind=kind,
+                        statics=statics, mesh=self.mesh)
+                except Exception:
+                    self._pending = run + self._pending
+                    raise
+                del run[0]
 
     # -- shape bookkeeping ----------------------------------------------
     @property
@@ -182,6 +203,10 @@ class Qureg:
 #: leak under angle sweeps).
 _STREAM_CACHE: "OrderedDict" = None  # initialised below
 _STREAM_CACHE_MAX = 64
+
+#: Op kinds the fused executor understands; everything else in a
+#: deferred stream (noise channels) runs via the donated kernel path.
+_GATE_KINDS = ("apply_2x2", "apply_phase")
 
 #: Sweep detection: structure key (kinds + statics, no scalars) -> the
 #: scalars that structure was last flushed with.  LRU-bounded.
