@@ -1,0 +1,57 @@
+"""Regenerate every recorded artifact for a round in one command.
+
+Usage: python tools/record_all.py [round_number]
+
+Runs each recorder as a subprocess (so a failure in one doesn't lose the
+rest) and prints a summary table.  Rough total runtime on the 1-chip
+host: ~25 minutes, dominated by the C-driver cold build and the soak.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+RECORDERS = [
+    ("qft_dist.py", []),
+    ("cdriver_bench.py", []),
+    ("rotate_bench.py", []),
+    ("random34.py", []),
+    ("scaling_bench.py", []),
+    ("density_bench.py", []),
+    ("scale_smoke.py", []),
+    ("soak.py", ["8", "200"]),
+]
+
+
+def main():
+    rnd = sys.argv[1] if len(sys.argv) > 1 else "2"
+    summary = []
+    for script, extra in RECORDERS:
+        path = os.path.join(REPO, "tools", script)
+        args = [sys.executable, path] + (extra if script == "soak.py"
+                                         else [rnd] + extra)
+        env = dict(os.environ)
+        if script == "soak.py":
+            env["SOAK_ROUND"] = rnd
+        t0 = time.time()
+        r = subprocess.run(args, capture_output=True, text=True,
+                           cwd=REPO, env=env, timeout=7200)
+        secs = time.time() - t0
+        ok = r.returncode == 0
+        summary.append((script, ok, secs))
+        print(f"{'OK  ' if ok else 'FAIL'} {script:22s} {secs:7.1f}s")
+        if not ok:
+            print(r.stdout[-1500:])
+            print(r.stderr[-1500:])
+    n_fail = sum(1 for _, ok, _ in summary if not ok)
+    print(f"{len(summary)} recorders, {n_fail} failed")
+    sys.exit(1 if n_fail else 0)
+
+
+if __name__ == "__main__":
+    main()
