@@ -22,23 +22,20 @@ from ..validation import (
     validate_outcome,
     validate_measurement_prob,
 )
-from .lattice import run_kernel
 from .calc import calc_prob_of_outcome
 from .. import precision
 
 
 def _collapse(qureg: Qureg, target: int, outcome: int, prob: float) -> None:
+    # Deferred like gates/channels: the flush's donated dispatch keeps
+    # collapse in place (a non-donated 30q f32 collapse would briefly
+    # hold two 8 GiB buffer pairs).
     if qureg.is_density:
-        re, im = run_kernel(
-            (qureg.re, qureg.im), (outcome, 1.0 / prob), kind="dm_collapse",
-            statics=(qureg.num_qubits, target), mesh=qureg.mesh,
-        )
+        qureg._defer(("dm_collapse", (qureg.num_qubits, target),
+                      (outcome, 1.0 / prob)))
     else:
-        re, im = run_kernel(
-            (qureg.re, qureg.im), (outcome, 1.0 / math.sqrt(prob)),
-            kind="sv_collapse", statics=(target,), mesh=qureg.mesh,
-        )
-    qureg._set(re, im)
+        qureg._defer(("sv_collapse", (target,),
+                      (outcome, 1.0 / math.sqrt(prob))))
 
 
 def collapse_to_outcome(qureg: Qureg, target: int, outcome: int) -> float:
