@@ -126,15 +126,22 @@ def main():
         num_qubits -= 1
 
     gates_per_sec = None
+    retries_at_size = 2
     while num_qubits >= 20:
         try:
             gates_per_sec, ngates, secs, npasses = run(
                 num_qubits, depth, reps, inner)
             break
-        except Exception as e:  # OOM on smaller-HBM chips: shrink
+        except Exception as e:  # OOM: retry (a just-exited process may
+            # still hold HBM for a few seconds), then shrink
             msg = str(e)
             if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
                     or "out of memory" in msg or "remote_compile" in msg):
+                if retries_at_size > 0:
+                    retries_at_size -= 1
+                    time.sleep(10)
+                    continue
+                retries_at_size = 2
                 num_qubits -= 1
                 continue
             raise
