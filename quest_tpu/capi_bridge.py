@@ -80,6 +80,11 @@ def init(precision_code: int, platform: str = "cpu") -> int:
                               0.0)
         except Exception:
             pass
+        # AOT executable cache for deferred gate streams: a warm C
+        # process skips the re-trace AND the compile entirely
+        # (deserialize ~0.3 s vs ~9 s; see register._aot_load).
+        os.environ.setdefault("QUEST_AOT_CACHE",
+                              os.path.join(cache_dir, "aot"))
 
     import quest_tpu as qt
 
