@@ -324,6 +324,17 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int):
         with os.fdopen(fd, "wb") as f:
             pickle.dump((blob, in_tree, out_tree), f)
         os.replace(tmp, path)
+        # bound the cache: blobs are ~20 MB each; keep the newest 32
+        d = os.path.dirname(path)
+        blobs = sorted(
+            (os.path.join(d, n) for n in os.listdir(d)
+             if n.startswith("stream-")),
+            key=os.path.getmtime, reverse=True)
+        for stale in blobs[32:]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
         return compiled
     except Exception:
         return None  # serialization unsupported: plain jit fn serves
