@@ -273,6 +273,10 @@ def _aot_path(ops: tuple, num_vec_qubits: int):
     d = os.environ.get("QUEST_AOT_CACHE")
     if not d:
         return None
+    if len(jax.devices()) > 1:
+        # lowering from avals on a multi-device host compiles for every
+        # local device; the AOT fast path is for the 1-chip case
+        return None
     dev = jax.devices()[0]
     tag = repr((ops, num_vec_qubits, jax.__version__, dev.platform,
                 dev.device_kind))

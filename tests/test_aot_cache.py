@@ -1,0 +1,73 @@
+"""AOT stream-executable cache (register._aot_save/_aot_load).
+
+The round trip needs a single-device backend (lowering from avals on a
+multi-device host compiles for every local device, so the cache guards
+itself off there) — run it in a 1-CPU-device subprocess; in the 8-device
+suite process, assert the guard disables the cache.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+_SUB = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["QUEST_AOT_CACHE"] = {cache!r}
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+import numpy as np
+import jax.numpy as jnp
+from quest_tpu import models, register
+from quest_tpu.ops.lattice import state_shape
+
+n = 10
+circ = models.random_circuit(n, depth=2, seed=4)
+ops = tuple(circ.ops)
+jit_fn = circ.compile(mesh=None, donate=False, pallas=False)
+
+compiled = register._aot_save(jit_fn, ops, n)
+assert compiled is not None
+assert any(f.startswith("stream-") for f in os.listdir({cache!r}))
+
+loaded = register._aot_load(ops, n)
+assert loaded is not None
+
+shape = state_shape(1 << n)
+re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+im = jnp.zeros(shape, jnp.float32)
+r1, i1 = jit_fn(re, im)
+r2, i2 = loaded(re, im)
+np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+# key changes with the stream: a different circuit misses
+other = tuple(models.random_circuit(n, depth=2, seed=5).ops)
+assert register._aot_load(other, n) is None
+print("AOT_ROUNDTRIP_OK")
+"""
+
+
+def test_aot_roundtrip_single_device(tmp_path):
+    src = tmp_path / "sub.py"
+    cache = str(tmp_path / "aot")
+    src.write_text(_SUB.format(repo=REPO, cache=cache))
+    os.makedirs(cache, exist_ok=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, str(src)], capture_output=True,
+                       text=True, timeout=600, env=env, cwd=tmp_path)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "AOT_ROUNDTRIP_OK" in r.stdout
+
+
+def test_aot_disabled_on_multi_device(tmp_path, monkeypatch):
+    """In this suite process (8 virtual devices) the cache guards off."""
+    monkeypatch.setenv("QUEST_AOT_CACHE", str(tmp_path))
+    from quest_tpu import register
+
+    assert register._aot_path((), 4) is None
