@@ -5,6 +5,11 @@ Usage: python tools/record_all.py [round_number]
 Runs each recorder as a subprocess (so a failure in one doesn't lose the
 rest) and prints a summary table.  Rough total runtime on the 1-chip
 host: ~25 minutes, dominated by the C-driver cold build and the soak.
+
+NOTE: on the 1-core dev host, back-to-back recorders contend (python
+startup, host-side oracle math) and report a few percent below
+idle-host numbers; for headline artifacts, run the relevant recorder
+alone.
 """
 
 from __future__ import annotations
