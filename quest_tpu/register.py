@@ -279,10 +279,37 @@ def _aot_path(ops: tuple, num_vec_qubits: int):
         return None
     dev = jax.devices()[0]
     tag = repr((ops, num_vec_qubits, jax.__version__, dev.platform,
-                dev.device_kind))
+                dev.device_kind, _code_fingerprint()))
     h = hashlib.sha256(tag.encode()).hexdigest()[:32]
     os.makedirs(d, exist_ok=True)
     return os.path.join(d, f"stream-{h}.pkl")
+
+
+_CODE_FP = None
+
+
+def _code_fingerprint() -> str:
+    """Content hash of every module that shapes a compiled stream, so a
+    kernel/scheduler change invalidates cached executables — a stale
+    blob would silently resurrect fixed bugs (e.g. the flip-path
+    miscompile barrier in ops/lattice.py)."""
+    global _CODE_FP
+    if _CODE_FP is None:
+        import hashlib
+        import os
+
+        h = hashlib.sha256()
+        base = os.path.dirname(os.path.abspath(__file__))
+        for rel in ("register.py", "circuit.py", "scheduler.py",
+                    "ops/lattice.py", "ops/pallas_kernels.py",
+                    "ops/kernels.py", "ops/gates.py"):
+            try:
+                with open(os.path.join(base, rel), "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(rel.encode())
+        _CODE_FP = h.hexdigest()[:16]
+    return _CODE_FP
 
 
 def _aot_load(ops: tuple, num_vec_qubits: int):
@@ -317,12 +344,16 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int):
     if not path:
         return None
     try:
-        from jax.experimental.serialize_executable import serialize
         from .ops.lattice import state_shape
 
         shape = state_shape(1 << num_vec_qubits)
         aval = jax.ShapeDtypeStruct(shape, jnp.float32)
         compiled = jit_fn.lower(aval, aval).compile()
+    except Exception:
+        return None  # explicit AOT compile unsupported: plain jit serves
+    try:
+        from jax.experimental.serialize_executable import serialize
+
         blob, in_tree, out_tree = serialize(compiled)
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
         with os.fdopen(fd, "wb") as f:
@@ -339,9 +370,9 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int):
                 os.remove(stale)
             except OSError:
                 pass
-        return compiled
     except Exception:
-        return None  # serialization unsupported: plain jit fn serves
+        pass  # persistence failed; the executable itself is still good
+    return compiled
 
 
 # ---------------------------------------------------------------------------

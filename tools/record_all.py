@@ -44,15 +44,21 @@ def main():
         if script == "soak.py":
             env["SOAK_ROUND"] = rnd
         t0 = time.time()
-        r = subprocess.run(args, capture_output=True, text=True,
-                           cwd=REPO, env=env, timeout=7200)
+        try:
+            r = subprocess.run(args, capture_output=True, text=True,
+                               cwd=REPO, env=env, timeout=7200)
+            ok, out, err = r.returncode == 0, r.stdout, r.stderr
+        except subprocess.TimeoutExpired as e:
+            ok = False
+            out = (e.stdout or b"").decode("utf-8", "replace") \
+                if isinstance(e.stdout, bytes) else (e.stdout or "")
+            err = f"TIMEOUT after {e.timeout}s"
         secs = time.time() - t0
-        ok = r.returncode == 0
         summary.append((script, ok, secs))
         print(f"{'OK  ' if ok else 'FAIL'} {script:22s} {secs:7.1f}s")
         if not ok:
-            print(r.stdout[-1500:])
-            print(r.stderr[-1500:])
+            print(out[-1500:])
+            print(err[-1500:])
     n_fail = sum(1 for _, ok, _ in summary if not ok)
     print(f"{len(summary)} recorders, {n_fail} failed")
     sys.exit(1 if n_fail else 0)
