@@ -94,12 +94,29 @@ def create_env(num_devices: int | None = None, devices=None) -> QuESTEnv:
 
 
 def destroy_env(env: QuESTEnv) -> None:
-    """No-op for API parity (reference: destroyQuESTEnv); JAX owns devices."""
+    """Tear down the environment (reference: destroyQuESTEnv).
+
+    Single-process: a no-op — JAX owns devices.  Multi-process: a
+    synchronising finalise, like the reference's MPI_Finalize
+    (QuEST_cpu_distributed.c:176-181, which blocks until every rank
+    arrives): without the barrier the first process to exit tears down
+    the coordination service while peers may still be executing their
+    last collective, killing them mid-flight."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("quest_tpu:destroy_env")
+        jax.distributed.shutdown()
 
 
 def sync_env(env: QuESTEnv) -> None:
-    """Block until all outstanding device work completes (reference:
-    syncQuESTEnv = MPI_Barrier, QuEST_cpu_distributed.c:166-168)."""
+    """Block until all outstanding device work completes, across every
+    process of a multi-host run (reference: syncQuESTEnv = MPI_Barrier,
+    QuEST_cpu_distributed.c:166-168)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("quest_tpu:sync_env")
     jax.block_until_ready(jax.device_put(0))
 
 

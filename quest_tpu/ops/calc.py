@@ -10,6 +10,8 @@ points in the reference too).
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..register import Qureg
 from ..validation import (
     QuESTError,
@@ -21,22 +23,48 @@ from ..validation import (
 from .lattice import run_kernel
 
 
+def _prob_table(qureg: Qureg) -> np.ndarray:
+    """Per-qubit P(outcome 0) table plus total, computed once per state.
+
+    One kernel dispatch + one device->host fetch serves every subsequent
+    per-qubit probability readout until the state mutates (the cache is
+    cleared by every mutation path — see Qureg._readout).  The end-of-run
+    per-qubit readout loop (e.g. the reference driver's 30
+    calcProbOfOutcome calls, tutorial_example.c:515-521) then costs one
+    round trip instead of one per qubit."""
+    re, im = qureg.re, qureg.im  # property reads flush pending gates
+    tab = qureg._readout.get("p0")
+    if tab is None:
+        if qureg.is_density:
+            vec = run_kernel(
+                (re, im), (), kind="dm_prob_zero_all",
+                statics=(qureg.num_qubits,), mesh=qureg.mesh,
+                out_kind="scalar",
+            )
+        else:
+            vec = run_kernel(
+                (re, im), (), kind="sv_prob_zero_all",
+                statics=(qureg.num_vec_qubits,), mesh=qureg.mesh,
+                out_kind="scalar",
+            )
+        import jax
+
+        tab = np.asarray(jax.device_get(vec), dtype=np.float64)
+        qureg._readout["p0"] = tab
+    return tab
+
+
 def calc_total_prob(qureg: Qureg) -> float:
     """Total probability: sum |amp|^2, or trace for density matrices
     (reference: calcTotalProb, QuEST.c:606-611; Kahan-summed serially in
     statevec_calcTotalProb QuEST_cpu_local.c:123 — XLA's tree reductions
-    give comparable error growth without the serial dependency)."""
-    if qureg.is_density:
-        v = run_kernel(
-            (qureg.re, qureg.im), (), kind="dm_total_prob",
-            statics=(qureg.num_qubits,), mesh=qureg.mesh, out_kind="scalar",
-        )
-    else:
-        v = run_kernel(
-            (qureg.re, qureg.im), (), kind="sv_total_prob",
-            mesh=qureg.mesh, out_kind="scalar",
-        )
-    return float(v)
+    give comparable error growth without the serial dependency).
+
+    Served from the shared readout table: the table kernel reads the
+    state once (the dominant cost, same as a dedicated total reduction)
+    and one fetch then covers the total AND every per-qubit probability
+    until the state mutates."""
+    return float(_prob_table(qureg)[-1])
 
 
 def calc_prob_of_outcome(qureg: Qureg, target: int, outcome: int) -> float:
@@ -45,12 +73,7 @@ def calc_prob_of_outcome(qureg: Qureg, target: int, outcome: int) -> float:
     1236-1262, density path via diagonal scan QuEST_cpu.c:2789-2842.)"""
     validate_target(qureg, target, "calcProbOfOutcome")
     validate_outcome(outcome, "calcProbOfOutcome")
-    kind = "dm_prob_zero" if qureg.is_density else "sv_prob_zero"
-    statics = (qureg.num_qubits, target) if qureg.is_density else (target,)
-    p0 = float(
-        run_kernel((qureg.re, qureg.im), (), kind=kind, statics=statics,
-                   mesh=qureg.mesh, out_kind="scalar")
-    )
+    p0 = float(_prob_table(qureg)[target])
     return p0 if outcome == 0 else 1.0 - p0
 
 

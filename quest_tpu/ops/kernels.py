@@ -113,6 +113,53 @@ def k_sv_prob_zero(lat, arrays, scalars, target: int):
     return lat.psum(jnp.sum(jnp.where(sel, prob, 0)))
 
 
+def _p0_all(lat, w, nq: int):
+    """[sum of ``w`` where bit q = 0, for q < nq] ++ [sum of ``w``].
+
+    One read of the state produces row- and lane-axis partial sums; the
+    per-qubit masked reductions then run over those small vectors, so the
+    whole table costs barely more than a single-qubit probability — and
+    exactly one device round trip serves every per-qubit readout
+    (the reference runs one full reduction + allreduce per queried qubit:
+    QuEST_cpu.c:2844-2891, QuEST_cpu_distributed.c:1236-1262)."""
+    row_w = jnp.sum(w, axis=1)   # (S_local,)
+    lane_w = jnp.sum(w, axis=0)  # (L,)
+    total = jnp.sum(row_w)
+    lane_i = jnp.arange(lat.lanes)
+    row_i = jnp.arange(lat.rows)
+    probs = []
+    for q in range(nq):
+        if q < lat.lane_bits:
+            sel = ((lane_i >> q) & 1) == 0
+            probs.append(jnp.sum(jnp.where(sel, lane_w, 0)))
+        elif q < lat.chunk_bits:
+            sel = ((row_i >> (q - lat.lane_bits)) & 1) == 0
+            probs.append(jnp.sum(jnp.where(sel, row_w, 0)))
+        else:
+            dbit = (lat._dev_index() >> (q - lat.chunk_bits)) & 1
+            probs.append(jnp.where(dbit == 0, total, jnp.zeros_like(total)))
+    return lat.psum(jnp.stack(probs + [total]))
+
+
+@kernel("sv_prob_zero_all")
+def k_sv_prob_zero_all(lat, arrays, scalars, num_vec_qubits: int):
+    """P(q = 0) for every qubit plus the total probability, as one vector
+    (the batched form of sv_prob_zero; feeds the host readout cache)."""
+    re, im = arrays
+    return _p0_all(lat, re * re + im * im, num_vec_qubits)
+
+
+@kernel("dm_prob_zero_all")
+def k_dm_prob_zero_all(lat, arrays, scalars, num_qubits: int):
+    """Density-matrix form of sv_prob_zero_all: per-qubit diagonal sums
+    with the target bit 0, plus the trace, as one vector.  Row bits are
+    the low ``num_qubits`` flat-index bits, so on the diagonal the flat
+    bit q IS qubit q (reference diagonal scan: QuEST_cpu.c:2789)."""
+    re, _ = arrays
+    d = jnp.where(_diag_sel(lat, num_qubits), re, 0)
+    return _p0_all(lat, d, num_qubits)
+
+
 @kernel("sv_inner_product")
 def k_sv_inner_product(lat, arrays, scalars):
     """<bra|ket> as (real, imag) (reference: statevec_calcInnerProductLocal,

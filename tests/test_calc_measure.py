@@ -158,3 +158,112 @@ def test_measure_density(env):
     out, prob = qt.measure_with_stats(d, 1)
     assert out == 1 and abs(prob - 1.0) < TOL
     assert qt.measure(d, 0) == 0
+
+
+def test_readout_cache_invalidation(env):
+    """The batched readout cache (per-qubit prob table, amplitude prefix)
+    must never serve stale values across ANY mutation path: gates,
+    collapse, inits, setAmps, cloneQureg."""
+    q = qt.create_qureg(N, env)
+    # populate both caches on |0...0>
+    assert abs(qt.calc_prob_of_outcome(q, 0, 0) - 1.0) < TOL
+    assert abs(qt.get_amp(q, 0) - 1.0) < TOL
+    # gate mutates -> fresh values
+    qt.hadamard(q, 0)
+    assert abs(qt.calc_prob_of_outcome(q, 0, 0) - 0.5) < TOL
+    assert abs(qt.get_amp(q, 0) - 1 / np.sqrt(2)) < TOL
+    assert abs(qt.get_amp(q, 1) - 1 / np.sqrt(2)) < TOL
+    # collapse mutates
+    qt.collapse_to_outcome(q, 0, 1)
+    assert abs(qt.calc_prob_of_outcome(q, 0, 1) - 1.0) < TOL
+    assert abs(qt.get_amp(q, 1) - 1.0) < TOL
+    # init mutates
+    qt.init_plus_state(q)
+    assert abs(qt.calc_prob_of_outcome(q, 0, 0) - 0.5) < TOL
+    assert abs(qt.get_amp(q, 0) - 2 ** (-N / 2)) < TOL
+    # setAmps mutates
+    qt.init_zero_state(q)
+    qt.set_amps(q, 0, [0.0, 1.0], [0.0, 0.0], 2)
+    assert abs(qt.calc_prob_of_outcome(q, 0, 1) - 1.0) < TOL
+    assert abs(qt.get_amp(q, 0)) < TOL
+    # cloneQureg mutates the target
+    src = qt.create_qureg(N, env)
+    qt.init_classical_state(src, 3)
+    assert abs(qt.get_amp(q, 1) - 1.0) < TOL  # populate cache
+    qt.clone_qureg(q, src)
+    assert abs(qt.calc_prob_of_outcome(q, 1, 1) - 1.0) < TOL
+    assert abs(qt.get_amp(q, 3) - 1.0) < TOL
+
+
+def test_prob_table_matches_singles(env):
+    """The all-qubits probability table agrees with per-qubit reductions
+    for every qubit, state-vector and density forms, beyond the
+    amplitude-prefix window."""
+    psi = random_statevector(N, 77)
+    q = qt.create_qureg(N, env)
+    load_statevector(q, psi)
+    for t in range(N):
+        want = float(np.sum(np.abs(psi[[(i >> t) & 1 == 0
+                                        for i in range(2**N)]]) ** 2))
+        assert abs(qt.calc_prob_of_outcome(q, t, 0) - want) < TOL
+    assert abs(qt.calc_total_prob(q) - 1.0) < TOL  # served from the table
+
+    rho = random_density_matrix(ND, 78)
+    d = qt.create_density_qureg(ND, env)
+    load_density_matrix(d, rho)
+    diag = np.real(np.diag(rho))
+    for t in range(ND):
+        want = float(diag[[(i >> t) & 1 == 0 for i in range(2**ND)]].sum())
+        assert abs(qt.calc_prob_of_outcome(d, t, 0) - want) < TOL
+    assert abs(qt.calc_total_prob(d) - 1.0) < TOL
+
+
+def test_amp_access_beyond_prefix(env):
+    """Amplitude reads past the prefix window (row >= _PREFIX_ROWS, the
+    uncached _amp_at branch) stay correct and consistent with reads
+    served from the cached prefix."""
+    from quest_tpu.register import _PREFIX_ROWS
+
+    n = 12  # 4096 amps = 32 rows of 128 lanes: rows 16-31 are past the
+    # prefix window under both env modes (sharded lanes are 128 too)
+    psi = random_statevector(n, 79)
+    q = qt.create_qureg(n, env)
+    load_statevector(q, psi)
+    lanes = q.state_shape[1]
+    beyond = _PREFIX_ROWS * lanes
+    assert beyond < 2**n, "test must exercise the uncached branch"
+    for ind in (0, 1, beyond - 1, beyond, beyond + 129, 2**n - 1):
+        got = qt.get_amp(q, ind)
+        assert abs(got - psi[ind]) < TOL
+
+
+def test_single_target_reduction_kernels(env):
+    """The per-target scalar reduction kernels (the reference's
+    findProbabilityOfZero / calcTotalProb kernel shapes, SURVEY §2.2)
+    agree with the batched table for every qubit.  These kernels remain
+    the minimal scalar-psum primitives (the multichip dryrun uses the sv
+    forms); the eager API serves reads from the batched table instead."""
+    from quest_tpu.ops.lattice import run_kernel
+
+    psi = random_statevector(N, 81)
+    q = qt.create_qureg(N, env)
+    load_statevector(q, psi)
+    total = float(run_kernel((q.re, q.im), (), kind="sv_total_prob",
+                             mesh=q.mesh, out_kind="scalar"))
+    assert abs(total - qt.calc_total_prob(q)) < TOL
+    for t in range(N):
+        p0 = float(run_kernel((q.re, q.im), (), kind="sv_prob_zero",
+                              statics=(t,), mesh=q.mesh, out_kind="scalar"))
+        assert abs(p0 - qt.calc_prob_of_outcome(q, t, 0)) < TOL
+
+    rho = random_density_matrix(ND, 82)
+    d = qt.create_density_qureg(ND, env)
+    load_density_matrix(d, rho)
+    total = float(run_kernel((d.re, d.im), (), kind="dm_total_prob",
+                             statics=(ND,), mesh=d.mesh, out_kind="scalar"))
+    assert abs(total - qt.calc_total_prob(d)) < TOL
+    for t in range(ND):
+        p0 = float(run_kernel((d.re, d.im), (), kind="dm_prob_zero",
+                              statics=(ND, t), mesh=d.mesh,
+                              out_kind="scalar"))
+        assert abs(p0 - qt.calc_prob_of_outcome(d, t, 0)) < TOL
