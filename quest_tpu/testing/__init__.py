@@ -1,6 +1,13 @@
 """Golden-file test harness compatible with the reference's data-driven
 ``.test`` corpus (see quest_tpu.testing.golden)."""
 
-from .golden import GoldenFile, run_test_file, discover_standard_tests
+from .golden import (
+    GoldenFile,
+    run_test_file,
+    discover_standard_tests,
+    generate_test_file,
+    generate_corpus,
+)
 
-__all__ = ["GoldenFile", "run_test_file", "discover_standard_tests"]
+__all__ = ["GoldenFile", "run_test_file", "discover_standard_tests",
+           "generate_test_file", "generate_corpus"]

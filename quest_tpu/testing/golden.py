@@ -310,3 +310,241 @@ def discover_standard_tests(root: str) -> list[str]:
                 if not GoldenFile(p).is_python:
                     out.append(p)
     return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# Golden-file GENERATION (the reference harness's -g flow: a trusted build
+# runs each function over a sweep of initial states x targets and records
+# the results as goldens — gen_std_test, QuESTCore.py:584-712).  The
+# produced files use the exact corpus grammar above, so they are consumed
+# by run_test_file here AND by the reference's own QuESTTest runner.
+# ---------------------------------------------------------------------------
+
+#: Unitary constants for generated arguments (exact in f64).
+_GEN_H = "0.7071067811865476,0.0,0.7071067811865476,0.0," \
+         "0.7071067811865476,0.0,-0.7071067811865476,0.0"
+_GEN_ALPHA, _GEN_BETA = "0.6,0.0", "0.0,0.8"
+
+#: funcName -> how its first swept argument scans: per-qubit targets,
+#: per-amplitude indices, or nothing.  Mirrors the reference's
+#: target/targetType registry (QuESTFunc.py argument metadata).
+_GEN_SCAN = {
+    "hadamard": "qubit", "pauliX": "qubit", "pauliY": "qubit",
+    "pauliZ": "qubit", "sGate": "qubit", "tGate": "qubit",
+    "phaseShift": "qubit", "rotateX": "qubit", "rotateY": "qubit",
+    "rotateZ": "qubit", "rotateAroundAxis": "qubit",
+    "compactUnitary": "qubit", "unitary": "qubit",
+    "controlledNot": "qubit", "controlledPauliY": "qubit",
+    "controlledPhaseFlip": "qubit", "controlledPhaseShift": "qubit",
+    "controlledRotateX": "qubit", "controlledRotateY": "qubit",
+    "controlledRotateZ": "qubit", "controlledRotateAroundAxis": "qubit",
+    "controlledCompactUnitary": "qubit", "controlledUnitary": "qubit",
+    "multiControlledPhaseFlip": "none", "multiControlledPhaseShift": "none",
+    "multiControlledUnitary": "qubit",
+    "applyOneQubitDephaseError": "qubit",
+    "applyOneQubitDepolariseError": "qubit",
+    "applyOneQubitDampingError": "qubit",
+    "applyTwoQubitDephaseError": "qubit",
+    "applyTwoQubitDepolariseError": "qubit",
+    "collapseToOutcome": "qubit",
+    "calcProbOfOutcome": "qubit",
+    "getAmp": "index", "getRealAmp": "index", "getImagAmp": "index",
+    "getProbAmp": "index", "getDensityAmp": "index",
+    "initClassicalState": "index",
+    "calcTotalProb": "none", "calcPurity": "none",
+    "getNumAmps": "none", "getNumQubits": "none",
+    "initZeroState": "none", "initPlusState": "none",
+    "initStateDebug": "none", "setAmps": "none",
+}
+
+
+def _gen_args(func: str, argspec: str, swept: int, n: int) -> list[str]:
+    """Spec-line argument tokens for one generated case.  ``swept`` fills
+    the function's scanned target/index slot; other slots get defaults
+    that never collide with it (controls pick different qubits, exactly
+    like the reference skips target==control cases)."""
+    toks: list[str] = []
+    qubits = [q for q in range(n) if q != swept]  # collision-free pool
+    first_i = True
+    last_list_len = 0
+    for kind in argspec:
+        if kind == "i":
+            if first_i and _GEN_SCAN[func] in ("qubit", "index"):
+                toks.append(str(swept))
+            else:
+                toks.append(str(qubits.pop(0)))
+            first_i = False
+        elif kind == "f":
+            # valid for every angle AND below every noise-probability cap
+            toks.append("0.1")
+        elif kind == "c":
+            toks.append(_GEN_ALPHA if _GEN_ALPHA not in toks else _GEN_BETA)
+        elif kind == "m":
+            toks.append(_GEN_H)
+        elif kind == "v":
+            toks.append("0.0,0.0,1.0")
+        elif kind == "l":
+            picked, qubits = qubits[:2], qubits[2:]
+            last_list_len = len(picked)
+            toks.append(",".join(str(q) for q in picked))
+        elif kind == "x":
+            # explicit length of the preceding list argument — must match
+            # what 'l' actually emitted (the reference parser trusts it)
+            toks.append(str(last_list_len))
+        elif kind == "F":
+            toks.append("0.1,0.2")
+        else:  # pragma: no cover
+            raise ValueError(f"no generator default for argspec {kind!r}")
+    if func == "setAmps":
+        toks = ["0", "0.1,0.2", "0.3,0.4", "2"]
+    if func == "collapseToOutcome":
+        toks[1] = "0"  # outcome, not a qubit
+    if func == "calcProbOfOutcome":
+        toks[1] = "1"
+    if func == "getDensityAmp":
+        toks[1] = str(swept)  # (row, col) indices
+    return toks
+
+
+def _rand_state_tok(n: int, qtype: str, rng) -> str:
+    """Inline custom-state token for a random register (the reference
+    writes random states the same way: as a c/C literal).  ``n``/``N``
+    are normalised; ``r`` is an unnormalised random state-vector;
+    ``R`` is a valid (PSD, trace-1) random density matrix."""
+    if qtype.isupper():
+        dim = 1 << n
+        a = rng.standard_normal((dim, dim)) + 1j * rng.standard_normal((dim, dim))
+        rho = a @ a.conj().T
+        rho /= np.trace(rho).real
+        flat = rho.T.reshape(-1)  # col-major flat layout (row + col*dim)
+    else:
+        flat = rng.standard_normal(1 << n) + 1j * rng.standard_normal(1 << n)
+        if qtype == "n":
+            flat /= np.linalg.norm(flat)
+    return ",".join(f"{v.real:.16g},{v.imag:.16g}" for v in flat)
+
+
+def generate_test_file(func: str, path: str, env, n_qubits: int = 3,
+                       qureg_types: str = "zpdnZPDR", checks: str = "PMS",
+                       targets=None, seed: int = 424243) -> int:
+    """Write a golden ``.test`` file for ``func`` by running it on this
+    build (the oracle role the reference gives a trusted build).
+
+    Sweeps ``qureg_types`` (corpus init-state codes; n/R become inline
+    c/C custom states from a seeded RNG) against every target qubit /
+    a spread of amplitude indices.  Returns the number of test cases
+    written (skip markers included, as in the corpus)."""
+    if n_qubits < 3:
+        # multi-control sweeps need 2 spare qubits besides the target
+        # (the reference generates at nQubits=3 for the same reason)
+        raise ValueError("generate_test_file needs n_qubits >= 3")
+    rng = np.random.default_rng(seed)
+    argspec, ret = FUNCS[func]
+    scan = _GEN_SCAN[func]
+    if targets is None:
+        targets = (list(range(n_qubits)) if scan == "qubit"
+                   else [0, 1, (1 << n_qubits) - 1] if scan == "index"
+                   else [0])
+    nice = {"z": "Zero State Vector", "p": "Plus State Vector",
+            "d": "Debug State Vector", "n": "Normalised Random State Vector",
+            "r": "Random State Vector",
+            "Z": "Zero Density Matrix", "P": "Plus Density Matrix",
+            "D": "Debug Density Matrix", "R": "Random Density Matrix",
+            "b": "Bit-string State Vector", "B": "Bit-string Density Matrix"}
+    out = [f"# {func}", str(len(targets) * len(qureg_types))]
+    written = 0
+    for swept in targets:
+        for qtype in qureg_types:
+            if qtype not in nice:
+                raise ValueError(f"unknown qureg type code {qtype!r}")
+            out.append("")
+            out.append(f"# {nice[qtype]}")
+            written += 1
+            spec_type = qtype
+            if qtype in "nNrR":
+                spec_type = "C" if qtype.isupper() else "c"
+                init_tok = _rand_state_tok(n_qubits, qtype, rng)
+            elif qtype in "bB":
+                init_tok = "1" + "0" * (n_qubits - 1)  # |10...0>
+            else:
+                init_tok = None
+            args = _gen_args(func, argspec, swept, n_qubits)
+            try:
+                qureg = _make_qureg(spec_type, n_qubits, init_tok, env)
+                result = _call(func, qureg, argspec, args)
+            except qt.QuESTError as e:
+                if "cannot shard" in str(e):
+                    # an env-capacity limit, NOT a property of the
+                    # function: baking a skip marker would silently drop
+                    # valid cases from the corpus.  Goldens are meant to
+                    # be generated on a single-device f64 oracle.
+                    raise
+                out.append("# Not valid for this function")
+                out.append("C- 0")
+                continue
+            spec = f"{spec_type}-{checks} {n_qubits}"
+            if init_tok is not None:
+                spec += f" [{init_tok}]"
+            if args:
+                spec += " " + " ".join(args)
+            out.append(spec)
+            if ret == "real":
+                out.append(f"{result:.13f}")
+            elif ret == "complex":
+                out.append(f"({result.real:.13f},{result.imag:.13f})")
+            elif ret == "int":
+                out.append(str(result))
+            else:
+                for check in checks:
+                    if check == "P":
+                        out.append(f"{qt.calc_total_prob(qureg):.12f}")
+                    elif check == "M":
+                        for qubit in range(qureg.num_qubits):
+                            p0 = qt.calc_prob_of_outcome(qureg, qubit, 0)
+                            p1 = qt.calc_prob_of_outcome(qureg, qubit, 1)
+                            out.append(f"{p0:.12f} {p1:.12f}")
+                    elif check == "S":
+                        state = qt.get_state_vector(qureg)
+                        out.extend(f"({v.real:.13f},{v.imag:.13f})"
+                                   for v in state)
+    with open(path, "w") as f:
+        f.write("\n".join(out) + "\n")
+    return written
+
+
+def generate_corpus(out_dir: str, env, funcs=None, **kw) -> list[str]:
+    """Generate golden files for every (or the given) registered function
+    (the reference's `-g` whole-corpus regeneration flow)."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for func in (funcs or sorted(FUNCS)):
+        p = os.path.join(out_dir, f"{func}.test")
+        generate_test_file(func, p, env, **kw)
+        paths.append(p)
+    return paths
+
+
+if __name__ == "__main__":  # python -m quest_tpu.testing.golden OUT_DIR
+    # The reference's `python3 -m QuESTTest -g` regeneration flow.
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Regenerate a golden .test corpus from this build")
+    ap.add_argument("out_dir")
+    ap.add_argument("--funcs", nargs="*", default=None)
+    ap.add_argument("--qubits", type=int, default=3)
+    ap.add_argument("--types", default="zpdnZPDR")
+    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform for the oracle run (default cpu: "
+                         "goldens need real f64; TPU silently degrades "
+                         "double precision)")
+    a = ap.parse_args()
+    import jax
+
+    jax.config.update("jax_platforms", a.platform)
+    qt.enable_double_precision()
+    _env = qt.create_env(num_devices=a.devices)
+    for _p in generate_corpus(a.out_dir, _env, funcs=a.funcs,
+                              n_qubits=a.qubits, qureg_types=a.types):
+        print(_p)
