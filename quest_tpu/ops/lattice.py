@@ -216,27 +216,20 @@ class Lattice:
         return lax.all_gather(x, self.axis, tiled=True)
 
 
-def _run_kernel_impl(arrays, scalars, *, kind: str, statics: tuple = (),
-                     mesh: Mesh | None = None, out_kind: str = "arrays"):
-    """Run kernel body ``kind`` over ``arrays`` (tuple of (S, L) arrays).
-
-    ``arrays`` are global views; with a mesh they must be sharded over the
-    mesh's single axis on their leading (row) dimension.  ``scalars`` is a
-    pytree of traced scalars (gate matrix elements, probabilities, ...)
-    replicated everywhere.  ``out_kind`` is ``"arrays"`` (amp arrays back,
-    sharded like the inputs) or ``"scalar"`` (replicated reduction result).
-    """
-    body = KERNELS[kind]
+def _dispatch(body, arrays, scalars, mesh: Mesh | None, out_kind: str):
+    """Run ``body(lat, arrays, scalars)`` locally, or as ONE shard_map
+    region over ``mesh``.  ``out_kind`` is ``"arrays"`` (amp arrays back,
+    sharded like the inputs) or ``"scalar"`` (replicated reduction
+    result)."""
     if mesh is None or math.prod(mesh.devices.shape) == 1:
-        lat = Lattice.for_array(arrays[0], None, 1)
-        return body(lat, arrays, scalars, *statics)
+        return body(Lattice.for_array(arrays[0], None, 1), arrays, scalars)
 
     (axis,) = mesh.axis_names
     ndev = math.prod(mesh.devices.shape)
 
     def shbody(arrays, scalars):
-        lat = Lattice.for_array(arrays[0], axis, ndev)
-        return body(lat, arrays, scalars, *statics)
+        return body(Lattice.for_array(arrays[0], axis, ndev), arrays,
+                    scalars)
 
     out_specs = {"arrays": P(axis), "scalar": P()}[out_kind]
     return jax.shard_map(
@@ -245,6 +238,23 @@ def _run_kernel_impl(arrays, scalars, *, kind: str, statics: tuple = (),
         in_specs=(P(axis), P()),
         out_specs=out_specs,
     )(arrays, scalars)
+
+
+def _run_kernel_impl(arrays, scalars, *, kind: str, statics: tuple = (),
+                     mesh: Mesh | None = None, out_kind: str = "arrays"):
+    """Run kernel body ``kind`` over ``arrays`` (tuple of (S, L) arrays).
+
+    ``arrays`` are global views; with a mesh they must be sharded over the
+    mesh's single axis on their leading (row) dimension.  ``scalars`` is a
+    pytree of traced scalars (gate matrix elements, probabilities, ...)
+    replicated everywhere.
+    """
+    kbody = KERNELS[kind]
+
+    def body(lat, arrays, scalars):
+        return kbody(lat, arrays, scalars, *statics)
+
+    return _dispatch(body, arrays, scalars, mesh, out_kind)
 
 
 _STATIC_NAMES = ("kind", "statics", "mesh", "out_kind")
@@ -258,6 +268,60 @@ run_kernel = jax.jit(_run_kernel_impl, static_argnames=_STATIC_NAMES)
 run_kernel_donated = jax.jit(
     _run_kernel_impl, static_argnames=_STATIC_NAMES, donate_argnums=(0,)
 )
+
+
+#: Longest kernel chain compiled as one program: bounds the cold-compile
+#: cost of a single flush (cf. the gate path's stream-chunking notes in
+#: docs/PERFORMANCE.md) while keeping whole channel layers fused.
+CHAIN_MAX_STEPS = 32
+
+#: Compiled chain programs, LRU-bounded: ``steps`` (kinds + statics) is a
+#: static key, so workloads whose channel/collapse structure varies per
+#: flush would otherwise grow jit's internal cache without bound.
+#: Evicting OUR jitted wrapper drops its compile cache with it.
+_CHAIN_CACHE = None
+_CHAIN_CACHE_MAX = 64
+
+
+def run_kernel_chain(arrays, scalars_list, *, steps, mesh: Mesh | None):
+    """Apply a SEQUENCE of state-updating kernels as one donated program.
+
+    ``steps`` is a static tuple of (kind, statics); ``scalars_list`` the
+    matching per-step traced scalars (parameter changes never recompile).
+    Under a mesh the whole chain runs inside ONE shard_map region, so XLA
+    fuses adjacent elementwise channels (a run of dephases costs one pass
+    over the state, not one per channel) and no per-step dispatch gaps
+    remain.  The reference necessarily streams the density matrix once
+    per channel call (QuEST.c dispatch; kernels QuEST_cpu.c:36-377).
+    Chains longer than CHAIN_MAX_STEPS split into bounded programs."""
+    global _CHAIN_CACHE
+    if _CHAIN_CACHE is None:
+        from collections import OrderedDict
+
+        _CHAIN_CACHE = OrderedDict()
+    while len(steps) > CHAIN_MAX_STEPS:
+        arrays = run_kernel_chain(
+            arrays, scalars_list[:CHAIN_MAX_STEPS],
+            steps=steps[:CHAIN_MAX_STEPS], mesh=mesh)
+        steps = steps[CHAIN_MAX_STEPS:]
+        scalars_list = scalars_list[CHAIN_MAX_STEPS:]
+
+    key = (steps, mesh)
+    fn = _CHAIN_CACHE.pop(key, None)
+    if fn is None:
+        def impl(arrays, scalars_list):
+            def body(lat, arrays, scalars_list):
+                for (kind, statics), scalars in zip(steps, scalars_list):
+                    arrays = KERNELS[kind](lat, arrays, scalars, *statics)
+                return arrays
+
+            return _dispatch(body, arrays, scalars_list, mesh, "arrays")
+
+        fn = jax.jit(impl, donate_argnums=(0,))
+    _CHAIN_CACHE[key] = fn
+    while len(_CHAIN_CACHE) > _CHAIN_CACHE_MAX:
+        _CHAIN_CACHE.popitem(last=False)
+    return fn(arrays, scalars_list)
 
 
 def amp_sharding(mesh: Mesh | None):

@@ -107,7 +107,7 @@ class Qureg:
     def _flush(self) -> None:
         import jax
 
-        from .ops.lattice import run_kernel_donated
+        from .ops.lattice import run_kernel_chain, run_kernel_donated
 
         while self._pending:
             # Maximal prefix of fusable GATE ops; the stream may also
@@ -119,12 +119,24 @@ class Qureg:
                 run.append(self._pending.pop(0))
             if run:
                 self._run_gates(jax, run, run_kernel_donated)
-            if self._pending:  # a non-gate kernel op at the head
-                kind, statics, scalars = self._pending[0]
-                self._re, self._im = run_kernel_donated(
-                    (self._re, self._im), scalars, kind=kind,
-                    statics=statics, mesh=self.mesh)
-                del self._pending[0]
+            # Maximal run of non-gate kernels (noise channels, collapse):
+            # one donated chain program — XLA fuses adjacent elementwise
+            # channels into shared passes over the state.
+            chain = []
+            while self._pending and self._pending[0][0] not in _GATE_KINDS:
+                chain.append(self._pending.pop(0))
+            if chain:
+                steps = tuple((kind, statics) for kind, statics, _ in chain)
+                scalars_list = tuple(sc for _, _, sc in chain)
+                try:
+                    self._re, self._im = run_kernel_chain(
+                        (self._re, self._im), scalars_list, steps=steps,
+                        mesh=self.mesh)
+                except Exception:
+                    # requeue the whole unapplied chain (the donated
+                    # program either ran fully or not at all)
+                    self._pending = chain + self._pending
+                    raise
 
     def _run_gates(self, jax, run, run_kernel_donated) -> None:
         # Fused Pallas needs tile-aligned (>= (8, 128)) chunks and f32

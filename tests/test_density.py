@@ -183,3 +183,25 @@ def test_depolarise_trace_at_flip_path_scale(env1):
     qt.apply_one_qubit_depolarise_error(rho, 1, 0.3)
     assert abs(qt.calc_total_prob(rho) - 1.0) < 1e-5
     qt.destroy_qureg(rho, env1)
+
+
+def test_long_channel_chain_splits(env):
+    """A deferred channel run longer than CHAIN_MAX_STEPS splits into
+    bounded programs and still applies every channel exactly once."""
+    from quest_tpu.ops.lattice import CHAIN_MAX_STEPS
+
+    n = 3
+    d = qt.create_density_qureg(n, env)
+    qt.init_plus_state(d)
+    k = CHAIN_MAX_STEPS + 7
+    for i in range(k):
+        qt.apply_one_qubit_dephase_error(d, i % n, 0.01)
+    # dephase scales each off-diagonal (in qubit i%n) by (1 - 2p); with
+    # k applications round-robin over 3 qubits the fully-off-diagonal
+    # element (0,7) picks up one factor per application
+    got = qt.get_density_matrix(d)
+    import numpy as np
+
+    want = (1 / 2**n) * (1 - 0.02) ** k
+    assert abs(got[0, 7].real - want) < 1e-10 * max(1.0, want)
+    assert abs(qt.calc_total_prob(d) - 1.0) < TOL

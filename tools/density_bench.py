@@ -76,9 +76,11 @@ def main():
         "trace_after": trace,
         "purity_after": purity,
         "note": "Gates run as U (x) U* double passes through the fused "
-                "executor; channels through the XLA kernel path. Trace "
-                "must stay 1 to f32 precision; purity decays "
-                "monotonically under the channels.",
+                "executor; each deferred channel run executes as one "
+                "donated chain program (adjacent elementwise channels "
+                "share passes over the state). Trace must stay 1 to f32 "
+                "precision; purity decays monotonically under the "
+                "channels.",
     }
     assert abs(trace - 1.0) < 1e-3, trace
     assert purity < 1.0
