@@ -120,23 +120,30 @@ class Qureg:
             if run:
                 self._run_gates(jax, run, run_kernel_donated)
             # Maximal run of non-gate kernels (noise channels, collapse):
-            # one donated chain program — XLA fuses adjacent elementwise
-            # channels into shared passes over the state.
+            # donated chain programs — XLA fuses adjacent elementwise
+            # channels into shared passes over the state.  Splitting at
+            # CHAIN_MAX_STEPS happens HERE, not inside the runner, so a
+            # failure in a later sub-chain requeues exactly the
+            # unapplied tail against the last successful sub-chain's
+            # buffers (each bounded program either ran fully or not at
+            # all; the donated buffers of completed sub-chains are gone).
+            from .ops.lattice import CHAIN_MAX_STEPS
+
             chain = []
             while self._pending and self._pending[0][0] not in _GATE_KINDS:
                 chain.append(self._pending.pop(0))
-            if chain:
-                steps = tuple((kind, statics) for kind, statics, _ in chain)
-                scalars_list = tuple(sc for _, _, sc in chain)
+            while chain:
+                sub = chain[:CHAIN_MAX_STEPS]
+                steps = tuple((kind, statics) for kind, statics, _ in sub)
+                scalars_list = tuple(sc for _, _, sc in sub)
                 try:
                     self._re, self._im = run_kernel_chain(
                         (self._re, self._im), scalars_list, steps=steps,
                         mesh=self.mesh)
                 except Exception:
-                    # requeue the whole unapplied chain (the donated
-                    # program either ran fully or not at all)
                     self._pending = chain + self._pending
                     raise
+                del chain[:CHAIN_MAX_STEPS]
 
     def _run_gates(self, jax, run, run_kernel_donated) -> None:
         # Fused Pallas needs tile-aligned (>= (8, 128)) chunks and f32

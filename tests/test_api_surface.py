@@ -1,0 +1,119 @@
+"""Direct tests for the API-surface corners otherwise exercised only
+through the C-ABI harness: reporting, QASM recording control, precision
+helpers, per-part amplitude accessors, debug initialisers, env sync."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import quest_tpu as qt
+from conftest import TOL, random_statevector, load_statevector
+
+N = 4
+
+
+def test_report_env_and_strings(env):
+    s = qt.report_env(env)
+    assert "EXECUTION ENVIRONMENT" in s and str(env.num_devices) in s
+    q = qt.create_qureg(N, env)
+    s = qt.get_environment_string(env, q)
+    # reference shape: "<n>qubits_<PLAT>_<...>" (QuEST_cpu.c:1276-1282)
+    assert s.startswith(f"{N}qubits_")
+    p = qt.report_qureg_params(q)
+    assert str(N) in p and str(2**N) in p
+
+
+def test_report_state_to_screen(env, capsys):
+    q = qt.create_qureg(4, env)
+    qt.hadamard(q, 0)
+    qt.report_state_to_screen(q, env)
+    out = capsys.readouterr().out
+    assert "0.7071067811865" in out
+    # rank header only when report_rank is set (reference:
+    # statevec_reportStateToScreen, QuEST_cpu.c:1252-1275)
+    qt.report_state_to_screen(q, env, report_rank=1)
+    assert "rank" in capsys.readouterr().out
+
+
+def test_qasm_recording_control(env, tmp_path):
+    q = qt.create_qureg(4, env)
+    qt.start_recording_qasm(q)
+    qt.hadamard(q, 0)
+    qt.stop_recording_qasm(q)
+    qt.pauli_x(q, 1)  # not recorded while stopped
+    text = qt.get_recorded_qasm(q)
+    assert "h q[0];" in text and "x q[1];" not in text
+    f = tmp_path / "out.qasm"
+    qt.write_recorded_qasm_to_file(q, str(f))
+    assert f.read_text() == text
+    qt.clear_recorded_qasm(q)
+    cleared = qt.get_recorded_qasm(q)
+    assert "h q[0];" not in cleared  # header only
+    qt.print_recorded_qasm(q)  # must not raise
+
+
+def test_precision_helpers():
+    assert qt.get_precision_code(jnp.dtype("float32")) == 1
+    assert qt.get_precision_code(jnp.dtype("float64")) == 2
+    # per-precision REAL_EPS (reference: QuEST_precision.h:25-62)
+    assert qt.real_eps(jnp.dtype("float32")) == pytest.approx(1e-5)
+    assert qt.real_eps(jnp.dtype("float64")) == pytest.approx(1e-13)
+    prev = qt.default_real_dtype()
+    try:
+        qt.enable_double_precision()
+        assert qt.default_real_dtype() == jnp.dtype("float64")
+    finally:
+        qt.set_default_precision(
+            "double" if prev == jnp.dtype("float64") else "single")
+
+
+def test_amp_part_accessors(env):
+    psi = random_statevector(N, 21)
+    q = qt.create_qureg(N, env)
+    load_statevector(q, psi)
+    for ind in (0, 3, 2**N - 1):
+        a = qt.get_amp(q, ind)
+        assert qt.get_real_amp(q, ind) == pytest.approx(a.real, abs=TOL)
+        assert qt.get_imag_amp(q, ind) == pytest.approx(a.imag, abs=TOL)
+        assert qt.get_prob_amp(q, ind) == pytest.approx(abs(a) ** 2, abs=TOL)
+
+
+def test_init_state_of_single_qubit(env):
+    # uniform over basis states with qubit 1 = 1 (reference:
+    # initStateOfSingleQubit, QuEST_cpu.c:1427-1467)
+    q = qt.create_qureg(N, env)
+    qt.init_state_of_single_qubit(q, 1, 1)
+    psi = qt.get_state_vector(q)
+    want = np.array([1.0 if (i >> 1) & 1 else 0.0 for i in range(2**N)])
+    want /= np.linalg.norm(want)
+    np.testing.assert_allclose(psi.real, want, atol=TOL)
+    np.testing.assert_allclose(psi.imag, 0, atol=TOL)
+
+
+def test_controlled_rotate_around_axis(env):
+    # control clear -> identity; control set -> the uncontrolled rotation
+    angle, axis = 0.37, (0.3, -1.2, 0.5)
+    a = qt.create_qureg(N, env)
+    qt.controlled_rotate_around_axis(a, 0, 1, angle, axis)
+    np.testing.assert_allclose(qt.get_state_vector(a)[0], 1.0, atol=TOL)
+
+    b = qt.create_qureg(N, env)
+    qt.pauli_x(b, 0)
+    qt.controlled_rotate_around_axis(b, 0, 1, angle, axis)
+    c = qt.create_qureg(N, env)
+    qt.pauli_x(c, 0)
+    qt.rotate_around_axis(c, 1, angle, axis)
+    np.testing.assert_allclose(qt.get_state_vector(b),
+                               qt.get_state_vector(c), atol=TOL)
+
+
+def test_env_sync_and_seed(env):
+    qt.sync_env(env)       # single-process barrier: must not raise
+    qt.seed_quest_default()
+    from quest_tpu import env as env_mod
+
+    v = env_mod.random_real()
+    assert 0.0 <= v < 1.0
+    qt.destroy_env(env)    # single-process: no-op, env stays usable
+    q = qt.create_qureg(N, env)
+    assert qt.calc_total_prob(q) == pytest.approx(1.0, abs=TOL)

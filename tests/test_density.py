@@ -205,3 +205,33 @@ def test_long_channel_chain_splits(env):
     want = (1 / 2**n) * (1 - 0.02) ** k
     assert abs(got[0, 7].real - want) < 1e-10 * max(1.0, want)
     assert abs(qt.calc_total_prob(d) - 1.0) < TOL
+
+
+def test_chain_failure_requeues_unapplied_tail(env):
+    """A failure in a later sub-chain must leave the register consistent:
+    completed sub-chains applied once, the unapplied tail (including the
+    failing op) requeued, and the register recoverable after the bad op
+    is removed."""
+    from quest_tpu.ops.lattice import CHAIN_MAX_STEPS
+
+    n = 3
+    d = qt.create_density_qureg(n, env)
+    qt.init_plus_state(d)
+    k = CHAIN_MAX_STEPS + 4
+    for i in range(k):
+        qt.apply_one_qubit_dephase_error(d, i % n, 0.01)
+    # an op with an unknown kernel kind lands in the SECOND sub-chain
+    d._defer(("no_such_kernel", (), ()))
+    with pytest.raises(KeyError):
+        _ = d.re  # flush: sub-chain 1 applies, sub-chain 2 raises
+    # the first sub-chain is no longer pending; the tail (incl. the bad
+    # op) is requeued
+    assert len(d._pending) == k - CHAIN_MAX_STEPS + 1
+    assert d._pending[-1][0] == "no_such_kernel"
+    # drop the poison op: the register recovers and the remaining
+    # channels apply exactly once
+    d._pending = [op for op in d._pending if op[0] != "no_such_kernel"]
+    got = qt.get_density_matrix(d)
+    want = (1 / 2**n) * (1 - 0.02) ** k
+    assert abs(got[0, 7].real - want) < 1e-10
+    assert abs(qt.calc_total_prob(d) - 1.0) < TOL
