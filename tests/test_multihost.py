@@ -1,12 +1,13 @@
 """Multi-process distributed execution (the reference's MPI axis).
 
-Launches two real OS processes, each owning two virtual CPU devices,
+Launches 2 or 4 real OS processes, each owning two virtual CPU devices,
 joined through ``quest_tpu.init_distributed`` (reference: MPI_Init,
-QuEST_cpu_distributed.c:135-164).  The 4-device global mesh shards a
-register across processes; a device-bit gate exercises the
-cross-process ppermute path (DCN-analogue of exchangeStateVectors) and
-seeded measurement outcomes must agree on every process, as the
-reference guarantees by broadcasting its RNG seed (:1294-1305).
+QuEST_cpu_distributed.c:135-164).  The global mesh shards a register
+across processes; device-bit gates exercise the cross-process ppermute
+path (DCN-analogue of exchangeStateVectors), seeded measurement
+outcomes must agree on every process (the reference broadcasts its RNG
+seed, :1294-1305), and the final ``destroy_env`` exercises the
+synchronising finalise (MPI_Finalize semantics, :176-181).
 """
 
 import os
@@ -25,27 +26,30 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 2)
 import quest_tpu as qt
-qt.init_distributed("localhost:{port}", 2, pid)
-assert jax.process_count() == 2
+qt.init_distributed("localhost:{port}", {nproc}, pid)
+assert jax.process_count() == {nproc}
 env = qt.create_env()
-assert env.num_devices == 4
+assert env.num_devices == 2 * {nproc}
 q = qt.create_qureg(8, env)
 qt.init_plus_state(q)
 qt.hadamard(q, 7)           # device-bit qubit: cross-process exchange
+qt.hadamard(q, 6)           # second device-bit layer (4-proc meshes)
 qt.controlled_not(q, 7, 0)
 p = qt.calc_total_prob(q)
 qt.seed_quest([42])
 outcomes = [qt.measure(q, k) for k in range(3)]
 print(f"RESULT total={{p:.6f}} outcomes={{outcomes}}", flush=True)
+qt.destroy_env(env)         # synchronising finalise across processes
 """
 
 
 @pytest.mark.skipif(os.environ.get("QUEST_SKIP_MULTIHOST") == "1",
                     reason="multihost test disabled")
-def test_two_process_mesh(tmp_path):
-    port = 19700 + (os.getpid() % 200)
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_multi_process_mesh(tmp_path, nproc):
+    port = 19700 + (os.getpid() % 100) + 100 * (nproc // 4)
     src = tmp_path / "worker.py"
-    src.write_text(_WORKER.format(repo=REPO, port=port))
+    src.write_text(_WORKER.format(repo=REPO, port=port, nproc=nproc))
     env = {k: v for k, v in os.environ.items()
            if "XLA_FLAGS" not in k}
     env["JAX_PLATFORMS"] = "cpu"
@@ -53,13 +57,20 @@ def test_two_process_mesh(tmp_path):
                               stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True, env=env,
                               cwd=tmp_path)
-             for i in range(2)]
+             for i in range(nproc)]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        assert p.returncode == 0, out[-2000:]
-        outs.append(next(l for l in out.splitlines()
-                         if l.startswith("RESULT ")))
-    # both processes computed a normalised state and IDENTICAL outcomes
-    assert outs[0] == outs[1]
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            assert p.returncode == 0, out[-2000:]
+            outs.append(next(l for l in out.splitlines()
+                             if l.startswith("RESULT ")))
+    finally:
+        # a failed/timed-out worker must not strand its peers in a
+        # collective (they would hold their ports for the whole run)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # every process computed a normalised state and IDENTICAL outcomes
+    assert len(set(outs)) == 1
     assert "total=1.000000" in outs[0]

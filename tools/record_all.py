@@ -4,7 +4,8 @@ Usage: python tools/record_all.py [round_number]
 
 Runs each recorder as a subprocess (so a failure in one doesn't lose the
 rest) and prints a summary table.  Rough total runtime on the 1-chip
-host: ~25 minutes, dominated by the C-driver cold build and the soak.
+host: ~35-40 minutes, dominated by the full-size soak (~20 min) and
+the C-driver cold build.
 
 NOTE: on the 1-core dev host, back-to-back recorders contend (python
 startup, host-side oracle math) and report a few percent below
