@@ -293,19 +293,20 @@ def run_kernel_chain(arrays, scalars_list, *, steps, mesh: Mesh | None):
     over the state, not one per channel) and no per-step dispatch gaps
     remain.  The reference necessarily streams the density matrix once
     per channel call (QuEST.c dispatch; kernels QuEST_cpu.c:36-377).
-    Chains longer than CHAIN_MAX_STEPS split into bounded programs."""
+
+    Chains are capped at CHAIN_MAX_STEPS: splitting is the CALLER's job
+    (Qureg._flush pops each bounded sub-chain only after it ran, keeping
+    failure requeues exact) — splitting here instead would donate the
+    inputs of already-run sub-chains behind the caller's back."""
+    if len(steps) > CHAIN_MAX_STEPS:
+        raise ValueError(
+            f"chain of {len(steps)} steps exceeds CHAIN_MAX_STEPS="
+            f"{CHAIN_MAX_STEPS}; split at the call site")
     global _CHAIN_CACHE
     if _CHAIN_CACHE is None:
         from collections import OrderedDict
 
         _CHAIN_CACHE = OrderedDict()
-    while len(steps) > CHAIN_MAX_STEPS:
-        arrays = run_kernel_chain(
-            arrays, scalars_list[:CHAIN_MAX_STEPS],
-            steps=steps[:CHAIN_MAX_STEPS], mesh=mesh)
-        steps = steps[CHAIN_MAX_STEPS:]
-        scalars_list = scalars_list[CHAIN_MAX_STEPS:]
-
     key = (steps, mesh)
     fn = _CHAIN_CACHE.pop(key, None)
     if fn is None:

@@ -776,21 +776,40 @@ def clone_qureg(target: Qureg, copy: Qureg) -> None:
 _PREFIX_ROWS = 16
 
 
-@lru_cache(maxsize=None)
+#: Jitted prefix-slice fns, LRU-bounded like every other compiled-fn
+#: cache here (a jitted wrapper pins its compile cache and, for meshes,
+#: the Mesh object — unbounded growth across many envs would leak).
+_PREFIX_FETCH_CACHE: "OrderedDict" = None
+_PREFIX_FETCH_CACHE_MAX = 16
+
+
 def _prefix_fetch(rows: int, mesh):
     """Jitted leading-rows slice with REPLICATED output, so the fetched
     window is addressable from every process of a multi-host run (a plain
     slice keeps the row sharding, and fetching it would span
     non-addressable devices)."""
-    def f(re, im):
-        return re[:rows], im[:rows]
+    global _PREFIX_FETCH_CACHE
+    if _PREFIX_FETCH_CACHE is None:
+        from collections import OrderedDict
 
-    if mesh is None:
-        return jax.jit(f)
-    from jax.sharding import NamedSharding, PartitionSpec
+        _PREFIX_FETCH_CACHE = OrderedDict()
+    key = (rows, mesh)
+    fn = _PREFIX_FETCH_CACHE.pop(key, None)
+    if fn is None:
+        def f(re, im):
+            return re[:rows], im[:rows]
 
-    rep = NamedSharding(mesh, PartitionSpec())
-    return jax.jit(f, out_shardings=(rep, rep))
+        if mesh is None:
+            fn = jax.jit(f)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+            fn = jax.jit(f, out_shardings=(rep, rep))
+    _PREFIX_FETCH_CACHE[key] = fn
+    while len(_PREFIX_FETCH_CACHE) > _PREFIX_FETCH_CACHE_MAX:
+        _PREFIX_FETCH_CACHE.popitem(last=False)
+    return fn
 
 
 def _amp_at(qureg: Qureg, index: int):
