@@ -50,6 +50,7 @@ of any op — the reference's split-by-target branching
 from __future__ import annotations
 
 import math
+from collections import OrderedDict
 from functools import lru_cache
 
 import numpy as np
@@ -275,11 +276,25 @@ run_kernel_donated = jax.jit(
 #: docs/PERFORMANCE.md) while keeping whole channel layers fused.
 CHAIN_MAX_STEPS = 32
 
+def lru_get(cache: OrderedDict, key, maxsize: int, build):
+    """Get-or-build with LRU eviction — the shared pattern for every
+    structure-keyed compiled-fn cache (stream programs, chain programs,
+    prefix fetches): evicting OUR jitted wrapper drops its compile cache
+    (and any captured Mesh) with it, which a bare ``jax.jit`` with a
+    static key never would."""
+    fn = cache.pop(key, None)
+    if fn is None:
+        fn = build()
+    cache[key] = fn
+    while len(cache) > maxsize:
+        cache.popitem(last=False)
+    return fn
+
+
 #: Compiled chain programs, LRU-bounded: ``steps`` (kinds + statics) is a
 #: static key, so workloads whose channel/collapse structure varies per
 #: flush would otherwise grow jit's internal cache without bound.
-#: Evicting OUR jitted wrapper drops its compile cache with it.
-_CHAIN_CACHE = None
+_CHAIN_CACHE: OrderedDict = OrderedDict()
 _CHAIN_CACHE_MAX = 64
 
 
@@ -302,14 +317,8 @@ def run_kernel_chain(arrays, scalars_list, *, steps, mesh: Mesh | None):
         raise ValueError(
             f"chain of {len(steps)} steps exceeds CHAIN_MAX_STEPS="
             f"{CHAIN_MAX_STEPS}; split at the call site")
-    global _CHAIN_CACHE
-    if _CHAIN_CACHE is None:
-        from collections import OrderedDict
 
-        _CHAIN_CACHE = OrderedDict()
-    key = (steps, mesh)
-    fn = _CHAIN_CACHE.pop(key, None)
-    if fn is None:
+    def build():
         def impl(arrays, scalars_list):
             def body(lat, arrays, scalars_list):
                 for (kind, statics), scalars in zip(steps, scalars_list):
@@ -318,10 +327,9 @@ def run_kernel_chain(arrays, scalars_list, *, steps, mesh: Mesh | None):
 
             return _dispatch(body, arrays, scalars_list, mesh, "arrays")
 
-        fn = jax.jit(impl, donate_argnums=(0,))
-    _CHAIN_CACHE[key] = fn
-    while len(_CHAIN_CACHE) > _CHAIN_CACHE_MAX:
-        _CHAIN_CACHE.popitem(last=False)
+        return jax.jit(impl, donate_argnums=(0,))
+
+    fn = lru_get(_CHAIN_CACHE, (steps, mesh), _CHAIN_CACHE_MAX, build)
     return fn(arrays, scalars_list)
 
 

@@ -16,6 +16,7 @@ compilation (see quest_tpu.circuit).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import lru_cache
 
 import numpy as np
@@ -25,7 +26,7 @@ import jax.numpy as jnp
 from . import precision
 from . import qasm
 from .env import QuESTEnv
-from .ops.lattice import amp_sharding, state_shape
+from .ops.lattice import amp_sharding, lru_get, state_shape
 from .validation import (
     QuESTError,
     validate_create_num_qubits,
@@ -233,7 +234,7 @@ class Qureg:
 #: Compiled flush programs, keyed by the exact op stream (LRU-bounded:
 #: scalars are burned into fused programs, so an unbounded cache would
 #: leak under angle sweeps).
-_STREAM_CACHE: "OrderedDict" = None  # initialised below
+_STREAM_CACHE: OrderedDict = OrderedDict()
 _STREAM_CACHE_MAX = 64
 
 #: Op kinds the fused executor understands; everything else in a
@@ -242,7 +243,7 @@ _GATE_KINDS = ("apply_2x2", "apply_phase")
 
 #: Sweep detection: structure key (kinds + statics, no scalars) -> the
 #: scalars that structure was last flushed with.  LRU-bounded.
-_STRUCT_HISTORY: "OrderedDict" = None
+_STRUCT_HISTORY: OrderedDict = OrderedDict()
 _STRUCT_HISTORY_MAX = 256
 _MISSING = object()
 
@@ -255,11 +256,6 @@ def _is_sweep(qureg, ops) -> bool:
     angle; the per-gate path's angle-traced compile cache serves them
     instead.  Keyed per register (id) so two registers running fixed-
     angle circuits of the same shape never misclassify each other."""
-    global _STRUCT_HISTORY
-    if _STRUCT_HISTORY is None:
-        from collections import OrderedDict
-
-        _STRUCT_HISTORY = OrderedDict()
     struct = (id(qureg), tuple((kind, statics) for kind, statics, _ in ops),
               qureg.num_vec_qubits, qureg.mesh)
     scalars = tuple(s for _, _, s in ops)
@@ -271,14 +267,7 @@ def _is_sweep(qureg, ops) -> bool:
 
 
 def _stream_fn(ops: tuple, num_vec_qubits: int, mesh):
-    global _STREAM_CACHE
-    if _STREAM_CACHE is None:
-        from collections import OrderedDict
-
-        _STREAM_CACHE = OrderedDict()
-    key = (ops, num_vec_qubits, mesh)
-    fn = _STREAM_CACHE.pop(key, None)
-    if fn is None:
+    def build():
         fn = mesh is None and _aot_load(ops, num_vec_qubits)
         if not fn:
             from .circuit import Circuit  # deferred: avoids import cycle
@@ -288,10 +277,10 @@ def _stream_fn(ops: tuple, num_vec_qubits: int, mesh):
             fn = c.compile(mesh=mesh, donate=True, pallas=True)
             if mesh is None:
                 fn = _aot_save(fn, ops, num_vec_qubits) or fn
-        while len(_STREAM_CACHE) >= _STREAM_CACHE_MAX:
-            _STREAM_CACHE.popitem(last=False)
-    _STREAM_CACHE[key] = fn
-    return fn
+        return fn
+
+    return lru_get(_STREAM_CACHE, (ops, num_vec_qubits, mesh),
+                   _STREAM_CACHE_MAX, build)
 
 
 def _aot_path(ops: tuple, num_vec_qubits: int):
@@ -540,7 +529,7 @@ def _init_body(kind: str, shape: tuple[int, int], dtype):
     return make
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=64)
 def _init_builder(kind: str, shape: tuple[int, int], dtype, mesh):
     """Jitted fresh-allocation builder, cached per (kind, shape, dtype,
     mesh) — used at register creation, when no old buffers exist."""
@@ -554,7 +543,7 @@ def _init_builder(kind: str, shape: tuple[int, int], dtype, mesh):
     return jax.jit(make(zeros), **kw)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=64)
 def _reinit_builder(kind: str, shape: tuple[int, int], dtype, mesh):
     """Jitted re-initialisation builder that DONATES the register's old
     buffers and derives the zero base from them (``old * 0``), so the
@@ -697,7 +686,7 @@ def init_state_from_amps(qureg: Qureg, reals, imags) -> None:
         qureg._set(jax.device_put(reals, sh), jax.device_put(imags, sh))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=64)
 def _row_window_update(shape: tuple[int, int], dtype, mesh):
     """Jitted donated row-window overwrite: the state buffers update in
     place and only the patch (window rows x lanes) is ever allocated —
@@ -776,10 +765,12 @@ def clone_qureg(target: Qureg, copy: Qureg) -> None:
 _PREFIX_ROWS = 16
 
 
-#: Jitted prefix-slice fns, LRU-bounded like every other compiled-fn
-#: cache here (a jitted wrapper pins its compile cache and, for meshes,
-#: the Mesh object — unbounded growth across many envs would leak).
-_PREFIX_FETCH_CACHE: "OrderedDict" = None
+#: Jitted prefix-slice fns, LRU-bounded like the other structure-keyed
+#: compiled-fn caches (_STREAM_CACHE, _CHAIN_CACHE).  The shape-keyed
+#: builder caches below (_init_builder, _reinit_builder,
+#: _row_window_update) are bounded too — their key space is register
+#: geometries, smaller but still open-ended across many meshes.
+_PREFIX_FETCH_CACHE: OrderedDict = OrderedDict()
 _PREFIX_FETCH_CACHE_MAX = 16
 
 
@@ -788,28 +779,19 @@ def _prefix_fetch(rows: int, mesh):
     window is addressable from every process of a multi-host run (a plain
     slice keeps the row sharding, and fetching it would span
     non-addressable devices)."""
-    global _PREFIX_FETCH_CACHE
-    if _PREFIX_FETCH_CACHE is None:
-        from collections import OrderedDict
-
-        _PREFIX_FETCH_CACHE = OrderedDict()
-    key = (rows, mesh)
-    fn = _PREFIX_FETCH_CACHE.pop(key, None)
-    if fn is None:
+    def build():
         def f(re, im):
             return re[:rows], im[:rows]
 
         if mesh is None:
-            fn = jax.jit(f)
-        else:
-            from jax.sharding import NamedSharding, PartitionSpec
+            return jax.jit(f)
+        from jax.sharding import NamedSharding, PartitionSpec
 
-            rep = NamedSharding(mesh, PartitionSpec())
-            fn = jax.jit(f, out_shardings=(rep, rep))
-    _PREFIX_FETCH_CACHE[key] = fn
-    while len(_PREFIX_FETCH_CACHE) > _PREFIX_FETCH_CACHE_MAX:
-        _PREFIX_FETCH_CACHE.popitem(last=False)
-    return fn
+        rep = NamedSharding(mesh, PartitionSpec())
+        return jax.jit(f, out_shardings=(rep, rep))
+
+    return lru_get(_PREFIX_FETCH_CACHE, (rows, mesh),
+                   _PREFIX_FETCH_CACHE_MAX, build)
 
 
 def _amp_at(qureg: Qureg, index: int):

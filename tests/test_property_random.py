@@ -165,3 +165,79 @@ def test_random_dm_interleaving_matches_oracle(env, seed):
     got = qt.get_density_matrix(q)
     np.testing.assert_allclose(got, rho, atol=TOL)
     assert abs(qt.calc_total_prob(q) - 1.0) < TOL
+
+
+def _lifecycle_op(qt_, q, psi, n, env, rng, seed, step):
+    """One random op mixing gates with the registry-lifecycle calls the
+    gate-only fuzz above does not reach: prob-table reads, amplitude
+    reads, collapse, cloneQureg, re-init, setAmps.  Reference semantics
+    throughout (e.g. outcome-1 probability is 1 - P(0) even for
+    unnormalised states, calcProbOfOutcome QuEST.c:613-621)."""
+    k = rng.randint(10)
+    t = rng.randint(n)
+    others = [x for x in range(n) if x != t]
+    c = others[rng.randint(len(others))]
+    ang = float(rng.uniform(0, 2 * math.pi))
+    if k == 0:
+        qt_.hadamard(q, t)
+        psi = oracle.apply_sv(psi, n, t, oracle.H)
+    elif k == 1:
+        qt_.rotate_y(q, t, ang)
+        psi = oracle.apply_sv(psi, n, t, oracle.rot(ang, (0, 1, 0)))
+    elif k == 2:
+        qt_.controlled_not(q, c, t)
+        psi = oracle.apply_sv(psi, n, t, oracle.X, controls=(c,))
+    elif k == 3:
+        qt_.t_gate(q, t)
+        psi = oracle.apply_sv(psi, n, t, oracle.T)
+    elif k == 4:  # per-qubit probability (the batched table + cache)
+        got = qt_.calc_prob_of_outcome(q, t, 1)
+        sel0 = [(i >> t) & 1 == 0 for i in range(1 << n)]
+        want = 1.0 - float(np.sum(np.abs(psi[sel0]) ** 2))
+        assert abs(got - want) < TOL, (seed, step)
+        got0 = qt_.calc_prob_of_outcome(q, c, 0)
+        selc = [(i >> c) & 1 == 0 for i in range(1 << n)]
+        assert abs(got0 - float(np.sum(np.abs(psi[selc]) ** 2))) < TOL
+    elif k == 5:  # amp reads, prefix-cached and beyond
+        for ind in (0, rng.randint(1 << n)):
+            assert abs(qt_.get_amp(q, ind) - complex(psi[ind])) < TOL
+    elif k == 6:
+        want = float(np.sum(np.abs(psi) ** 2))
+        assert abs(qt_.calc_total_prob(q) - want) < TOL
+    elif k == 7:
+        total = float(np.sum(np.abs(psi) ** 2))
+        sel = np.array([(i >> t) & 1 == 1 for i in range(1 << n)])
+        p1 = float(np.sum(np.abs(psi[sel]) ** 2))
+        if abs(total - 1) < 1e-9 and 1e-6 < p1 < 1 - 1e-6:
+            qt_.collapse_to_outcome(q, t, 1)
+            psi = np.where(sel, psi, 0) / math.sqrt(p1)
+    elif k == 8:  # clone into a fresh register, continue on the clone
+        q2 = qt_.create_qureg(n, env)
+        qt_.clone_qureg(q2, q)
+        q = q2
+    elif k == 9:
+        which = rng.randint(2)
+        if which == 0:
+            ind = rng.randint(1 << n)
+            qt_.init_classical_state(q, ind)
+            psi = np.zeros(1 << n, complex)
+            psi[ind] = 1.0
+        else:
+            start = rng.randint((1 << n) - 3)
+            vals = rng.randn(4) + 1j * rng.randn(4)
+            qt_.set_amps(q, start, vals.real.copy(), vals.imag.copy(), 4)
+            psi = psi.copy()
+            psi[start:start + 4] = vals
+    return q, psi
+
+
+@pytest.mark.parametrize("seed", [5, 17, 29])
+def test_random_lifecycle_interleaving(env, seed):
+    n = N
+    rng = np.random.RandomState(seed)
+    q = qt.create_qureg(n, env)
+    psi = np.zeros(1 << n, dtype=np.complex128)
+    psi[0] = 1.0
+    for step in range(100):
+        q, psi = _lifecycle_op(qt, q, psi, n, env, rng, seed, step)
+    np.testing.assert_allclose(qt.get_state_vector(q), psi, atol=TOL)
