@@ -19,9 +19,11 @@ import math
 from dataclasses import dataclass, field
 
 import jax
+import jax.numpy as jnp
 
 from .ops.lattice import run_kernel
 from .ops import gates as _g
+from . import precision as _prec
 from . import validation as _v
 
 
@@ -170,57 +172,232 @@ class Circuit:
         return self._2x2(t, _g._compact_m(complex(alpha), complex(beta)),
                          controls=(c,))
 
+    # -- measurement -----------------------------------------------------
+    def measure(self, t):
+        """Record a mid-circuit measurement of qubit ``t``.
+
+        Fully on-device in the compiled program: the outcome is sampled
+        with ``jax.random`` from the reduced P(target=0) and the collapse
+        runs as an outcome-parameterised elementwise kernel — no host
+        round trip per shot (the reference syncs to the host for its
+        MT19937 draw every time: statevec_measureWithStats,
+        QuEST_common.c:305-311; SURVEY §7.3 lists avoiding that sync as a
+        hard part).  The compiled function then takes a PRNG key and
+        additionally returns the outcomes vector (one int32 per recorded
+        measurement, in record order); see ``compile``/``as_fn``.
+
+        The eager path (quest_tpu.measure) is unchanged: it keeps the
+        reference's bit-exact shared-seed MT19937 sampling semantics.
+        """
+        _v.validate_target(self, t)
+        self._record(("measure", (t,), ()))
+        return self
+
+    def collapse_to_outcome(self, t, outcome):
+        """Record a deterministic projection of ``t`` onto ``outcome``
+        (reference: collapseToOutcome, QuEST.c:546-563).  Runs on-device;
+        the projection probability is computed in-program for the
+        renormalisation.  Does not consume randomness and does not
+        contribute to the outcomes vector."""
+        _v.validate_target(self, t)
+        _v.validate_outcome(outcome)
+        self._record(("collapse", (t, outcome), ()))
+        return self
+
+    @property
+    def num_measurements(self) -> int:
+        """Recorded ``measure`` ops (= length of the outcomes vector)."""
+        return sum(1 for kind, _, _ in self.ops if kind == "measure")
+
+    def _measure_step(self, re, im, key, meas_ix, target, mesh):
+        """One on-device measurement: reduce P(0), sample, collapse."""
+        eps = _prec.real_eps(re.dtype)
+        if self.is_density:
+            p0 = run_kernel((re, im), (), kind="dm_prob_zero",
+                            statics=(self.num_qubits, target), mesh=mesh,
+                            out_kind="scalar")
+        else:
+            p0 = run_kernel((re, im), (), kind="sv_prob_zero",
+                            statics=(target,), mesh=mesh,
+                            out_kind="scalar")
+        u = jax.random.uniform(jax.random.fold_in(key, meas_ix),
+                               dtype=jnp.float32)
+        # Degenerate probabilities short-circuit the draw, mirroring the
+        # eager path / generateMeasurementOutcome (QuEST_common.c:103-121).
+        outcome = jnp.where(p0 < eps, 1,
+                            jnp.where(1 - p0 < eps, 0,
+                                      (u > p0).astype(jnp.int32)))
+        re, im = self._collapse_step(re, im, target, outcome, p0, mesh)
+        return re, im, outcome
+
+    def _collapse_step(self, re, im, target, outcome, p0, mesh):
+        prob = jnp.where(outcome == 0, p0, 1 - p0)
+        # Degenerate projection (prob ~ 0, possible only via a recorded
+        # collapse onto an impossible outcome): compiled code cannot
+        # raise like the eager path's validate_measurement_prob, so
+        # clamp the renorm divisor — the kept block is (near-)zero, so
+        # the result is a (near-)zero state, detectable via
+        # calc_total_prob, rather than a silent NaN/Inf poisoning.
+        eps = _prec.real_eps(re.dtype)
+        prob = jnp.maximum(prob, eps)
+        if self.is_density:
+            re, im = run_kernel((re, im), (outcome, 1.0 / prob),
+                                kind="dm_collapse",
+                                statics=(self.num_qubits, target),
+                                mesh=mesh)
+        else:
+            re, im = run_kernel((re, im), (outcome, 1.0 / jnp.sqrt(prob)),
+                                kind="sv_collapse", statics=(target,),
+                                mesh=mesh)
+        return re, im
+
+    def _nonunitary_step(self, re, im, key, meas_ix, op, mesh):
+        """Dispatch one recorded measure/collapse op; returns
+        (re, im, outcome-or-None, consumed_randomness)."""
+        kind, statics, _ = op
+        if kind == "measure":
+            re, im, out = self._measure_step(re, im, key, meas_ix,
+                                             statics[0], mesh)
+            return re, im, out, True
+        target, outcome = statics
+        if self.is_density:
+            p0 = run_kernel((re, im), (), kind="dm_prob_zero",
+                            statics=(self.num_qubits, target), mesh=mesh,
+                            out_kind="scalar")
+        else:
+            p0 = run_kernel((re, im), (), kind="sv_prob_zero",
+                            statics=(target,), mesh=mesh,
+                            out_kind="scalar")
+        re, im = self._collapse_step(re, im, target,
+                                     jnp.asarray(outcome, jnp.int32), p0,
+                                     mesh)
+        return re, im, None, False
+
     # -- compilation -----------------------------------------------------
     @property
     def num_gates(self) -> int:
-        """User-visible gate count (density second passes not counted)."""
+        """User-visible gate count (density second passes not counted;
+        measure/collapse ops are recorded once and count once)."""
+        n_meas = sum(1 for kind, _, _ in self.ops
+                     if kind in ("measure", "collapse"))
         per = 2 if self.is_density else 1
-        return len(self.ops) // per
+        return (len(self.ops) - n_meas) // per + n_meas
+
+    @property
+    def _has_nonunitary(self) -> bool:
+        return any(kind in ("measure", "collapse") for kind, _, _ in self.ops)
 
     def as_fn(self, mesh=None):
-        """A pure (re, im) -> (re, im) function applying the circuit
-        gate-at-a-time via the XLA kernel path; jit-compatible, correct for
-        single-device or mesh-sharded arrays."""
-        ops = list(self.ops)
+        """A pure function applying the circuit gate-at-a-time via the XLA
+        kernel path; jit-compatible, correct for single-device or
+        mesh-sharded arrays.
 
-        def fn(re, im):
-            for kind, statics, scalars in ops:
-                re, im = run_kernel((re, im), scalars, kind=kind,
-                                    statics=statics, mesh=mesh)
+        Signature is ``(re, im) -> (re, im)``; when the circuit records
+        ``measure`` or ``collapse`` ops it is ``(re, im, key) ->
+        (re, im, outcomes)`` with ``key`` a jax PRNG key and ``outcomes``
+        an int32 vector of the recorded measurements in record order."""
+        ops = list(self.ops)
+        has_nu = self._has_nonunitary
+
+        def fn(re, im, key=None):
+            outcomes = []
+            for op in ops:
+                kind, statics, scalars = op
+                if kind in ("measure", "collapse"):
+                    re, im, out, _ = self._nonunitary_step(
+                        re, im, key, len(outcomes), op, mesh)
+                    if out is not None:
+                        outcomes.append(out)
+                else:
+                    re, im = run_kernel((re, im), scalars, kind=kind,
+                                        statics=statics, mesh=mesh)
+            if has_nu:
+                return re, im, jnp.stack(outcomes) if outcomes \
+                    else jnp.zeros((0,), jnp.int32)
             return re, im
 
         return fn
 
     def as_fused_fn(self, interpret: bool = False, mesh=None):
-        """A pure (re, im) -> (re, im) function applying the circuit as
-        scheduled fused Pallas segments — each segment is ONE in-place
-        pass over the state (see quest_tpu.scheduler).  With a mesh, the
-        segments run per-chunk inside shard_map and sharded-qubit gates
-        are handled by half-chunk relayout exchanges
-        (quest_tpu.parallel.mesh_exec).  Runs in interpreter mode off-TPU."""
-        if mesh is not None and mesh.devices.size > 1:
-            from .parallel.mesh_exec import as_mesh_fused_fn
+        """A pure function applying the circuit as scheduled fused Pallas
+        segments — each segment is ONE in-place pass over the state (see
+        quest_tpu.scheduler).  With a mesh, the segments run per-chunk
+        inside shard_map and sharded-qubit gates are handled by
+        half-chunk relayout exchanges (quest_tpu.parallel.mesh_exec).
+        Runs in interpreter mode off-TPU.
 
-            nvec = self.num_qubits * (2 if self.is_density else 1)
-            return as_mesh_fused_fn(list(self.ops), nvec, mesh,
-                                    interpret=interpret)
+        Signature as in :meth:`as_fn`: measure/collapse ops split the
+        gate stream into fused runs and execute on-device between them
+        (one reduction + one elementwise collapse, still inside the same
+        compiled program — no host sync)."""
+        gate_runs, nu_ops = self._split_runs()
 
-        from .ops.pallas_kernels import apply_fused_segment
-        from .scheduler import schedule_segments
+        def run_fn(run_ops):
+            if mesh is not None and mesh.devices.size > 1:
+                nvec = self.num_qubits * (2 if self.is_density else 1)
+                if (1 << nvec) // mesh.devices.size < 2:
+                    # no local bits to relabel onto: tiny registers run
+                    # the per-gate XLA path (trivially cheap at this size)
+                    def fn(re, im):
+                        for kind, statics, scalars in run_ops:
+                            re, im = run_kernel((re, im), scalars,
+                                                kind=kind, statics=statics,
+                                                mesh=mesh)
+                        return re, im
 
-        ops = list(self.ops)
+                    return fn
+                from .parallel.mesh_exec import as_mesh_fused_fn
 
-        def fn(re, im):
-            lanes = re.shape[1]
-            lane_bits = lanes.bit_length() - 1
-            nbits = (re.shape[0] * lanes).bit_length() - 1
-            for seg_ops, high in schedule_segments(ops, nbits,
-                                                   lane_bits=lane_bits):
-                re, im = apply_fused_segment(re, im, seg_ops, high,
-                                             interpret=interpret)
-            return re, im
+                return as_mesh_fused_fn(run_ops, nvec, mesh,
+                                        interpret=interpret)
+
+            from .ops.pallas_kernels import apply_fused_segment
+            from .scheduler import schedule_segments
+
+            def fn(re, im):
+                lanes = re.shape[1]
+                lane_bits = lanes.bit_length() - 1
+                nbits = (re.shape[0] * lanes).bit_length() - 1
+                for seg_ops, high in schedule_segments(run_ops, nbits,
+                                                       lane_bits=lane_bits):
+                    re, im = apply_fused_segment(re, im, seg_ops, high,
+                                                 interpret=interpret)
+                return re, im
+
+            return fn
+
+        run_fns = [run_fn(r) if r else None for r in gate_runs]
+        if not nu_ops:
+            return run_fns[0] or (lambda re, im: (re, im))
+
+        def fn(re, im, key=None):
+            outcomes = []
+            for i, op in enumerate(nu_ops + [None]):
+                if run_fns[i] is not None:
+                    re, im = run_fns[i](re, im)
+                if op is not None:
+                    re, im, out, _ = self._nonunitary_step(
+                        re, im, key, len(outcomes), op, mesh)
+                    if out is not None:
+                        outcomes.append(out)
+            return re, im, (jnp.stack(outcomes) if outcomes
+                            else jnp.zeros((0,), jnp.int32))
 
         return fn
+
+    def _split_runs(self):
+        """Split ops at measure/collapse boundaries: returns
+        (gate_runs, nu_ops) with len(gate_runs) == len(nu_ops) + 1."""
+        gate_runs, nu_ops, cur = [], [], []
+        for op in self.ops:
+            if op[0] in ("measure", "collapse"):
+                gate_runs.append(cur)
+                nu_ops.append(op)
+                cur = []
+            else:
+                cur.append(op)
+        gate_runs.append(cur)
+        return gate_runs, nu_ops
 
     def compile(self, mesh=None, donate: bool = True, pallas: str = "auto"):
         """One XLA program for the whole circuit.  ``donate`` reuses the
@@ -250,9 +427,25 @@ class Circuit:
             self._compiled[key] = fn
         return fn
 
-    def run(self, qureg, pallas: str = "auto"):
-        """Apply to a register (mutating facade, like the eager API)."""
+    def run(self, qureg, pallas: str = "auto", key=None):
+        """Apply to a register (mutating facade, like the eager API).
+
+        For circuits with recorded measurements, ``key`` (a jax PRNG key;
+        fresh from the entropy pool when omitted) seeds the on-device
+        outcome sampling, and the measured outcomes are returned as an
+        int32 array (record order)."""
         fn = self.compile(mesh=qureg.mesh, donate=False, pallas=pallas)
+        if self._has_nonunitary:
+            draws = self.num_measurements > 0
+            if key is None and draws:
+                import secrets
+
+                key = jax.random.PRNGKey(secrets.randbits(31))
+            re, im, outcomes = fn(qureg.re, qureg.im, key)
+            qureg._set(re, im)
+            # collapse-only circuits consume no randomness and yield no
+            # outcomes: keep the mutating-facade contract (return qureg)
+            return outcomes if draws else qureg
         re, im = fn(qureg.re, qureg.im)
         qureg._set(re, im)
         return qureg

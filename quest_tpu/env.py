@@ -101,12 +101,27 @@ def destroy_env(env: QuESTEnv) -> None:
     (QuEST_cpu_distributed.c:176-181, which blocks until every rank
     arrives): without the barrier the first process to exit tears down
     the coordination service while peers may still be executing their
-    last collective, killing them mid-flight."""
-    if jax.process_count() > 1:
+    last collective, killing them mid-flight.
+
+    Finalisation is one-shot, like MPI_Finalize: a second destroy_env
+    (or a sync_env after it) is a harmless no-op here, where running a
+    collective over the torn-down coordination service would hang."""
+    if jax.process_count() > 1 and not _finalised():
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("quest_tpu:destroy_env")
         jax.distributed.shutdown()
+
+
+def _finalised() -> bool:
+    """True once jax.distributed.shutdown() has run (the coordination
+    client is gone, so cross-process barriers must not be attempted)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is None
+    except Exception:
+        return False
 
 
 def sync_env(env: QuESTEnv) -> None:

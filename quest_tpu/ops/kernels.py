@@ -27,6 +27,8 @@ Conventions (bit ``q`` of the flat amplitude index is qubit ``q``):
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -304,123 +306,91 @@ def k_dm_add_mix(lat, arrays, scalars):
 # ---------------------------------------------------------------------------
 
 
-@kernel("dm_dephase1")
-def k_dm_dephase1(lat, arrays, scalars, num_qubits: int, target: int):
-    """Scale single-qubit off-diagonals (row bit != col bit on target) by
-    ``retain`` (reference: densmatr_oneQubitDegradeOffDiagonal,
-    QuEST_cpu.c:36-72; dephase passes retain = 1 - 2*prob via
-    QuEST.c:652-658, damping's dephase passes sqrt(1-prob))."""
+@kernel("dm_chan")
+def k_dm_chan(lat, arrays, scalars, tag: str, *bits):
+    """Explicit-bit decoherence channel: the canonical deferred form of
+    every channel (noise.py), dispatching on ``tag``:
+
+    * ``deph``  (a, b): scale elements with bit a != bit b by retain
+    * ``deph2`` (a, aN, b, bN): scale mismatch on either pair by retain
+    * ``depol`` (a, aN): one-qubit depolarise, level d
+    * ``damp``  (a, aN): amplitude damping, probability p
+    * ``depol2``(a, aN, b, bN): two-qubit depolarise, (d, delta, gamma)
+
+    References: dephase densmatr_oneQubitDegradeOffDiagonal
+    QuEST_cpu.c:36-116 (retain = 1-2p / 1-4p/3 via QuEST.c:652-667);
+    depolarise QuEST_cpu.c:118-165/:217-290 (level d = 4p/3); damping
+    QuEST_cpu.c:167-215/:292-376; two-qubit depolarise decomposition
+    densmatr_twoQubitDepolarise QuEST_cpu_distributed.c:724-814 with
+    the delta/gamma three-round pair mixing of QuEST_cpu_local.c:40-51
+    (each round's partner fetch is one xor_shift — on device bits
+    exactly the reference's pairwise exchanges, including the
+    composite-stride part-3 pairing, :329-350).
+
+    Explicit global bit indices (rather than (num_qubits, target)) keep
+    one representation valid for the XLA kernel path, the fused Pallas
+    executor (quest_tpu.ops.pallas_kernels), and mesh relabeling
+    (quest_tpu.scheduler.schedule_mesh), which rewrites the bits.
+    Formulas: references as in the per-channel kernels below."""
     re, im = arrays
-    (retain,) = scalars
-    off = lat.bit(target) != lat.bit(target + num_qubits)
-    nr = jnp.where(off, retain * re, re)
-    ni = jnp.where(off, retain * im, im)
-    return nr, ni
+    if tag == "deph":
+        a, b = bits
+        (retain,) = scalars
+        off = lat.bit(a) != lat.bit(b)
+        return (jnp.where(off, retain * re, re),
+                jnp.where(off, retain * im, im))
+    if tag == "deph2":
+        a, aN, b, bN = bits
+        (retain,) = scalars
+        off = jnp.logical_or(lat.bit(a) != lat.bit(aN),
+                             lat.bit(b) != lat.bit(bN))
+        return (jnp.where(off, retain * re, re),
+                jnp.where(off, retain * im, im))
+    if tag == "depol":
+        a, aN = bits
+        (d,) = scalars
+        tot = (1 << a) | (1 << aN)
+        diag = lat.bit(a) == lat.bit(aN)
+        pre = lat.xor_shift(re, tot)
+        pim = lat.xor_shift(im, tot)
+        nr = jnp.where(diag, (1 - d / 2) * re + (d / 2) * pre, (1 - d) * re)
+        ni = jnp.where(diag, (1 - d / 2) * im + (d / 2) * pim, (1 - d) * im)
+        return nr, ni
+    if tag == "damp":
+        a, aN = bits
+        (p,) = scalars
+        bt, bT = lat.bit(a), lat.bit(aN)
+        diag = bt == bT
+        zero = jnp.logical_and(diag, bt == 0)
+        tot = (1 << a) | (1 << aN)
+        pre = lat.xor_shift(re, tot)
+        pim = lat.xor_shift(im, tot)
+        deph = math.sqrt(1 - p) if isinstance(p, float) else jnp.sqrt(1 - p)
+        nr = jnp.where(zero, re + p * pre,
+                       jnp.where(diag, (1 - p) * re, deph * re))
+        ni = jnp.where(zero, im + p * pim,
+                       jnp.where(diag, (1 - p) * im, deph * im))
+        return nr, ni
+    if tag == "depol2":
+        a, aN, b, bN = bits
+        d, delta, gamma = scalars
+        tot1 = (1 << a) | (1 << aN)
+        tot2 = (1 << b) | (1 << bN)
+        sel = jnp.logical_and(lat.bit(a) == lat.bit(aN),
+                              lat.bit(b) == lat.bit(bN))
+        re = jnp.where(sel, re, (1 - d) * re)
+        im = jnp.where(sel, im, (1 - d) * im)
+        for mask, g in ((tot1, None), (tot2, None), (tot1 | tot2, gamma)):
+            pre = lat.xor_shift(re, mask)
+            pim = lat.xor_shift(im, mask)
+            nr = re + delta * pre
+            ni = im + delta * pim
+            if g is not None:
+                nr = g * nr
+                ni = g * ni
+            re = jnp.where(sel, nr, re)
+            im = jnp.where(sel, ni, im)
+        return re, im
+    raise ValueError(tag)
 
 
-@kernel("dm_dephase2")
-def k_dm_dephase2(lat, arrays, scalars, num_qubits: int, q1: int, q2: int):
-    """Two-qubit dephase: scale elements mismatched on q1 or q2 by
-    ``retain`` (reference: densmatr_twoQubitDephase, QuEST_cpu.c:77-116;
-    API passes retain = 1 - 4*prob/3, QuEST.c:660-667)."""
-    re, im = arrays
-    (retain,) = scalars
-    off1 = lat.bit(q1) != lat.bit(q1 + num_qubits)
-    off2 = lat.bit(q2) != lat.bit(q2 + num_qubits)
-    off = jnp.logical_or(off1, off2)
-    nr = jnp.where(off, retain * re, re)
-    ni = jnp.where(off, retain * im, im)
-    return nr, ni
-
-
-@kernel("dm_depolarise1")
-def k_dm_depolarise1(lat, arrays, scalars, num_qubits: int, target: int):
-    """One-qubit depolarising with level d = 4*prob/3:
-
-    * off-diagonals (target row bit != col bit): scale by 1 - d
-    * diagonal pair (00),(11): x -> (1-d)x + d*(x + partner)/2
-
-    (reference: densmatr_oneQubitDepolariseLocal QuEST_cpu.c:118-165 and
-    the identical Distributed update :217-290; the partner fetch across the
-    outer bit is the xor_shift, replacing
-    compressPairVectorForSingleQubitDepolarise + exchange,
-    QuEST_cpu_distributed.c:515-580, :680-700.)"""
-    re, im = arrays
-    (d,) = scalars
-    tot = (1 << target) | (1 << (target + num_qubits))
-    diag = lat.bit(target) == lat.bit(target + num_qubits)
-    pre = lat.xor_shift(re, tot)
-    pim = lat.xor_shift(im, tot)
-    nr = jnp.where(diag, (1 - d / 2) * re + (d / 2) * pre, (1 - d) * re)
-    ni = jnp.where(diag, (1 - d / 2) * im + (d / 2) * pim, (1 - d) * im)
-    return nr, ni
-
-
-@kernel("dm_damping")
-def k_dm_damping(lat, arrays, scalars, num_qubits: int, target: int):
-    """Amplitude damping with probability p:
-
-    * off-diagonals: scale by sqrt(1-p)
-    * rho_00 += p * rho_11 ; rho_11 *= (1-p)
-
-    (reference: densmatr_oneQubitDampingLocal QuEST_cpu.c:167-215,
-    Distributed :292-376.)"""
-    re, im = arrays
-    (p,) = scalars
-    bt = lat.bit(target)
-    bT = lat.bit(target + num_qubits)
-    diag = bt == bT
-    zero = jnp.logical_and(diag, bt == 0)
-    tot = (1 << target) | (1 << (target + num_qubits))
-    pre = lat.xor_shift(re, tot)
-    pim = lat.xor_shift(im, tot)
-    dephase = jnp.sqrt(1 - p)
-    nr = jnp.where(zero, re + p * pre, jnp.where(diag, (1 - p) * re, dephase * re))
-    ni = jnp.where(zero, im + p * pim, jnp.where(diag, (1 - p) * im, dephase * im))
-    return nr, ni
-
-
-@kernel("dm_depolarise2")
-def k_dm_depolarise2(lat, arrays, scalars, num_qubits: int, q1: int, q2: int):
-    """Two-qubit depolarising with level d = 16*prob/15.
-
-    Reference decomposition (densmatr_twoQubitDepolarise,
-    QuEST_cpu_distributed.c:724-814 / QuEST_cpu_local.c:40-51, kernels
-    QuEST_cpu.c:379-625): a two-qubit dephase by (1-d) on all elements
-    mismatched in q1 or q2, then three symmetric pair-mixing rounds over
-    the elements diagonal in both qubits, with
-    eta = 2/d, delta = eta - 1 - sqrt((eta-1)^2 - 1), gamma = (1+delta)^-3:
-
-      x += delta * x[i ^ tot1]
-      x += delta * x[i ^ tot2]
-      x  = gamma * (x + delta * x[i ^ tot1 ^ tot2])
-
-    Each round's partner fetch is one xor_shift — when the qubits are on
-    device bits this is exactly the reference's three pairwise exchanges
-    (including the composite-stride "part 3" pairing,
-    getChunkOuterBlockPairIdForPart3, QuEST_cpu_distributed.c:329-350).
-    """
-    re, im = arrays
-    d, delta, gamma = scalars
-    tot1 = (1 << q1) | (1 << (q1 + num_qubits))
-    tot2 = (1 << q2) | (1 << (q2 + num_qubits))
-    diag1 = lat.bit(q1) == lat.bit(q1 + num_qubits)
-    diag2 = lat.bit(q2) == lat.bit(q2 + num_qubits)
-    sel = jnp.logical_and(diag1, diag2)
-
-    # dephase on everything not doubly-diagonal
-    retain = 1 - d
-    re = jnp.where(sel, re, retain * re)
-    im = jnp.where(sel, im, retain * im)
-
-    for mask, g in ((tot1, None), (tot2, None), (tot1 | tot2, gamma)):
-        pre = lat.xor_shift(re, mask)
-        pim = lat.xor_shift(im, mask)
-        nr = re + delta * pre
-        ni = im + delta * pim
-        if g is not None:
-            nr = g * nr
-            ni = g * ni
-        re = jnp.where(sel, nr, re)
-        im = jnp.where(sel, ni, im)
-    return re, im

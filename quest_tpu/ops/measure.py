@@ -6,8 +6,10 @@ P(0), one host RNG draw (shared-seed semantics — see quest_tpu.env), then a
 communication-free collapse kernel (reference: statevec_measureWithStats,
 QuEST_common.c:305-311; collapse kernels QuEST_cpu.c:3023-3171,
 QuEST_cpu_distributed.c:1274-1292).  The data-dependent outcome forces one
-host sync per measurement — the same sync the reference pays; fully
-on-device measurement for jitted circuits lives in quest_tpu.circuit.
+host sync per measurement — the same sync the reference pays.  Fully
+on-device measurement for compiled circuits (jax.random sampling +
+outcome-parameterised collapse, no host round trip) is
+``quest_tpu.circuit.Circuit.measure``.
 """
 
 from __future__ import annotations

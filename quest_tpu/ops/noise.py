@@ -27,12 +27,16 @@ from ..validation import (
 from .lattice import run_kernel
 
 
-def _run(qureg: Qureg, kind: str, scalars, statics) -> None:
-    # Deferred like gates: the flush runs channels through donated
-    # kernels in submission order, so a gate+channel sequence dispatches
-    # asynchronously (one host sync per state READ, not per call) and
-    # never holds two full state copies.
-    qureg._defer((kind, statics, tuple(scalars)))
+def _run(qureg: Qureg, tag: str, scalars, bits) -> None:
+    # Deferred like gates, in the explicit-bit canonical form
+    # (kernels.k_dm_chan): the flush fuses channel runs into the Pallas
+    # gate stream on TPU (one in-place pass can carry gates AND
+    # channels), or runs them through donated XLA kernels elsewhere —
+    # either way a gate+channel sequence dispatches asynchronously (one
+    # host sync per state READ, not per call) and never holds two full
+    # state copies.  The reference streams the density matrix once per
+    # channel call (QuEST_cpu.c:36-377).
+    qureg._defer(("dm_chan", (tag, *bits), tuple(scalars)))
 
 
 def apply_one_qubit_dephase_error(qureg: Qureg, target: int, prob: float) -> None:
@@ -43,7 +47,8 @@ def apply_one_qubit_dephase_error(qureg: Qureg, target: int, prob: float) -> Non
     validate_one_qubit_dephase_prob(prob, "applyOneQubitDephaseError")
     if prob == 0:
         return
-    _run(qureg, "dm_dephase1", (1.0 - 2.0 * prob,), (qureg.num_qubits, target))
+    n = qureg.num_qubits
+    _run(qureg, "deph", (1.0 - 2.0 * prob,), (target, target + n))
 
 
 def apply_two_qubit_dephase_error(qureg: Qureg, q1: int, q2: int,
@@ -56,8 +61,9 @@ def apply_two_qubit_dephase_error(qureg: Qureg, q1: int, q2: int,
     if prob == 0:
         return
     q1, q2 = min(q1, q2), max(q1, q2)
-    _run(qureg, "dm_dephase2", (1.0 - 4.0 * prob / 3.0,),
-         (qureg.num_qubits, q1, q2))
+    n = qureg.num_qubits
+    _run(qureg, "deph2", (1.0 - 4.0 * prob / 3.0,),
+         (q1, q1 + n, q2, q2 + n))
 
 
 def apply_one_qubit_depolarise_error(qureg: Qureg, target: int,
@@ -69,8 +75,8 @@ def apply_one_qubit_depolarise_error(qureg: Qureg, target: int,
     validate_one_qubit_depol_prob(prob, "applyOneQubitDepolariseError")
     if prob == 0:
         return
-    _run(qureg, "dm_depolarise1", (4.0 * prob / 3.0,),
-         (qureg.num_qubits, target))
+    n = qureg.num_qubits
+    _run(qureg, "depol", (4.0 * prob / 3.0,), (target, target + n))
 
 
 def apply_one_qubit_damping_error(qureg: Qureg, target: int,
@@ -82,7 +88,8 @@ def apply_one_qubit_damping_error(qureg: Qureg, target: int,
     validate_one_qubit_damping_prob(prob, "applyOneQubitDampingError")
     if prob == 0:
         return
-    _run(qureg, "dm_damping", (prob,), (qureg.num_qubits, target))
+    n = qureg.num_qubits
+    _run(qureg, "damp", (prob,), (target, target + n))
 
 
 def apply_two_qubit_depolarise_error(qureg: Qureg, q1: int, q2: int,
@@ -100,8 +107,8 @@ def apply_two_qubit_depolarise_error(qureg: Qureg, q1: int, q2: int,
     delta = eta - 1.0 - math.sqrt((eta - 1.0) * (eta - 1.0) - 1.0)
     gamma = 1.0 / ((1.0 + delta) ** 3)
     q1, q2 = min(q1, q2), max(q1, q2)
-    _run(qureg, "dm_depolarise2", (d, delta, gamma),
-         (qureg.num_qubits, q1, q2))
+    n = qureg.num_qubits
+    _run(qureg, "depol2", (d, delta, gamma), (q1, q1 + n, q2, q2 + n))
 
 
 def add_density_matrix(combine: Qureg, prob: float, other: Qureg) -> None:

@@ -87,6 +87,14 @@ def _combine_2x2(r, i, pr, pi, bit, m):
 # Generalized fused segment: low bits + up to MAX_HIGH_BITS arbitrary qubits
 # ---------------------------------------------------------------------------
 
+def _row_flip_enabled() -> bool:
+    """A/B knob for the tile-aligned row-partner formulation (half-swap
+    view vs paired rolls); QUEST_ROW_FLIP=0 selects the roll path."""
+    import os
+
+    return os.environ.get("QUEST_ROW_FLIP", "1") != "0"
+
+
 #: Max number of arbitrary high qubits a fused segment can expose as
 #: dedicated block axes.  Raising this trades contiguous-row block size
 #: (c_blk = _ROW_BUDGET >> k) for more adaptively-chosen high targets per
@@ -94,7 +102,7 @@ def _combine_2x2(r, i, pr, pi, bit, m):
 #: k=7 wins below 30 qubits (2725 vs 2020 gates/s at 28q) but the 4 KB
 #: DMA pieces cost at 30q, where k=6 is best (582 vs 517 gates/s) — the
 #: scheduler picks per register size via ``default_max_high``.
-MAX_HIGH_BITS = 7
+MAX_HIGH_BITS = 8
 
 
 def default_max_high(num_vec_bits: int) -> int:
@@ -227,6 +235,28 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
             planned.append(op)
         else:
             planned.append(op)
+    # Pair-fuse adjacent uncontrolled 2x2s on DISTINCT exposed axes: the
+    # tensor gate (M1 on axis1) (x) (M2 on axis2) costs one slice+concat
+    # round over the block instead of two — exposed-axis ops are
+    # VMEM-copy-bound, so this halves their cost (same-axis runs were
+    # already composed by the scheduler's T groups).
+    if high_axis:
+        merged = []
+        for op in planned:
+            if (op[0] == "2x2" and merged and merged[-1][0] == "2x2"):
+                prev = merged[-1]
+                t1, t2 = prev[1], op[1]
+                if (prev[3] == 0 and prev[4] < 0 and op[3] == 0
+                        and op[4] < 0 and t1 != t2
+                        and t1 >= lane_bits and t2 >= lane_bits
+                        and (t1 - lane_bits) in high_axis
+                        and (t2 - lane_bits) in high_axis):
+                    merged[-1] = ("2x2pair",
+                                  high_axis[t1 - lane_bits], prev[2],
+                                  high_axis[t2 - lane_bits], op[2])
+                    continue
+            merged.append(op)
+        planned = merged
     planned = tuple(planned)
     n_flags = 0 if dev_flags is None else dev_flags.shape[-1]
 
@@ -339,9 +369,169 @@ class _FusedBits:
         return out
 
 
+def _xor_partner(x, t: int, bf: _FusedBits, high_axis, lane_bits: int,
+                 c_blk: int):
+    """``x[i ^ (1 << t)]`` over the fused block value, choosing the
+    cheapest formulation per bit class (exposed axis: half-swap; lane:
+    paired rolls + select; tile-aligned row: half-swap view; small row:
+    paired rolls).  The in-kernel analogue of Lattice.xor_shift."""
+    shape = x.shape
+    if t >= lane_bits and (t - lane_bits) in high_axis:
+        ax = high_axis[t - lane_bits]
+        x0 = lax.index_in_dim(x, 0, ax, keepdims=True)
+        x1 = lax.index_in_dim(x, 1, ax, keepdims=True)
+        return jnp.concatenate([x1, x0], ax)
+    if t < lane_bits:
+        s = 1 << t
+        axis = len(shape) - 1
+        up = pltpu.roll(x, shape[-1] - s, axis=axis)
+        dn = pltpu.roll(x, s, axis=axis)
+        return jnp.where(bf.bit(t) == 0, up, dn)
+    s = 1 << (t - lane_bits)
+    assert s < c_blk, (t, c_blk)
+    if s >= 8 and _row_flip_enabled():
+        view = shape[:-2] + (c_blk // (2 * s), 2, s, shape[-1])
+        ax = len(view) - 3
+        v = x.reshape(view)
+        h0 = lax.index_in_dim(v, 0, ax, keepdims=True)
+        h1 = lax.index_in_dim(v, 1, ax, keepdims=True)
+        return jnp.concatenate([h1, h0], ax).reshape(shape)
+    axis = len(shape) - 2
+    up = pltpu.roll(x, c_blk - s, axis=axis)
+    dn = pltpu.roll(x, s, axis=axis)
+    return jnp.where(bf.bit(t) == 0, up, dn)
+
+
+def _apply_chan(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
+                dtype):
+    """Decoherence channel inside a fused segment (planned form of the
+    explicit-bit dm_chan kernel, quest_tpu.ops.kernels.k_dm_chan — same
+    formulas; partner fetches via _xor_partner instead of
+    Lattice.xor_shift).  The reference streams the density matrix once
+    per channel call (QuEST_cpu.c:36-377); here channels share the
+    segment's single in-place pass with the gates around them."""
+    _, tag, bits, sc = op
+
+    def fetch(x, mask_bits):
+        for b in mask_bits:
+            x = _xor_partner(x, b, bf, high_axis, lane_bits, c_blk)
+        return x
+
+    c = lambda v: jnp.array(v, dtype)  # noqa: E731
+    if tag == "deph":
+        a, b = bits
+        (retain,) = sc
+        off = bf.bit(a) != bf.bit(b)
+        return (jnp.where(off, c(retain) * r, r),
+                jnp.where(off, c(retain) * i, i))
+    if tag == "deph2":
+        a, aN, b, bN = bits
+        (retain,) = sc
+        off = jnp.logical_or(bf.bit(a) != bf.bit(aN),
+                             bf.bit(b) != bf.bit(bN))
+        return (jnp.where(off, c(retain) * r, r),
+                jnp.where(off, c(retain) * i, i))
+    if tag == "depol":
+        a, aN = bits
+        (d,) = sc
+        diag = bf.bit(a) == bf.bit(aN)
+        pr = fetch(r, (a, aN))
+        pi = fetch(i, (a, aN))
+        nr = jnp.where(diag, c(1 - d / 2) * r + c(d / 2) * pr, c(1 - d) * r)
+        ni = jnp.where(diag, c(1 - d / 2) * i + c(d / 2) * pi, c(1 - d) * i)
+        return nr, ni
+    if tag == "damp":
+        a, aN = bits
+        (p,) = sc
+        bt, bT = bf.bit(a), bf.bit(aN)
+        diag = bt == bT
+        zero = jnp.logical_and(diag, bt == 0)
+        pr = fetch(r, (a, aN))
+        pi = fetch(i, (a, aN))
+        deph = float(np.sqrt(1 - p))
+        nr = jnp.where(zero, r + c(p) * pr,
+                       jnp.where(diag, c(1 - p) * r, c(deph) * r))
+        ni = jnp.where(zero, i + c(p) * pi,
+                       jnp.where(diag, c(1 - p) * i, c(deph) * i))
+        return nr, ni
+    if tag == "depol2":
+        a, aN, b, bN = bits
+        d, delta, gamma = sc
+        sel = jnp.logical_and(bf.bit(a) == bf.bit(aN),
+                              bf.bit(b) == bf.bit(bN))
+        r = jnp.where(sel, r, c(1 - d) * r)
+        i = jnp.where(sel, i, c(1 - d) * i)
+        for mask_bits, g in (((a, aN), None), ((b, bN), None),
+                             ((a, aN, b, bN), gamma)):
+            pr = fetch(r, mask_bits)
+            pi = fetch(i, mask_bits)
+            nr = r + c(delta) * pr
+            ni = i + c(delta) * pi
+            if g is not None:
+                nr = c(g) * nr
+                ni = c(g) * ni
+            r = jnp.where(sel, nr, r)
+            i = jnp.where(sel, ni, i)
+        return r, i
+    raise ValueError(tag)
+
+
+def _apply_2x2_pair(r, i, op):
+    """(M1 on exposed axis1) (x) (M2 on exposed axis2) in one
+    slice+concat round: out[b1,b2] = sum_{a1,a2} M1[b1,a1] M2[b2,a2]
+    x[a1,a2], with zero products skipped at trace time."""
+    _, ax1, m1, ax2, m2 = op
+
+    def mat(m):
+        (ar, ai_), (br, bi), (cr, ci), (dr, di) = m
+        return [[complex(ar, ai_), complex(br, bi)],
+                [complex(cr, ci), complex(dr, di)]]
+
+    m1c, m2c = mat(m1), mat(m2)
+
+    def quads(x):
+        x0 = lax.index_in_dim(x, 0, ax1, keepdims=True)
+        x1 = lax.index_in_dim(x, 1, ax1, keepdims=True)
+        return [[lax.index_in_dim(xa, a2, ax2, keepdims=True)
+                 for a2 in (0, 1)] for xa in (x0, x1)]
+
+    qr, qi = quads(r), quads(i)
+    zero = jnp.zeros_like(qr[0][0])
+    rows_r, rows_i = [], []
+    for b1 in (0, 1):
+        out_r, out_i = [], []
+        for b2 in (0, 1):
+            accr = acci = None
+
+            def acc(o, term):
+                return term if o is None else o + term
+
+            for a1 in (0, 1):
+                for a2 in (0, 1):
+                    w = m1c[b1][a1] * m2c[b2][a2]
+                    if w == 0:
+                        continue
+                    xr, xi = qr[a1][a2], qi[a1][a2]
+                    if w.real != 0.0:
+                        accr = acc(accr, w.real * xr)
+                        acci = acc(acci, w.real * xi)
+                    if w.imag != 0.0:
+                        accr = acc(accr, -w.imag * xi)
+                        acci = acc(acci, w.imag * xr)
+            out_r.append(zero if accr is None else accr)
+            out_i.append(zero if acci is None else acci)
+        rows_r.append(jnp.concatenate(out_r, ax2))
+        rows_i.append(jnp.concatenate(out_i, ax2))
+    return (jnp.concatenate(rows_r, ax1), jnp.concatenate(rows_i, ax1))
+
+
 def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
                     dtype, mats, flags=None):
     kind = op[0]
+    if kind == "chan":
+        return _apply_chan(r, i, op, bf, high_axis, lane_bits, c_blk, dtype)
+    if kind == "2x2pair":
+        return _apply_2x2_pair(r, i, op)
     hi = _MAT_PRECISION
     shape = r.shape
 
@@ -514,6 +704,27 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
             sel0 = bit == 0
             pr = jnp.where(sel0, up_r, dn_r)
             pi = jnp.where(sel0, up_i, dn_i)
+        elif (1 << (t - lane_bits)) >= 8 and _row_flip_enabled():
+            # tile-aligned row stride: the XOR partner is one half-swap of
+            # a leading-dim-split view (a single VMEM copy via slice +
+            # concat; jnp.flip lowers to `rev`, unimplemented in Pallas
+            # TPU) — the paired roll+select below moves the data four
+            # times for the same result, which stops hiding behind the
+            # HBM stream once a segment carries several of these
+            s = 1 << (t - lane_bits)
+            assert s < c_blk, (t, c_blk)
+            view = shape[:-2] + (c_blk // (2 * s), 2, s, shape[-1])
+            ax = len(view) - 3
+
+            def half_swap(x):
+                v = x.reshape(view)
+                h0 = lax.index_in_dim(v, 0, ax, keepdims=True)
+                h1 = lax.index_in_dim(v, 1, ax, keepdims=True)
+                return jnp.concatenate([h1, h0], ax).reshape(shape)
+
+            pr = half_swap(r)
+            pi = half_swap(i)
+            bit = bf.bit(t)
         else:
             j = t - lane_bits
             s = 1 << j

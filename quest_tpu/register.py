@@ -55,7 +55,7 @@ class Qureg:
     """
 
     __slots__ = ("_re", "_im", "num_qubits", "is_density", "mesh", "qasm",
-                 "_pending", "_readout")
+                 "_pending", "_readout", "_struct_history")
 
     def __init__(self, re, im, num_qubits: int, is_density: bool, mesh):
         self._re = re
@@ -65,6 +65,9 @@ class Qureg:
         self.mesh = mesh
         self.qasm = None  # attached by quest_tpu.qasm on creation
         self._pending = []
+        # Sweep-detection history (see _is_sweep), hung off the instance
+        # so a recycled id() can never inherit another register's history.
+        self._struct_history = OrderedDict()
         # Host-side readout cache (per-qubit probability table, amplitude
         # prefix), valid only for the CURRENT state: every mutation path
         # (_defer, _set, the re/im setters) clears it.  Batching readouts
@@ -146,7 +149,49 @@ class Qureg:
                     raise
                 del chain[:CHAIN_MAX_STEPS]
 
+    def _norm_check(self, jax, tag: str, n_ops: int, before: float | None):
+        """Debug-mode unitarity guardrail (QUEST_DEBUG_NORM=1): every
+        flushed gate stream is unitary, so the state norm must be
+        preserved to accumulated-roundoff order.  Catches kernel
+        regressions (e.g. a miscompiled partner fetch) at the op where
+        they happen instead of thousands of ops later in a soak run.
+        Costs one reduction per flush — off by default."""
+        import os
+
+        if not os.environ.get("QUEST_DEBUG_NORM"):
+            return None
+        from .ops.lattice import run_kernel
+        from . import precision as _prec
+
+        if self.is_density:
+            norm = float(run_kernel((self._re, self._im), (),
+                                    kind="dm_total_prob",
+                                    statics=(self.num_qubits,),
+                                    mesh=self.mesh, out_kind="scalar"))
+        else:
+            norm = float(run_kernel((self._re, self._im), (),
+                                    kind="sv_total_prob", statics=(),
+                                    mesh=self.mesh, out_kind="scalar"))
+        if before is not None:
+            # Per-op error is a few ulps on a unit-norm reduction; allow
+            # a generous multiple so only genuine kernel bugs trip it.
+            bound = 64 * max(n_ops, 1) * _prec.real_eps(self.real_dtype)
+            drift = abs(norm - before)
+            if drift > bound * max(before, 1.0):
+                raise QuESTError(
+                    f"norm drift {drift:.3e} after {n_ops} {tag} ops "
+                    f"exceeds debug bound {bound:.3e} (norm {before!r} -> "
+                    f"{norm!r}) — kernel regression?")
+        return norm
+
     def _run_gates(self, jax, run, run_kernel_donated) -> None:
+        n_run = len(run)
+        norm0 = self._norm_check(jax, "gate", n_run, None)
+        self._run_gates_inner(jax, run, run_kernel_donated)
+        if norm0 is not None:
+            self._norm_check(jax, "gate", n_run, norm0)
+
+    def _run_gates_inner(self, jax, run, run_kernel_donated) -> None:
         # Fused Pallas needs tile-aligned (>= (8, 128)) chunks and f32
         # (Mosaic has no f64 dot lowering); below/besides that the
         # per-gate XLA path is the right one anyway (tiny states are
@@ -238,12 +283,14 @@ _STREAM_CACHE: OrderedDict = OrderedDict()
 _STREAM_CACHE_MAX = 64
 
 #: Op kinds the fused executor understands; everything else in a
-#: deferred stream (noise channels) runs via the donated kernel path.
-_GATE_KINDS = ("apply_2x2", "apply_phase")
+#: deferred stream (measurement collapse) runs via the donated chain
+#: path.  Noise channels (dm_chan) fuse INTO the gate stream: one
+#: in-place Pallas pass carries gates and channels together — the
+#: reference streams the density matrix once per channel call
+#: (QuEST_cpu.c:36-377).
+_GATE_KINDS = ("apply_2x2", "apply_phase", "dm_chan")
 
-#: Sweep detection: structure key (kinds + statics, no scalars) -> the
-#: scalars that structure was last flushed with.  LRU-bounded.
-_STRUCT_HISTORY: OrderedDict = OrderedDict()
+#: Per-register sweep-history bound (see Qureg._struct_history).
 _STRUCT_HISTORY_MAX = 256
 _MISSING = object()
 
@@ -254,15 +301,17 @@ def _is_sweep(qureg, ops) -> bool:
     parameters (e.g. the reference's rotate_benchmark.test, 20 trials x
     29 targets).  Such streams would recompile the fused executor per
     angle; the per-gate path's angle-traced compile cache serves them
-    instead.  Keyed per register (id) so two registers running fixed-
-    angle circuits of the same shape never misclassify each other."""
-    struct = (id(qureg), tuple((kind, statics) for kind, statics, _ in ops),
+    instead.  History lives ON the register instance: keying a module
+    table by id(qureg) would let a garbage-collected register's recycled
+    id leak stale history into a fresh register."""
+    hist = qureg._struct_history
+    struct = (tuple((kind, statics) for kind, statics, _ in ops),
               qureg.num_vec_qubits, qureg.mesh)
     scalars = tuple(s for _, _, s in ops)
-    prev = _STRUCT_HISTORY.pop(struct, _MISSING)
-    _STRUCT_HISTORY[struct] = scalars
-    while len(_STRUCT_HISTORY) > _STRUCT_HISTORY_MAX:
-        _STRUCT_HISTORY.popitem(last=False)
+    prev = hist.pop(struct, _MISSING)
+    hist[struct] = scalars
+    while len(hist) > _STRUCT_HISTORY_MAX:
+        hist.popitem(last=False)
     return prev is not _MISSING and prev != scalars
 
 

@@ -97,14 +97,25 @@ def _normalize_cx(ops, lane_bits: int, low_row_bits: int):
        complex one costs 3 (Gauss), and on v5e the MXU dots are exactly
        what dense fused segments are bound by.
 
+    3. High-target X with a LOW-field (lane/row) control also becomes
+       H . CZ . H: kept as a controlled-X, its low-field support raises
+       every composition group's barriers and fragments the lane/row
+       runs into multiple dense matmuls — on v5e the composed lane dots
+       are precisely what dense segments are bound by (one real 128-dot
+       pair costs ~12 ms/pass at 30q while the exposed-axis H's ride the
+       VPU at ~1 ms) — so trading one X-copy for two high 2x2s plus a
+       free diagonal wins whenever it keeps the lane run whole.
+
     Same-field-controlled X (control and target both lane, or both low
     row) folds whole into its field matrix and is kept as-is; so are
-    high-target CNOTs, which keep the X partner-copy fast path (the
-    analogue of the reference's dedicated controlledNot kernel,
-    QuEST_cpu.c:2273)."""
+    high-target CNOTs controlled on mid/high/device bits, which keep the
+    X partner-copy fast path (the analogue of the reference's dedicated
+    controlledNot kernel, QuEST_cpu.c:2273) and raise no low-field
+    barriers."""
     lanes = 1 << lane_bits
     row_field = ((1 << low_row_bits) - 1) << lane_bits
     low_cov = lane_bits + low_row_bits
+    low_mask = (1 << low_cov) - 1
     out = []
     for op in ops:
         kind, statics, scalars = op
@@ -113,10 +124,15 @@ def _normalize_cx(ops, lane_bits: int, low_row_bits: int):
             (ar, ai), (br, bi), (cr, ci), (dr, di) = scalars
             in_field = (cm < lanes) if t < lane_bits \
                 else (cm & ~row_field) == 0
-            if (cm and t < low_cov and not in_field
-                    and ar == ai == dr == di == 0.0
+            is_x = (ar == ai == dr == di == 0.0
                     and br == 1.0 and bi == 0.0
-                    and cr == 1.0 and ci == 0.0):
+                    and cr == 1.0 and ci == 0.0)
+            if cm and t >= low_cov and (cm & low_mask) and is_x:
+                out.append(("apply_2x2", (t, 0), _H_M))
+                out.append(("apply_phase", (cm | (1 << t),), (-1.0, 0.0)))
+                out.append(("apply_2x2", (t, 0), _H_M))
+                continue
+            if (cm and t < low_cov and not in_field and is_x):
                 out.append(("apply_2x2", (t, 0), _H_M))
                 out.append(("apply_phase", (cm | (1 << t),), (-1.0, 0.0)))
                 out.append(("apply_2x2", (t, 0), _H_M))
@@ -139,6 +155,11 @@ def _normalize_cx(ops, lane_bits: int, low_row_bits: int):
     return out
 
 
+#: Channel tags whose kernels fetch XOR partners (they MIX their bits);
+#: dephase tags are diagonal (support only).
+_CHAN_MIXING = ("depol", "damp", "depol2")
+
+
 def _op_sets(op):
     """(mixing_bits, support_bits) of a recorded circuit op."""
     kind, statics, scalars = op
@@ -149,6 +170,12 @@ def _op_sets(op):
         target, ctrl_mask = statics
         t = 1 << target
         return t, t | ctrl_mask
+    if kind == "dm_chan":
+        tag, *bits = statics
+        mask = 0
+        for b in bits:
+            mask |= 1 << b
+        return (mask if tag in _CHAN_MIXING else 0), mask
     raise ValueError(kind)
 
 
@@ -158,8 +185,21 @@ def _commutes(a, b) -> bool:
     return not (am & bsup) and not (bm & asup)
 
 
+#: Minimum run length at which a lane / low-row gate run composes into a
+#: dense matrix ('lanemm'/'rowmm') instead of per-gate roll-selects.
+#: Measured on v5e at 30q (tools/probe30.py): a real 128x128 HIGHEST
+#: lane dot costs ~12 ms/pass of MXU time that does NOT hide behind the
+#: 37 ms HBM stream, while a lane roll-select rides the VPU at ~0.4 ms
+#: hidden — so per-gate rolls win until the run is long enough that
+#: roll count x roll cost crosses the dot cost.
+_LANE_COMPOSE_MIN = 2
+_ROW_COMPOSE_MIN = 3
+
+
 def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
-                    row_budget: int, max_high: int):
+                    row_budget: int, max_high: int,
+                    lane_compose_min: int = None,
+                    row_compose_min: int = None):
     """Partition ops (2x2 targets all < ``chunk_bits``; masks may include
     bits >= chunk_bits, which become per-device flags) into fused
     segments.  Returns a list of (seg_ops, high_bits, dev_masks)."""
@@ -173,20 +213,25 @@ def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
         seg, high, skipped = [], [], []
         for op in remaining:
             kind, statics, scalars = op
-            addable = True
+            # mixing bits above the low field need an exposed block axis
             if kind == "apply_2x2":
-                t = statics[0]
-                if t >= low_cov and t not in high:
-                    addable = len(high) < max_high
+                mix_targets = [statics[0]]
+            elif kind == "dm_chan" and statics[0] in _CHAN_MIXING:
+                mix_targets = list(statics[1:])
+            else:
+                mix_targets = []
+            needed = [t for t in mix_targets
+                      if t >= low_cov and t not in high]
+            addable = len(high) + len(needed) <= max_high
             if addable and all(_commutes(op, s) for s in skipped):
-                if kind == "apply_2x2" and statics[0] >= low_cov \
-                        and statics[0] not in high:
-                    high.append(statics[0])
+                high.extend(needed)
                 seg.append(op)
             else:
                 skipped.append(op)
         seg_ops, dev_masks = _plan_seg(seg, lane_bits, chunk_bits,
-                                       low_row_bits)
+                                       low_row_bits,
+                                       lane_compose_min=lane_compose_min,
+                                       row_compose_min=row_compose_min)
         segments.append((seg_ops, tuple(sorted(high)), dev_masks))
         remaining = skipped
     return segments
@@ -194,7 +239,9 @@ def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
 
 def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
                       row_budget: int = _ROW_BUDGET,
-                      max_high: int | None = None):
+                      max_high: int | None = None,
+                      lane_compose_min: int | None = None,
+                      row_compose_min: int | None = None):
     """Single-device scheduling: partition ``ops`` into fused segments.
 
     Returns a list of (seg_ops, high_bits) where seg_ops is the tuple for
@@ -206,7 +253,8 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
         (seg_ops, high)
         for seg_ops, high, _ in _schedule_chunk(
             normalize_diag(ops), num_vec_bits, lane_bits, row_budget,
-            max_high)
+            max_high, lane_compose_min=lane_compose_min,
+            row_compose_min=row_compose_min)
     ]
 
 
@@ -241,6 +289,9 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     for i, (kind, statics, _s) in enumerate(ops):
         if kind == "apply_2x2":
             mix_uses.setdefault(statics[0], []).append(i)
+        elif kind == "dm_chan":
+            for q in statics[1:]:
+                mix_uses.setdefault(q, []).append(i)
 
     def next_mix_use(q: int, i: int) -> int:
         lst = mix_uses.get(q, ())
@@ -273,20 +324,35 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
         inv[a], inv[b] = qb, qa
         pos[qa], pos[qb] = b, a
 
-    for i, op in enumerate(ops):
-        kind, statics, scalars = op
-        if kind == "apply_2x2" and pos[statics[0]] >= chunk_bits:
-            # bring the target's device bit local; evict the local bit
-            # whose logical qubit mixes farthest in the future (ties:
-            # prefer high row bits, keeping lanes free for matmul runs)
+    def localise(q: int, i: int, keep=()):
+        """Relabel logical qubit ``q``'s bit into the chunk if sharded.
+        ``keep``: logical qubits that must stay local (the current op's
+        other bits — already-localised partners must not be evicted)."""
+        if pos[q] >= chunk_bits:
+            # evict the local bit whose logical qubit mixes farthest in
+            # the future (ties: prefer high row bits, keeping lanes free
+            # for matmul runs)
             victim = max(
-                range(chunk_bits),
+                (p for p in range(chunk_bits) if inv[p] not in keep),
                 key=lambda p: (next_mix_use(inv[p], i), p),
             )
-            do_swap(pos[statics[0]], victim)
+            do_swap(pos[q], victim)
+
+    for i, op in enumerate(ops):
+        kind, statics, scalars = op
         if kind == "apply_2x2":
+            localise(statics[0], i)
             t, cm = statics
             pending.append((kind, (pos[t], tr_mask(cm)), scalars))
+        elif kind == "dm_chan":
+            # every channel bit is made local — the xor-partner fetches
+            # and the off-diagonal selections then run comm-free on each
+            # chunk (the reference pairs ranks across the outer bit per
+            # channel call instead: QuEST_cpu_distributed.c:697-814)
+            tag, *bits = statics
+            for q in bits:
+                localise(q, i, keep=bits)
+            pending.append((kind, (tag, *(pos[q] for q in bits)), scalars))
         else:
             (sm,) = statics
             pending.append((kind, (tr_mask(sm),), scalars))
@@ -319,12 +385,15 @@ class _Group:
     ``bar_mix``/``bar_sup`` are the unions of mixing/support bits of every
     entry placed after this group opened; an op (mix, sup) may join iff
     ``bar_mix & sup == 0 and mix & bar_sup == 0`` (it then commutes past
-    everything between its original position and the group)."""
+    everything between its original position and the group).  ``tag``
+    further keys the group (the (target, ctrl_mask) of a same-target
+    2x2 run; None for field-matrix/diagonal groups)."""
 
-    __slots__ = ("kind", "bar_mix", "bar_sup", "items")
+    __slots__ = ("kind", "tag", "bar_mix", "bar_sup", "items")
 
-    def __init__(self, kind):
+    def __init__(self, kind, tag=None):
         self.kind = kind
+        self.tag = tag
         self.bar_mix = 0
         self.bar_sup = 0
         self.items = []
@@ -333,26 +402,30 @@ class _Group:
 def _fold_groups(seg, lane_bits: int, low_row_bits: int):
     """Slide ops backward into the earliest compatible composition group.
 
-    Three group kinds: ``D`` collects diagonal phases (one combined-
+    Four group kinds: ``D`` collects diagonal phases (one combined-
     diagonal state pass regardless of count — in a Clifford+T stream half
     the gates land here), ``L`` collects lane-targeted 2x2 gates with
     lane controls (one LxL matrix on the MXU), ``R`` collects low-row-
     targeted 2x2 gates with low-row controls (one RxR matrix contracted
-    over the row axis).  Everything else is emitted in place and raises
-    the barriers of every earlier group.
+    over the row axis), and ``T`` collects a same-(target, controls) run
+    of 2x2 gates on one mid/high qubit — composed on the host into a
+    single 2x2, so a qubit hit k times in a segment costs ONE exposed-
+    axis pass instead of k (the reference applies every one as its own
+    state sweep, QuEST_cpu.c:1629-1798).  Everything else is emitted in
+    place and raises the barriers of every earlier group.
     """
     lanes = 1 << lane_bits
     row_field = ((1 << low_row_bits) - 1) << lane_bits
     out = []       # ops and _Group entries, in execution order
     groups = []    # same _Group objects, creation order
 
-    def join(kind, mix, sup, item):
+    def join(kind, mix, sup, item, tag=None):
         for g in groups:
-            if g.kind == kind and not (g.bar_mix & sup) \
-                    and not (mix & g.bar_sup):
+            if g.kind == kind and g.tag == tag \
+                    and not (g.bar_mix & sup) and not (mix & g.bar_sup):
                 break
         else:
-            g = _Group(kind)
+            g = _Group(kind, tag)
             groups.append(g)
             out.append(g)
             # entries after earlier groups now include g's items; account
@@ -364,11 +437,26 @@ def _fold_groups(seg, lane_bits: int, low_row_bits: int):
             other.bar_mix |= mix
             other.bar_sup |= sup
 
+    # Note: folding lane-masked phases INTO lane groups (to merge the
+    # real matmul runs they split into one complex matmul) was measured
+    # and rejected on v5e: the Gauss 3-dot complex path plus its extra
+    # full-block adds costs as much as the two real 2-dot groups it
+    # replaces (probe30d/e, round 3).
+
     for op in seg:
         kind, statics, scalars = op
         if kind == "apply_phase":
             (mask,) = statics
             join("D", 0, mask, (mask, scalars[0], scalars[1]))
+            continue
+        if kind == "dm_chan":
+            # channels execute in place (no composition group) and bar
+            # everything before them that touches their bits
+            mix, sup = _op_sets(op)
+            out.append(op)
+            for g in groups:
+                g.bar_mix |= mix
+                g.bar_sup |= sup
             continue
         target, ctrl_mask = statics
         mix = 1 << target
@@ -380,10 +468,7 @@ def _fold_groups(seg, lane_bits: int, low_row_bits: int):
             join("R", mix, sup,
                  (target - lane_bits, scalars, ctrl_mask >> lane_bits))
             continue
-        out.append(op)
-        for g in groups:
-            g.bar_mix |= mix
-            g.bar_sup |= sup
+        join("T", mix, sup, scalars, tag=(target, ctrl_mask))
     return out
 
 
@@ -396,7 +481,8 @@ def _compose(items, dim: int):
     return m
 
 
-def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int):
+def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
+              lane_compose_min: int = None, row_compose_min: int = None):
     """Convert recorded ops to kernel seg-ops: phases fold into combined
     diagonal groups, lane/low-row 2x2 runs compose into one LxL / RxR
     complex matrix ('lanemm' / 'rowmm'), and X-matrix gates are tagged
@@ -450,28 +536,54 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int):
                         (mask & chunk_mask, phr, phi, flag_ix(mask))
                         for mask, phr, phi in rest)))
             elif entry.kind == "L":
-                if len(entry.items) == 1:
-                    # a lone lane gate is cheaper as the per-gate
-                    # xor-permutation path than a composed 4-dot matmul
-                    target, scalars, ctrl_mask = entry.items[0]
-                    out.append(("2x2", target, tuple(scalars), ctrl_mask,
-                                -1))
+                cmin = (_LANE_COMPOSE_MIN if lane_compose_min is None
+                        else lane_compose_min)
+                if len(entry.items) < cmin:
+                    # short runs: per-gate roll-selects ride the VPU and
+                    # hide behind the HBM stream; the composed dense dot
+                    # occupies the MXU and does not (probe30.py)
+                    for target, scalars, ctrl_mask in entry.items:
+                        out.append(("2x2", target, tuple(scalars),
+                                    ctrl_mask, -1))
                     continue
                 m = _compose(entry.items, lanes)
                 out.append(("lanemm", m.real.copy(), m.imag.copy()))
-            else:  # "R"
-                if len(entry.items) <= 2:
-                    # small row runs: per-gate roll-select beats the
-                    # batched K=R matmul (measured ~1 ms vs ~7 ms on v5e)
+            elif entry.kind == "R":
+                cmin = (_ROW_COMPOSE_MIN if row_compose_min is None
+                        else row_compose_min)
+                if len(entry.items) < cmin:
                     for rt, scalars, rcm in entry.items:
                         out.append(("2x2", rt + lane_bits, tuple(scalars),
                                     rcm << lane_bits, -1))
                     continue
                 m = _compose(entry.items, nrow)
                 out.append(("rowmm", m.real.copy(), m.imag.copy()))
+            else:  # "T": same-(target, controls) run -> one composed 2x2
+                target, ctrl_mask = entry.tag
+                m = _compose_2x2(entry.items)
+                out.append(("2x2", target, m, ctrl_mask & chunk_mask,
+                            flag_ix(ctrl_mask)))
             continue
         kind, statics, scalars = entry
+        if kind == "dm_chan":
+            tag, *bits = statics
+            assert all(b < chunk_bits for b in bits), (
+                "dm_chan bits must be local (schedule_mesh relabels them)")
+            out.append(("chan", tag, tuple(bits), tuple(scalars)))
+            continue
         target, ctrl_mask = statics
         out.append(("2x2", target, tuple(scalars), ctrl_mask & chunk_mask,
                     flag_ix(ctrl_mask)))
     return tuple(out), tuple(dev_masks)
+
+
+def _compose_2x2(items):
+    """Product of a run of 2x2 gates in program order, back in the
+    executor's ((re, im) x 4) tuple form."""
+    m = np.eye(2, dtype=np.complex128)
+    for (ar, ai), (br, bi), (cr, ci), (dr, di) in items:
+        g = np.array([[ar + 1j * ai, br + 1j * bi],
+                      [cr + 1j * ci, dr + 1j * di]])
+        m = g @ m
+    return ((m[0, 0].real, m[0, 0].imag), (m[0, 1].real, m[0, 1].imag),
+            (m[1, 0].real, m[1, 0].imag), (m[1, 1].real, m[1, 1].imag))

@@ -1,0 +1,185 @@
+"""On-device measurement in compiled circuits (Circuit.measure).
+
+The reference performs measurement eagerly with a host-side MT19937 draw
+per call (statevec_measureWithStats, QuEST_common.c:305-311); SURVEY
+§7.3 flags the per-measure host sync as a hard part.  Here the whole
+circuit — gates, probability reduction, jax.random outcome draw, and the
+outcome-parameterised collapse — compiles into ONE program taking a PRNG
+key, so repeated shots never sync to the host mid-circuit.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuit import Circuit
+from quest_tpu.validation import QuESTError
+
+from conftest import TOL, random_statevector, load_statevector
+
+
+@pytest.mark.parametrize("pallas", [False, True])
+def test_bv_compiled_with_measurement(env, pallas):
+    """Bernstein-Vazirani end-to-end in one compiled program, including
+    the final measurements: outcomes must read off the secret exactly
+    (the state is a computational-basis state, so outcomes are
+    deterministic regardless of key)."""
+    n, secret = 6, 0b10110
+    from quest_tpu import models
+
+    circ = models.bernstein_vazirani(n, secret)
+    for t in range(n - 1):
+        circ.measure(t)
+    q = qt.create_qureg(n, env)
+    qt.init_zero_state(q)
+    outcomes = circ.run(q, pallas=pallas, key=jax.random.PRNGKey(0))
+    got = sum(int(b) << i for i, b in enumerate(np.asarray(outcomes)))
+    assert got == secret
+    # post-measurement state is still normalised
+    assert abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+
+
+def test_measurement_statistics(env1):
+    """|+> measured: outcome frequencies approach 1/2, and the collapsed
+    state matches the outcome deterministically."""
+    circ = Circuit(1).hadamard(0).measure(0)
+    fn = jax.jit(circ.as_fn(mesh=None))
+    shape = qt.create_qureg(1, env1).re.shape
+
+    import jax.numpy as jnp
+
+    ones = 0
+    shots = 200
+    re0 = jnp.zeros(shape, jnp.float64).at[0, 0].set(1.0)
+    im0 = jnp.zeros(shape, jnp.float64)
+    outs = jax.vmap(lambda k: fn(re0, im0, k)[2][0])(
+        jax.random.split(jax.random.PRNGKey(7), shots))
+    outs = np.asarray(outs)
+    ones = int(outs.sum())
+    # binomial(200, .5): mean 100, sigma ~7; 5 sigma ~ 35
+    assert 65 <= ones <= 135
+
+
+def test_measure_collapse_consistency(env):
+    """After measuring qubit t, P(t = outcome) == 1 and the state equals
+    the renormalised projection of the input."""
+    n = 4
+    psi = random_statevector(n, 11)
+    circ = Circuit(n).measure(2)
+    q = qt.create_qureg(n, env)
+    load_statevector(q, psi)
+    out = circ.run(q, key=jax.random.PRNGKey(3))
+    o = int(np.asarray(out)[0])
+    got = qt.get_state_vector(q)
+
+    mask = np.array([((i >> 2) & 1) == o for i in range(2**n)])
+    proj = np.where(mask, psi, 0)
+    proj = proj / np.linalg.norm(proj)
+    np.testing.assert_allclose(got, proj, atol=1e-10)
+    assert abs(qt.calc_prob_of_outcome(q, 2, o) - 1.0) < 1e-10
+
+
+def test_collapse_to_outcome_compiled(env):
+    """Recorded deterministic collapse matches the eager API."""
+    n = 3
+    psi = random_statevector(n, 5)
+    circ = Circuit(n).hadamard(0).collapse_to_outcome(1, 1).hadamard(2)
+    q = qt.create_qureg(n, env)
+    load_statevector(q, psi)
+    circ.run(q, key=jax.random.PRNGKey(0))
+
+    q2 = qt.create_qureg(n, env)
+    load_statevector(q2, psi)
+    qt.hadamard(q2, 0)
+    qt.collapse_to_outcome(q2, 1, 1)
+    qt.hadamard(q2, 2)
+    np.testing.assert_allclose(
+        qt.get_state_vector(q), qt.get_state_vector(q2), atol=TOL)
+
+
+def test_density_circuit_measure(env1):
+    """Density-matrix circuit measurement: measuring |+><+| collapses to
+    |o><o| with the right renormalisation (1/prob, not 1/sqrt(prob))."""
+    circ = Circuit(2, is_density=True).hadamard(0).measure(0)
+    q = qt.create_density_qureg(2, env1)
+    qt.init_zero_state(q)
+    out = circ.run(q, key=jax.random.PRNGKey(1))
+    o = int(np.asarray(out)[0])
+    rho = qt.get_density_matrix(q)
+    expected = np.zeros((4, 4), complex)
+    expected[o, o] = 1.0
+    np.testing.assert_allclose(rho, expected, atol=1e-10)
+
+
+def test_mid_circuit_measurement_gates_after(env):
+    """Gates recorded after a measurement apply to the collapsed state
+    (the measure op splits the fused gate stream correctly)."""
+    n = 3
+    circ = Circuit(n).hadamard(0).measure(0).pauli_x(0)
+    q = qt.create_qureg(n, env)
+    qt.init_zero_state(q)
+    out = circ.run(q, key=jax.random.PRNGKey(9))
+    o = int(np.asarray(out)[0])
+    psi = qt.get_state_vector(q)
+    expected = np.zeros(2**n, complex)
+    expected[1 - o] = 1.0
+    np.testing.assert_allclose(psi, expected, atol=TOL)
+
+
+def test_measure_validates_target():
+    with pytest.raises(QuESTError):
+        Circuit(3).measure(3)
+    with pytest.raises(QuESTError):
+        Circuit(3).collapse_to_outcome(0, 2)
+
+
+def test_collapse_only_circuit_returns_qureg(env1):
+    """A circuit with only deterministic collapses has no outcomes and
+    must keep the mutating-facade contract (run returns the register,
+    no PRNG key consumed)."""
+    circ = Circuit(2).hadamard(0).collapse_to_outcome(0, 1)
+    q = qt.create_qureg(2, env1)
+    qt.init_zero_state(q)
+    out = circ.run(q)
+    assert out is q
+    assert abs(qt.calc_prob_of_outcome(q, 0, 1) - 1.0) < TOL
+
+
+def test_degenerate_collapse_yields_zero_state_not_nan(env1):
+    """Recorded collapse onto an impossible outcome cannot raise inside
+    a compiled program (the eager path does); it must produce a finite
+    (near-zero) state, never NaN/Inf."""
+    circ = Circuit(2).collapse_to_outcome(0, 1)  # |00> has P(q0=1) = 0
+    q = qt.create_qureg(2, env1)
+    qt.init_zero_state(q)
+    circ.run(q)
+    psi = qt.get_state_vector(q)
+    assert np.all(np.isfinite(psi.view(float)))
+    assert qt.calc_total_prob(q) < 1e-6
+
+
+def test_debug_norm_guardrail(env1, monkeypatch):
+    """QUEST_DEBUG_NORM=1: a norm-breaking op in the gate stream raises
+    at the flush where it happens."""
+    from quest_tpu.validation import QuESTError as QE
+
+    monkeypatch.setenv("QUEST_DEBUG_NORM", "1")
+    q = qt.create_qureg(3, env1)
+    qt.init_zero_state(q)
+    qt.hadamard(q, 0)
+    assert abs(qt.calc_total_prob(q) - 1.0) < TOL  # clean flush passes
+    # a non-unitary 2x2 smuggled into the stream must trip the check
+    q._defer(("apply_2x2", (0, 0),
+              ((2.0, 0.0), (0.0, 0.0), (0.0, 0.0), (2.0, 0.0))))
+    with pytest.raises(QE, match="norm drift"):
+        _ = q.re
+
+
+def test_num_gates_with_measure():
+    c = Circuit(3).hadamard(0).measure(0).collapse_to_outcome(1, 0)
+    assert c.num_gates == 3
+    assert c.num_measurements == 1
+    d = Circuit(2, is_density=True).hadamard(0).measure(1)
+    assert d.num_gates == 2
+    assert d.num_measurements == 1

@@ -211,15 +211,23 @@ def test_chain_failure_requeues_unapplied_tail(env):
     """A failure in a later sub-chain must leave the register consistent:
     completed sub-chains applied once, the unapplied tail (including the
     failing op) requeued, and the register recoverable after the bad op
-    is removed."""
+    is removed.
+
+    Channels now ride the fused GATE stream (dm_chan in _GATE_KINDS), so
+    the chain path is exercised with its remaining clients — collapse
+    kernels, deferred raw with known outcome/renorm scalars here so the
+    chain stays non-empty without eager probability reads."""
     from quest_tpu.ops.lattice import CHAIN_MAX_STEPS
 
     n = 3
     d = qt.create_density_qureg(n, env)
     qt.init_plus_state(d)
     k = CHAIN_MAX_STEPS + 4
-    for i in range(k):
-        qt.apply_one_qubit_dephase_error(d, i % n, 0.01)
+    # Repeated projections onto |0> of qubit 0 (idempotent: first one
+    # scales the kept block by 1/prob = 2, the rest renorm by 1/1).
+    d._defer(("dm_collapse", (n, 0), (0, 2.0)))
+    for _ in range(k - 1):
+        d._defer(("dm_collapse", (n, 0), (0, 1.0)))
     # an op with an unknown kernel kind lands in the SECOND sub-chain
     d._defer(("no_such_kernel", (), ()))
     with pytest.raises(KeyError):
@@ -229,9 +237,17 @@ def test_chain_failure_requeues_unapplied_tail(env):
     assert len(d._pending) == k - CHAIN_MAX_STEPS + 1
     assert d._pending[-1][0] == "no_such_kernel"
     # drop the poison op: the register recovers and the remaining
-    # channels apply exactly once
+    # collapses apply exactly once
     d._pending = [op for op in d._pending if op[0] != "no_such_kernel"]
     got = qt.get_density_matrix(d)
-    want = (1 / 2**n) * (1 - 0.02) ** k
-    assert abs(got[0, 7].real - want) < 1e-10
+    import numpy as np
+
+    want = np.zeros((2**n, 2**n), complex)
+    # |+><+| projected onto qubit0=0 and renormalised: uniform over the
+    # 4x4 block with qubit0 row/col bits 0
+    for r in range(2**n):
+        for c in range(2**n):
+            if not (r & 1) and not (c & 1):
+                want[r, c] = 1 / 4.0
+    np.testing.assert_allclose(got, want, atol=1e-10)
     assert abs(qt.calc_total_prob(d) - 1.0) < TOL

@@ -1,0 +1,129 @@
+"""Noise channels through the fused Pallas executor.
+
+Round-3 change: channels defer in the explicit-bit dm_chan form and join
+the fused GATE stream — one in-place segment pass carries gates and
+channels together (the reference streams the density matrix once per
+channel call, QuEST_cpu.c:36-377; distributed pairing
+QuEST_cpu_distributed.c:697-814).  These tests pin the fused ('chan'
+planned op) path against the XLA kernel path, single-device and under
+the 8-device mesh plan with relabeling.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt
+from quest_tpu.ops.lattice import run_kernel, state_shape
+from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from quest_tpu.scheduler import schedule_segments
+
+from conftest import TOL, random_density_matrix, load_density_matrix
+
+
+H_M = ((0.7071067811865476, 0.0), (0.7071067811865476, 0.0),
+       (0.7071067811865476, 0.0), (-0.7071067811865476, 0.0))
+
+
+def _chan_ops(n):
+    """A gates+channels op stream over an n-qubit density register
+    (2n vector qubits) covering every channel tag and bit class."""
+    ops = [
+        ("apply_2x2", (0, 0), H_M),
+        ("apply_2x2", (n, 0), H_M),          # the U* outer partner
+        ("dm_chan", ("deph", 0, n), (0.96,)),
+        ("dm_chan", ("depol", 1, 1 + n), (0.04,)),
+        ("apply_phase", ((1 << 1) | (1 << (1 + n)),), (0.8, 0.6)),
+        ("dm_chan", ("damp", n - 1, 2 * n - 1), (0.1,)),
+        ("dm_chan", ("deph2", 0, n, 2, 2 + n), (0.9,)),
+        ("dm_chan", ("depol2", 1, 1 + n, 2, 2 + n),
+         (0.05, 0.02532, 0.92736)),
+        ("apply_2x2", (2, 0), H_M),
+        ("apply_2x2", (2 + n, 0), H_M),
+    ]
+    return ops
+
+
+@pytest.mark.parametrize("n", [3, 5])
+def test_fused_channels_match_xla(n):
+    """schedule_segments + apply_fused_segment (interpret) must agree
+    with the per-op XLA kernel path on a mixed gate/channel stream."""
+    nvec = 2 * n
+    shape = state_shape(1 << nvec)
+    rho = random_density_matrix(n, seed=n)
+    flat = rho.T.reshape(-1)
+    re = jnp.asarray(flat.real.reshape(shape))
+    im = jnp.asarray(flat.imag.reshape(shape))
+
+    ops = _chan_ops(n)
+    r2, i2 = re, im
+    for kind, statics, scalars in ops:
+        r2, i2 = run_kernel((r2, i2), scalars, kind=kind, statics=statics,
+                            mesh=None)
+
+    r1, i1 = re, im
+    segs = schedule_segments(list(ops), nvec, lane_bits=min(7, nvec))
+    assert any(op[0] == "chan" for seg_ops, _ in segs for op in seg_ops)
+    for seg_ops, high in segs:
+        r1, i1 = apply_fused_segment(r1, i1, seg_ops, high, interpret=True)
+
+    np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(i1), np.asarray(i2), atol=1e-12)
+
+
+def test_channels_fuse_into_gate_stream(env1):
+    """The eager API defers channels into the same pending stream as
+    gates (one flush, no chain split), and the result matches the dense
+    matrix algebra."""
+    n = 2
+    d = qt.create_density_qureg(n, env1)
+    rho = random_density_matrix(n, seed=9)
+    load_density_matrix(d, rho)
+
+    qt.hadamard(d, 0)
+    qt.apply_one_qubit_dephase_error(d, 0, 0.05)
+    qt.apply_one_qubit_damping_error(d, 1, 0.2)
+    assert len(d._pending) == 4  # H (2 ops) + 2 channels, one stream
+    got = qt.get_density_matrix(d)
+
+    H = np.array([[1, 1], [1, -1]]) / np.sqrt(2)
+    U = np.kron(np.eye(2), H)  # qubit 0 is the LOW bit
+    want = U @ rho @ U.conj().T
+    # dephase qubit 0: off-diagonals in bit 0 scaled by 1-2p
+    for r in range(4):
+        for c in range(4):
+            if (r & 1) != (c & 1):
+                want[r, c] *= 1 - 2 * 0.05
+    # damping qubit 1 (Kraus form)
+    p = 0.2
+    K0 = np.array([[1, 0], [0, np.sqrt(1 - p)]])
+    K1 = np.array([[0, np.sqrt(p)], [0, 0]])
+    K0f = np.kron(K0, np.eye(2))
+    K1f = np.kron(K1, np.eye(2))
+    want = K0f @ want @ K0f.conj().T + K1f @ want @ K1f.conj().T
+    np.testing.assert_allclose(got, want, atol=1e-10)
+
+
+def test_channels_under_mesh(env8):
+    """Channels on qubits whose outer bits are device bits: the mesh
+    plan relabels them local (half-chunk exchanges) and the result
+    matches the single-device path."""
+    n = 4  # 8 vector qubits over 8 devices -> outer bits sharded
+    rho = random_density_matrix(n, seed=4)
+
+    d8 = qt.create_density_qureg(n, env8)
+    load_density_matrix(d8, rho)
+    env1 = qt.create_env(num_devices=1)
+    d1 = qt.create_density_qureg(n, env1)
+    load_density_matrix(d1, rho)
+
+    for d in (d8, d1):
+        qt.hadamard(d, n - 1)
+        qt.apply_one_qubit_depolarise_error(d, n - 1, 0.06)
+        qt.apply_two_qubit_dephase_error(d, 0, n - 1, 0.03)
+        qt.apply_one_qubit_damping_error(d, n - 2, 0.12)
+    np.testing.assert_allclose(
+        qt.get_density_matrix(d8), qt.get_density_matrix(d1), atol=TOL)
+    assert abs(qt.calc_total_prob(d8) - 1.0) < TOL

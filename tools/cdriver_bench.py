@@ -78,9 +78,12 @@ def main():
         cache = os.path.join(tmp, "cache")
         cold_wall, cold_sim = run_once(exe, cache)
         # warm time fluctuates with the tunnel's program-upload latency
-        # (~1-2 s of a ~3 s run): record two warm runs, headline the best
-        warm_runs = [run_once(exe, cache) for _ in range(2)]
-        warm_wall, warm_sim = min(warm_runs, key=lambda ws: ws[1])
+        # (~1-2 s of a ~3 s run): record three warm runs, headline the
+        # MEDIAN (the best-of is also recorded, explicitly labelled)
+        warm_runs = [run_once(exe, cache) for _ in range(3)]
+        warm_runs.sort(key=lambda ws: ws[1])
+        best_wall, best_sim = warm_runs[0]
+        warm_wall, warm_sim = warm_runs[len(warm_runs) // 2]
     art = {
         "config": "reference tutorial_example.c (30 qubits, 667 gates), "
                   "compiled unmodified against libQuEST.so, QuEST_PREC=1",
@@ -91,6 +94,9 @@ def main():
         "warm": {"wall_seconds": round(warm_wall, 2),
                  "driver_sim_seconds": round(warm_sim, 2),
                  "gates_per_sec": round(n_gates / warm_sim, 1),
+                 "headline_statistic": "median of 3 warm runs",
+                 "best_of_3_sim_seconds": round(best_sim, 2),
+                 "best_of_3_gates_per_sec": round(n_gates / best_sim, 1),
                  "all_warm_sim_seconds": [round(s, 2)
                                           for _, s in warm_runs]},
         "reference_in_file_estimate_seconds": 3783.93,

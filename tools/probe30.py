@@ -1,0 +1,84 @@
+"""Attribute the per-pass cost of the 30q bench segments by op class.
+
+Times the real seg0/seg1 content filtered down to one op kind at a time
+(same exposed high bits, so the DMA layout matches the real pass), plus
+floor-at-k probes.  MB_INNER amortises the ~90 ms tunnel dispatch.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from quest_tpu.ops.lattice import state_shape
+from quest_tpu.scheduler import schedule_segments
+from quest_tpu import models
+
+N = int(os.environ.get("MB_QUBITS", "30"))
+INNER = int(os.environ.get("MB_INNER", "16"))
+REPS = 2
+SEG = int(os.environ.get("MB_SEG", "0"))
+
+
+def timed(label, seg_ops, high=(), row_budget=1024):
+    shape = state_shape(1 << N)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(
+            0, INNER,
+            lambda _, s: apply_fused_segment(*s, seg_ops, high,
+                                             row_budget=row_budget),
+            (re, im))
+
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    re, im = run(re, im)
+    jax.block_until_ready((re, im))
+    float(re[0, 0])
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times.append((time.perf_counter() - t0) / INNER)
+    best = min(times)
+    gib = 2 * (1 << N) * 4 / 2**30
+    print(f"{label:44s} {best*1e3:8.2f} ms/pass   {2*gib/best:7.1f} GB/s-equiv",
+          flush=True)
+    return best
+
+
+circ = models.random_circuit(N, depth=8, seed=123)
+segs = schedule_segments(list(circ.ops), N, lane_bits=7)
+seg_ops, high = segs[SEG]
+
+lane_bits = 7
+
+
+def cls(op):
+    k = op[0]
+    if k != "2x2":
+        return k
+    t = op[1]
+    return "2x2-lane" if t < lane_bits else (
+        "2x2-row" if t < 11 else "2x2-high")
+
+
+kinds = sorted({cls(op) for op in seg_ops})
+print(f"n={N} seg{SEG}: {len(seg_ops)} ops, high={high}", flush=True)
+
+timed("floor k=0", (), ())
+timed(f"floor k={len(high)} (exposed, no ops)", (), high)
+for kind in kinds:
+    sub = tuple(op for op in seg_ops if cls(op) == kind)
+    timed(f"only {kind} (x{len(sub)})", sub, high)
+timed("full seg", tuple(seg_ops), high)
+timed("full seg rb=2048", tuple(seg_ops), high, row_budget=2048)

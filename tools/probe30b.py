@@ -1,0 +1,74 @@
+"""Full-circuit scheduling-policy sweep at the bench size.
+
+Times the whole 30q depth-8 random circuit (all segments, chained like
+bench.py) under scheduling variants: lane/row compose thresholds and the
+exposed-high-bit budget.  Decides _LANE_COMPOSE_MIN/_ROW_COMPOSE_MIN and
+default_max_high.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import jax
+import jax.numpy as jnp
+
+from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from quest_tpu.ops.lattice import state_shape
+from quest_tpu.scheduler import schedule_segments
+from quest_tpu import models
+
+N = int(os.environ.get("MB_QUBITS", "30"))
+INNER = int(os.environ.get("MB_INNER", "8"))
+REPS = 2
+
+circ = models.random_circuit(N, depth=8, seed=123)
+ops = list(circ.ops)
+shape = state_shape(1 << N)
+
+
+def timed(label, lane_min, row_min, max_high):
+    segs = schedule_segments(ops, N, lane_bits=7, max_high=max_high,
+                             lane_compose_min=lane_min,
+                             row_compose_min=row_min)
+
+    def apply(re, im):
+        for seg_ops, high in segs:
+            re, im = apply_fused_segment(re, im, seg_ops, high)
+        return re, im
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, INNER, lambda _, s: apply(*s), (re, im))
+
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    re, im = run(re, im)
+    jax.block_until_ready((re, im))
+    float(re[0, 0])
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times.append((time.perf_counter() - t0) / INNER)
+    best = min(times)
+    gps = circ.num_gates / best
+    print(f"{label:42s} {best*1e3:8.1f} ms/circ  {gps:7.1f} gates/s  "
+          f"({len(segs)} passes, {circ.num_gates/len(segs):.0f} g/pass)",
+          flush=True)
+    return best
+
+
+print(f"n={N} depth=8 ({circ.num_gates} gates)", flush=True)
+timed("baseline (lane>=2, row>=3, k=6)", 2, 3, 6)
+timed("rolls-only lanes (lane>=999, row>=3, k=6)", 999, 3, 6)
+timed("rolls lanes, rowmm>=2 (k=6)", 999, 2, 6)
+timed("rolls lanes+rows (999/999, k=6)", 999, 999, 6)
+timed("lane>=6, row>=3, k=6", 6, 3, 6)
+timed("lane>=10, row>=3, k=6", 10, 3, 6)
+timed("rolls-only lanes, k=7", 999, 3, 7)
+timed("lane>=6, row>=2, k=7", 6, 2, 7)

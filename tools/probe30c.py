@@ -1,0 +1,76 @@
+"""Round-3 executor probes: floors at high k, and the improved scheduler
+(same-target composition + high-CNOT rewrite) across depths and budgets."""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import jax
+import jax.numpy as jnp
+
+from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from quest_tpu.ops.lattice import state_shape
+from quest_tpu.scheduler import schedule_segments
+from quest_tpu import models
+
+N = int(os.environ.get("MB_QUBITS", "30"))
+INNER = int(os.environ.get("MB_INNER", "8"))
+REPS = 2
+shape = state_shape(1 << N)
+
+H = ((0.7071067811865476, 0.0), (0.7071067811865476, 0.0),
+     (0.7071067811865476, 0.0), (-0.7071067811865476, 0.0))
+
+
+def timed_segs(label, segs, n_gates, row_budget=1024):
+    def apply(re, im):
+        for seg_ops, high in segs:
+            re, im = apply_fused_segment(re, im, seg_ops, high,
+                                         row_budget=row_budget)
+        return re, im
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, INNER, lambda _, s: apply(*s), (re, im))
+
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    try:
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+    except Exception as e:
+        print(f"{label:46s} FAILED: {str(e)[:120]}", flush=True)
+        return None
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times.append((time.perf_counter() - t0) / INNER)
+    best = min(times)
+    npass = max(len(segs), 1)
+    print(f"{label:46s} {best*1e3:8.1f} ms  {n_gates/best if n_gates else 0:7.1f} gates/s"
+          f"  ({npass} passes, {best*1e3/npass:.1f} ms/pass)", flush=True)
+    return best
+
+
+print(f"n={N}", flush=True)
+# floors at k (exposed axes, no ops)
+timed_segs("floor k=7 rb=1024", [((), tuple(range(N - 7, N)))], 0)
+timed_segs("floor k=7 rb=2048", [((), tuple(range(N - 7, N)))], 0,
+           row_budget=2048)
+# 20 high 2x2 at k=7 (uncomposable: alternating targets)
+hb = tuple(range(N - 7, N))
+ops20 = tuple(("2x2", hb[i % 7], H, 0, -1) for i in range(20))
+timed_segs("20 high 2x2 k=7", [(ops20, hb)], 0)
+
+for depth in (8, 16, 32):
+    circ = models.random_circuit(N, depth=depth, seed=123)
+    for mh in (6, 7):
+        segs = schedule_segments(list(circ.ops), N, lane_bits=7,
+                                 max_high=mh)
+        timed_segs(f"depth={depth} k={mh}", segs, circ.num_gates)

@@ -1,0 +1,71 @@
+"""Sweep the round-3 executor changes at the bench size: lane-phase
+folding into lane groups, flip-view row partners, row-budget 2048
+(5-bit row field), across depths."""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from quest_tpu.ops.lattice import state_shape
+from quest_tpu.scheduler import schedule_segments
+from quest_tpu import models
+
+N = int(os.environ.get("MB_QUBITS", "30"))
+INNER = int(os.environ.get("MB_INNER", "8"))
+REPS = 2
+shape = state_shape(1 << N)
+
+
+def timed(label, depth, mh, rb):
+    circ = models.random_circuit(N, depth=depth, seed=123)
+    segs = schedule_segments(list(circ.ops), N, lane_bits=7, max_high=mh,
+                             row_budget=rb)
+    ndots = sum((2 if not np.asarray(op[2]).any() else 3)
+                for s, _ in segs for op in s if op[0] == "lanemm")
+
+    def apply(re, im):
+        for seg_ops, high in segs:
+            re, im = apply_fused_segment(re, im, seg_ops, high,
+                                         row_budget=rb)
+        return re, im
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, INNER, lambda _, s: apply(*s), (re, im))
+
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    try:
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+    except Exception as e:
+        print(f"{label:40s} FAILED: {str(e)[:150]}", flush=True)
+        return
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times.append((time.perf_counter() - t0) / INNER)
+    best = min(times)
+    print(f"{label:40s} {circ.num_gates/best:7.1f} gates/s  "
+          f"({len(segs)} passes, {best*1e3/len(segs):.1f} ms/pass, "
+          f"{ndots} lane-dots)", flush=True)
+
+
+print(f"n={N}", flush=True)
+timed("depth=8  k=6 rb=1024", 8, 6, 1024)
+timed("depth=8  k=6 rb=2048", 8, 6, 2048)
+timed("depth=16 k=6 rb=1024", 16, 6, 1024)
+timed("depth=16 k=6 rb=2048", 16, 6, 2048)
+timed("depth=16 k=7 rb=2048", 16, 7, 2048)
+timed("depth=32 k=6 rb=2048", 32, 6, 2048)

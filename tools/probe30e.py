@@ -1,0 +1,87 @@
+"""Discriminating probes: E1 flip on/off, k=7/8, scoped-vmem rb=2048,
+and the raw MXU dot precision ladder at bench shapes."""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from quest_tpu.ops.lattice import state_shape
+from quest_tpu.scheduler import schedule_segments
+from quest_tpu import models
+
+N = 30
+INNER = int(os.environ.get("MB_INNER", "8"))
+REPS = 2
+shape = state_shape(1 << N)
+
+
+def timed_fn(label, fn, units=1.0):
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, INNER, lambda _, s: fn(*s), (re, im))
+
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    try:
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+    except Exception as e:
+        print(f"{label:44s} FAILED: {str(e)[:100]}", flush=True)
+        return
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times.append((time.perf_counter() - t0) / INNER)
+    best = min(times)
+    print(f"{label:44s} {best*1e3:8.1f} ms  ({units/best:.1f}/s)",
+          flush=True)
+    return best
+
+
+# raw dot ladder: is HIGHEST already ~3x DEFAULT?
+M = jnp.asarray(np.random.RandomState(0).randn(128, 128), jnp.float32)
+for prec in ("DEFAULT", "HIGHEST"):
+    p = getattr(lax.Precision, prec)
+
+    def dot_pass(re, im, p=p):
+        re = jnp.dot(re, M, precision=p,
+                     preferred_element_type=jnp.float32)
+        return re, im
+
+    timed_fn(f"raw full-state dot {prec}", dot_pass)
+
+
+def circ_fn(depth, mh, rb):
+    circ = models.random_circuit(N, depth=depth, seed=123)
+    segs = schedule_segments(list(circ.ops), N, lane_bits=7, max_high=mh,
+                             row_budget=rb)
+
+    def apply(re, im):
+        for seg_ops, high in segs:
+            re, im = apply_fused_segment(re, im, seg_ops, high,
+                                         row_budget=rb)
+        return re, im
+
+    return apply, circ.num_gates, len(segs)
+
+
+for label, depth, mh, rb in [
+    ("depth=8  k=7 rb=1024", 8, 7, 1024),
+    ("depth=16 k=7 rb=1024", 16, 7, 1024),
+    ("depth=16 k=8 rb=1024", 16, 8, 1024),
+    ("depth=32 k=8 rb=2048", 32, 8, 2048),
+]:
+    fn, ng, np_ = circ_fn(depth, mh, rb)
+    timed_fn(f"{label} ({np_} passes)", fn, units=ng)
