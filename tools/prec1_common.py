@@ -1,0 +1,65 @@
+"""Shared PREC=1 (f32) helpers: the shim build command and the
+reference-harness compatibility wrapper.
+
+Used by tools/prec1_parity.py, tools/cdriver_bench.py, and
+tests/test_reference_harness.py (loaded by file path — tools/ is not a
+package) so the three stay in lockstep: a new harness patch or build
+flag lands in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+#: Runs the reference's QuESTTest corpus with the two latent PREC=1
+#: bugs in the reference harness itself patched at invocation:
+#: (a) QuESTPy's argument type map lacks the f32 pointer spelling
+#: ("LP_c_float" — QuESTTypes.QuESTTestee._basicTypeConv hardcodes
+#: only LP_c_double; its argPointerQreal helper is precision-generic),
+#: and (b) seedQuEST.test types genrand_real1 as qreal though it
+#: returns double at every precision (mt19937ar.h:13).
+#: argv: <libdir> <tolerance> [suite...]
+HARNESS_WRAPPER = """
+import runpy, sys
+from ctypes import c_double
+libdir, tol = sys.argv[1], sys.argv[2]
+suites = sys.argv[3:] or ["unit"]
+sys.argv = ["QuESTTest", "-Q", libdir, "-t", tol, *suites]
+from QuESTPy.QuESTBase import init_QuESTLib
+init_QuESTLib(libdir)
+from QuESTPy import QuESTTypes
+QuESTTypes.QuESTTestee._basicTypeConv['LP_c_float'] = \\
+    QuESTTypes.argPointerQreal
+QuESTTypes.QuESTTestee('genrand_real1', retType=c_double)
+runpy.run_module('QuESTTest', run_name='__main__')
+"""
+
+
+def build_shim(out_dir: str, prec: int = 1, repo: str = REPO) -> str:
+    """Compile capi/src/quest_capi.c at QuEST_PREC=``prec`` into
+    ``out_dir``/libQuEST.so; returns ``out_dir`` (the -Q libdir)."""
+    os.makedirs(out_dir, exist_ok=True)
+    py_cflags = subprocess.check_output(
+        ["python3-config", "--includes"], text=True).split()
+    py_ldflags = subprocess.check_output(
+        ["python3-config", "--ldflags", "--embed"], text=True).split()
+    r = subprocess.run(
+        ["cc", "-O2", "-fPIC", f"-DQuEST_PREC={prec}",
+         f"-DQUEST_TPU_ROOT=\"{repo}\"", f"-I{repo}/capi/include",
+         *py_cflags, "-shared",
+         "-o", os.path.join(out_dir, "libQuEST.so"),
+         f"{repo}/capi/src/quest_capi.c", *py_ldflags],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(f"PREC={prec} shim build failed:\n"
+                           f"{r.stderr[-1500:]}")
+    return out_dir
+
+
+def write_wrapper(path: str) -> str:
+    with open(path, "w") as f:
+        f.write(HARNESS_WRAPPER)
+    return path

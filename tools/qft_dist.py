@@ -116,9 +116,15 @@ def run_real_chip(max_qubits: int = 30):
     }
 
 
-def run_virtual_mesh(n: int = 22, ndev: int = 8):
-    """Sharded QFT on a virtual CPU mesh, in a subprocess so the CPU
-    platform config never touches this process's real-TPU backend."""
+def run_virtual_mesh(n: int = 26, ndev: int = 8):
+    """Sharded QFT on a virtual CPU mesh through the COMPILED XLA kernel
+    path (not interpret-mode Pallas — round-2's virtual-mesh evidence
+    topped out at 22q because the interpreter bounded the feasible
+    size), in a subprocess so the CPU platform config never touches this
+    process's real-TPU backend.  Alongside the executed run, the mesh
+    scheduler's relayout plan for the same circuit is accounted
+    per-swap (exact bytes at this chunk size) against the reference's
+    full-chunk-per-gate exchange scheme."""
     code = f"""
 import json, math, time
 import jax
@@ -137,7 +143,11 @@ dev_bits = (ndev - 1).bit_length()
 mesh = Mesh(np.array(jax.devices()[:ndev]), (AMP_AXIS,))
 sh = NamedSharding(mesh, P(AMP_AXIS))
 circ = models.qft(n)
-fn = circ.as_fused_fn(mesh=mesh, interpret=True)
+# Per-gate jitted kernels (run_kernel caches per (kind, statics)): one
+# giant jit over all {n} QFT ops explodes XLA:CPU compile time at this
+# size; the per-gate path is the same compiled (non-interpret) code the
+# sharded production XLA fallback runs.
+fn = circ.as_fn(mesh=mesh)
 shape = state_shape(1 << n, ndev)
 lanes = shape[1]
 x = (0b1011 << (n - 8)) | 0b1101
@@ -147,7 +157,7 @@ im = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
 t0 = time.perf_counter()
 re, im = fn(re, im)
 jax.block_until_ready((re, im))
-secs = time.perf_counter() - t0
+compile_plus_run = time.perf_counter() - t0
 
 norm = 2.0 ** (-n / 2.0)
 err = 0.0
@@ -158,20 +168,38 @@ for k in (0, 1, 5, (1 << n) - 1, (1 << (n - 1)) + 3):
                   float(im[k // lanes, k % lanes]))
     err = max(err, abs(got - expect))
 
-# comm volume of the mesh plan vs reference full-chunk exchanges
+# relayout-plan comm accounting at THIS chunk size: per-swap volumes
+# of the fused-mesh plan vs the reference full-chunk-per-gate scheme
 lane_bits = (lanes - 1).bit_length()
+chunk_bits = n - dev_bits
+chunk_bytes = 2 * (1 << chunk_bits) * 4       # re+im f32 per device
 plan = schedule_mesh(list(circ.ops), n, dev_bits, lane_bits)
-half_exchanges = sum(1 for step in plan if step[0] == "swap"
-                     and max(step[1], step[2]) >= n - dev_bits)
+swaps = []
+for step in plan:
+    if step[0] != "swap":
+        continue
+    a, b = sorted(step[1:])
+    if b < chunk_bits:
+        kind, vol = "local", 0
+    elif a >= chunk_bits:
+        kind, vol = "device-device", chunk_bytes
+    else:
+        kind, vol = "half-exchange", chunk_bytes // 2
+    swaps.append({{"bits": [a, b], "kind": kind,
+                   "bytes_per_device": vol}})
+moved = sum(s["bytes_per_device"] for s in swaps)
 ref_exchanges = sum(1 for kind, statics, _ in circ.ops
-                    if kind == "apply_2x2" and statics[0] >= n - dev_bits)
+                    if kind == "apply_2x2" and statics[0] >= chunk_bits)
 print("RESULT " + json.dumps({{
     "qubits": n, "devices": ndev, "gates": circ.num_gates,
-    "seconds": round(secs, 3),
+    "path": "compiled XLA kernels under shard_map (non-interpret)",
+    "compile_plus_run_seconds": round(compile_plus_run, 3),
     "max_amp_error_vs_analytic": err,
-    "relayout_half_exchanges": half_exchanges,
-    "chunk_volumes_moved": half_exchanges / 2.0,
+    "chunk_bytes_per_device": chunk_bytes,
+    "plan_swaps": swaps,
+    "plan_bytes_moved_per_device": moved,
     "reference_full_chunk_exchanges": ref_exchanges,
+    "reference_bytes_moved_per_device": ref_exchanges * chunk_bytes,
 }}))
 """
     env = dict(os.environ)

@@ -33,7 +33,7 @@ def main():
 
     from quest_tpu import models
     from quest_tpu.ops.lattice import state_shape
-    from quest_tpu.scheduler import schedule_segments
+    from quest_tpu.scheduler import schedule_segments_best
 
     dev = jax.devices()[0]
     hbm = 16 << 30
@@ -46,7 +46,7 @@ def main():
         n -= 1
 
     circ = models.random_circuit(n, depth=DEPTH, seed=77)
-    n_passes = len(schedule_segments(list(circ.ops), n))
+    n_passes = len(schedule_segments_best(list(circ.ops), n))
     fn = circ.compile(mesh=None, donate=True)
     shape = state_shape(1 << n)
 

@@ -30,12 +30,12 @@ def measure(n: int):
 
     from quest_tpu import models
     from quest_tpu.ops.lattice import state_shape
-    from quest_tpu.scheduler import schedule_segments
+    from quest_tpu.scheduler import schedule_segments_best
 
     circ = models.random_circuit(n, depth=DEPTH, seed=123)
     on_tpu = jax.default_backend() == "tpu"
     apply = circ.as_fused_fn() if on_tpu else circ.as_fn(mesh=None)
-    n_passes = len(schedule_segments(list(circ.ops), n)) if on_tpu \
+    n_passes = len(schedule_segments_best(list(circ.ops), n)) if on_tpu \
         else circ.num_gates
     # Keep each timed call ~1s: more inner reps for small, fast states.
     inner = max(4, min(256, (1 << 30) // (1 << n) * 2))
