@@ -1,6 +1,6 @@
 """Sharded QFT over every visible device; on a multi-host pod, run one
 process per host with quest_tpu.init_distributed (see
-examples/pod_launch.sh).  Single host: shards over local devices."""
+examples/submissionScripts/tpu_pod_example.sh).  Single host: shards over local devices."""
 
 import os
 import sys

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Multi-host run under PBS (analogue of the reference's
+# examples/submissionScripts/mpi_PBS_example.sh).  One process per
+# node; jax.distributed replaces MPI (see slurm_hosts_example.sh for
+# the SLURM spelling and the in-program quest_tpu.init_distributed
+# call).
+
+#PBS -l nodes=4:ppn=8
+#PBS -l walltime=00:10:00
+
+cd "$PBS_O_WORKDIR"
+NODES=($(sort -u "$PBS_NODEFILE"))
+COORD="${NODES[0]}:7521"
+NPROC=${#NODES[@]}
+
+i=0
+for node in "${NODES[@]}"; do
+  pbsdsh -h "$node" env QT_COORD="$COORD" QT_NPROC="$NPROC" QT_PID="$i" \
+    python "$PBS_O_WORKDIR/examples/distributed_qft.py" &
+  i=$((i + 1))
+done
+wait

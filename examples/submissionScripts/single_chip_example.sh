@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Single-accelerator run (analogue of the reference's
+# examples/submissionScripts/{cpu,gpu}_SLURM_example.sh, which pin one
+# node / one GPU).  On a TPU VM there is no scheduler to ask — the chip
+# is attached to the VM — so the "submission" is just the program; a
+# QuEST_PREC=1 C binary linked against capi/libQuEST.so auto-selects
+# the accelerator, and Python programs use jax's default device.
+set -euo pipefail
+
+PROGRAM=${1:-examples/tutorial.py}
+
+# Python program on the attached chip:
+python "${PROGRAM}"
+
+# or an unmodified QuEST C program against the drop-in ABI:
+#   make -C capi QuEST_PREC=1
+#   cc -Icapi/include prog.c -Lcapi -lQuEST -Wl,-rpath,capi -o prog
+#   ./prog

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Multi-host CPU-cluster run under SLURM (analogue of the reference's
+# examples/submissionScripts/mpi_SLURM_example.sh: 4 nodes x 1 rank).
+# Instead of mpirun, each task joins a jax.distributed coordination
+# service; quest_tpu.init_distributed() builds the global amplitude
+# mesh and all exchange traffic rides XLA collectives (SURVEY §2.4).
+
+#SBATCH --nodes=4
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=8
+
+# rank 0's hostname is the coordinator; any free port
+export QT_COORD="$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1):7521"
+
+srun --export=ALL python - <<'PY'
+import os
+import quest_tpu as qt
+
+qt.init_distributed(
+    coordinator_address=os.environ["QT_COORD"],
+    num_processes=int(os.environ["SLURM_NTASKS"]),
+    process_id=int(os.environ["SLURM_PROCID"]),
+)
+env = qt.create_env()
+q = qt.create_qureg(30, env)          # sharded across all tasks
+qt.init_plus_state(q)
+qt.hadamard(q, 29)                    # sharded-qubit gate: DCN exchange
+print(qt.report_env(env))
+print("total prob:", qt.calc_total_prob(q))
+qt.destroy_env(env)                   # synchronising finalise
+PY

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Run the test suite on a cluster node (analogue of the reference's
+# examples/submissionScripts/mpi_SLURM_unit_tests.sh).  The suite
+# self-provisions an 8-device virtual mesh (tests/conftest.py), so the
+# sharded path — ppermute exchanges, psum reductions, multi-process
+# workers — is exercised on ONE node; the reference needed mpirun and
+# real ranks for the same coverage (SURVEY §4).
+
+#SBATCH --nodes=1
+#SBATCH --cpus-per-task=8
+#SBATCH --time=00:30:00
+#SBATCH --output=results.txt
+
+cd "${SLURM_SUBMIT_DIR:-$(dirname "$0")/../..}"
+python -m pytest tests/ -q

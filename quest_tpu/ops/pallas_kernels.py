@@ -223,6 +223,12 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
             _, mr, mi = op
             planned.append(add_mm("lanemm", np.asarray(mr).T,
                                   np.asarray(mi).T))
+        elif op[0] == "lanemmc":
+            _, cond_bits, mats = op
+            planned.append((
+                "lanemmc", cond_bits,
+                tuple(add_mm("m", np.asarray(mr).T, np.asarray(mi).T)[1:]
+                      for mr, mi in mats)))
         elif op[0] == "rowmm":
             _, mr, mi = op
             planned.append(add_mm("rowmm", np.asarray(mr),
@@ -549,6 +555,46 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         t2 = lanemul(i, mats[mi_ix])
         t3 = lanemul(r + i, mats[ms_ix])
         return t1 - t2, t3 - t1 - t2
+    if kind == "lanemmc":
+        # Conditioned lane matmul: one composed matrix per value of the
+        # conditioning exposed-axis bits, each applied to its axis
+        # slice.  Total contraction flops equal ONE unconditioned lane
+        # matmul (the slices partition the rows), so a cross-field real
+        # diagonal no longer costs an extra matmul group.
+        _, cond_bits, mats_ix = op
+        axes = [high_axis[b - lane_bits] for b in cond_bits]
+
+        def apply_mm(rv, iv, ixs):
+            mr_ix, mi_ix, ms_ix = ixs
+            sh = rv.shape
+
+            def mul(x, m):
+                flat = x.reshape(-1, sh[-1])
+                return jnp.dot(flat, m, precision=hi,
+                               preferred_element_type=dtype).reshape(sh)
+
+            mr = mats[mr_ix]
+            if mi_ix < 0:
+                return mul(rv, mr), mul(iv, mr)
+            t1 = mul(rv, mr)
+            t2 = mul(iv, mats[mi_ix])
+            t3 = mul(rv + iv, mats[ms_ix])
+            return t1 - t2, t3 - t1 - t2
+
+        def recurse(rv, iv, depth, v):
+            if depth == len(axes):
+                return apply_mm(rv, iv, mats_ix[v])
+            ax = axes[depth]
+            r0 = lax.index_in_dim(rv, 0, ax, keepdims=True)
+            r1 = lax.index_in_dim(rv, 1, ax, keepdims=True)
+            i0 = lax.index_in_dim(iv, 0, ax, keepdims=True)
+            i1 = lax.index_in_dim(iv, 1, ax, keepdims=True)
+            n0r, n0i = recurse(r0, i0, depth + 1, v)
+            n1r, n1i = recurse(r1, i1, depth + 1, v | (1 << depth))
+            return (jnp.concatenate([n0r, n1r], ax),
+                    jnp.concatenate([n0i, n1i], ax))
+
+        return recurse(r, i, 0, 0)
     if kind == "rowmm":
         # Composed (R, R) complex matrix over the low row bits: one
         # batched MXU contraction replaces a per-gate roll-select chain —

@@ -230,6 +230,7 @@ def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
                 skipped.append(op)
         seg_ops, dev_masks = _plan_seg(seg, lane_bits, chunk_bits,
                                        low_row_bits,
+                                       high=tuple(sorted(high)),
                                        lane_compose_min=lane_compose_min,
                                        row_compose_min=row_compose_min)
         segments.append((seg_ops, tuple(sorted(high)), dev_masks))
@@ -420,7 +421,13 @@ class _Group:
         self.items = []
 
 
-def _fold_groups(seg, lane_bits: int, low_row_bits: int):
+#: Max distinct exposed-axis conditioning bits per lane group (2^j
+#: composed matrix variants are built host-side and applied to the 2^j
+#: axis slices — same total MXU flops as one unconditioned matmul).
+_MAX_COND_BITS = 2
+
+
+def _fold_groups(seg, lane_bits: int, low_row_bits: int, high: tuple = ()):
     """Slide ops backward into the earliest compatible composition group.
 
     Four group kinds: ``D`` collects diagonal phases (one combined-
@@ -458,16 +465,52 @@ def _fold_groups(seg, lane_bits: int, low_row_bits: int):
             other.bar_mix |= mix
             other.bar_sup |= sup
 
-    # Note: folding lane-masked phases INTO lane groups (to merge the
-    # real matmul runs they split into one complex matmul) was measured
-    # and rejected on v5e: the Gauss 3-dot complex path plus its extra
-    # full-block adds costs as much as the two real 2-dot groups it
-    # replaces (probe30d/e, round 3).
+    # REAL phases touching lane bits fold INTO lane groups so the matmul
+    # runs they would otherwise split stay merged: a real diagonal keeps
+    # the composed matrix real (2 MXU dots) — this is where the
+    # H.CZ.H-rewritten CNOTs and plain Z/CZ land.  A phase whose mask
+    # also covers EXPOSED high bits joins as a *conditional* diagonal:
+    # the group later composes one matrix per conditioning-bit value and
+    # the kernel applies each to its axis slice (same total flops, see
+    # 'lanemmc').  COMPLEX phases (S/T/Rz) stay in D groups: folding
+    # them was measured and rejected on v5e — the Gauss 3-dot complex
+    # path plus its extra full-block adds costs as much as the two real
+    # 2-dot groups it replaces (probe30d/e, round 3).
+    lane_mask_all = lanes - 1
+    high_mask_all = 0
+    for t in high:
+        high_mask_all |= 1 << t
+
+    def join_lane_real_phase(mask, phr) -> bool:
+        lane_part = mask & lane_mask_all
+        cond_part = mask & ~lane_mask_all
+        if cond_part & ~high_mask_all:
+            return False  # touches row/mid/device bits: not foldable
+        cond_bits = tuple(t for t in high if (mask >> t) & 1)
+        for g in groups:
+            if g.kind != "L" or not g.items:
+                continue
+            if g.bar_mix & mask:
+                continue
+            new_conds = set(cond_bits) | {
+                b for it in g.items if it[0] == "cd" for b in it[2]}
+            if len(new_conds) > _MAX_COND_BITS:
+                continue
+            g.items.append(("cd", lane_part, cond_bits, phr))
+            for other in groups:
+                if other is g:
+                    break
+                other.bar_sup |= mask
+            return True
+        return False
 
     for op in seg:
         kind, statics, scalars = op
         if kind == "apply_phase":
             (mask,) = statics
+            if (mask & lane_mask_all) and scalars[1] == 0.0 \
+                    and join_lane_real_phase(mask, scalars[0]):
+                continue
             join("D", 0, mask, (mask, scalars[0], scalars[1]))
             continue
         if kind == "dm_chan":
@@ -502,7 +545,27 @@ def _compose(items, dim: int):
     return m
 
 
+def _compose_lane(items, dim: int, sigma: dict):
+    """Dense lane matrix of a run of 2x2 gates and folded REAL diagonals
+    (("cd", lane_mask, cond_bits, phr) items), in program order, under
+    conditioning-bit assignment ``sigma`` (bit -> 0/1): a diagonal
+    contributes iff every one of its conditioning bits is 1."""
+    m = np.eye(dim, dtype=np.complex128)
+    ix = np.arange(dim)
+    for it in items:
+        if it[0] == "cd":
+            _, lane_mask, cond_bits, phr = it
+            if all(sigma[b] == 1 for b in cond_bits):
+                d = np.where((ix & lane_mask) == lane_mask, phr, 1.0)
+                m = d[:, None] * m
+        else:
+            target, scalars, ctrl_mask = it
+            m = expand_gate(dim, target, scalars, ctrl_mask) @ m
+    return m
+
+
 def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
+              high: tuple = (),
               lane_compose_min: int = None, row_compose_min: int = None):
     """Convert recorded ops to kernel seg-ops: phases fold into combined
     diagonal groups, lane/low-row 2x2 runs compose into one LxL / RxR
@@ -534,7 +597,7 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
         return dev_masks.index(dm)
 
     out = []
-    for entry in _fold_groups(seg, lane_bits, low_row_bits):
+    for entry in _fold_groups(seg, lane_bits, low_row_bits, high):
         if isinstance(entry, _Group):
             if entry.kind == "D":
                 folded = [it for it in entry.items
@@ -557,18 +620,46 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
                         (mask & chunk_mask, phr, phi, flag_ix(mask))
                         for mask, phr, phi in rest)))
             elif entry.kind == "L":
+                gates = [it for it in entry.items if it[0] != "cd"]
+                cds = [it for it in entry.items if it[0] == "cd"]
                 cmin = (_LANE_COMPOSE_MIN if lane_compose_min is None
                         else lane_compose_min)
-                if len(entry.items) < cmin:
+                if len(gates) < cmin:
                     # short runs: per-gate roll-selects ride the VPU and
                     # hide behind the HBM stream; the composed dense dot
-                    # occupies the MXU and does not (probe30.py)
-                    for target, scalars, ctrl_mask in entry.items:
-                        out.append(("2x2", target, tuple(scalars),
-                                    ctrl_mask, -1))
+                    # occupies the MXU and does not (probe30.py).  Folded
+                    # diagonals re-emit as free diag entries, preserving
+                    # the in-group order.
+                    for it in entry.items:
+                        if it[0] == "cd":
+                            _, lane_part, cond_bits, phr = it
+                            m2 = lane_part
+                            for b in cond_bits:
+                                m2 |= 1 << b
+                            out.append(("diag", ((m2 & chunk_mask, phr,
+                                                  0.0, flag_ix(m2)),)))
+                        else:
+                            target, scalars, ctrl_mask = it
+                            out.append(("2x2", target, tuple(scalars),
+                                        ctrl_mask, -1))
                     continue
-                m = _compose(entry.items, lanes)
-                out.append(("lanemm", m.real.copy(), m.imag.copy()))
+                cond_bits = sorted({b for it in cds for b in it[2]})
+                if not cond_bits:
+                    m = _compose_lane(entry.items, lanes, {})
+                    out.append(("lanemm", m.real.copy(), m.imag.copy()))
+                else:
+                    # one composed matrix per conditioning-bit value,
+                    # applied to the matching exposed-axis slices by the
+                    # 'lanemmc' kernel op — a cross-field REAL diagonal
+                    # (e.g. the CZ of a rewritten high-CNOT) no longer
+                    # splits the lane run, at identical total MXU flops
+                    mats = []
+                    for v in range(1 << len(cond_bits)):
+                        sigma = {b: (v >> i) & 1
+                                 for i, b in enumerate(cond_bits)}
+                        mv = _compose_lane(entry.items, lanes, sigma)
+                        mats.append((mv.real.copy(), mv.imag.copy()))
+                    out.append(("lanemmc", tuple(cond_bits), tuple(mats)))
             elif entry.kind == "R":
                 cmin = (_ROW_COMPOSE_MIN if row_compose_min is None
                         else row_compose_min)

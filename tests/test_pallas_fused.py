@@ -89,15 +89,24 @@ def test_scheduler_respects_commutation():
     # All three ops conflict pairwise on qubit 20 (mixing vs support), so
     # the schedule must preserve their relative order exactly.  The CNOT
     # (lane target, high control) normalizes to H(1).CZ(20,1).H(1); the
-    # H(1)'s stay on opposite sides of the CZ diagonal (lone lane gates
-    # emit as per-gate 2x2s), and the H(20)'s bracket everything.
+    # CZ is REAL, so it folds into the lane run as a CONDITIONAL diagonal
+    # and the whole H.CZ.H composes into ONE lane matmul with per-value-
+    # of-bit-20 matrices (round-3 'lanemmc'); the H(20)'s bracket it.
     c = Circuit(24)
     c.hadamard(20).controlled_not(20, 1).hadamard(20)
     segs = schedule_segments(c.ops, 24)
     flat = [op for seg, high in segs for op in seg]
     kinds = [(op[0], op[1]) if op[0] == "2x2" else op[0] for op in flat]
-    assert kinds == [("2x2", 20), ("2x2", 1), "diag", ("2x2", 1),
-                     ("2x2", 20)]
+    assert kinds == [("2x2", 20), "lanemmc", ("2x2", 20)]
+    (mmc,) = [op for op in flat if op[0] == "lanemmc"]
+    assert mmc[1] == (20,)          # conditioned on qubit 20
+    m0, m1 = mmc[2]                 # bit20=0: identity; bit20=1: X on 1
+    assert not np.asarray(m0[1]).any() and not np.asarray(m1[1]).any()
+    np.testing.assert_allclose(m0[0], np.eye(128), atol=1e-12)
+    x1 = np.zeros((128, 128))
+    for r in range(128):
+        x1[r, r ^ 2] = 1.0
+    np.testing.assert_allclose(m1[0], x1, atol=1e-12)
 
 
 def test_nonunitary_diagonal_falls_back(env1):
@@ -160,3 +169,22 @@ def test_rx_rewrite_keeps_matrices_real(env1):
             if op[0] in ("lanemm", "rowmm"):
                 assert not np.asarray(op[2]).any(), "complex matrix leaked"
     _compare(env1, circ, n=N_HIGH, seed=71)
+
+
+def test_conditional_lane_group_two_bits(env1):
+    """Two distinct cross-field real diagonals fold into ONE lane matmul
+    with 4 per-assignment matrices (j=2 'lanemmc'), bit-compatible with
+    the eager path."""
+    from quest_tpu.scheduler import schedule_segments
+
+    c = Circuit(N_HIGH)
+    c.hadamard(2)
+    c.controlled_phase_flip(14, 3)      # CZ(lane 3, high 14): real
+    c.hadamard(3)
+    c.controlled_phase_flip(13, 2)      # CZ(lane 2, high 13): real
+    c.hadamard(2).hadamard(3)
+    c.hadamard(14).hadamard(13)         # make 13/14 exposed-axis targets
+    segs = schedule_segments(c.ops, N_HIGH)
+    mmcs = [op for seg, _ in segs for op in seg if op[0] == "lanemmc"]
+    assert len(mmcs) == 1 and len(mmcs[0][2]) == 4  # 2 cond bits -> 4 mats
+    _compare(env1, c, n=N_HIGH, seed=33)

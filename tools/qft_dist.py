@@ -95,8 +95,36 @@ def run_real_chip(max_qubits: int = 30):
     _ = float(re[0, 0])
     run_s = time.perf_counter() - t0
 
-    # Fresh pass for the analytic amplitude check.
+    # Sustained on-chip throughput: amortise the ~90 ms tunnel dispatch
+    # over INNER chained applications inside one compiled call (the
+    # methodology bench.py uses; the single-shot run_s above includes
+    # one dispatch + one host read).
+    import functools
+
+    inner = 8
+    circ2 = models.qft(n)
+    apply2 = circ2.as_fused_fn() if jax.default_backend() == "tpu" \
+        else circ2.as_fn(mesh=None)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def spin(re, im):
+        return jax.lax.fori_loop(0, inner, lambda _, s: apply2(*s),
+                                 (re, im))
+
     del re, im
+    sre, sim = spin(*fresh())
+    _ = float(sre[0, 0])
+    best = None
+    for _rep in range(2):
+        t0 = time.perf_counter()
+        sre, sim = spin(sre, sim)
+        _ = float(sre[0, 0])
+        dt = (time.perf_counter() - t0) / inner
+        best = dt if best is None else min(best, dt)
+    sustained = circ.num_gates / best
+    del sre, sim
+
+    # Fresh pass for the analytic amplitude check.
     re, im = fn(*fresh())
 
     def get_amp(k):
@@ -110,8 +138,12 @@ def run_real_chip(max_qubits: int = 30):
         "gates": circ.num_gates,
         "device": dev.device_kind,
         "compile_plus_run_seconds": round(compile_s, 3),
-        "run_seconds": round(run_s, 3),
-        "gates_per_sec": round(circ.num_gates / run_s, 1),
+        "single_shot_seconds": round(run_s, 3),
+        "single_shot_gates_per_sec": round(circ.num_gates / run_s, 1),
+        "sustained_gates_per_sec": round(sustained, 1),
+        "sustained_note": f"fori_loop x{inner} on donated buffers, "
+                          "best of 2 (amortises the ~90 ms tunnel "
+                          "dispatch the single-shot figure includes)",
         "max_amp_error_vs_analytic": err,
     }
 
