@@ -155,7 +155,8 @@ class Qureg:
         preserved to accumulated-roundoff order.  Catches kernel
         regressions (e.g. a miscompiled partner fetch) at the op where
         they happen instead of thousands of ops later in a soak run.
-        Costs one reduction per flush — off by default."""
+        Costs two reductions per flush (before and after) — off by
+        default."""
         import os
 
         if not os.environ.get("QUEST_DEBUG_NORM"):

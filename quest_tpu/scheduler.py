@@ -266,7 +266,8 @@ def schedule_segments_best(ops, num_vec_bits: int, lane_bits: int = 7,
     k=7 pays +11 ms of pass floor at 30 vector qubits (the k=7 config's
     4 KB DMA pieces) but packs more exposed targets per pass.  Measured
     on v5e at 30q: k=7 wins for DEEP schedules (random depth-16: 700 vs
-    642 gates/s; QFT: 967 vs 885) and loses for shallow ones (random
+    642 gates/s; QFT: 967 vs 885 — pre-conditional-group numbers; the
+    crossover is structural) and loses for shallow ones (random
     depth-8: 598 vs 678).  A per-op additive cost model could not
     reproduce this ranking (the wins come from overlap, not op counts),
     so the rule is the empirical one: at the k=6-budget size, schedules

@@ -16,6 +16,7 @@ EXAMPLES = os.path.join(REPO, "examples")
     ("bernstein_vazirani.py", "solution reached with probability 1.000000"),
     ("damping.py", "rho00"),
     ("distributed_qft.py", "ok"),
+    ("sampled_bv.py", "every shot read the secret exactly"),
 ])
 def test_example_runs(name, expect):
     env = dict(os.environ)
