@@ -127,3 +127,25 @@ def test_channels_under_mesh(env8):
     np.testing.assert_allclose(
         qt.get_density_matrix(d8), qt.get_density_matrix(d1), atol=TOL)
     assert abs(qt.calc_total_prob(d8) - 1.0) < TOL
+
+
+def test_debug_norm_covers_density_channel_stream(env1, monkeypatch):
+    """QUEST_DEBUG_NORM also guards the density stream: gates AND
+    channels are trace-preserving, so a clean gate+channel flush passes,
+    and a trace-breaking op smuggled into the stream trips the check."""
+    from quest_tpu.validation import QuESTError as QE
+
+    monkeypatch.setenv("QUEST_DEBUG_NORM", "1")
+    d = qt.create_density_qureg(3, env1)
+    qt.init_plus_state(d)
+    qt.hadamard(d, 0)
+    qt.apply_one_qubit_depolarise_error(d, 1, 0.1)
+    qt.apply_one_qubit_damping_error(d, 2, 0.2)
+    assert abs(qt.calc_total_prob(d) - 1.0) < 1e-10  # clean flush passes
+    # a non-trace-preserving fake "dephase" (retain > 1 scales
+    # off-diagonals, fine) would pass; scale the DIAGONAL instead via a
+    # raw 2x2 that doubles everything — trace 1 -> 2 must trip
+    d._defer(("apply_2x2", (0, 0),
+              ((2.0, 0.0), (0.0, 0.0), (0.0, 0.0), (2.0, 0.0))))
+    with pytest.raises(QE, match="norm drift"):
+        _ = d.re

@@ -165,3 +165,30 @@ def test_26q_sharded_vs_local_xla(env8, env1):
         a1 = to_host(arr1).reshape(-1)
         assert float(np.abs(a8 - a1).max()) < 1e-6
     assert abs(qt.calc_total_prob(regs[0]) - 1.0) < 1e-5
+
+
+def test_conditional_lane_group_under_mesh(env8, env1):
+    """Conditional lane groups ('lanemmc') forming inside a mesh plan:
+    a CZ between a lane bit and a high local bit folds into the lane
+    run per-chunk, and the sharded result matches single-device — with
+    a sharded-qubit gate forcing a relayout in the same plan."""
+    n = 14  # 3 device bits over env8; chunk = 11 bits
+    circ = Circuit(n)
+    circ.hadamard(2)
+    circ.controlled_phase_flip(10, 3)   # real CZ: lane 3 x high-local 10
+    circ.hadamard(3)
+    circ.hadamard(10)                   # makes 10 an exposed-axis target
+    circ.hadamard(n - 1)                # sharded qubit: relayout path
+    circ.cnot(n - 1, 2)
+    circ.hadamard(2).hadamard(3)
+
+    regs = []
+    for env in (env8, env1):
+        q = qt.create_qureg(n, env)
+        qt.init_zero_state(q)
+        circ.run(q, pallas=True)
+        regs.append(q)
+    np.testing.assert_allclose(
+        qt.get_state_vector(regs[0]), qt.get_state_vector(regs[1]),
+        atol=TOL)
+    assert abs(qt.calc_total_prob(regs[0]) - 1.0) < TOL
