@@ -9,7 +9,6 @@ half-exchange + relabeling advantage.
 """
 
 import numpy as np
-import pytest
 
 import quest_tpu as qt
 from quest_tpu import models
@@ -18,11 +17,7 @@ from quest_tpu.scheduler import schedule_mesh
 from quest_tpu.parallel.mesh_exec import plan_comm_stats
 from quest_tpu.ops.lattice import state_shape, _ilog2
 
-from conftest import (
-    TOL,
-    random_statevector,
-    load_statevector,
-)
+from conftest import TOL, random_statevector
 
 N = 9  # 3 device bits + 6 local on the 8-device mesh
 
