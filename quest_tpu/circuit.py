@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 
-from .ops.lattice import run_kernel
+from .ops.lattice import run_kernel, state_shape
 from .ops import gates as _g
 from . import precision as _prec
 from . import validation as _v
@@ -426,6 +426,61 @@ class Circuit:
             fn = jax.jit(raw, donate_argnums=(0, 1) if donate else ())
             self._compiled[key] = fn
         return fn
+
+    def sample(self, shots: int, key=None, dtype=None):
+        """Run ``shots`` independent executions of the circuit from
+        |0...0> and return the measurement outcomes as an int32 array of
+        shape (shots, num_measurements).
+
+        TPU-native shot batching the reference cannot express: the shot
+        axis is ``jax.vmap``-ed over PRNG keys, so every shot shares ONE
+        compiled program and the gate kernels batch across shots — the
+        reference re-enters the C API per gate per shot with a host RNG
+        draw at each measurement (measure, QuEST.c:578-590).
+
+        Memory scales as shots x 2^n amplitudes (the shots evolve
+        concurrently); intended for small/medium registers.  Requires at
+        least one recorded ``measure``.
+        """
+        import operator
+
+        if self.num_measurements == 0:
+            raise _v.QuESTError("Circuit.sample requires at least one "
+                                "recorded measure()")
+        try:
+            shots = operator.index(shots)
+        except TypeError:
+            raise _v.QuESTError("Circuit.sample: shots must be an integer")
+        if shots < 1:
+            raise _v.QuESTError("Circuit.sample: shots must be >= 1")
+        if key is None:
+            import secrets
+
+            key = jax.random.PRNGKey(secrets.randbits(31))
+        dtype = jnp.dtype(dtype or _prec.default_real_dtype())
+        # Memoised like compile(): jit caches on function identity, so a
+        # fresh closure per call would re-trace and re-compile the whole
+        # vmapped circuit on every sample() call.
+        memo_key = ("sample", tuple(self.ops), dtype.name)
+        sampler = self._compiled.get(memo_key)
+        if sampler is None:
+            nvec = self.num_qubits * (2 if self.is_density else 1)
+            shape = state_shape(1 << nvec)
+            # the gate-at-a-time XLA kernels are shape-polymorphic under
+            # vmap; the fused Pallas path is not (block specs assume an
+            # unbatched state), so sample() always uses the kernel path
+            fn = self.as_fn(mesh=None)
+
+            def one(k):
+                # flat index 0 is |0...0> for state-vectors and |0><0|
+                # for density matrices alike
+                re0 = jnp.zeros(shape, dtype).at[0, 0].set(1)
+                im0 = jnp.zeros(shape, dtype)
+                return fn(re0, im0, k)[2]
+
+            sampler = jax.jit(jax.vmap(one))
+            self._compiled[memo_key] = sampler
+        return sampler(jax.random.split(key, shots))
 
     def run(self, qureg, pallas: str = "auto", key=None):
         """Apply to a register (mutating facade, like the eager API).

@@ -183,3 +183,27 @@ def test_num_gates_with_measure():
     d = Circuit(2, is_density=True).hadamard(0).measure(1)
     assert d.num_gates == 2
     assert d.num_measurements == 1
+
+
+def test_sample_batches_shots(env1):
+    """Circuit.sample vmaps the shot axis over PRNG keys: one compiled
+    program serves every shot.  |+> measured 400 times is ~50/50; a GHZ
+    pair measures perfectly correlated within each shot."""
+    circ = Circuit(1).hadamard(0).measure(0)
+    outs = np.asarray(circ.sample(400, key=jax.random.PRNGKey(2)))
+    assert outs.shape == (400, 1)
+    ones = int(outs.sum())
+    assert 180 <= ones <= 220  # sigma = 10; 2-sigma band
+
+    ghz = Circuit(2).hadamard(0).cnot(0, 1).measure(0).measure(1)
+    outs = np.asarray(ghz.sample(128, key=jax.random.PRNGKey(5)))
+    assert outs.shape == (128, 2)
+    assert (outs[:, 0] == outs[:, 1]).all()      # perfect correlation
+    assert 0 < int(outs[:, 0].sum()) < 128       # both outcomes occur
+
+
+def test_sample_validates():
+    with pytest.raises(QuESTError):
+        Circuit(2).hadamard(0).sample(8)         # no measurements
+    with pytest.raises(QuESTError):
+        Circuit(2).hadamard(0).measure(0).sample(0)
