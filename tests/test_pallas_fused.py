@@ -188,3 +188,31 @@ def test_conditional_lane_group_two_bits(env1):
     mmcs = [op for seg, _ in segs for op in seg if op[0] == "lanemmc"]
     assert len(mmcs) == 1 and len(mmcs[0][2]) == 4  # 2 cond bits -> 4 mats
     _compare(env1, c, n=N_HIGH, seed=33)
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_property_cz_heavy_fused(env1, seed):
+    """Property stress of the round-3 scheduler machinery: random
+    CZ/CNOT/H/T-heavy circuits maximise conditional folds (lanemmc),
+    same-target composition, CNOT rewrites, and pair fusion; the fused
+    interpret-mode result must match the per-gate XLA path exactly."""
+    rng = np.random.RandomState(seed)
+    n = 13
+    circ = Circuit(n)
+    for _ in range(40):
+        k = rng.randint(6)
+        t = int(rng.randint(n))
+        c = int((t + 1 + rng.randint(n - 1)) % n)
+        if k == 0:
+            circ.hadamard(t)
+        elif k == 1:
+            circ.controlled_phase_flip(c, t)      # real cross-field CZ
+        elif k == 2:
+            circ.cnot(c, t)
+        elif k == 3:
+            circ.t_gate(t)
+        elif k == 4:
+            circ.pauli_y(t)                       # complex lane entries
+        else:
+            circ.controlled_phase_shift(c, t, float(rng.uniform(0, 6.2)))
+    _compare(env1, circ, n=n, seed=seed)
