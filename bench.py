@@ -21,9 +21,14 @@ context fields (BASELINE.md targets) are:
   performance figure the reference ships; see BASELINE.md).
 
 Env overrides: QUEST_BENCH_QUBITS (default 30, auto-falls back on OOM),
-QUEST_BENCH_DEPTH (default 16 layers -> 16*n gates; deeper units let the
-scheduler's same-target composition amortise more per pass, measured
-best on v5e), QUEST_BENCH_REPS.
+QUEST_BENCH_DEPTH (default 22 layers -> 660 gates at 30q, matching the
+reference driver's 667-gate workload shape), QUEST_BENCH_REPS.
+
+NOTE on ``hbm_gbps``/``roofline_frac``: modelled from SCHEDULED traffic
+(passes x one in-place read+write of the state), not from a hardware
+counter — the figure moves when gates/pass moves, independent of chip
+behaviour.  Cross-check pass-time drift against ``seconds``/``gates``
+directly (round-3 lesson: a denser schedule can mask a slower pass).
 """
 
 import json
@@ -108,7 +113,7 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
 
 def main():
     num_qubits = int(os.environ.get("QUEST_BENCH_QUBITS", "30"))
-    depth = int(os.environ.get("QUEST_BENCH_DEPTH", "16"))
+    depth = int(os.environ.get("QUEST_BENCH_DEPTH", "22"))
     reps = int(os.environ.get("QUEST_BENCH_REPS", "3"))
     inner = int(os.environ.get("QUEST_BENCH_INNER", "8"))
 

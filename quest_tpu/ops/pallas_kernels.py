@@ -96,21 +96,33 @@ def _row_flip_enabled() -> bool:
 
 
 #: Max number of arbitrary high qubits a fused segment can expose as
-#: dedicated block axes.  Raising this trades contiguous-row block size
-#: (c_blk = _ROW_BUDGET >> k) for more adaptively-chosen high targets per
-#: pass.  Measured on v5e (random depth-8 circuit, donated fori_loop):
-#: k=7 wins below 30 qubits (2725 vs 2020 gates/s at 28q) but the 4 KB
-#: DMA pieces cost at 30q, where k=6 is best (582 vs 517 gates/s) — the
-#: scheduler picks per register size via ``default_max_high``.
-MAX_HIGH_BITS = 8
+#: dedicated block axes.  Each extra axis halves the contiguous-row
+#: block piece (c_blk = row_budget >> k), so k >= 8 needs a raised
+#: row budget AND a raised Mosaic VMEM limit (set automatically below).
+#: k up to 10 compiles and runs on v5e; the sweet spot by size is
+#: ``default_max_high`` (round-4 sweeps, tools/probe40.py).
+MAX_HIGH_BITS = 10
 
 
 def default_max_high(num_vec_bits: int) -> int:
-    """Empirically-best exposed-high-bit budget for a state size."""
-    return 7 if num_vec_bits <= 29 else 6
+    """Empirically-best exposed-high-bit budget for a state size.
+
+    Measured on v5e (random depth-16, donated fori_loop, round 4):
+    30q: k=8 825 vs k=7 737 gates/s (5 passes vs 6 — each exposed axis
+    saves a ~39 ms stream floor, and the k=8 floor is no worse);
+    29q: k=8 1581 vs k=7 1478; 28q: k=7 2627 vs k=8 2590."""
+    return 8 if num_vec_bits >= 29 else 7
+
+
+def default_row_budget(max_high: int) -> int:
+    """Row budget keeping the contiguous block piece at >= 8 rows (the
+    f32 (8, 128) tile floor) for the given exposed-axis budget."""
+    return max(1024, 8 << max_high)
+
 
 #: Per-block row budget (rows x 128 lanes x 4 B x ~8 pipeline buffers
-#: must sit well inside the ~16 MB VMEM).
+#: must sit inside VMEM; segments planned for k >= 8 raise the Mosaic
+#: VMEM limit to 110 MB — v5e has 128 MB — via CompilerParams).
 _ROW_BUDGET = 1024
 
 #: MXU precision for the composed lane/row matrices.  Measured on v5e:
@@ -170,7 +182,7 @@ def plan_fused_shapes(rows: int, lanes: int, high_row_bits: tuple[int, ...],
 
 
 def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
-                        *, row_budget: int = _ROW_BUDGET,
+                        *, row_budget: int | None = None,
                         interpret: bool = False, dev_flags=None):
     """One in-place pipelined HBM pass applying a run of gates whose 2x2
     targets are lane bits, low row bits (< log2(c_blk)), or one of up to
@@ -193,6 +205,8 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     """
     rows, lanes = re.shape
     lane_bits = _ilog2(lanes)
+    if row_budget is None:
+        row_budget = default_row_budget(len(high_bits))
     high_row = tuple(sorted(t - lane_bits for t in high_bits))
     dims, block_shape, grid, index_map, c_blk = plan_fused_shapes(
         rows, lanes, high_row, row_budget)
@@ -313,6 +327,19 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     if n_flags:
         flag_inputs = (jnp.asarray(dev_flags, re.dtype),)
         flag_specs = [pl.BlockSpec((1, n_flags), lambda *g: (0, 0))]
+    import os as _os
+
+    cparams = {}
+    ck = {}
+    # k >= 8 segments (512-piece gathers, 2048-row budget) exceed the
+    # toolchain's default VMEM allowance; v5e has 128 MB physical.
+    # QUEST_VMEM_MB overrides the 110 MB default; "0" disables the
+    # override entirely.
+    vmem = int(_os.environ.get("QUEST_VMEM_MB", "0") or "0")
+    if not interpret and (vmem > 0 or k >= 8):
+        ck["vmem_limit_bytes"] = (vmem if vmem > 0 else 110) << 20
+    if ck:
+        cparams["compiler_params"] = pltpu.CompilerParams(**ck)
     out_r, out_i = pl.pallas_call(
         kern,
         grid=grid,
@@ -321,6 +348,7 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
         out_shape=[jax.ShapeDtypeStruct(dims, re.dtype)] * 2,
         input_output_aliases={0: 0, 1: 1},
         interpret=interpret,
+        **cparams,
     )(re.reshape(dims), im.reshape(dims), *mat_inputs, *flag_inputs)
     return out_r.reshape(re.shape), out_i.reshape(im.shape)
 
@@ -541,8 +569,24 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
     hi = _MAT_PRECISION
     shape = r.shape
 
+    import os as _os
+    split3 = _os.environ.get("QUEST_SPLIT3", "0") != "0"
+
+    def _dot3(flat, m):
+        """bf16x3 emulated f32 dot: ~16-17 mantissa bits (vs HIGHEST's
+        f32-exact 6-pass form) for half the MXU passes."""
+        xh = flat.astype(jnp.bfloat16)
+        xl = (flat - xh.astype(dtype)).astype(jnp.bfloat16)
+        mh = m.astype(jnp.bfloat16)
+        ml = (m - mh.astype(dtype)).astype(jnp.bfloat16)
+        return (jnp.dot(xh, mh, preferred_element_type=dtype)
+                + jnp.dot(xh, ml, preferred_element_type=dtype)
+                + jnp.dot(xl, mh, preferred_element_type=dtype))
+
     def lanemul(x, m):
         flat = x.reshape(-1, shape[-1])
+        if split3:
+            return _dot3(flat, m).reshape(shape)
         return jnp.dot(flat, m, precision=hi,
                        preferred_element_type=dtype).reshape(shape)
 

@@ -37,8 +37,8 @@ import bisect
 import numpy as np
 
 from .ops.pallas_kernels import (
-    _ROW_BUDGET,
     default_max_high,
+    default_row_budget,
     expand_gate,
 )
 
@@ -196,6 +196,98 @@ _LANE_COMPOSE_MIN = 2
 _ROW_COMPOSE_MIN = 3
 
 
+def _mix_targets(op, low_cov: int):
+    """Mixing targets of a recorded op that need an exposed block axis."""
+    kind, statics, _s = op
+    if kind == "apply_2x2":
+        ts = [statics[0]]
+    elif kind == "dm_chan" and statics[0] in _CHAN_MIXING:
+        ts = list(statics[1:])
+    else:
+        ts = []
+    return [t for t in ts if t >= low_cov]
+
+
+def _partition_chunk(ops, low_cov: int, max_high: int):
+    """Greedy commute-slide partition into (seg_ops_list, high_set)."""
+    remaining = list(ops)
+    parts = []
+    while remaining:
+        seg, high, skipped = [], [], []
+        for op in remaining:
+            needed = [t for t in _mix_targets(op, low_cov)
+                      if t not in high]
+            addable = len(high) + len(needed) <= max_high
+            if addable and all(_commutes(op, s) for s in skipped):
+                high.extend(needed)
+                seg.append(op)
+            else:
+                skipped.append(op)
+        parts.append((seg, high))
+        remaining = skipped
+    return parts
+
+
+def _tail_merge(parts, low_cov: int, max_high: int):
+    """Empty trailing micro-segments backward to save whole HBM passes.
+
+    The greedy partition often strands a few gates in a final segment —
+    a ~40 ms stream floor for a handful of ops at 30 qubits.  An op in
+    the last segment may move to the END of an earlier segment when it
+    commutes with everything in between and the target segment has
+    exposed-axis capacity.  Only fully-emptied segments are dropped
+    (partial moves shuffle cost between passes without saving a floor).
+    """
+    parts = [(list(s), list(h)) for s, h in parts]
+    changed = True
+    while changed and len(parts) > 1:
+        changed = False
+        last_ops, _last_high = parts[-1]
+        # Dry-run a home for EVERY op (nearest earlier segment with
+        # exposed-axis capacity that the op commutes back to); commit
+        # only if the segment empties completely — partial moves burn
+        # earlier segments' capacity without saving a floor.
+        trial_high = [list(h) for _, h in parts[:-1]]
+        trial_moves: list[list] = [[] for _ in parts[:-1]]
+        placed_all = True
+        for idx, op in enumerate(last_ops):
+            placed = False
+            for e in range(len(parts) - 2, -1, -1):
+                # ops between segment e and the op: later segments'
+                # ops, ops already (trial-)moved to segments after e,
+                # and the ops before it in the last segment
+                between = [o for s, _ in parts[e + 1:-1] for o in s]
+                between += [o for ms in trial_moves[e + 1:] for o in ms]
+                needed = [t for t in _mix_targets(op, low_cov)
+                          if t not in trial_high[e]]
+                if len(trial_high[e]) + len(needed) > max_high:
+                    continue
+                prior = between + last_ops[:idx]
+                if all(_commutes(op, o) for o in prior):
+                    trial_high[e].extend(needed)
+                    trial_moves[e].append(op)
+                    placed = True
+                    break
+            if not placed:
+                placed_all = False
+                break
+        if placed_all:
+            for e, (eseg, ehigh) in enumerate(parts[:-1]):
+                eseg.extend(trial_moves[e])
+                ehigh[:] = trial_high[e]
+            parts.pop()
+            changed = True
+    out = []
+    for s, _h in parts:
+        high = []
+        for op in s:
+            for t in _mix_targets(op, low_cov):
+                if t not in high:
+                    high.append(t)
+        out.append((s, high))
+    return out
+
+
 def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
                     row_budget: int, max_high: int,
                     lane_compose_min: int = None,
@@ -207,39 +299,22 @@ def _schedule_chunk(ops, chunk_bits: int, lane_bits: int,
     low_row_bits = min(rows_bits, (row_budget >> max_high).bit_length() - 1)
     low_cov = lane_bits + low_row_bits  # 2x2 targets below this are "low"
 
-    remaining = _normalize_cx(ops, lane_bits, low_row_bits)
+    parts = _partition_chunk(
+        _normalize_cx(ops, lane_bits, low_row_bits), low_cov, max_high)
+    parts = _tail_merge(parts, low_cov, max_high)
     segments = []
-    while remaining:
-        seg, high, skipped = [], [], []
-        for op in remaining:
-            kind, statics, scalars = op
-            # mixing bits above the low field need an exposed block axis
-            if kind == "apply_2x2":
-                mix_targets = [statics[0]]
-            elif kind == "dm_chan" and statics[0] in _CHAN_MIXING:
-                mix_targets = list(statics[1:])
-            else:
-                mix_targets = []
-            needed = [t for t in mix_targets
-                      if t >= low_cov and t not in high]
-            addable = len(high) + len(needed) <= max_high
-            if addable and all(_commutes(op, s) for s in skipped):
-                high.extend(needed)
-                seg.append(op)
-            else:
-                skipped.append(op)
+    for seg, high in parts:
         seg_ops, dev_masks = _plan_seg(seg, lane_bits, chunk_bits,
                                        low_row_bits,
                                        high=tuple(sorted(high)),
                                        lane_compose_min=lane_compose_min,
                                        row_compose_min=row_compose_min)
         segments.append((seg_ops, tuple(sorted(high)), dev_masks))
-        remaining = skipped
     return segments
 
 
 def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
-                      row_budget: int = _ROW_BUDGET,
+                      row_budget: int | None = None,
                       max_high: int | None = None,
                       lane_compose_min: int | None = None,
                       row_compose_min: int | None = None):
@@ -250,6 +325,8 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
     """
     if max_high is None:
         max_high = default_max_high(num_vec_bits)
+    if row_budget is None:
+        row_budget = default_row_budget(max_high)
     return [
         (seg_ops, high)
         for seg_ops, high, _ in _schedule_chunk(
@@ -260,29 +337,17 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
 
 
 def schedule_segments_best(ops, num_vec_bits: int, lane_bits: int = 7,
-                           row_budget: int = _ROW_BUDGET):
-    """Pick the exposed-high-bit budget per CIRCUIT, not just per size.
-
-    k=7 pays +11 ms of pass floor at 30 vector qubits (the k=7 config's
-    4 KB DMA pieces) but packs more exposed targets per pass.  Measured
-    on v5e at 30q: k=7 wins for DEEP schedules (random depth-16: 700 vs
-    642 gates/s; QFT: 967 vs 885 — pre-conditional-group numbers; the
-    crossover is structural) and loses for shallow ones (random
-    depth-8: 598 vs 678).  A per-op additive cost model could not
-    reproduce this ranking (the wins come from overlap, not op counts),
-    so the rule is the empirical one: at the k=6-budget size, schedules
-    of >= 5 passes are rescheduled at k=7."""
-    mh = default_max_high(num_vec_bits)
-    segs = schedule_segments(ops, num_vec_bits, lane_bits=lane_bits,
-                             row_budget=row_budget, max_high=mh)
-    if mh < 7 and len(segs) >= 5:
-        segs = schedule_segments(ops, num_vec_bits, lane_bits=lane_bits,
-                                 row_budget=row_budget, max_high=7)
-    return segs
+                           row_budget: int | None = None):
+    """Schedule at the per-size empirical exposed-axis budget
+    (``default_max_high``: k=8 at >= 29 vector qubits, else 7 — each
+    extra axis saves a whole ~39 ms stream floor per avoided pass at
+    30q, and the round-4 floor for k=8 matches k=7's)."""
+    return schedule_segments(ops, num_vec_bits, lane_bits=lane_bits,
+                             row_budget=row_budget)
 
 
 def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
-                  row_budget: int = _ROW_BUDGET,
+                  row_budget: int | None = None,
                   max_high: int | None = None):
     """Mesh scheduling with qubit relabeling.
 
@@ -302,6 +367,8 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     chunk_bits = num_vec_bits - dev_bits
     if max_high is None:
         max_high = default_max_high(chunk_bits)
+    if row_budget is None:
+        row_budget = default_row_budget(max_high)
     pos = list(range(num_vec_bits))  # pos[logical qubit] = physical bit
     inv = list(range(num_vec_bits))  # inv[physical bit] = logical qubit
 
@@ -482,6 +549,9 @@ def _fold_groups(seg, lane_bits: int, low_row_bits: int, high: tuple = ()):
     for t in high:
         high_mask_all |= 1 << t
 
+    import os
+    fold_complex = os.environ.get("QUEST_FOLD_COMPLEX", "0") != "0"
+
     def join_lane_real_phase(mask, phr) -> bool:
         lane_part = mask & lane_mask_all
         cond_part = mask & ~lane_mask_all
@@ -505,12 +575,72 @@ def _fold_groups(seg, lane_bits: int, low_row_bits: int, high: tuple = ()):
             return True
         return False
 
-    for op in seg:
+    def join_high_phase(mask, ph, phase_run_len) -> bool:
+        """Route a phase with a mask bit on an EXPOSED axis into the 2x2
+        stream: diag(1, p) on pivot t (controls = the rest of the mask)
+        composes free into an open same-(target, ctrl) T run, or costs
+        one exposed-axis 2x2 (~0.9 ms) — versus a masked full-block
+        'diag' multiply (~2.2 ms).  This is where the random circuit's
+        S/T/Rz phases on exposed qubits land (the reference applies each
+        as its own state sweep, QuEST_cpu.c:2666-3010)."""
+        m2 = ((1.0, 0.0), (0.0, 0.0), (0.0, 0.0), (ph.real, ph.imag))
+        # fold ONLY into an existing same-(pivot, controls) T run: the
+        # composition is then free.  Creating a NEW group per phase was
+        # measured catastrophic for phase-dense circuits (QFT's ladder
+        # phases all coalesce into one 'diag'/'dtab' group instead —
+        # 1087 -> 618 gates/s at 30q with per-phase groups).
+        cands = [t for t in high if (mask >> t) & 1]
+        for t in cands:
+            tag = (t, mask & ~(1 << t))
+            for g in groups:
+                if g.kind == "T" and g.tag == tag \
+                        and not (g.bar_mix & mask):
+                    g.items.append(m2)
+                    for other in groups:
+                        if other is g:
+                            break
+                        other.bar_sup |= mask
+                    return True
+        # An ISOLATED phase (not inside a consecutive run of phases) may
+        # START a T run: later 2x2s/phases on that qubit join it free,
+        # and one exposed-axis 2x2 (~0.9 ms) beats a masked full-block
+        # diag (~2.2 ms).  Phases inside LONG consecutive runs (QFT's
+        # controlled-phase ladders) coalesce into combined diag groups
+        # instead — per-phase groups there were measured catastrophic
+        # (1087 -> 618 gates/s at 30q).
+        if phase_run_len < 3:
+            t = cands[0]
+            join("T", 0, mask, m2, tag=(t, mask & ~(1 << t)))
+            return True
+        return False
+
+    # length of the consecutive run of apply_phase ops each phase sits in
+    # (the T-vs-D routing signal in join_high_phase)
+    run_lens = [0] * len(seg)
+    j = 0
+    while j < len(seg):
+        if seg[j][0] == "apply_phase":
+            j2 = j
+            while j2 < len(seg) and seg[j2][0] == "apply_phase":
+                j2 += 1
+            for jj in range(j, j2):
+                run_lens[jj] = j2 - j
+            j = j2
+        else:
+            j += 1
+
+    for op_ix, op in enumerate(seg):
         kind, statics, scalars = op
         if kind == "apply_phase":
             (mask,) = statics
-            if (mask & lane_mask_all) and scalars[1] == 0.0 \
-                    and join_lane_real_phase(mask, scalars[0]):
+            if (mask & lane_mask_all) \
+                    and (scalars[1] == 0.0 or fold_complex) \
+                    and join_lane_real_phase(
+                        mask, complex(scalars[0], scalars[1])):
+                continue
+            if (mask & high_mask_all) and join_high_phase(
+                    mask, complex(scalars[0], scalars[1]),
+                    run_lens[op_ix]):
                 continue
             join("D", 0, mask, (mask, scalars[0], scalars[1]))
             continue
@@ -637,8 +767,9 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
                             m2 = lane_part
                             for b in cond_bits:
                                 m2 |= 1 << b
-                            out.append(("diag", ((m2 & chunk_mask, phr,
-                                                  0.0, flag_ix(m2)),)))
+                            ph = complex(phr)
+                            out.append(("diag", ((m2 & chunk_mask, ph.real,
+                                                  ph.imag, flag_ix(m2)),)))
                         else:
                             target, scalars, ctrl_mask = it
                             out.append(("2x2", target, tuple(scalars),

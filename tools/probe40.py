@@ -1,0 +1,103 @@
+"""Round-4 probes at the bench config: attack the exposed MXU time.
+
+Levers under test (each measured inside full bench segments — isolated
+microbenches lie about in-segment costs, see docs/PERFORMANCE.md):
+
+  base       round-3 executor as shipped
+  foldc      transitive complex folding: S/T/Rz lane phases fold into
+             lane groups, merging the real matmul runs they split into
+             ONE complex (Gauss 3-dot) group per run-cluster
+  split3     manual bf16x3 lane dots (3 passes vs HIGHEST's 6)
+  rowgate    never compose row runs (per-gate roll/flip row 2x2s)
+
+Usage: [MB_QUBITS=30] [MB_INNER=16] python tools/probe40.py base foldc ...
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import jax
+import jax.numpy as jnp
+
+import quest_tpu.ops.pallas_kernels as pk
+import quest_tpu.scheduler as sched
+from quest_tpu.ops.lattice import state_shape
+from quest_tpu import models
+
+N = int(os.environ.get("MB_QUBITS", "30"))
+DEPTH = int(os.environ.get("MB_DEPTH", "16"))
+INNER = int(os.environ.get("MB_INNER", "16"))
+REPS = int(os.environ.get("MB_REPS", "2"))
+shape = state_shape(1 << N)
+
+
+def timed(label, segs, row_budget=None):
+    def apply(re, im):
+        for seg_ops, high in segs:
+            re, im = pk.apply_fused_segment(re, im, seg_ops, high,
+                                            row_budget=row_budget)
+        return re, im
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(re, im):
+        return jax.lax.fori_loop(0, INNER, lambda _, s: apply(*s), (re, im))
+
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    try:
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+    except Exception as e:
+        print(f"{label:28s} FAILED: {str(e)[:200]}", flush=True)
+        return
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times.append((time.perf_counter() - t0) / INNER)
+    best = min(times)
+    ng = N * DEPTH
+    print(f"{label:28s} {ng/best:7.1f} gates/s  ({len(segs)} passes, "
+          f"{best*1e3/len(segs):5.1f} ms/pass)", flush=True)
+
+
+def get_segs():
+    circ = models.random_circuit(N, depth=DEPTH, seed=123)
+    return sched.schedule_segments_best(list(circ.ops), N)
+
+
+def main():
+    which = sys.argv[1:] or ["base"]
+    print(f"n={N} depth={DEPTH} inner={INNER}", flush=True)
+    for w in which:
+        if w == "base":
+            timed("base", get_segs())
+        elif w == "foldc":
+            os.environ["QUEST_FOLD_COMPLEX"] = "1"
+            try:
+                timed("fold complex phases", get_segs())
+            finally:
+                os.environ.pop("QUEST_FOLD_COMPLEX", None)
+        elif w == "split3":
+            os.environ["QUEST_SPLIT3"] = "1"
+            try:
+                timed("bf16x3 lane dots", get_segs())
+            finally:
+                os.environ.pop("QUEST_SPLIT3", None)
+        elif w == "rowgate":
+            circ = models.random_circuit(N, depth=DEPTH, seed=123)
+            segs = sched.schedule_segments(
+                list(circ.ops), N, row_compose_min=999)
+            timed("row per-gate", segs)
+        else:
+            print(f"unknown probe {w}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

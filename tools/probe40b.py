@@ -1,0 +1,224 @@
+"""Does the MXU overlap the HBM stream at 30q?  Minimal kernels:
+stream a 2^30 f32 pair block-by-block, apply N chained 128x128 HIGHEST
+dots per block, in place.
+
+  std    — plain pallas_call grid pipeline (what the executor uses)
+  emit   — grid=() outer call + pltpu.emit_pipeline inner loop
+
+If overlap works, time should be ~max(stream_floor, dot_time), not
+their sum.  probe30 measured the std path strictly additive.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = int(os.environ.get("MB_QUBITS", "30"))
+INNER = int(os.environ.get("MB_INNER", "16"))
+NDOTS = [int(x) for x in os.environ.get("MB_NDOTS", "0,2,4,8").split(",")]
+
+ROWS = 1 << (N - 7)
+LANES = 128
+C_BLK = 1024  # rows per block -> 512 KB blocks, 8192 steps at 30q
+GRID = ROWS // C_BLK
+HI = lax.Precision.HIGHEST
+
+
+def run_one(label, make_fn):
+    re = jnp.zeros((ROWS, LANES), jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros((ROWS, LANES), jnp.float32)
+    m = jnp.eye(LANES, dtype=jnp.float32)
+    for nd in NDOTS:
+        fn = make_fn(nd)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def run(re, im, m=m, fn=fn):
+            return lax.fori_loop(0, INNER, lambda _, s: fn(*s, m), (re, im))
+
+        try:
+            re, im = run(re, im)
+            jax.block_until_ready((re, im))
+            float(re[0, 0])
+            times = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                re, im = run(re, im)
+                jax.block_until_ready((re, im))
+                float(re[0, 0])
+                times.append((time.perf_counter() - t0) / INNER)
+            print(f"{label} ndots={nd:2d}  {min(times)*1e3:7.2f} ms/pass",
+                  flush=True)
+        except Exception as e:
+            print(f"{label} ndots={nd:2d}  FAILED {str(e)[:150]}", flush=True)
+
+
+def make_std(nd):
+    def kern(re_ref, im_ref, m_ref, ro_ref, io_ref):
+        r, i = re_ref[:], im_ref[:]
+        m = m_ref[:]
+        for _ in range(nd):
+            r = jnp.dot(r, m, precision=HI, preferred_element_type=r.dtype)
+            i = jnp.dot(i, m, precision=HI, preferred_element_type=i.dtype)
+        ro_ref[:] = r
+        io_ref[:] = i
+
+    spec = pl.BlockSpec((C_BLK, LANES), lambda g: (g, 0))
+    mspec = pl.BlockSpec((LANES, LANES), lambda g: (0, 0))
+
+    def fn(re, im, m):
+        return pl.pallas_call(
+            kern, grid=(GRID,),
+            in_specs=[spec, spec, mspec], out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), re.dtype)] * 2,
+            input_output_aliases={0: 0, 1: 1},
+        )(re, im, m)
+    return fn
+
+
+def make_emit(nd):
+    def inner(re_blk, im_blk, m_ref, ro_blk, io_blk):
+        r, i = re_blk[:], im_blk[:]
+        m = m_ref[:]
+        for _ in range(nd):
+            r = jnp.dot(r, m, precision=HI, preferred_element_type=r.dtype)
+            i = jnp.dot(i, m, precision=HI, preferred_element_type=i.dtype)
+        ro_blk[:] = r
+        io_blk[:] = i
+
+    spec = pl.BlockSpec((C_BLK, LANES), lambda g: (g, 0))
+    mspec = pl.BlockSpec((LANES, LANES), lambda g: (0, 0))
+
+    def outer(re_hbm, im_hbm, m_hbm, ro_hbm, io_hbm):
+        pipe = pltpu.emit_pipeline(
+            inner, grid=(GRID,),
+            in_specs=[spec, spec, mspec], out_specs=[spec, spec])
+        pipe(re_hbm, im_hbm, m_hbm, ro_hbm, io_hbm)
+
+    def fn(re, im, m):
+        return pl.pallas_call(
+            outer,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
+            out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 2,
+            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), re.dtype)] * 2,
+            input_output_aliases={0: 0, 1: 1},
+        )(re, im, m)
+    return fn
+
+
+def _main():
+    which = sys.argv[1:] or ["std", "emit"]
+    print(f"n={N} grid={GRID} inner={INNER}", flush=True)
+    table = {"std": make_std, "emit": make_emit, "roll": make_roll,
+             "bf16": make_bf16dot, "split6": make_split6}
+    for w in which:
+        if w not in table:
+            print(f"unknown probe {w} (choose from {sorted(table)})")
+            continue
+        run_one(f"{w:6s}", table[w])
+
+
+def make_roll(nrolls):
+    """nrolls paired-roll+select lane 'gates' per block, no MXU at all."""
+    def kern(re_ref, im_ref, m_ref, ro_ref, io_ref):
+        r, i = re_ref[:], im_ref[:]
+        lane = lax.broadcasted_iota(jnp.int32, (C_BLK, LANES), 1)
+        for k in range(nrolls):
+            s = 1 << (k % 7)
+            sel0 = ((lane >> (k % 7)) & 1) == 0
+            pr = jnp.where(sel0, pltpu.roll(r, LANES - s, axis=1),
+                           pltpu.roll(r, s, axis=1))
+            pi = jnp.where(sel0, pltpu.roll(i, LANES - s, axis=1),
+                           pltpu.roll(i, s, axis=1))
+            h = 0.7071067811865476
+            r, i = h * (r + pr), h * (i + pi)
+        ro_ref[:] = r
+        io_ref[:] = i
+
+    spec = pl.BlockSpec((C_BLK, LANES), lambda g: (g, 0))
+    mspec = pl.BlockSpec((LANES, LANES), lambda g: (0, 0))
+
+    def fn(re, im, m):
+        return pl.pallas_call(
+            kern, grid=(GRID,),
+            in_specs=[spec, spec, mspec], out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), re.dtype)] * 2,
+            input_output_aliases={0: 0, 1: 1},
+        )(re, im, m)
+    return fn
+
+
+def make_bf16dot(nd):
+    """nd pairs of native bf16 dots (split3's building block)."""
+    def kern(re_ref, im_ref, m_ref, ro_ref, io_ref):
+        r, i = re_ref[:], im_ref[:]
+        m = m_ref[:].astype(jnp.bfloat16)
+        for _ in range(nd):
+            r = jnp.dot(r.astype(jnp.bfloat16), m,
+                        preferred_element_type=jnp.float32)
+            i = jnp.dot(i.astype(jnp.bfloat16), m,
+                        preferred_element_type=jnp.float32)
+        ro_ref[:] = r
+        io_ref[:] = i
+
+    spec = pl.BlockSpec((C_BLK, LANES), lambda g: (g, 0))
+    mspec = pl.BlockSpec((LANES, LANES), lambda g: (0, 0))
+
+    def fn(re, im, m):
+        return pl.pallas_call(
+            kern, grid=(GRID,),
+            in_specs=[spec, spec, mspec], out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), re.dtype)] * 2,
+            input_output_aliases={0: 0, 1: 1},
+        )(re, im, m)
+    return fn
+
+
+def _split3_chunks(x, dtype=jnp.float32):
+    x0 = x.astype(jnp.bfloat16)
+    r = x - x0.astype(dtype)
+    x1 = r.astype(jnp.bfloat16)
+    x2 = (r - x1.astype(dtype)).astype(jnp.bfloat16)
+    return x0, x1, x2
+
+
+def make_split6(nd):
+    """nd logical f32-exact dots, each as 6 bf16 chunk products."""
+    def kern(re_ref, im_ref, m_ref, ro_ref, io_ref):
+        r, i = re_ref[:], im_ref[:]
+        m0, m1, m2 = _split3_chunks(m_ref[:])
+
+        def ldot(x):
+            x0, x1, x2 = _split3_chunks(x)
+            d = lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32)
+            return ((d(x2, m0) + d(x1, m1) + d(x0, m2))
+                    + (d(x1, m0) + d(x0, m1)) + d(x0, m0))
+
+        for _ in range(nd):
+            r = ldot(r)
+            i = ldot(i)
+        ro_ref[:] = r
+        io_ref[:] = i
+
+    spec = pl.BlockSpec((C_BLK, LANES), lambda g: (g, 0))
+    mspec = pl.BlockSpec((LANES, LANES), lambda g: (0, 0))
+
+    def fn(re, im, m):
+        return pl.pallas_call(
+            kern, grid=(GRID,),
+            in_specs=[spec, spec, mspec], out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), re.dtype)] * 2,
+            input_output_aliases={0: 0, 1: 1},
+        )(re, im, m)
+    return fn
+
+
+if __name__ == "__main__":
+    _main()

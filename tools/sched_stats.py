@@ -1,0 +1,59 @@
+"""Dump the fused-schedule op histogram for the bench workload, with a
+per-pass cost model from the round-3 probe numbers (tools/probe30*.py),
+so scheduler changes can be sanity-costed before touching the chip."""
+
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import numpy as np
+
+from quest_tpu import models
+from quest_tpu.scheduler import schedule_segments_best
+
+N = int(os.environ.get("MB_QUBITS", "30"))
+DEPTH = int(os.environ.get("MB_DEPTH", "16"))
+
+circ = models.random_circuit(N, depth=DEPTH, seed=123)
+segs = schedule_segments_best(list(circ.ops), N)
+
+# probe30 costs (ms/pass at 30q, k<=6)
+COST = {"floor": 37.2, "lanemm_real": 12.4, "lanemm_cplx": 18.6,
+        "2x2_exposed": 0.9, "2x2_row": 2.5, "2x2_lane": 7.0,
+        "rowmm_real": 12.4, "rowmm_cplx": 18.6,
+        "dtab": 0.3, "diag": 0.3, "2x2pair": 1.2}
+
+total = 0.0
+print(f"n={N} depth={DEPTH} gates={circ.num_gates} passes={len(segs)}")
+for si, (seg_ops, high) in enumerate(segs):
+    hist = Counter()
+    est = COST["floor"]
+    for op in seg_ops:
+        k = op[0]
+        if k in ("lanemm", "rowmm"):
+            cplx = op[2] >= 0 if isinstance(op[2], int) else \
+                np.asarray(op[2]).any()
+            key = f"{k}_{'cplx' if cplx else 'real'}"
+            hist[key] += 1
+            est += COST[key]
+        elif k == "lanemmc":
+            hist[f"lanemmc_{len(op[1])}b"] += 1
+            est += COST["lanemm_real"]
+        elif k == "2x2":
+            t = op[1]
+            if t in high:
+                hist["2x2_exposed"] += 1
+                est += COST["2x2_exposed"]
+            elif t < 7:
+                hist["2x2_lane"] += 1
+                est += COST["2x2_lane"]
+            else:
+                hist["2x2_row"] += 1
+                est += COST["2x2_row"]
+        else:
+            hist[k] += 1
+            est += COST.get(k, 0.3)
+    total += est
+    print(f"  seg{si}: high={high} est={est:6.1f}ms  {dict(hist)}")
+print(f"est total {total:.0f} ms/loop -> est {circ.num_gates/total*1000:.0f} gates/s")
