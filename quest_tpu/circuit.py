@@ -454,9 +454,9 @@ class Circuit:
         if shots < 1:
             raise _v.QuESTError("Circuit.sample: shots must be >= 1")
         if key is None:
-            import secrets
+            from .env import default_measure_key
 
-            key = jax.random.PRNGKey(secrets.randbits(31))
+            key = default_measure_key()
         dtype = jnp.dtype(dtype or _prec.default_real_dtype())
         # Memoised like compile(): jit caches on function identity, so a
         # fresh closure per call would re-trace and re-compile the whole
@@ -493,9 +493,9 @@ class Circuit:
         if self._has_nonunitary:
             draws = self.num_measurements > 0
             if key is None and draws:
-                import secrets
+                from .env import default_measure_key
 
-                key = jax.random.PRNGKey(secrets.randbits(31))
+                key = default_measure_key()
             re, im, outcomes = fn(qureg.re, qureg.im, key)
             qureg._set(re, im)
             # collapse-only circuits consume no randomness and yield no

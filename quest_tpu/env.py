@@ -127,8 +127,11 @@ def _finalised() -> bool:
 def sync_env(env: QuESTEnv) -> None:
     """Block until all outstanding device work completes, across every
     process of a multi-host run (reference: syncQuESTEnv = MPI_Barrier,
-    QuEST_cpu_distributed.c:166-168)."""
-    if jax.process_count() > 1:
+    QuEST_cpu_distributed.c:166-168).  After destroy_env has finalised
+    the coordination service the cross-process barrier is skipped (a
+    collective over the torn-down service would hang), keeping
+    post-finalise sync_env the harmless no-op destroy_env promises."""
+    if jax.process_count() > 1 and not _finalised():
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("quest_tpu:sync_env")
@@ -210,6 +213,22 @@ def random_real() -> float:
     """One uniform draw in [0, 1] from the global RNG (reference:
     genrand_real1 via generateMeasurementOutcome, QuEST_common.c:103-121)."""
     return _rng.genrand_real1()
+
+
+def default_measure_key():
+    """A jax PRNG key drawn from the process-agreed measurement RNG.
+
+    Compiled-circuit measurement (Circuit.run/sample with key=None) must
+    use a key that is IDENTICAL on every rank of a multi-process run:
+    collapse kernels project each shard onto the traced outcome, so
+    per-process entropy would silently project different shards onto
+    different outcomes.  The global MT19937 is seeded process-agreed
+    (seed broadcast, exactly as the reference broadcasts its seed —
+    QuEST_cpu_distributed.c:1294-1305), so one draw from it yields the
+    same key everywhere.  Consumes one draw on every rank alike."""
+    import jax as _jax
+
+    return _jax.random.PRNGKey(int(_rng.genrand_real1() * 0x7FFFFFFF))
 
 
 seed_quest_default()

@@ -87,14 +87,6 @@ def _combine_2x2(r, i, pr, pi, bit, m):
 # Generalized fused segment: low bits + up to MAX_HIGH_BITS arbitrary qubits
 # ---------------------------------------------------------------------------
 
-def _row_flip_enabled() -> bool:
-    """A/B knob for the tile-aligned row-partner formulation (half-swap
-    view vs paired rolls); QUEST_ROW_FLIP=0 selects the roll path."""
-    import os
-
-    return os.environ.get("QUEST_ROW_FLIP", "1") != "0"
-
-
 #: Max number of arbitrary high qubits a fused segment can expose as
 #: dedicated block axes.  Each extra axis halves the contiguous-row
 #: block piece (c_blk = row_budget >> k), so k >= 8 needs a raised
@@ -423,7 +415,7 @@ def _xor_partner(x, t: int, bf: _FusedBits, high_axis, lane_bits: int,
         return jnp.where(bf.bit(t) == 0, up, dn)
     s = 1 << (t - lane_bits)
     assert s < c_blk, (t, c_blk)
-    if s >= 8 and _row_flip_enabled():
+    if s >= 8:
         view = shape[:-2] + (c_blk // (2 * s), 2, s, shape[-1])
         ax = len(view) - 3
         v = x.reshape(view)
@@ -794,7 +786,7 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
             sel0 = bit == 0
             pr = jnp.where(sel0, up_r, dn_r)
             pi = jnp.where(sel0, up_i, dn_i)
-        elif (1 << (t - lane_bits)) >= 8 and _row_flip_enabled():
+        elif (1 << (t - lane_bits)) >= 8:
             # tile-aligned row stride: the XOR partner is one half-swap of
             # a leading-dim-split view (a single VMEM copy via slice +
             # concat; jnp.flip lowers to `rev`, unimplemented in Pallas

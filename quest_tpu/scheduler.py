@@ -549,9 +549,6 @@ def _fold_groups(seg, lane_bits: int, low_row_bits: int, high: tuple = ()):
     for t in high:
         high_mask_all |= 1 << t
 
-    import os
-    fold_complex = os.environ.get("QUEST_FOLD_COMPLEX", "0") != "0"
-
     def join_lane_real_phase(mask, phr) -> bool:
         lane_part = mask & lane_mask_all
         cond_part = mask & ~lane_mask_all
@@ -633,8 +630,7 @@ def _fold_groups(seg, lane_bits: int, low_row_bits: int, high: tuple = ()):
         kind, statics, scalars = op
         if kind == "apply_phase":
             (mask,) = statics
-            if (mask & lane_mask_all) \
-                    and (scalars[1] == 0.0 or fold_complex) \
+            if (mask & lane_mask_all) and scalars[1] == 0.0 \
                     and join_lane_real_phase(
                         mask, complex(scalars[0], scalars[1])):
                 continue

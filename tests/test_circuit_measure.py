@@ -207,3 +207,23 @@ def test_sample_validates():
         Circuit(2).hadamard(0).sample(8)         # no measurements
     with pytest.raises(QuESTError):
         Circuit(2).hadamard(0).measure(0).sample(0)
+
+
+def test_default_measure_key_follows_agreed_seed():
+    """Circuit.run/sample's default key comes from the process-agreed
+    measurement RNG: identical seeding -> identical key, so in a
+    multi-process mesh every rank traces the same outcomes (the seed
+    itself is broadcast, as the reference broadcasts its seed —
+    QuEST_cpu_distributed.c:1294-1305)."""
+    import numpy as np
+    import quest_tpu as qt
+    from quest_tpu.env import default_measure_key
+
+    qt.seed_quest([12345])
+    k1 = np.asarray(default_measure_key())
+    qt.seed_quest([12345])
+    k2 = np.asarray(default_measure_key())
+    k3 = np.asarray(default_measure_key())
+    assert (k1 == k2).all()          # agreed seed -> agreed key
+    assert not (k2 == k3).all()      # successive draws differ
+    qt.seed_quest_default()

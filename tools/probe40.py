@@ -3,14 +3,12 @@
 Levers under test (each measured inside full bench segments — isolated
 microbenches lie about in-segment costs, see docs/PERFORMANCE.md):
 
-  base       round-3 executor as shipped
-  foldc      transitive complex folding: S/T/Rz lane phases fold into
-             lane groups, merging the real matmul runs they split into
-             ONE complex (Gauss 3-dot) group per run-cluster
-  split3     manual bf16x3 lane dots (3 passes vs HIGHEST's 6)
+  base       executor as shipped
+  split3     manual bf16x3 lane dots (3 passes vs HIGHEST's 6) —
+             QUEST_SPLIT3 fast-math opt-in, ~16-bit mantissa
   rowgate    never compose row runs (per-gate roll/flip row 2x2s)
 
-Usage: [MB_QUBITS=30] [MB_INNER=16] python tools/probe40.py base foldc ...
+Usage: [MB_QUBITS=30] [MB_INNER=16] python tools/probe40.py base split3 ...
 """
 
 import os
@@ -78,12 +76,6 @@ def main():
     for w in which:
         if w == "base":
             timed("base", get_segs())
-        elif w == "foldc":
-            os.environ["QUEST_FOLD_COMPLEX"] = "1"
-            try:
-                timed("fold complex phases", get_segs())
-            finally:
-                os.environ.pop("QUEST_FOLD_COMPLEX", None)
         elif w == "split3":
             os.environ["QUEST_SPLIT3"] = "1"
             try:
