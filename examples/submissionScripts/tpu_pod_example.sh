@@ -6,7 +6,19 @@
 # coordinator, so programs only need quest_tpu.init_distributed() —
 # or, for unmodified C programs linked against capi/libQuEST.so, set
 # QUEST_CAPI_COORDINATOR=auto QUEST_CAPI_DEVICES=0.
+#
+# --rehearse: exercise the identical multi-host launch path on THIS
+# machine — 2 OS processes x 4 virtual devices, init_distributed over a
+# local coordinator, the 20-qubit fused-mesh plan executed with real
+# cross-process relayout exchanges — and record REHEARSAL_r{N}.json
+# (per-process timing + exchange volumes).  No TPU pod required; the
+# pod run is then exactly this script without --rehearse.
 set -euo pipefail
+
+if [[ "${1:-}" == "--rehearse" ]]; then
+    cd "$(dirname "$0")/../.."
+    exec python tools/pod_rehearsal.py "${2:-4}"
+fi
 
 : "${TPU_NAME:?set TPU_NAME to the pod slice name}"
 PROGRAM=${1:-examples/distributed_qft.py}

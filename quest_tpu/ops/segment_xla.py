@@ -1,0 +1,226 @@
+"""XLA fallback executor for fused-segment plans.
+
+Runs a scheduler segment (the exact seg-op tuples
+``quest_tpu.scheduler._plan_seg`` emits for
+``apply_fused_segment``) as plain XLA array ops on a whole chunk — no
+Pallas.  Purpose: executing ``schedule_mesh`` plans at scale on hosts
+where the Pallas TPU kernels cannot lower (the virtual CPU mesh used for
+multi-chip validation): interpret-mode Pallas walks the grid step by
+step in Python and is size-bound in practice, while this path is one
+fused XLA program per segment, so the PLAN ITSELF — segments plus
+``bitswap_chunk`` relayouts — executes at 24+ qubits.
+
+Semantics mirror ``pallas_kernels._apply_fused_op`` op for op; the
+per-op shapes differ (full chunk instead of a grid block) but the
+index algebra is the shared ``Lattice`` one.  The reference has no
+analogue seam — its distributed path executes eagerly per gate
+(QuEST_cpu_distributed.c:816-1214).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .lattice import Lattice, _ilog2
+from .pallas_kernels import _X_MAT
+
+
+def _mm_lane(r, i, mr, mi):
+    """Apply the (complex) lane matrix M to the lane axis."""
+    mr = jnp.asarray(mr, r.dtype)
+    nr = r @ mr.T
+    ni = i @ mr.T
+    if np.asarray(mi).any():
+        mi = jnp.asarray(mi, r.dtype)
+        nr = nr - i @ mi.T
+        ni = ni + r @ mi.T
+    return nr, ni
+
+
+def _mm_row(r, i, mr, mi):
+    rr = np.asarray(mr).shape[0]
+    rows, lanes = r.shape
+    view = (rows // rr, rr, lanes)
+    mr = jnp.asarray(mr, r.dtype)
+
+    def app(x, m):
+        return jnp.einsum("ab,gbl->gal", m, x.reshape(view),
+                          precision="highest").reshape(r.shape)
+
+    nr, ni = app(r, mr), app(i, mr)
+    if np.asarray(mi).any():
+        mi = jnp.asarray(mi, r.dtype)
+        nr = nr - app(i, mi)
+        ni = ni + app(r, mi)
+    return nr, ni
+
+
+def _apply_2x2(r, i, lat, t, m, keep):
+    (ar, ai), (br, bi), (cr, ci), (dr, di) = m
+    pr = lat.xor_shift(r, 1 << t)
+    pi = lat.xor_shift(i, 1 << t)
+    if tuple(m) == _X_MAT:
+        nr, ni = pr, pi
+    else:
+        bit = lat.bit(t)
+        is0 = bit == 0
+        sr = jnp.where(is0, ar, dr)
+        si = jnp.where(is0, ai, di)
+        tr = jnp.where(is0, br, cr)
+        ti = jnp.where(is0, bi, ci)
+        nr = sr * r - si * i + tr * pr - ti * pi
+        ni = sr * i + si * r + tr * pi + ti * pr
+    if keep is not None:
+        nr = jnp.where(keep, nr, r)
+        ni = jnp.where(keep, ni, i)
+    return nr, ni
+
+
+def _chan(r, i, lat, tag, bits, sc, dtype):
+    """Channel formulas, identical to pallas_kernels._apply_chan (which
+    documents them against QuEST_cpu.c:36-377)."""
+    c = lambda v: jnp.array(v, dtype)  # noqa: E731
+
+    def fetch(x, mask_bits):
+        mask = 0
+        for b in mask_bits:
+            mask |= 1 << b
+        return lat.xor_shift(x, mask)
+
+    if tag == "deph":
+        a, b = bits
+        (retain,) = sc
+        off = lat.bit(a) != lat.bit(b)
+        return (jnp.where(off, c(retain) * r, r),
+                jnp.where(off, c(retain) * i, i))
+    if tag == "deph2":
+        a, aN, b, bN = bits
+        (retain,) = sc
+        off = jnp.logical_or(lat.bit(a) != lat.bit(aN),
+                             lat.bit(b) != lat.bit(bN))
+        return (jnp.where(off, c(retain) * r, r),
+                jnp.where(off, c(retain) * i, i))
+    if tag == "depol":
+        a, aN = bits
+        (d,) = sc
+        diag = lat.bit(a) == lat.bit(aN)
+        pr, pi = fetch(r, (a, aN)), fetch(i, (a, aN))
+        return (jnp.where(diag, c(1 - d / 2) * r + c(d / 2) * pr,
+                          c(1 - d) * r),
+                jnp.where(diag, c(1 - d / 2) * i + c(d / 2) * pi,
+                          c(1 - d) * i))
+    if tag == "damp":
+        a, aN = bits
+        (p,) = sc
+        bt, bT = lat.bit(a), lat.bit(aN)
+        diag = bt == bT
+        zero = jnp.logical_and(diag, bt == 0)
+        pr, pi = fetch(r, (a, aN)), fetch(i, (a, aN))
+        deph = float(np.sqrt(1 - p))
+        return (jnp.where(zero, r + c(p) * pr,
+                          jnp.where(diag, c(1 - p) * r, c(deph) * r)),
+                jnp.where(zero, i + c(p) * pi,
+                          jnp.where(diag, c(1 - p) * i, c(deph) * i)))
+    if tag == "depol2":
+        a, aN, b, bN = bits
+        d, delta, gamma = sc
+        sel = jnp.logical_and(lat.bit(a) == lat.bit(aN),
+                              lat.bit(b) == lat.bit(bN))
+        r = jnp.where(sel, r, c(1 - d) * r)
+        i = jnp.where(sel, i, c(1 - d) * i)
+        for mask_bits, g in (((a, aN), None), ((b, bN), None),
+                             ((a, aN, b, bN), gamma)):
+            pr, pi = fetch(r, mask_bits), fetch(i, mask_bits)
+            nr = r + c(delta) * pr
+            ni = i + c(delta) * pi
+            if g is not None:
+                nr = c(g) * nr
+                ni = c(g) * ni
+            r = jnp.where(sel, nr, r)
+            i = jnp.where(sel, ni, i)
+        return r, i
+    raise ValueError(tag)
+
+
+def apply_segment_xla(re, im, seg_ops: tuple, high_bits: tuple = (),
+                      dev_flags=None):
+    """Pure-XLA equivalent of ``apply_fused_segment`` on one chunk.
+
+    ``high_bits`` only determines the 2x2pair axis->bit mapping; the
+    chunk is processed whole, so exposure is irrelevant here.
+    """
+    lat = Lattice.for_array(re, None, 1)
+    lanes = re.shape[1]
+    lane_bits = _ilog2(lanes)
+    high_row = tuple(sorted(t - lane_bits for t in high_bits))
+    k = len(high_row)
+    axis_to_bit = {k - 1 - i: b + lane_bits
+                   for i, b in enumerate(high_row)}
+    dtype = re.dtype
+
+    def flag_sel(flag_ix, sel=None):
+        if flag_ix is None or flag_ix < 0:
+            return sel
+        f = dev_flags[0, flag_ix] > 0.5
+        return f if sel is None else jnp.logical_and(sel, f)
+
+    for op in seg_ops:
+        kind = op[0]
+        if kind == "lanemm":
+            re, im = _mm_lane(re, im, op[1], op[2])
+        elif kind == "lanemmc":
+            _, cond_bits, mats = op
+            nb = len(cond_bits)
+            out_r, out_i = re, im
+            for v in range(1 << nb):
+                sel = None
+                for ix, b in enumerate(cond_bits):
+                    want = (v >> ix) & 1
+                    s = lat.bit(b) == want
+                    sel = s if sel is None else jnp.logical_and(sel, s)
+                mr, mi = mats[v]
+                vr, vi = _mm_lane(re, im, mr, mi)
+                out_r = jnp.where(sel, vr, out_r)
+                out_i = jnp.where(sel, vi, out_i)
+            re, im = out_r, out_i
+        elif kind == "rowmm":
+            re, im = _mm_row(re, im, op[1], op[2])
+        elif kind == "dtab":
+            _, tr, ti = op
+            rt = np.asarray(tr).shape[0]
+            rows = re.shape[0]
+            view = (rows // rt, rt, lanes)
+            fr = jnp.asarray(tr, dtype)[None]
+            fi = jnp.asarray(ti, dtype)[None]
+            wr = re.reshape(view)
+            wi = im.reshape(view)
+            re = (wr * fr - wi * fi).reshape(re.shape)
+            im = (wr * fi + wi * fr).reshape(im.shape)
+        elif kind == "diag":
+            _, phases = op
+            dre = jnp.array(1.0, dtype)
+            dim = jnp.array(0.0, dtype)
+            for sel_mask, phr, phi, flag_ix in phases:
+                sel = flag_sel(flag_ix, lat.bits_all_set(sel_mask))
+                fr = jnp.where(sel, jnp.array(phr, dtype),
+                               jnp.array(1.0, dtype))
+                fi = jnp.where(sel, jnp.array(phi, dtype),
+                               jnp.array(0.0, dtype))
+                dre, dim = dre * fr - dim * fi, dre * fi + dim * fr
+            re, im = re * dre - im * dim, im * dre + re * dim
+        elif kind == "2x2":
+            _, t, m, ctrl_mask, flag_ix = op
+            keep = lat.bits_all_set(ctrl_mask) if ctrl_mask else None
+            keep = flag_sel(flag_ix, keep)
+            re, im = _apply_2x2(re, im, lat, t, m, keep)
+        elif kind == "2x2pair":
+            _, ax1, m1, ax2, m2 = op
+            re, im = _apply_2x2(re, im, lat, axis_to_bit[ax1], m1, None)
+            re, im = _apply_2x2(re, im, lat, axis_to_bit[ax2], m2, None)
+        elif kind == "chan":
+            _, tag, bits, sc = op
+            re, im = _chan(re, im, lat, tag, bits, sc, dtype)
+        else:
+            raise ValueError(kind)
+    return re, im

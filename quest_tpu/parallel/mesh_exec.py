@@ -113,12 +113,19 @@ def plan_comm_stats(plan, num_vec_bits: int, dev_bits: int):
 
 
 def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
-                     interpret: bool = False):
+                     interpret: bool = False, backend: str = "pallas"):
     """A pure (re, im) -> (re, im) function running the recorded ops as
-    fused Pallas segments inside shard_map over ``mesh``, with relayout
+    fused segments inside shard_map over ``mesh``, with relayout
     half-exchanges for sharded-qubit gates.  Input and output arrays are
-    in the canonical (identity) qubit layout."""
+    in the canonical (identity) qubit layout.
+
+    ``backend``: "pallas" (the TPU kernels; ``interpret`` selects
+    interpreter mode) or "xla" (``apply_segment_xla`` — the same plan,
+    segment bodies as plain XLA ops; this is how the full plan,
+    relayouts included, executes at 24+ qubits on the virtual CPU
+    mesh, where interpret-mode Pallas is size-bound)."""
     from ..scheduler import schedule_mesh
+    from ..ops.segment_xla import apply_segment_xla
 
     (axis,) = mesh.axis_names
     ndev = math.prod(mesh.devices.shape)
@@ -138,9 +145,13 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
                     flags = jnp.stack(
                         [(dev & dm) == dm for dm in dev_masks]
                     ).astype(re.dtype).reshape(1, -1)
-                re, im = apply_fused_segment(
-                    re, im, seg_ops, high,
-                    interpret=interpret, dev_flags=flags)
+                if backend == "xla":
+                    re, im = apply_segment_xla(re, im, seg_ops, high,
+                                               dev_flags=flags)
+                else:
+                    re, im = apply_fused_segment(
+                        re, im, seg_ops, high,
+                        interpret=interpret, dev_flags=flags)
             else:
                 _, a, b = item
                 re = bitswap_chunk(re, a, b, dev, axis, ndev,

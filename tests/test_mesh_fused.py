@@ -187,3 +187,85 @@ def test_conditional_lane_group_under_mesh(env8, env1):
         qt.get_state_vector(regs[0]), qt.get_state_vector(regs[1]),
         atol=TOL)
     assert abs(qt.calc_total_prob(regs[0]) - 1.0) < TOL
+
+
+def test_plan_xla_backend_equivalence_20q(env8, env1):
+    """The PLAN ITSELF — fused segments plus real bitswap_chunk
+    relayouts — executed via the XLA segment backend at 20 qubits must
+    match the per-gate path amplitude-for-amplitude (VERDICT r3 item 2:
+    plan execution must not depend on interpret-mode Pallas).  The
+    circuit forces multiple relayouts (mixing gates on device bits,
+    interleaved with lane/row/mid content and measur-free noise-less
+    ops of every scheduler class)."""
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn
+
+    n = 20
+    circ = models.random_circuit(n, depth=4, seed=77)
+    # extra device-bit traffic: mix on all three device bits
+    circ.hadamard(n - 1).cnot(n - 1, n - 2).rotate_x(n - 3, 0.9)
+
+    q = qt.create_qureg(n, env8, dtype=jnp.float32)
+    qt.init_zero_state(q)
+    fn = as_mesh_fused_fn(list(circ.ops), n, q.mesh, backend="xla")
+    re, im = jax.jit(fn)(q.re, q.im)
+    q._set(re, im)
+
+    ref = qt.create_qureg(n, env1, dtype=jnp.float32)
+    qt.init_zero_state(ref)
+    circ.run(ref, pallas=False)
+
+    from quest_tpu.parallel import to_host
+
+    a = to_host(q.re).reshape(-1) + 1j * to_host(q.im).reshape(-1)
+    b = to_host(ref.re).reshape(-1) + 1j * to_host(ref.im).reshape(-1)
+    assert float(np.abs(a - b).max()) < 1e-6
+    assert abs(qt.calc_total_prob(q) - 1.0) < 1e-5
+
+
+def test_plan_xla_backend_density_channels(env8, env1):
+    """XLA segment backend under the mesh with decoherence channels in
+    the plan (fused 'chan' ops + relayouts on a density register):
+    channels on SHARDED qubits force the scheduler to relabel their
+    bits local, and the per-chunk channel kernels must then match the
+    per-gate path."""
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn
+    from quest_tpu.ops.lattice import run_kernel
+
+    n = 7  # density: 14 vector qubits, top 3 sharded over 8 devices
+    H_M = ((0.7071067811865476, 0.0), (0.7071067811865476, 0.0),
+           (0.7071067811865476, 0.0), (-0.7071067811865476, 0.0))
+    ops = [
+        ("apply_2x2", (0, 0), H_M),
+        ("apply_2x2", (n, 0), H_M),
+        ("dm_chan", ("depol", n - 1, 2 * n - 1), (0.2,)),   # sharded bit
+        ("apply_2x2", (n - 2, 0), H_M),
+        ("apply_2x2", (2 * n - 2, 0), H_M),
+        ("dm_chan", ("damp", 0, n), (0.3,)),
+        ("dm_chan", ("deph2", 0, n, n - 1, 2 * n - 1), (0.75,)),
+        ("dm_chan", ("depol2", 1, 1 + n, n - 1, 2 * n - 1),
+         (0.05, 0.02532, 0.92736)),
+    ]
+
+    q = qt.create_density_qureg(n, env8, dtype=jnp.float32)
+    qt.init_zero_state(q)
+    fn = as_mesh_fused_fn(ops, 2 * n, q.mesh, backend="xla")
+    re, im = jax.jit(fn)(q.re, q.im)
+    q._set(re, im)
+
+    ref = qt.create_density_qureg(n, env1, dtype=jnp.float32)
+    qt.init_zero_state(ref)
+    r2, i2 = ref.re, ref.im
+    for kind, statics, scalars in ops:
+        r2, i2 = run_kernel((r2, i2), scalars, kind=kind,
+                            statics=statics, mesh=None)
+    ref._set(r2, i2)
+
+    from quest_tpu.parallel import to_host
+
+    a = to_host(q.re).reshape(-1) + 1j * to_host(q.im).reshape(-1)
+    b = to_host(ref.re).reshape(-1) + 1j * to_host(ref.im).reshape(-1)
+    assert float(np.abs(a - b).max()) < 1e-6

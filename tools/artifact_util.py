@@ -1,0 +1,52 @@
+"""Shared artifact helpers: round-over-round drift surfacing.
+
+Every artifact generator calls ``delta_note`` so regressions surface AT
+RECORD TIME (round-3 lesson: the eager-path latency drifted 111 -> 131
+ms across rounds and nobody noticed until judging)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+
+def previous_artifact(repo: str, stem: str, rnd: int):
+    """Load the newest ``{stem}_r{M}.json`` with M < rnd, or None."""
+    best = None
+    for path in glob.glob(os.path.join(repo, f"{stem}_r*.json")):
+        m = re.search(rf"{stem}_r(\d+)\.json$", path)
+        if not m or int(m.group(1)) >= rnd:
+            continue
+        if best is None or int(m.group(1)) > best[0]:
+            try:
+                with open(path) as f:
+                    best = (int(m.group(1)), json.load(f))
+            except Exception:
+                continue
+    return best
+
+
+def delta_note(repo: str, stem: str, rnd: int, picks: dict):
+    """One-line drift summary vs the previous round's artifact.
+
+    ``picks``: {label: (path_in_artifact, current_value)} where path is
+    a dotted key path into the previous artifact's JSON."""
+    prev = previous_artifact(repo, stem, rnd)
+    if prev is None:
+        return "no previous round artifact"
+    prnd, pdata = prev
+    parts = []
+    for label, (path, cur) in picks.items():
+        node = pdata
+        try:
+            for kk in path.split("."):
+                node = node[int(kk)] if kk.isdigit() else node[kk]
+            old = float(node)
+            cur = float(cur)
+            pct = (cur - old) / old * 100 if old else float("inf")
+            parts.append(f"{label} {old:g} -> {cur:g} ({pct:+.1f}%)")
+        except Exception:
+            parts.append(f"{label}: no r{prnd:02d} value")
+    return f"vs r{prnd:02d}: " + "; ".join(parts)

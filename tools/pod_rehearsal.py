@@ -1,0 +1,155 @@
+"""Pod launch-path rehearsal: the multi-HOST fused-mesh flow, locally.
+
+A TPU pod run is one process per host, ``quest_tpu.init_distributed``
+joining them into one global mesh, and the fused-mesh plan executing
+per-chunk with cross-process relayout exchanges over DCN/ICI
+(reference launch analogue: mpirun via
+examples/submissionScripts/mpi_SLURM_example.sh + MPI_Init,
+QuEST_cpu_distributed.c:135-164).  This tool rehearses that exact
+launch path on one machine — 2 OS processes x 4 virtual CPU devices
+each, a 20-qubit state sharded across all 8 chunks, the schedule_mesh
+plan executed through the XLA segment backend with real
+``bitswap_chunk`` exchanges crossing the process boundary — and
+records per-process timing plus the plan's exchange volumes, so the
+pod story is one gcloud invocation away (see
+examples/submissionScripts/tpu_pod_example.sh --rehearse), not a
+rewrite away.
+
+Writes REHEARSAL_r{N}.json.  Usage: python tools/pod_rehearsal.py [N]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N_QUBITS = int(os.environ.get("QUEST_REHEARSE_QUBITS", "20"))
+NPROC = 2
+DEV_PER_PROC = 4
+
+_WORKER = """
+import sys, time, json
+sys.path.insert(0, {repo!r})
+pid = int(sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", {dev_per_proc})
+import numpy as np
+import jax.numpy as jnp
+import quest_tpu as qt
+from quest_tpu import models
+from quest_tpu.parallel import to_host
+from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn, plan_comm_stats
+from quest_tpu.scheduler import schedule_mesh
+from quest_tpu.ops.lattice import state_shape
+
+t_init = time.perf_counter()
+qt.init_distributed("localhost:{port}", {nproc}, pid)
+env = qt.create_env()
+assert env.num_devices == {nproc} * {dev_per_proc}
+init_s = time.perf_counter() - t_init
+
+n = {n}
+ndev = env.num_devices
+dev_bits = (ndev - 1).bit_length()
+circ = models.random_circuit(n, depth=3, seed=9)
+for t in range(n - dev_bits, n):     # sharded-qubit mixing layers:
+    circ.hadamard(t)                 # every relayout class, incl. the
+    circ.cnot(t, 0)                  # process-boundary exchanges
+lanes = state_shape(1 << n, ndev)[1]
+lane_bits = (lanes - 1).bit_length()
+plan = schedule_mesh(list(circ.ops), n, dev_bits, lane_bits)
+stats = plan_comm_stats(plan, n, dev_bits)
+
+q = qt.create_qureg(n, env)
+qt.init_zero_state(q)
+fn = jax.jit(as_mesh_fused_fn(list(circ.ops), n, q.mesh, backend="xla"))
+t0 = time.perf_counter()
+re, im = fn(q.re, q.im)
+jax.block_until_ready((re, im))
+compile_plus_run = time.perf_counter() - t0
+q._set(re, im)
+t0 = time.perf_counter()
+re, im = fn(q.re, q.im)
+jax.block_until_ready((re, im))
+warm = time.perf_counter() - t0
+q._set(re, im)
+total = qt.calc_total_prob(q)
+
+chunk_bytes = 2 * (1 << (n - dev_bits)) * 4
+print("RESULT " + json.dumps({{
+    "pid": pid, "devices": ndev, "qubits": n,
+    "gates": circ.num_gates,
+    "init_distributed_seconds": round(init_s, 3),
+    "compile_plus_run_seconds": round(compile_plus_run, 3),
+    "warm_run_seconds": round(warm, 3),
+    "total_prob": float(total),
+    "plan_swaps": stats["swaps"],
+    "plan_chunk_volume": stats["chunk_volume"],
+    "exchange_bytes_per_device": int(stats["chunk_volume"] * chunk_bytes),
+}}), flush=True)
+qt.destroy_env(env)
+"""
+
+
+def main():
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    port = 19960 + (os.getpid() % 37)
+    worker = _WORKER.format(repo=REPO, port=port, nproc=NPROC,
+                            dev_per_proc=DEV_PER_PROC, n=N_QUBITS)
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.perf_counter()
+    procs = [subprocess.Popen([sys.executable, "-c", worker, str(i)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env,
+                              cwd=REPO)
+             for i in range(NPROC)]
+    results, errs = [], []
+    for p in procs:
+        out, _ = p.communicate(timeout=1800)
+        line = next((ln for ln in out.splitlines()
+                     if ln.startswith("RESULT ")), None)
+        if p.returncode != 0 or line is None:
+            errs.append(out[-1500:])
+        else:
+            results.append(json.loads(line[len("RESULT "):]))
+    wall = time.perf_counter() - t0
+
+    ok = (not errs and len(results) == NPROC
+          and all(abs(r["total_prob"] - 1.0) < 1e-4 for r in results))
+    art = {
+        "config": f"pod launch rehearsal: {NPROC} processes x "
+                  f"{DEV_PER_PROC} virtual devices, {N_QUBITS}q "
+                  "fused-mesh plan (XLA segment backend), real "
+                  "cross-process relayout exchanges",
+        "ok": ok,
+        "wall_seconds": round(wall, 2),
+        "per_process": results,
+        "launch_command": "examples/submissionScripts/"
+                          "tpu_pod_example.sh --rehearse",
+        "errors": errs,
+    }
+    from artifact_util import delta_note
+    if results:
+        art["delta_note"] = delta_note(
+            REPO, "REHEARSAL", rnd,
+            {"warm_run_seconds": ("per_process.0.warm_run_seconds",
+                                  results[0]["warm_run_seconds"])})
+    out_path = os.path.join(REPO, f"REHEARSAL_r{rnd:02d}.json")
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art, indent=1))
+    print(f"wrote {out_path}")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
