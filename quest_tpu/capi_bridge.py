@@ -114,6 +114,13 @@ def init(precision_code: int, platform: str = "cpu") -> int:
     # means "all visible devices".
     ndev = int(os.environ.get("QUEST_CAPI_DEVICES", "1"))
     _env = qt.create_env(num_devices=ndev if ndev > 0 else None)
+    # Kick off the speculative AOT executable upload NOW (backend is
+    # live): on the tunnelled 1-chip host the ~1-2 s device upload then
+    # overlaps the driver's startup + gate recording instead of sitting
+    # on the first flush's critical path (CDRIVER_r03 breakdown).
+    from .register import aot_speculative_preload
+
+    aot_speculative_preload()
     return 0
 
 

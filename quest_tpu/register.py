@@ -383,16 +383,10 @@ def _code_fingerprint() -> str:
     return _CODE_FP
 
 
-def _aot_load(ops: tuple, num_vec_qubits: int):
-    """Deserialize a previously-compiled stream program — ~0.3 s against
-    ~9 s to re-trace and compile (even with a warm XLA compile cache)
-    for the reference's 30-qubit driver stream."""
-    import os
+def _aot_load_path(path: str):
+    """Deserialize + device-load one blob file, or None on any failure."""
     import pickle
 
-    path = _aot_path(ops, num_vec_qubits)
-    if not path or not os.path.exists(path):
-        return None
     try:
         from jax.experimental.serialize_executable import (
             deserialize_and_load,
@@ -402,6 +396,84 @@ def _aot_load(ops: tuple, num_vec_qubits: int):
         return deserialize_and_load(blob, in_tree, out_tree)
     except Exception:
         return None  # stale/incompatible blob: fall through to compile
+
+
+#: (path, thread, holder) of an in-flight speculative blob load.
+_SPEC_AOT = None
+
+
+def aot_speculative_preload() -> None:
+    """Start deserialising the most-recently-USED stream blob on a
+    background thread.
+
+    On the tunnelled 1-chip host, ``deserialize_and_load`` spends ~1-2 s
+    uploading the executable to the device — the dominant warm-run cost
+    of a C driver process after the AOT cache removed trace+compile
+    (CDRIVER_r03 breakdown).  A C program's stream is almost always the
+    one it ran last time, so the bridge kicks the upload off at init,
+    overlapping it with the driver's own startup and gate recording;
+    ``_aot_load`` then adopts the loaded executable if the stream hash
+    matches, and falls back to a synchronous load if not.  Opt out with
+    QUEST_AOT_SPECULATE=0."""
+    global _SPEC_AOT
+    import os
+    import threading
+
+    if os.environ.get("QUEST_AOT_SPECULATE", "1") == "0":
+        return
+    d = os.environ.get("QUEST_AOT_CACHE")
+    if not d or not os.path.isdir(d) or _SPEC_AOT is not None:
+        return
+    try:
+        if len(jax.devices()) > 1:
+            return  # AOT fast path is 1-chip only (see _aot_path)
+    except Exception:
+        return
+    try:
+        blobs = sorted(
+            (os.path.join(d, n) for n in os.listdir(d)
+             if n.startswith("stream-")),
+            key=os.path.getmtime, reverse=True)
+    except OSError:
+        return
+    if not blobs:
+        return
+    path, holder = blobs[0], {}
+
+    def work():
+        holder["fn"] = _aot_load_path(path)
+
+    th = threading.Thread(target=work, daemon=True,
+                          name="quest-aot-preload")
+    th.start()
+    _SPEC_AOT = (path, th, holder)
+
+
+def _aot_load(ops: tuple, num_vec_qubits: int):
+    """Deserialize a previously-compiled stream program — ~0.3 s against
+    ~9 s to re-trace and compile (even with a warm XLA compile cache)
+    for the reference's 30-qubit driver stream.  Adopts the
+    speculatively-preloaded executable when its blob path matches."""
+    global _SPEC_AOT
+    import os
+
+    path = _aot_path(ops, num_vec_qubits)
+    if not path or not os.path.exists(path):
+        return None
+    fn = None
+    if _SPEC_AOT is not None and _SPEC_AOT[0] == path:
+        _, th, holder = _SPEC_AOT
+        th.join()
+        _SPEC_AOT = None
+        fn = holder.get("fn")
+    if fn is None:
+        fn = _aot_load_path(path)
+    if fn is not None:
+        try:
+            os.utime(path)  # keep most-recently-USED ordering fresh
+        except OSError:
+            pass
+    return fn
 
 
 def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int):

@@ -102,6 +102,13 @@ def main():
         "note": (
             "Warm-run breakdown on this tunnelled 1-chip host: ~0.3 s AOT executable load (the serialized stream program skips re-trace and compile entirely), ~1-2 s program upload through the tunnel, ~1.3 s execution of the fused gate stream, and ~3 batched readout fetches (the per-qubit probability table and the amplitude-prefix cache serve the driver's 30 calcProbOfOutcome + 10 getAmp calls; each device round trip costs ~90 ms here, so batching them is worth ~3.5 s). Sustained on-chip gate throughput is bench.py's figure; this artifact is the whole-process cost a C user observes."),
     }
+    from artifact_util import delta_note
+    art["delta_note"] = delta_note(REPO, "CDRIVER", rnd, {
+        "warm_gates_per_sec": ("warm.gates_per_sec",
+                               art["warm"]["gates_per_sec"]),
+        "cold_wall_seconds": ("cold.wall_seconds",
+                              art["cold"]["wall_seconds"]),
+    })
     out = os.path.join(REPO, f"CDRIVER_r{rnd:02d}.json")
     with open(out, "w") as f:
         json.dump(art, f, indent=1)

@@ -88,6 +88,10 @@ def main():
     }
     assert abs(trace - 1.0) < 1e-3, trace
     assert purity < 1.0
+    from artifact_util import delta_note
+    art["delta_note"] = delta_note(REPO, "DENSITY", rnd, {
+        "ops_per_sec": ("ops_per_sec", art["ops_per_sec"]),
+    })
     out = os.path.join(REPO, f"DENSITY_r{rnd:02d}.json")
     with open(out, "w") as f:
         json.dump(art, f, indent=1)

@@ -103,6 +103,11 @@ def main():
                     "schedule density. An estimate, not a measurement.",
         },
     }
+    from artifact_util import delta_note
+    art["delta_note"] = delta_note(REPO, "RANDOM34", rnd, {
+        "gates_per_sec": ("measured.gates_per_sec",
+                          art["measured"]["gates_per_sec"]),
+    })
     out = os.path.join(REPO, f"RANDOM34_r{rnd:02d}.json")
     with open(out, "w") as f:
         json.dump(art, f, indent=1)

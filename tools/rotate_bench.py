@@ -93,6 +93,11 @@ def main():
             t["synced_ms"] for t in per_target), 3),
         "per_target": per_target,
     }
+    from artifact_util import delta_note
+    art["delta_note"] = delta_note(REPO, "ROTATE", rnd, {
+        "streamed_ms_mean": ("streamed_ms_mean", art["streamed_ms_mean"]),
+        "synced_ms_mean": ("synced_ms_mean", art["synced_ms_mean"]),
+    })
     out = os.path.join(REPO, f"ROTATE_r{rnd:02d}.json")
     with open(out, "w") as f:
         json.dump(art, f, indent=1)

@@ -1,0 +1,131 @@
+"""On-chip compiled-measurement throughput: SAMPLE_r{N}.json.
+
+Workload: 20-qubit Bernstein-Vazirani with a full measurement layer
+(20 recorded measures), the round-3 flagship feature — measurement
+compiled INTO the program, outcomes drawn on device
+(quest_tpu.circuit.Circuit.measure).  Records shots/sec at 1, 8 and 64
+shots via ``Circuit.sample`` (vmapped shot batching: one compiled
+program, gate kernels batch across shots) against the eager per-shot
+loop (``Circuit.run`` once per shot — itself already compiled, but one
+dispatch + key per shot), and states the memory bound.
+
+Reference being beaten: a host RNG draw + full API re-entry per gate
+per shot (measure -> generateMeasurementOutcome, QuEST.c:578-590,
+QuEST_common.c:103-121).
+
+Usage: python tools/sample_bench.py [round]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N = int(os.environ.get("QUEST_SAMPLE_QUBITS", "20"))
+SECRET = 0b1011_0111_0110_0101 & ((1 << N) - 1)
+
+
+def main():
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+
+    import jax
+    import numpy as np
+
+    import quest_tpu as qt
+    from quest_tpu import models
+
+    env = qt.create_env()
+    dev = jax.devices()[0]
+    circ = models.bernstein_vazirani(N, SECRET)
+    for t in range(N):
+        circ.measure(t)
+
+    def check(outs):
+        outs = np.asarray(outs)
+        read = (outs * (1 << np.arange(N))).sum(axis=-1)
+        assert (read == SECRET).all(), "BV must read the secret"
+
+    # -- Circuit.sample: one vmapped compiled program per shot count
+    sample_rows = []
+    for shots in (1, 8, 64):
+        key = jax.random.PRNGKey(7)
+        outs = circ.sample(shots, key=key)      # compile + run
+        jax.block_until_ready(outs)
+        check(outs)
+        times = []
+        for r in range(3):
+            k = jax.random.PRNGKey(100 + r)
+            t0 = time.perf_counter()
+            outs = circ.sample(shots, key=k)
+            outs = np.asarray(outs)             # host fetch = real sync
+            times.append(time.perf_counter() - t0)
+        check(outs)
+        best = min(times)
+        sample_rows.append({
+            "shots": shots,
+            "seconds": round(best, 4),
+            "shots_per_sec": round(shots / best, 2),
+        })
+
+    # -- eager per-shot loop: Circuit.run per shot (compiled once, one
+    # dispatch + fresh key per shot — the shape of the reference's
+    # per-shot flow, minus its per-gate sweeps)
+    q = qt.create_qureg(N, env)
+    qt.init_zero_state(q)
+    outs = circ.run(q, key=jax.random.PRNGKey(0))   # compile
+    jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    per_shot_outs = []
+    SHOTS = 8
+    for s in range(SHOTS):
+        qt.init_zero_state(q)
+        per_shot_outs.append(np.asarray(
+            circ.run(q, key=jax.random.PRNGKey(200 + s))))
+    eager = time.perf_counter() - t0
+    check(np.stack(per_shot_outs))
+
+    state_bytes = 2 * (1 << N) * 4
+    art = {
+        "config": f"{N}q Bernstein-Vazirani + full measurement layer "
+                  f"({circ.num_gates} gates, {N} measures), f32",
+        "device": dev.device_kind,
+        "sample_vmapped": sample_rows,
+        "eager_per_shot": {
+            "shots": SHOTS,
+            "seconds": round(eager, 4),
+            "shots_per_sec": round(SHOTS / eager, 2),
+        },
+        "memory_bound": {
+            "bytes_per_shot": state_bytes,
+            "note": f"sample(shots) holds shots x {state_bytes >> 20} MiB "
+                    "of f32 amplitudes concurrently (vmapped states); "
+                    "64 shots at 20q = 1 GiB. The shot axis batches "
+                    "every gate kernel, so throughput rises with shots "
+                    "until HBM bounds the batch "
+                    "(~1800 shots at 20q on a 15.75 GiB chip).",
+        },
+        "path_note": "sample() uses the per-gate XLA kernels under vmap "
+                     "(documented Pallas block-spec shape constraint); "
+                     "the eager row is the same compiled program "
+                     "dispatched once per shot.",
+    }
+    from artifact_util import delta_note
+    art["delta_note"] = delta_note(
+        REPO, "SAMPLE", rnd,
+        {"shots64_per_sec": ("sample_vmapped.2.shots_per_sec",
+                             sample_rows[2]["shots_per_sec"])})
+    out = os.path.join(REPO, f"SAMPLE_r{rnd:02d}.json")
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art, indent=1))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
