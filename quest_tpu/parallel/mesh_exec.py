@@ -113,7 +113,8 @@ def plan_comm_stats(plan, num_vec_bits: int, dev_bits: int):
 
 
 def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
-                     interpret: bool = False, backend: str = "pallas"):
+                     interpret: bool = False, backend: str = "pallas",
+                     per_item: bool = False):
     """A pure (re, im) -> (re, im) function running the recorded ops as
     fused segments inside shard_map over ``mesh``, with relayout
     half-exchanges for sharded-qubit gates.  Input and output arrays are
@@ -123,7 +124,20 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
     interpreter mode) or "xla" (``apply_segment_xla`` — the same plan,
     segment bodies as plain XLA ops; this is how the full plan,
     relayouts included, executes at 24+ qubits on the virtual CPU
-    mesh, where interpret-mode Pallas is size-bound)."""
+    mesh, where interpret-mode Pallas is size-bound).
+
+    ``per_item=True`` jits each plan item as its own shard_map program
+    instead of one fused program over the whole plan: at 24+ qubits a
+    single XLA:CPU compile of a many-segment plan takes tens of
+    minutes, while per-item programs compile in seconds each (and
+    repeated structures hit jit's cache); dispatch overhead is noise
+    at these state sizes."""
+    return _mesh_plan_fn(ops, num_vec_bits, mesh, interpret, backend,
+                         per_item=per_item)
+
+
+def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
+                  backend: str, per_item: bool):
     from ..scheduler import schedule_mesh
     from ..ops.segment_xla import apply_segment_xla
 
@@ -135,32 +149,29 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
     chunk_bits = num_vec_bits - dev_bits
     plan = schedule_mesh(list(ops), num_vec_bits, dev_bits, lane_bits)
 
-    def body(re, im):
+    def item_body(item, re, im):
         dev = lax.axis_index(axis)
-        for item in plan:
-            if item[0] == "seg":
-                _, seg_ops, high, dev_masks = item
-                flags = None
-                if dev_masks:
-                    flags = jnp.stack(
-                        [(dev & dm) == dm for dm in dev_masks]
-                    ).astype(re.dtype).reshape(1, -1)
-                if backend == "xla":
-                    re, im = apply_segment_xla(re, im, seg_ops, high,
-                                               dev_flags=flags)
-                else:
-                    re, im = apply_fused_segment(
-                        re, im, seg_ops, high,
-                        interpret=interpret, dev_flags=flags)
-            else:
-                _, a, b = item
-                re = bitswap_chunk(re, a, b, dev, axis, ndev,
-                                   chunk_bits, lane_bits)
-                im = bitswap_chunk(im, a, b, dev, axis, ndev,
-                                   chunk_bits, lane_bits)
+        if item[0] == "seg":
+            _, seg_ops, high, dev_masks = item
+            flags = None
+            if dev_masks:
+                flags = jnp.stack(
+                    [(dev & dm) == dm for dm in dev_masks]
+                ).astype(re.dtype).reshape(1, -1)
+            if backend == "xla":
+                return apply_segment_xla(re, im, seg_ops, high,
+                                         dev_flags=flags)
+            return apply_fused_segment(re, im, seg_ops, high,
+                                       interpret=interpret,
+                                       dev_flags=flags)
+        _, a, b = item
+        re = bitswap_chunk(re, a, b, dev, axis, ndev,
+                           chunk_bits, lane_bits)
+        im = bitswap_chunk(im, a, b, dev, axis, ndev,
+                           chunk_bits, lane_bits)
         return re, im
 
-    def fn(re, im):
+    def shmap(body):
         # check_vma=False: pallas_call's out_shape carries no varying-
         # mesh-axes annotation, and every output here is trivially
         # per-shard (specs are all P(axis)).
@@ -169,6 +180,29 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
             in_specs=(P(axis), P(axis)),
             out_specs=(P(axis), P(axis)),
             check_vma=False,
-        )(re, im)
+        )
+
+    if per_item:
+        import functools
+
+        item_fns = [
+            jax.jit(shmap(functools.partial(item_body, item)))
+            for item in plan
+        ]
+
+        def fn(re, im):
+            for f in item_fns:
+                re, im = f(re, im)
+            return re, im
+
+        return fn
+
+    def body(re, im):
+        for item in plan:
+            re, im = item_body(item, re, im)
+        return re, im
+
+    def fn(re, im):
+        return shmap(body)(re, im)
 
     return fn

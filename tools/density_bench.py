@@ -36,7 +36,7 @@ def main():
     def sync():
         return float(rho.re[0, 0])
 
-    def one_round(count: bool):
+    def one_round(count: bool, do_sync: bool = True):
         # gates AND channels share one deferred stream (round 3: dm_chan
         # joins the fused Pallas segments), so a round is ONE flush at
         # the closing sync — no mid-round host round trip
@@ -56,7 +56,8 @@ def main():
         qt.apply_two_qubit_depolarise_error(rho, 2, 3, 0.02)
         if count:
             n_channels += 2
-        sync()
+        if do_sync:
+            sync()
 
     n_gates = n_channels = 0
     one_round(False)  # warm-up: compiles every (kernel, target) combo
@@ -64,7 +65,22 @@ def main():
     t0 = time.perf_counter()
     for r in range(ROUNDS):
         one_round(True)
-    secs = time.perf_counter() - t0
+    secs_synced = time.perf_counter() - t0
+
+    # The same workload DEFERRED: all rounds queue into one stream, one
+    # flush, ONE host sync at the end — the natural eager-API usage when
+    # nothing reads state between rounds.  On this tunnelled host a
+    # device->host sync costs ~90 ms, so the per-round-sync figure above
+    # is tunnel-bound, not chip-bound (docs/PERFORMANCE.md, density
+    # roofline section).
+    for r in range(ROUNDS):           # warm-up: compile the 4-round
+        one_round(False, do_sync=False)  # deferred stream once
+    sync()
+    t0 = time.perf_counter()
+    for r in range(ROUNDS):
+        one_round(False, do_sync=False)
+    sync()
+    secs_deferred = time.perf_counter() - t0
 
     trace = qt.calc_total_prob(rho)
     purity = qt.calc_purity(rho)
@@ -73,8 +89,20 @@ def main():
                   f"{2 * (1 << (2 * N)) * 4 / 2**30:.2f} GiB f32)",
         "gates": n_gates,
         "channels": n_channels,
-        "seconds": round(secs, 3),
-        "ops_per_sec": round((n_gates + n_channels) / secs, 1),
+        "seconds": round(secs_deferred, 3),
+        "ops_per_sec": round((n_gates + n_channels) / secs_deferred, 1),
+        "headline_statistic": "all rounds deferred, one flush + one "
+                              "host sync (the natural eager-API form "
+                              "when nothing reads between rounds)",
+        "sync_each_round_seconds": round(secs_synced, 3),
+        "ops_per_sec_sync_each_round": round(
+            (n_gates + n_channels) / secs_synced, 1),
+        "sync_note": "a device->host sync costs ~90 ms on this "
+                     "tunnelled host; syncing every round (the r02/r03 "
+                     "statistic, kept above for comparability) spends "
+                     "~35% of its wall time in the tunnel, not the "
+                     "chip — the on-chip pass rate is floor-bound "
+                     "either way (docs/PERFORMANCE.md).",
         "trace_after": trace,
         "purity_after": purity,
         "note": "Gates (U (x) U* double ops) AND noise channels run in "

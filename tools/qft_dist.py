@@ -179,7 +179,10 @@ sh = NamedSharding(mesh, P(AMP_AXIS))
 circ = models.qft(n)
 # THE PLAN, EXECUTED: schedule_mesh segments with per-chunk XLA bodies
 # and the planned bitswap_chunk half-exchanges actually performed.
-fn = jax.jit(as_mesh_fused_fn(list(circ.ops), n, mesh, backend="xla"))
+# per_item: one giant XLA:CPU program over the whole 26q plan takes
+# tens of minutes to compile; per-item programs compile in seconds.
+fn = as_mesh_fused_fn(list(circ.ops), n, mesh, backend="xla",
+                      per_item=True)
 shape = state_shape(1 << n, ndev)
 lanes = shape[1]
 x = (0b1011 << (n - 8)) | 0b1101
@@ -283,20 +286,27 @@ def pod_memory_model(n: int = 34):
 
 def main():
     rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    # QUEST_QFT_PARTS=virtual (etc.) runs a subset while debugging, so a
+    # retry never re-burns the ~5 min real-chip phase.
+    parts = os.environ.get("QUEST_QFT_PARTS", "real,virtual,model")
     art = {"config": "QFT 34 qubits, distributed state-vector sharded "
                      "across pod (BASELINE.json configs[4])"}
-    art["real_chip"] = run_real_chip()
-    art["virtual_mesh_sharded"] = run_virtual_mesh()
-    art["pod_model_34q"] = pod_memory_model()
-    from artifact_util import delta_note
-    art["delta_note"] = delta_note(REPO, "QFT", rnd, {
-        "sustained_gates_per_sec":
-            ("real_chip.sustained_gates_per_sec",
-             art["real_chip"]["sustained_gates_per_sec"]),
-        "single_shot_seconds":
-            ("real_chip.single_shot_seconds",
-             art["real_chip"]["single_shot_seconds"]),
-    })
+    if "real" in parts:
+        art["real_chip"] = run_real_chip()
+    if "virtual" in parts:
+        art["virtual_mesh_sharded"] = run_virtual_mesh()
+    if "model" in parts:
+        art["pod_model_34q"] = pod_memory_model()
+    if "real_chip" in art:
+        from artifact_util import delta_note
+        art["delta_note"] = delta_note(REPO, "QFT", rnd, {
+            "sustained_gates_per_sec":
+                ("real_chip.sustained_gates_per_sec",
+                 art["real_chip"]["sustained_gates_per_sec"]),
+            "single_shot_seconds":
+                ("real_chip.single_shot_seconds",
+                 art["real_chip"]["single_shot_seconds"]),
+        })
     out = os.path.join(REPO, f"QFT_r{rnd:02d}.json")
     with open(out, "w") as f:
         json.dump(art, f, indent=1)
