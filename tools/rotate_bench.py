@@ -54,6 +54,19 @@ def main():
     def sync():
         _ = float(q.re[0, 0])  # host read = real sync under the tunnel
 
+    # Bare tunnel round trip: an element read of an ALREADY-FLUSHED
+    # state.  The synced per-gate statistic below is dominated by this
+    # (measured ~108 of ~120 ms in round 4) — which is why it drifts
+    # round-over-round with ambient tunnel latency (r02 111 -> r03 131
+    # ms) while the chip-bound streamed statistic moves independently.
+    sync()
+    rtts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        sync()
+        rtts.append(time.perf_counter() - t0)
+    tunnel_rtt_ms = round(statistics.mean(rtts) * 1e3, 2)
+
     per_target = []
     for target in range(N_QUBITS):
         # warm-up: first flush of this structure may compile
@@ -92,6 +105,11 @@ def main():
         "synced_ms_mean": round(statistics.mean(
             t["synced_ms"] for t in per_target), 3),
         "per_target": per_target,
+        "tunnel_rtt_ms": tunnel_rtt_ms,
+        "synced_note": "synced_ms ~= tunnel_rtt_ms + one fused pass; "
+                       "subtract tunnel_rtt_ms before comparing rounds "
+                       "(the tunnel drifts; r02->r03's 111->131 ms was "
+                       "tunnel, not executor — streamed improved).",
     }
     from artifact_util import delta_note
     art["delta_note"] = delta_note(REPO, "ROTATE", rnd, {
