@@ -29,6 +29,8 @@ RECORDERS = [
     ("random34.py", []),
     ("scaling_bench.py", []),
     ("density_bench.py", []),
+    ("sample_bench.py", []),
+    ("pod_rehearsal.py", []),
     ("scale_smoke.py", []),
     # full-size soak: anything smaller overwrites the recorded
     # 6000-op artifact with a weaker one
