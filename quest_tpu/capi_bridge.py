@@ -118,9 +118,10 @@ def init(precision_code: int, platform: str = "cpu") -> int:
     # live): on the tunnelled 1-chip host the ~1-2 s device upload then
     # overlaps the driver's startup + gate recording instead of sitting
     # on the first flush's critical path (CDRIVER_r03 breakdown).
-    from .register import aot_speculative_preload
+    from .register import _trace, aot_speculative_preload
 
     aot_speculative_preload()
+    _trace("bridge init done (speculative preload started)")
     return 0
 
 
@@ -142,6 +143,14 @@ def _int_view(ptr: int, n: int) -> list[int]:
 
 
 def createQuESTEnv() -> int:
+    return 0
+
+
+def speculationBarrier() -> int:
+    """Join the speculative preload thread (shim eager-init ctor)."""
+    from .register import spec_join
+
+    spec_join()
     return 0
 
 
@@ -197,6 +206,9 @@ def _register(q) -> int:
 
 
 def createQureg(num_qubits: int) -> int:
+    from .register import _trace
+
+    _trace(f"createQureg({num_qubits})")
     return _register(_qt.create_qureg(num_qubits, _env))
 
 

@@ -35,6 +35,8 @@ def _prob_table(qureg: Qureg) -> np.ndarray:
     re, im = qureg.re, qureg.im  # property reads flush pending gates
     tab = qureg._readout.get("p0")
     if tab is None:
+        from ..register import _trace
+        _trace("prob table build start")
         if qureg.is_density:
             vec = run_kernel(
                 (re, im), (), kind="dm_prob_zero_all",
@@ -50,6 +52,8 @@ def _prob_table(qureg: Qureg) -> np.ndarray:
         import jax
 
         tab = np.asarray(jax.device_get(vec), dtype=np.float64)
+        from ..register import _trace
+        _trace("prob table fetched")
         qureg._readout["p0"] = tab
     return tab
 
