@@ -735,6 +735,11 @@ def _alloc(num_qubits: int, is_density: bool, env: QuESTEnv, dtype) -> Qureg:
         re = _LazyZero(shape, dtype)
         im = _LazyZero(shape, dtype)
     else:
+        # allocating a non-matching register: release any speculative
+        # result FIRST — a held full-size pair plus this allocation
+        # could exceed HBM (e.g. a 29q density register after a 30q
+        # speculated run)
+        _spec_exec_drop()
         build = _init_builder("classical", shape, dtype, env.mesh)
         re, im = build(0)
     q = Qureg(re, im, num_qubits, is_density, env.mesh)

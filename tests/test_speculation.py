@@ -108,3 +108,18 @@ def test_spec_pending_requires_matching_config():
     assert not reg._spec_exec_pending(n + 1, jnp.float32, None)
     assert not reg._spec_exec_pending(n, jnp.float64, None)
     assert not reg._spec_exec_pending(n, jnp.float32, object())
+
+
+def test_nonmatching_alloc_drops_speculation():
+    """Allocating a register that can't adopt the speculation releases
+    the held result first — a full-size speculative pair plus a fresh
+    full-size allocation must never coexist in HBM."""
+    env = qt.create_env(num_devices=1)
+    from quest_tpu.ops.lattice import state_shape
+
+    shape = state_shape(1 << 6)
+    _fake_spec(((("v",),), 6, jnp.dtype(jnp.float32)),
+               (jnp.zeros(shape, jnp.float32),
+                jnp.zeros(shape, jnp.float32)))
+    qt.create_qureg(7, env, dtype=jnp.float32)   # different size
+    assert reg._SPEC_EXEC is None
