@@ -20,6 +20,7 @@
 #include <pthread.h>
 #include <stdarg.h>
 #include <stdio.h>
+#include <unistd.h>
 #include <stdlib.h>
 #include <string.h>
 
@@ -126,13 +127,28 @@ static void ensure_bridge(void) {
  * speculative re-execution) all happen before the first user
  * instruction, which is how a natively-linked simulator behaves.  The
  * ctypes-in-process case is unaffected in substance: the same init ran
- * on first API call anyway.  Programs that must configure QUEST_CAPI_*
- * env vars from inside main() can opt out with QUEST_CAPI_EAGER_INIT=0
- * (the boot then happens, as before, on the first API call). */
+ * on first API call anyway.  Programs that configure ANY QUEST_CAPI_*
+ * knob or QUEST_TPU_ROOT from inside main() (instead of the
+ * environment) must opt out with QUEST_CAPI_EAGER_INIT=0 in the
+ * environment — main() has not run yet here, so their setenv calls
+ * cannot be seen (the boot then happens, as before, on the first API
+ * call).  As a guard for the commonest such pattern, eager init is
+ * skipped when the package root does not resolve yet: first-call init
+ * then honours a QUEST_TPU_ROOT exported from main. */
 __attribute__((constructor)) static void quest_capi_eager_init(void) {
     const char *e = getenv("QUEST_CAPI_EAGER_INIT");
     if (e && e[0] == '0' && e[1] == '\0')
         return;
+    {
+        const char *root = getenv("QUEST_TPU_ROOT");
+        if (!root)
+            root = QUEST_TPU_ROOT;
+        char probe[4096];
+        snprintf(probe, sizeof probe,
+                 "%s/quest_tpu/capi_bridge.py", root);
+        if (access(probe, R_OK) != 0)
+            return; /* unresolvable root: defer init to the first call */
+    }
     ensure_bridge();
     /* Block until the speculative warm path (executable upload, stream
      * re-execution, readout pre-warm) completes: everything lands

@@ -185,10 +185,18 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
     if per_item:
         import functools
 
-        item_fns = [
-            jax.jit(shmap(functools.partial(item_body, item)))
-            for item in plan
-        ]
+        # one jitted program per UNIQUE plan item: repeated relayouts
+        # and structurally identical segments reuse the same compiled
+        # function (jit caches per function identity, so a fresh
+        # partial per occurrence would recompile each time)
+        unique: dict = {}
+        item_fns = []
+        for item in plan:
+            f = unique.get(item)
+            if f is None:
+                f = jax.jit(shmap(functools.partial(item_body, item)))
+                unique[item] = f
+            item_fns.append(f)
 
         def fn(re, im):
             for f in item_fns:

@@ -253,7 +253,12 @@ class Qureg:
                 if adopted is not None:
                     _trace("speculative stream result ADOPTED")
                     (self._re, self._im), readout = adopted
-                    if readout and not self.is_density:
+                    # install the pre-warmed readout caches ONLY when
+                    # nothing else is queued: a pending collapse/channel
+                    # would mutate the state right after, and the chain
+                    # path updates buffers directly (readout was cleared
+                    # at defer time, so stale caches would survive)
+                    if readout and not self.is_density                             and not self._pending:
                         self._readout.update(readout)
                     return
                 self._materialize()
@@ -563,7 +568,7 @@ def aot_speculative_preload() -> None:
     try:
         blobs = sorted(
             (os.path.join(d, n) for n in os.listdir(d)
-             if n.startswith("stream-")),
+             if n.startswith("stream-") and n.endswith(".pkl")),
             key=os.path.getmtime, reverse=True)
     except OSError:
         return
@@ -690,16 +695,18 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int):
                          jnp.dtype(jnp.float32).name), f)
         os.replace(tmp, path + ".meta")
         # bound the cache: blobs are ~20 MB each; keep the newest 32
+        # (.meta sidecars travel with their blob, not counted)
         d = os.path.dirname(path)
         blobs = sorted(
             (os.path.join(d, n) for n in os.listdir(d)
-             if n.startswith("stream-")),
+             if n.startswith("stream-") and n.endswith(".pkl")),
             key=os.path.getmtime, reverse=True)
         for stale in blobs[32:]:
-            try:
-                os.remove(stale)
-            except OSError:
-                pass
+            for victim in (stale, stale + ".meta"):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass
     except Exception:
         pass  # persistence failed; the executable itself is still good
     return compiled

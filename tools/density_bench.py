@@ -117,8 +117,13 @@ def main():
     assert abs(trace - 1.0) < 1e-3, trace
     assert purity < 1.0
     from artifact_util import delta_note
+    # like-for-like drift: previous rounds' ops_per_sec IS the
+    # sync-each-round statistic (the headline was redefined in r04 to
+    # the deferred one-sync form; comparing across definitions would
+    # manufacture a spurious delta)
     art["delta_note"] = delta_note(REPO, "DENSITY", rnd, {
-        "ops_per_sec": ("ops_per_sec", art["ops_per_sec"]),
+        "ops_per_sec_sync_each_round":
+            ("ops_per_sec", art["ops_per_sec_sync_each_round"]),
     })
     out = os.path.join(REPO, f"DENSITY_r{rnd:02d}.json")
     with open(out, "w") as f:
