@@ -606,6 +606,8 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
 
             def mul(x, m):
                 flat = x.reshape(-1, sh[-1])
+                if split3:
+                    return _dot3(flat, m).reshape(sh)
                 return jnp.dot(flat, m, precision=hi,
                                preferred_element_type=dtype).reshape(sh)
 
@@ -647,6 +649,17 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         def rowmul(v, m_ix):
             mb = jnp.broadcast_to(mats[m_ix], (lead, rr, rr))
             w = v.reshape(lead, rr, shape[-1])
+            if split3:
+                mh = mb.astype(jnp.bfloat16)
+                ml = (mb - mh.astype(dtype)).astype(jnp.bfloat16)
+                wh = w.astype(jnp.bfloat16)
+                wl = (w - wh.astype(dtype)).astype(jnp.bfloat16)
+                return (lax.dot_general(mh, wh, dn,
+                                        preferred_element_type=dtype)
+                        + lax.dot_general(mh, wl, dn,
+                                          preferred_element_type=dtype)
+                        + lax.dot_general(ml, wh, dn,
+                                          preferred_element_type=dtype))
             return lax.dot_general(mb, w, dn, precision=hi,
                                    preferred_element_type=dtype)
 
