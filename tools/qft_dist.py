@@ -291,6 +291,15 @@ def main():
     parts = os.environ.get("QUEST_QFT_PARTS", "real,virtual,model")
     art = {"config": "QFT 34 qubits, distributed state-vector sharded "
                      "across pod (BASELINE.json configs[4])"}
+    # partial runs UPDATE this round's existing artifact (so a quick
+    # real-chip refresh never drops the expensive virtual-mesh section)
+    prev_path = os.path.join(REPO, f"QFT_r{rnd:02d}.json")
+    if os.path.exists(prev_path) and parts != "real,virtual,model":
+        try:
+            with open(prev_path) as f:
+                art.update(json.load(f))
+        except Exception:
+            pass
     if "real" in parts:
         art["real_chip"] = run_real_chip()
     if "virtual" in parts:
