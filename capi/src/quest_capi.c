@@ -50,8 +50,17 @@ static void fatal(const char *what) {
  * Two modes: embedded in a plain C program (we own Py_Initialize), or
  * loaded via ctypes into an already-running Python process (e.g. the
  * QuESTPy golden-test harness), where the interpreter and quest_tpu
- * already exist and only the import is needed. */
-static void ensure_bridge_once(void) {
+ * already exist and only the import is needed.
+ *
+ * ``soft`` selects the failure policy: 0 = print-and-exit (the
+ * reference's exitWithError behaviour — right for API calls, where the
+ * program cannot proceed), 1 = clean up and return -1 so the caller can
+ * defer (right for the load-time constructor: a binary that merely
+ * LINKS the shim must not die before main() just because the bridge
+ * could not boot; the first real API call retries and, if it still
+ * fails, exits with the full diagnostic). */
+static int bridge_boot(int soft) {
+    const char *failed = NULL;
     /* Configure JAX before the interpreter first imports it, and enable
      * x64 when qreal is double.  Platform policy by precision:
      *   PREC=1 (float): f32 is accelerator-native, so AUTO-select the
@@ -88,13 +97,18 @@ static void ensure_bridge_once(void) {
     {
         PyObject *sys_path = PySys_GetObject("path"); /* borrowed */
         PyObject *entry = sys_path ? PyUnicode_FromString(root) : NULL;
-        if (!entry || PyList_Insert(sys_path, 0, entry) < 0)
-            fatal("sys.path setup");
+        if (!entry || PyList_Insert(sys_path, 0, entry) < 0) {
+            Py_XDECREF(entry);
+            failed = "sys.path setup";
+            goto fail;
+        }
         Py_DECREF(entry);
     }
     bridge = PyImport_ImportModule("quest_tpu.capi_bridge");
-    if (!bridge)
-        fatal("import quest_tpu.capi_bridge");
+    if (!bridge) {
+        failed = "import quest_tpu.capi_bridge";
+        goto fail;
+    }
     /* Pass the platform explicitly: in the ctypes-in-process case the
      * interpreter's os.environ snapshot predates our setenv above.  An
      * empty string means "machine default" (the bridge then leaves the
@@ -107,16 +121,46 @@ static void ensure_bridge_once(void) {
                                       "cpu"
 #endif
                                       );
-    if (!r)
-        fatal("capi_bridge.init");
+    if (!r) {
+        Py_CLEAR(bridge); /* retry boots from scratch */
+        failed = "capi_bridge.init";
+        goto fail;
+    }
     Py_DECREF(r);
     PyGILState_Release(g);
+    return 0;
+
+fail:
+    if (!soft)
+        fatal(failed);
+    fprintf(stderr,
+            "QuEST-TPU: %s failed during library load; "
+            "deferring init to the first API call\n", failed);
+    PyErr_Clear();
+    PyGILState_Release(g);
+    return -1;
 }
 
-static pthread_once_t bridge_once = PTHREAD_ONCE_INIT;
+static pthread_mutex_t bridge_mu = PTHREAD_MUTEX_INITIALIZER;
+static int bridge_ok = 0;
 
 static void ensure_bridge(void) {
-    pthread_once(&bridge_once, ensure_bridge_once);
+    pthread_mutex_lock(&bridge_mu);
+    if (!bridge_ok && bridge_boot(0) == 0)
+        bridge_ok = 1;
+    pthread_mutex_unlock(&bridge_mu);
+}
+
+/* Constructor-time variant: returns whether the bridge is up instead of
+ * exiting the (not-yet-started) host program on failure. */
+static int ensure_bridge_soft(void) {
+    int ok;
+    pthread_mutex_lock(&bridge_mu);
+    if (!bridge_ok && bridge_boot(1) == 0)
+        bridge_ok = 1;
+    ok = bridge_ok;
+    pthread_mutex_unlock(&bridge_mu);
+    return ok;
 }
 
 /* Boot the embedded interpreter — and with it the bridge's speculative
@@ -149,7 +193,9 @@ __attribute__((constructor)) static void quest_capi_eager_init(void) {
         if (access(probe, R_OK) != 0)
             return; /* unresolvable root: defer init to the first call */
     }
-    ensure_bridge();
+    if (!ensure_bridge_soft())
+        return; /* boot failed at load: the first API call retries and
+                 * reports the failure with exit semantics */
     /* Block until the speculative warm path (executable upload, stream
      * re-execution, readout pre-warm) completes: everything lands
      * before main() starts its clock. */

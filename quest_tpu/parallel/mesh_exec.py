@@ -94,6 +94,19 @@ def bitswap_chunk(x, a: int, b: int, dev, axis: str, ndev: int,
     return jnp.stack([new0, new1], axis=ax2).reshape(x.shape)
 
 
+def _item_key(obj):
+    """Hashable structural key for a plan item: ndarray leaves become
+    (shape, dtype, raw bytes); containers recurse; everything else must
+    already be hashable (ints, strs, floats, None)."""
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        return ("__nd__", obj.shape, obj.dtype.str, obj.tobytes())
+    if isinstance(obj, (tuple, list)):
+        return (type(obj).__name__,) + tuple(_item_key(o) for o in obj)
+    return obj
+
+
 def plan_comm_stats(plan, num_vec_bits: int, dev_bits: int):
     """Communication volume of a mesh plan, in units of one device's
     chunk (per device): half-exchanges count 0.5, device-device swaps 1.
@@ -188,14 +201,18 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
         # one jitted program per UNIQUE plan item: repeated relayouts
         # and structurally identical segments reuse the same compiled
         # function (jit caches per function identity, so a fresh
-        # partial per occurrence would recompile each time)
+        # partial per occurrence would recompile each time).  Segment
+        # items carry numpy matrices (lanemm/rowmm/dtab), which are
+        # unhashable — the memo key replaces every ndarray leaf with
+        # (shape, dtype, bytes).
         unique: dict = {}
         item_fns = []
         for item in plan:
-            f = unique.get(item)
+            key = _item_key(item)
+            f = unique.get(key)
             if f is None:
                 f = jax.jit(shmap(functools.partial(item_body, item)))
-                unique[item] = f
+                unique[key] = f
             item_fns.append(f)
 
         def fn(re, im):

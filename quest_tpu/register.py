@@ -258,7 +258,9 @@ class Qureg:
                     # would mutate the state right after, and the chain
                     # path updates buffers directly (readout was cleared
                     # at defer time, so stale caches would survive)
-                    if readout and not self.is_density                             and not self._pending:
+                    if (readout
+                            and not self.is_density
+                            and not self._pending):
                         self._readout.update(readout)
                     return
                 self._materialize()
@@ -266,7 +268,8 @@ class Qureg:
                 # One fused program per unique stream, buffers donated —
                 # the state is updated strictly in place (a 30q f32
                 # register needs one 8 GiB buffer pair, not two).
-                fn = _stream_fn(ops, self.num_vec_qubits, self.mesh)
+                fn = _stream_fn(ops, self.num_vec_qubits, self.mesh,
+                                self._re.dtype)
                 _trace("stream dispatch")
                 self._re, self._im = fn(self._re, self._im)
                 _trace("stream dispatched (async)")
@@ -386,10 +389,12 @@ def _trace(msg: str) -> None:
               file=sys.stderr, flush=True)
 
 
-def _stream_fn(ops: tuple, num_vec_qubits: int, mesh):
+def _stream_fn(ops: tuple, num_vec_qubits: int, mesh, dtype=jnp.float32):
+    dtype = jnp.dtype(dtype)
+
     def build():
         _trace(f"stream build start ({len(ops)} ops)")
-        fn = mesh is None and _aot_load(ops, num_vec_qubits)
+        fn = mesh is None and _aot_load(ops, num_vec_qubits, dtype)
         if fn:
             _trace("stream AOT-loaded")
         if not fn:
@@ -399,15 +404,15 @@ def _stream_fn(ops: tuple, num_vec_qubits: int, mesh):
             c.ops = list(ops)
             fn = c.compile(mesh=mesh, donate=True, pallas=True)
             if mesh is None:
-                fn = _aot_save(fn, ops, num_vec_qubits) or fn
+                fn = _aot_save(fn, ops, num_vec_qubits, dtype) or fn
             _trace("stream compiled+saved")
         return fn
 
-    return lru_get(_STREAM_CACHE, (ops, num_vec_qubits, mesh),
+    return lru_get(_STREAM_CACHE, (ops, num_vec_qubits, mesh, dtype),
                    _STREAM_CACHE_MAX, build)
 
 
-def _aot_path(ops: tuple, num_vec_qubits: int):
+def _aot_path(ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
     """Cache file for a serialized stream executable, or None when the
     AOT cache is off (QUEST_AOT_CACHE unset).  Scalars are burned into
     the program, so the key hashes the COMPLETE op stream plus
@@ -423,7 +428,8 @@ def _aot_path(ops: tuple, num_vec_qubits: int):
         # local device; the AOT fast path is for the 1-chip case
         return None
     dev = jax.devices()[0]
-    tag = repr((ops, num_vec_qubits, jax.__version__, dev.platform,
+    tag = repr((ops, num_vec_qubits, jnp.dtype(dtype).name,
+                jax.__version__, dev.platform,
                 dev.device_kind, _code_fingerprint()))
     h = hashlib.sha256(tag.encode()).hexdigest()[:32]
     os.makedirs(d, exist_ok=True)
@@ -635,7 +641,7 @@ def aot_speculative_preload() -> None:
                       "holder": exec_holder, "thread": th}
 
 
-def _aot_load(ops: tuple, num_vec_qubits: int):
+def _aot_load(ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
     """Deserialize a previously-compiled stream program — ~0.3 s against
     ~9 s to re-trace and compile (even with a warm XLA compile cache)
     for the reference's 30-qubit driver stream.  Adopts the
@@ -643,7 +649,7 @@ def _aot_load(ops: tuple, num_vec_qubits: int):
     global _SPEC_AOT
     import os
 
-    path = _aot_path(ops, num_vec_qubits)
+    path = _aot_path(ops, num_vec_qubits, dtype)
     if not path or not os.path.exists(path):
         return None
     fn = None
@@ -662,21 +668,21 @@ def _aot_load(ops: tuple, num_vec_qubits: int):
     return fn
 
 
-def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int):
+def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
     """Compile ``jit_fn`` ahead-of-time, persist the executable, and
     return the Compiled (callable like the jitted fn, aliasing kept)."""
     import os
     import pickle
     import tempfile
 
-    path = _aot_path(ops, num_vec_qubits)
+    path = _aot_path(ops, num_vec_qubits, dtype)
     if not path:
         return None
     try:
         from .ops.lattice import state_shape
 
         shape = state_shape(1 << num_vec_qubits)
-        aval = jax.ShapeDtypeStruct(shape, jnp.float32)
+        aval = jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
         compiled = jit_fn.lower(aval, aval).compile()
     except Exception:
         return None  # explicit AOT compile unsupported: plain jit serves
@@ -692,7 +698,7 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int):
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
         with os.fdopen(fd, "wb") as f:
             pickle.dump((ops, num_vec_qubits,
-                         jnp.dtype(jnp.float32).name), f)
+                         jnp.dtype(dtype).name), f)
         os.replace(tmp, path + ".meta")
         # bound the cache: blobs are ~20 MB each; keep the newest 32
         # (.meta sidecars travel with their blob, not counted)
