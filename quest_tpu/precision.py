@@ -68,6 +68,21 @@ def real_eps(dtype) -> float:
     return _REAL_EPS[jnp.dtype(dtype)]
 
 
+def norm_drift_bound(n_ops: int, dtype) -> float:
+    """Expected-accumulation bound on |total_prob - 1| after ``n_ops``
+    unitary gate applications: linear worst-case roundoff growth in
+    MACHINE epsilon with a 16x constant for the per-gate arithmetic and
+    the closing norm reduction.  This is an expectation bound for
+    artifacts that print a norm (drift inside it is ordinary
+    floating-point accumulation, not error) — distinct from
+    register._norm_check's QUEST_DEBUG_NORM guardrail, which is
+    deliberately loose (64 * n * REAL_EPS) so only genuine kernel bugs
+    trip it."""
+    import numpy as np
+
+    return 16 * max(n_ops, 1) * float(np.finfo(np.dtype(dtype)).eps)
+
+
 def enable_double_precision() -> None:
     """Enable f64 support in JAX and make it the default register precision."""
     jax.config.update("jax_enable_x64", True)
