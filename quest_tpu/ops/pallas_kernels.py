@@ -239,6 +239,11 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
             _, mr, mi = op
             planned.append(add_mm("rowmm", np.asarray(mr),
                                   np.asarray(mi)))
+        elif op[0] == "expmm":
+            _, axes, mr, mi = op
+            planned.append(("expmm", tuple(axes))
+                           + add_mm("m", np.asarray(mr),
+                                    np.asarray(mi))[1:])
         elif op[0] == "dtab":
             _, tr, ti = op
             planned.append(("dtab", add_mat(np.asarray(tr)),
@@ -277,7 +282,7 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     # previous mm.  Touch sets: lanemm = lane bits; rowmm = low rows;
     # lanemmc = lanes + its conditioning bits; moving past an op
     # requires disjoint touch sets.
-    _MM = ("lanemm", "lanemmc", "rowmm")
+    _MM = ("lanemm", "lanemmc", "rowmm", "expmm")
     if any(op[0] in _MM for op in planned) \
             and any(op[0] not in _MM for op in planned):
         lane_mask = (1 << lane_bits) - 1
@@ -289,6 +294,12 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
                 return lane_mask
             if kind == "rowmm":
                 return row_mask
+            if kind == "expmm":
+                m = 0
+                for b, a in high_axis.items():
+                    if a in op[1]:
+                        m |= 1 << (b + lane_bits)
+                return m
             if kind == "lanemmc":
                 m = lane_mask
                 for b in op[1]:
@@ -704,6 +715,53 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
                     jnp.concatenate([n0i, n1i], ax))
 
         return recurse(r, i, 0, 0)
+    if kind == "expmm":
+        # Composed operator over a SUBSET of exposed axes as one MXU
+        # contraction: a run of exposed-axis 2x2s/CZs/phases composes on
+        # the host into a (2^j, 2^j) matrix applied per remaining-index
+        # column.  A chain of exposed 2x2s costs ~2.6 ms each on the VPU
+        # serial spine at 30q (round-5 probes, tools/probe50.py) while
+        # the MXU has capacity; composed, the whole run costs 2 (real)
+        # or 3 (Gauss complex) 2^j-dot passes.  j=7 (128-dim) matches
+        # the MXU contraction width — a 256-dim operator costs double.
+        # Exact: the non-participating index bits are untouched by the
+        # contraction (they become dot columns).
+        _, axes, mr_ix, mi_ix, ms_ix = op
+        sh = r.shape
+        lanes_n = sh[-1]
+        axes = tuple(axes)
+        two_j = 1 << len(axes)
+        # Non-participating axes BEFORE the last participating axis are
+        # sliced to size-1 leaves; everything AFTER (trailing exposed
+        # axes, the c_blk axis, lanes) merges into the dot's column
+        # dimension — fewer, wider dots per block.
+        other = [a for a in range(len(sh) - 1)
+                 if a not in axes and a < max(axes)]
+        tail = 1
+        for a in range(max(axes) + 1, len(sh)):
+            tail *= sh[a]
+
+        def emul(x, m):
+            def rec(v, rest):
+                if not rest:
+                    vsh = v.shape
+                    ys = jnp.dot(m, v.reshape(two_j, tail),
+                                 precision=hi,
+                                 preferred_element_type=dtype)
+                    return ys.reshape(vsh)
+                ax = rest[0]
+                parts = [rec(lax.index_in_dim(v, s, ax, keepdims=True),
+                             rest[1:]) for s in range(v.shape[ax])]
+                return jnp.concatenate(parts, axis=ax)
+            return rec(x, other)
+
+        mr = mats[mr_ix]
+        if mi_ix < 0:
+            return emul(r, mr), emul(i, mr)
+        t1 = emul(r, mr)
+        t2 = emul(i, mats[mi_ix])
+        t3 = emul(r + i, mats[ms_ix])
+        return t1 - t2, t3 - t1 - t2
     if kind == "rowmm":
         # Composed (R, R) complex matrix over the low row bits: one
         # batched MXU contraction replaces a per-gate roll-select chain —

@@ -214,6 +214,47 @@ def apply_segment_xla(re, im, seg_ops: tuple, high_bits: tuple = (),
             keep = lat.bits_all_set(ctrl_mask) if ctrl_mask else None
             keep = flag_sel(flag_ix, keep)
             re, im = _apply_2x2(re, im, lat, t, m, keep)
+        elif kind == "expmm":
+            _, axes, mr, mi = op
+            # participating axes ascending = exposed bits DESCENDING;
+            # matrix index is MSB-first over that order (the Pallas
+            # kernel's leading-dim merge convention)
+            bits = sorted((axis_to_bit[a] for a in axes), reverse=True)
+            rbits = [b - lane_bits for b in bits]
+            j = len(rbits)
+            rows = re.shape[0]
+            row_bits_n = _ilog2(rows)
+            dims = []
+            prev = row_bits_n
+            for rb in rbits:
+                dims.append(1 << (prev - rb - 1))
+                dims.append(2)
+                prev = rb
+            dims.append(1 << prev)
+            dims.append(lanes)
+            two_axes = [2 * ix + 1 for ix in range(j)]
+
+            def esplit(x):
+                v = x.reshape(dims)
+                v = jnp.moveaxis(v, two_axes, range(j))
+                return v.reshape((1 << j, -1)), v.shape
+
+            def eunsplit(flat, mshape, like):
+                v = flat.reshape(mshape)
+                v = jnp.moveaxis(v, range(j), two_axes)
+                return v.reshape(like.shape)
+
+            fr, mshape = esplit(re)
+            fi, _ = esplit(im)
+            umr = jnp.asarray(mr, dtype)
+            nr = umr @ fr
+            ni = umr @ fi
+            if np.asarray(mi).any():
+                umi = jnp.asarray(mi, dtype)
+                nr = nr - umi @ fi
+                ni = ni + umi @ fr
+            re = eunsplit(nr, mshape, re)
+            im = eunsplit(ni, mshape, im)
         elif kind == "2x2pair":
             _, ax1, m1, ax2, m2 = op
             re, im = _apply_2x2(re, im, lat, axis_to_bit[ax1], m1, None)

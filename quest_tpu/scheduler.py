@@ -789,7 +789,14 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
                         mats.append((mv.real.copy(), mv.imag.copy()))
                     out.append(("lanemmc", tuple(cond_bits), tuple(mats)))
             elif entry.kind == "R":
-                cmin = (_ROW_COMPOSE_MIN if row_compose_min is None
+                # c_blk = 8 (k=8 at >= 29 qubits) leaves R <= 8 matrices:
+                # a full MXU pass for 8 rows of content loses to per-gate
+                # roll-selects end-to-end (tools/probe50.py schedvar,
+                # 906 vs 882 gates/s at 30q) — never compose there.
+                # At c_blk >= 16 composition wins (3069 vs 3036 at 28q).
+                default_rcm = (_ROW_COMPOSE_MIN if low_row_bits >= 4
+                               else 10 ** 9)
+                cmin = (default_rcm if row_compose_min is None
                         else row_compose_min)
                 if len(entry.items) < cmin:
                     for rt, scalars, rcm in entry.items:
@@ -814,7 +821,269 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
         target, ctrl_mask = statics
         out.append(("2x2", target, tuple(scalars), ctrl_mask & chunk_mask,
                     flag_ix(ctrl_mask)))
-    return tuple(out), tuple(dev_masks)
+    return _fold_expmm(tuple(out), high, lane_bits), tuple(dev_masks)
+
+
+#: Fold a segment's exposed-axis content into one composed 2^j operator
+#: ('expmm', MXU-applied) when at least this many ops fold.  ~2.6 ms of
+#: VPU serial chain per exposed 2x2 at 30q vs ~2 ms visible for a real
+#: 128-dim expmm (tools/probe50.py) — the fold pays off fast.
+_EXPMM_MIN = 4
+#: Complex operators cost 3 Gauss dot passes (vs 2 real) and hide less:
+#: they need more folded content to pay for themselves.
+_EXPMM_MIN_CPLX = 10
+#: Cap the composed operator at 2^7 = 128 — the MXU contraction width.
+#: A 256-dim operator costs double the dot passes for the same content.
+_EXPMM_MAX_AXES = 7
+
+
+def _expmm_enabled() -> bool:
+    """Opt-in (QUEST_EXPMM=1): folding exposed content onto the MXU
+    measured NET NEGATIVE on the 30q random bench (732 vs 882 gates/s,
+    round 5) — in-situ exposed 2x2s mostly hide behind the in-place
+    stream, while the composed operator's 2-3 dot passes land on the
+    MXU, which IS the serial bottleneck of dense passes.  Kept for
+    workloads with exposed-heavy, matmul-light passes."""
+    import os
+
+    return os.environ.get("QUEST_EXPMM", "0") == "1"
+
+
+def _fold_expmm(seg_ops, high, lane_bits):
+    """Compose the foldable exposed-axis content of a planned segment
+    into a single ('expmm', axes, Ur, Ui) op on the MXU.
+
+    Foldable: uncontrolled or exposed-controlled 2x2s on participating
+    exposed bits, and diag entries whose masks sit entirely on
+    participating bits — each bubbled left to the first fold position
+    across ops it commutes with (mixing-vs-support commutation, tracked
+    as separate mixing/diagonal barrier masks).  Exposed 2x2 chains ride
+    the VPU serial spine at ~2.6 ms each at 30q; the composed operator
+    is 2 (real) / 3 (Gauss complex) MXU dot passes total
+    (tools/probe50.py, round 5)."""
+    k = len(high)
+    if k == 0 or not _expmm_enabled():
+        return seg_ops
+    high_sorted = sorted(high)
+    axis_of = {b: k - 1 - i for i, b in enumerate(high_sorted)}
+
+    pmask_all = 0
+    for b in high_sorted:
+        pmask_all |= 1 << b
+
+    def op_exposed_sets(op):
+        """(mixing, diagonal-support, foldable-items) of a planned op on
+        the exposed field.  foldable-items: list of ("g", (t, m, cm)) or
+        ("d", eix, (mask, phr, phi)) candidates (None = op never
+        folds)."""
+        kind = op[0]
+        if kind == "2x2":
+            _, t, m, cm, fx = op
+            tm = 1 << t
+            if fx < 0 and (tm & pmask_all) and (cm & ~pmask_all) == 0:
+                return tm, cm, [("g", (t, m, cm))]
+            return tm, cm, []
+        if kind == "diag":
+            items = []
+            diag_sup = 0
+            for eix, (mask, phr, phi, fx) in enumerate(op[1]):
+                diag_sup |= mask
+                if fx < 0 and mask and (mask & ~pmask_all) == 0:
+                    items.append(("d", eix, (mask, phr, phi)))
+            return 0, diag_sup, items
+        if kind == "lanemmc":
+            sup = 0
+            for b in op[1]:
+                sup |= 1 << b
+            return 0, sup, []
+        if kind in ("dtab", "lanemm", "rowmm", "expmm"):
+            return 0, 0, []
+        if kind == "chan":
+            sup = 0
+            for b in op[2]:
+                sup |= 1 << b
+            return sup, sup, []
+        return ~0, ~0, []  # unknown: blocks everything
+
+    # Greedy multi-group commute-bubble: each op folds into the EARLIEST
+    # open group it can still commute back to (and whose exposed-bit
+    # union stays within the axis cap); if none, it opens a new group at
+    # its own position.  A group's (mix_bar, diag_bar) accrue the
+    # exposed support of every op NOT in that group seen since the group
+    # opened — folded-into-later-group ops still move to a position
+    # after this group, so they bar it like kept ops do.
+    groups: list[dict] = []  # {first, members:[(idx, item)], mix, diag,
+    #                           bits: set}
+
+    def item_bits(item):
+        if item[0] == "g":
+            sup = (1 << item[1][0]) | item[1][2]
+        else:
+            sup = item[2][0]
+        return {b for b in high_sorted if sup & (1 << b)}
+
+    def try_fold(idx, item):
+        if item[0] == "g":
+            _t, _m, cm = item[1]
+            sup_mix = 1 << _t
+            sup_diag = cm
+        else:
+            sup_mix = 0
+            sup_diag = item[2][0]
+        bits = item_bits(item)
+        if len(bits) > _EXPMM_MAX_AXES:
+            return None  # wider than one operator: never folds
+        for g in groups:
+            if (sup_mix & (g["mix"] | g["diag"])) \
+                    or (sup_diag & g["mix"]):
+                continue
+            if len(g["bits"] | bits) > _EXPMM_MAX_AXES:
+                continue
+            g["members"].append((idx, item))
+            g["bits"] |= bits
+            return g
+        g = {"first": idx, "members": [(idx, item)], "mix": 0, "diag": 0,
+             "bits": set(bits)}
+        groups.append(g)
+        return g
+
+    for idx, op in enumerate(seg_ops):
+        mix, diag_sup, items = op_exposed_sets(op)
+        taken = []
+        for item in items:
+            g = try_fold(idx, item)
+            if g is not None:
+                taken.append((item, g))
+        # residual support of the op (unfolded parts) bars every group
+        # it is not a member of; folded parts bar every OTHER group
+        if op[0] == "diag":
+            kept = [e for e in range(len(op[1]))
+                    if not any(it[0] == "d" and it[1] == e
+                               for it, _ in taken)]
+            res_diag = 0
+            for e in kept:
+                res_diag |= op[1][e][0]
+            res_mix = 0
+        else:
+            res_mix = 0 if taken else mix
+            res_diag = 0 if taken else diag_sup
+        for g in groups:
+            # Bar g with every part of the op that is NOT a member of g:
+            # the residual (kept) support AND parts folded into OTHER
+            # groups.  Parts folded into g itself never self-bar —
+            # but their siblings still do (a kept diag entry must bar
+            # the group a co-entry folded into, or a later mixing gate
+            # folds across it; ADVICE-confirmed bug in round 5).
+            part_mix, part_diag = res_mix, res_diag
+            for it, gg in taken:
+                if gg is g:
+                    continue
+                if it[0] == "g":
+                    part_mix |= 1 << it[1][0]
+                    part_diag |= it[1][2]
+                else:
+                    part_diag |= it[2][0]
+            g["mix"] |= part_mix
+            g["diag"] |= part_diag
+
+    # dissolve undersized groups: their members re-emit at their
+    # original positions, which is sound — relative member order was
+    # preserved, and every other group already accrued their support.
+    # Economics (probe50, 30q): a REAL operator is 2 MXU dot passes
+    # (~16.6 ms raw, mostly hidden), a complex one 3 (Gauss); a folded
+    # 2x2 saves ~2.6 ms of VPU serial chain — so complex groups need
+    # more members to pay.
+    def _is_real(g):
+        for _idx, item in g["members"]:
+            if item[0] == "g":
+                (_, ai), (_, bi), (_, ci), (_, di) = item[1][1]
+                if ai or bi or ci or di:
+                    return False
+            else:
+                if item[2][2]:
+                    return False
+        return True
+
+    live = [g for g in groups
+            if len(g["members"]) >= (_EXPMM_MIN if _is_real(g)
+                                     else _EXPMM_MIN_CPLX)]
+    if not live:
+        return seg_ops
+
+    import numpy as _np
+
+    emit_at: dict[int, list] = {}
+    drop: dict[int, list] = {}  # idx -> folded items to remove
+    for g in live:
+        members = g["members"]
+        pbits = set(g["bits"])
+        # pad to the full axis width with unused exposed bits (identity
+        # on them): the contraction pads to the 128-wide MXU anyway, and
+        # narrow operators fragment into many tiny dots in the kernel's
+        # leaf loop — a 2-axis group measured catastrophically slow
+        for b in high_sorted:
+            if len(pbits) >= min(_EXPMM_MAX_AXES, k):
+                break
+            pbits.add(b)
+        j = len(pbits)
+        paxes = sorted(axis_of[b] for b in pbits)
+        ubit = {b: j - 1 - paxes.index(axis_of[b]) for b in pbits}
+        dim = 1 << j
+        U = _np.eye(dim, dtype=_np.complex128)
+        rows_ix = _np.arange(dim)
+
+        def tr_mask(cm):
+            out = 0
+            for b in pbits:
+                if cm & (1 << b):
+                    out |= 1 << ubit[b]
+            return out
+
+        for idx, item in members:
+            if item[0] == "g":
+                t, m, cm = item[1]
+                (ar, ai), (br, bi), (cr, ci), (dr, di) = m
+                u = _np.array([[ar + 1j * ai, br + 1j * bi],
+                               [cr + 1j * ci, dr + 1j * di]])
+                tb = 1 << ubit[t]
+                cmask = tr_mask(cm)
+                gm = _np.zeros((dim, dim), dtype=_np.complex128)
+                for row in range(dim):
+                    if (row & cmask) != cmask:
+                        gm[row, row] = 1.0
+                        continue
+                    bv = 1 if row & tb else 0
+                    gm[row, row & ~tb] = u[bv, 0]
+                    gm[row, row | tb] = u[bv, 1]
+                U = gm @ U
+            else:
+                mask, phr, phi = item[2]
+                sel_mask = tr_mask(mask)
+                sel = (rows_ix & sel_mask) == sel_mask
+                U[sel, :] *= complex(phr, phi)
+            drop.setdefault(idx, []).append(item)
+        emit_at.setdefault(g["first"], []).append(
+            ("expmm", tuple(paxes), U.real.copy(), U.imag.copy()))
+
+    if not emit_at:
+        return seg_ops
+
+    out = []
+    for idx, op in enumerate(seg_ops):
+        for eop in emit_at.get(idx, ()):
+            out.append(eop)
+        dropped = drop.get(idx)
+        if not dropped:
+            out.append(op)
+            continue
+        if op[0] == "2x2":
+            continue  # whole op folded
+        kept = [e for eix, e in enumerate(op[1])
+                if not any(it[0] == "d" and it[1] == eix
+                           for it in dropped)]
+        if kept:
+            out.append(("diag", tuple(kept)))
+    return tuple(out)
 
 
 def _compose_2x2(items):

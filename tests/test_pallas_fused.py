@@ -216,3 +216,131 @@ def test_property_cz_heavy_fused(env1, seed):
         else:
             circ.controlled_phase_shift(c, t, float(rng.uniform(0, 6.2)))
     _compare(env1, circ, n=n, seed=seed)
+
+
+@pytest.mark.parametrize("seed", [11, 22])
+def test_expmm_fold_equivalence(env1, seed, monkeypatch):
+    """QUEST_EXPMM=1 folds exposed-axis runs into composed ('expmm')
+    MXU operators (opt-in — measured net-negative on the 30q random
+    bench, kept for exposed-heavy matmul-light workloads).  The folded
+    schedule must match the per-gate path exactly, and the fold must
+    actually fire on this workload."""
+    from quest_tpu.scheduler import schedule_segments
+
+    monkeypatch.setenv("QUEST_EXPMM", "1")
+    n = 14
+    rng = np.random.RandomState(seed)
+    circ = Circuit(n)
+    # exposed-heavy content: H/X/CZ/T on high qubits (real 2x2 folds,
+    # exposed-ctrl folds, diag folds) mixed with lane gates
+    for _ in range(30):
+        t = int(rng.randint(10, n))
+        k = rng.randint(5)
+        if k == 0:
+            circ.hadamard(t)
+        elif k == 1:
+            circ.pauli_x(t)
+        elif k == 2:
+            circ.controlled_phase_flip(10 + (t - 9) % 4, t)
+        elif k == 3:
+            circ.t_gate(t)
+        else:
+            circ.hadamard(int(rng.randint(7)))
+    segs = schedule_segments(circ.ops, n)
+    assert any(op[0] == "expmm" for seg, _ in segs for op in seg), \
+        "expected at least one expmm fold in this schedule"
+    _compare(env1, circ, n=n, seed=seed)
+
+
+def test_expmm_default_off(monkeypatch):
+    """The fold is strictly opt-in: without QUEST_EXPMM (or with it set
+    to a disabled value) the schedule must contain no expmm ops."""
+    from quest_tpu.scheduler import schedule_segments
+
+    monkeypatch.delenv("QUEST_EXPMM", raising=False)
+    circ = models.random_circuit(14, depth=6, seed=11)
+    segs = schedule_segments(circ.ops, 14)
+    assert not any(op[0] == "expmm" for seg, _ in segs for op in seg)
+
+
+def test_expmm_kept_diag_entry_bars_group(monkeypatch):
+    """A kept (non-foldable) diag entry must bar the group its
+    co-entries folded into: a later mixing gate on the kept entry's
+    exposed bit must NOT fold across it (round-5 review repro: H(12)
+    folded past a kept Z(12&3), wrong amplitudes whenever bits 3&12
+    select).  Checked numerically: the folded segment must equal the
+    unfolded one amplitude-for-amplitude."""
+    import jax.numpy as jnp
+    from quest_tpu.scheduler import _fold_expmm
+    from quest_tpu.ops.segment_xla import apply_segment_xla
+
+    monkeypatch.setenv("QUEST_EXPMM", "1")
+    monkeypatch.setattr("quest_tpu.scheduler._EXPMM_MIN", 1)
+    monkeypatch.setattr("quest_tpu.scheduler._EXPMM_MIN_CPLX", 1)
+    H = ((0.7071067811865476, 0.0), (0.7071067811865476, 0.0),
+         (0.7071067811865476, 0.0), (-0.7071067811865476, 0.0))
+    seg = (
+        ("2x2", 10, H, 0, -1),
+        ("diag", (((1 << 11), 0.0, 1.0, -1),          # foldable phase
+                  ((1 << 12) | (1 << 3), -1.0, 0.0, -1))),  # kept: bit 3
+        ("2x2", 12, H, 0, -1),
+    )
+    high = (10, 11, 12)
+    folded = _fold_expmm(seg, high, 7)
+    assert any(op[0] == "expmm" for op in folded)
+
+    n = 13
+    rng = np.random.RandomState(3)
+    re0 = rng.randn(1 << (n - 7), 128).astype(np.float32)
+    im0 = rng.randn(1 << (n - 7), 128).astype(np.float32)
+    hb = tuple(b for b in high)
+    r1, i1 = apply_segment_xla(jnp.array(re0), jnp.array(im0), seg, hb)
+    r2, i2 = apply_segment_xla(jnp.array(re0), jnp.array(im0), folded, hb)
+    a = np.asarray(r1) + 1j * np.asarray(i1)
+    b = np.asarray(r2) + 1j * np.asarray(i2)
+    assert float(np.abs(a - b).max()) < 1e-5
+
+
+def test_expmm_xla_backend_equivalence(env8, env1, monkeypatch):
+    """The XLA segment backend's expmm (mesh plans on the virtual CPU
+    mesh) must match the per-gate path — covers the dims/moveaxis/MSB
+    convention bookkeeping the Pallas test never executes."""
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn
+    from quest_tpu.parallel import to_host
+
+    monkeypatch.setenv("QUEST_EXPMM", "1")
+    n = 17  # chunk = 14 bits over env8: exposed local window = bits 10-13
+    rng = np.random.RandomState(7)
+    circ = Circuit(n)
+    for _ in range(40):
+        t = int(rng.randint(10, 14))
+        k = rng.randint(4)
+        if k == 0:
+            circ.hadamard(t)
+        elif k == 1:
+            circ.pauli_x(t)
+        elif k == 2:
+            circ.controlled_phase_flip(10 + (t - 9) % 4, t)
+        else:
+            circ.hadamard(int(rng.randint(7)))  # lane separator
+    from quest_tpu.scheduler import schedule_mesh
+    from quest_tpu.ops.lattice import state_shape, _ilog2
+    plan = schedule_mesh(list(circ.ops), n, 3,
+                         _ilog2(state_shape(1 << n, 8)[1]))
+    assert any(item[0] == "seg" and any(o[0] == "expmm" for o in item[1])
+               for item in plan), "expected an expmm in the mesh plan"
+
+    q = qt.create_qureg(n, env8, dtype=jnp.float32)
+    qt.init_zero_state(q)
+    fn = as_mesh_fused_fn(list(circ.ops), n, q.mesh, backend="xla")
+    re, im = jax.jit(fn)(q.re, q.im)
+    q._set(re, im)
+
+    ref = qt.create_qureg(n, env1, dtype=jnp.float32)
+    qt.init_zero_state(ref)
+    circ.run(ref, pallas=False)
+    a = to_host(q.re).reshape(-1) + 1j * to_host(q.im).reshape(-1)
+    b = to_host(ref.re).reshape(-1) + 1j * to_host(ref.im).reshape(-1)
+    assert float(np.abs(a - b).max()) < 1e-6

@@ -1,0 +1,381 @@
+"""Round-5 probes: (a) the TRUE in-place stream ceiling — is the
+~39-40 ms/pass (~410 GB/s) floor hardware or recoverable? — and (c) the
+super-additive in-segment term.
+
+Probes (select by argv):
+
+  copy      — minimal donated in-place COPY kernel (no compute at all),
+              c_blk swept: the floor the executor could ever reach.
+  copy2d    — same but k=8-style block shape ((2,)*8 + (c_blk, 128)):
+              the floor with the REAL executor block structure.
+  read      — read-only pass (block-sum into a tiny accumulator): pure
+              HBM read bandwidth.
+  write     — write-only pass (fill from a broadcast constant): pure
+              HBM write bandwidth.
+  xla       — donated jitted elementwise scale (XLA's stream rate).
+  seg       — apply_fused_segment with n synthetic exposed-axis 2x2s
+              (the real executor pass): marginal cost per op and the
+              nonlinearity (super-additive) term, n swept.
+  segmm     — same with a composed real lane matmul group added, to see
+              the mm's in-context cost vs the chain length.
+
+Usage: python tools/probe50.py [probe ...]   (env: MB_QUBITS, MB_INNER)
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = int(os.environ.get("MB_QUBITS", "30"))
+INNER = int(os.environ.get("MB_INNER", "16"))
+
+ROWS = 1 << (N - 7)
+LANES = 128
+
+
+def timeit(label, fn, *args, reps=2, inner=INNER, donate=True):
+    """fn must be (re, im) -> (re, im); donated fori_loop, host-read sync."""
+    re = jnp.zeros((ROWS, LANES), jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros((ROWS, LANES), jnp.float32)
+
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+    def run(re, im):
+        return lax.fori_loop(0, inner, lambda _, s: fn(*s), (re, im))
+
+    try:
+        re, im = run(re, im)
+        jax.block_until_ready((re, im))
+        float(re[0, 0])
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            re, im = run(re, im)
+            jax.block_until_ready((re, im))
+            float(re[0, 0])
+            times.append((time.perf_counter() - t0) / inner)
+        ms = min(times) * 1e3
+        gbps = 2 * 2 * ROWS * LANES * 4 / (ms / 1e3) / 1e9  # r+w, re+im
+        print(f"{label:34s} {ms:8.2f} ms/pass  ({gbps:6.1f} GB/s rw)",
+              flush=True)
+        return ms
+    except Exception as e:
+        print(f"{label:34s} FAILED {str(e)[:200]}", flush=True)
+        return None
+
+
+# ---------------------------------------------------------------- floors
+
+def make_copy(c_blk, vmem_mb=0):
+    def kern(re_ref, im_ref, ro_ref, io_ref):
+        ro_ref[:] = re_ref[:]
+        io_ref[:] = im_ref[:]
+
+    spec = pl.BlockSpec((c_blk, LANES), lambda g: (g, 0))
+    cp = {}
+    if vmem_mb:
+        cp["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb << 20)
+
+    def fn(re, im):
+        return pl.pallas_call(
+            kern, grid=(ROWS // c_blk,),
+            in_specs=[spec, spec], out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), re.dtype)] * 2,
+            input_output_aliases={0: 0, 1: 1}, **cp,
+        )(re, im)
+    return fn
+
+
+def make_copy2d(k, row_budget=2048):
+    """Copy with the executor's k-exposed-axis block structure."""
+    from quest_tpu.ops.pallas_kernels import plan_fused_shapes
+    high_row = tuple(range(ROWS.bit_length() - 1 - k, ROWS.bit_length() - 1))
+    dims, block_shape, grid, index_map, c_blk = plan_fused_shapes(
+        ROWS, LANES, high_row, row_budget)
+
+    def kern(re_ref, im_ref, ro_ref, io_ref):
+        ro_ref[:] = re_ref[:]
+        io_ref[:] = im_ref[:]
+
+    spec = pl.BlockSpec(block_shape, index_map)
+    cp = {"compiler_params": pltpu.CompilerParams(
+        vmem_limit_bytes=110 << 20)} if k >= 8 else {}
+
+    def fn(re, im):
+        r, i = pl.pallas_call(
+            kern, grid=grid,
+            in_specs=[spec, spec], out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct(dims, re.dtype)] * 2,
+            input_output_aliases={0: 0, 1: 1}, **cp,
+        )(re.reshape(dims), im.reshape(dims))
+        return r.reshape(re.shape), i.reshape(im.shape)
+    return fn
+
+
+def make_read(c_blk):
+    """Read both arrays, write a (8,128) accumulator: ~pure-read pass."""
+    def kern(re_ref, im_ref, acc_ref):
+        g = pl.program_id(0)
+
+        @pl.when(g == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+        acc_ref[:] += (re_ref[:].reshape(-1, 8, 128).sum(0)
+                       + im_ref[:].reshape(-1, 8, 128).sum(0))
+
+    spec = pl.BlockSpec((c_blk, LANES), lambda g: (g, 0))
+
+    def fn(re, im):
+        acc = pl.pallas_call(
+            kern, grid=(ROWS // c_blk,),
+            in_specs=[spec, spec],
+            out_specs=pl.BlockSpec((8, LANES), lambda g: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, LANES), re.dtype),
+        )(re, im)
+        # keep signature (re, im) -> (re, im); fold acc in cheaply
+        return re.at[0, 0].add(acc[0, 0] * 0), im
+    return fn
+
+
+def make_write(c_blk):
+    """Write both arrays from a constant, reading (almost) nothing."""
+    def kern(seed_ref, ro_ref, io_ref):
+        v = seed_ref[0, 0]
+        ro_ref[:] = jnp.full(ro_ref.shape, v, ro_ref.dtype)
+        io_ref[:] = jnp.full(io_ref.shape, v, io_ref.dtype)
+
+    spec = pl.BlockSpec((c_blk, LANES), lambda g: (g, 0))
+
+    def fn(re, im):
+        r, i = pl.pallas_call(
+            kern, grid=(ROWS // c_blk,),
+            in_specs=[pl.BlockSpec((1, 1), lambda g: (0, 0))],
+            out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((ROWS, LANES), re.dtype)] * 2,
+        )(re[:1, :1])
+        return r, i
+    return fn
+
+
+def make_xla():
+    # NOT ~1.0: a constant that rounds to 1.0f folds the multiply away
+    # and the "stream" measures nothing (first version of this probe
+    # printed 1438 GB/s that way).
+    c = jnp.float32(0.99999994)
+
+    def fn(re, im):
+        return re * c, im * c
+    return fn
+
+
+def make_copy_big(c_blk, vmem_mb=110):
+    return make_copy(c_blk, vmem_mb)
+
+
+# ------------------------------------------------- real executor segments
+
+def _h():
+    h = 0.7071067811865476
+    return ((h, 0.0), (h, 0.0), (h, 0.0), (-h, 0.0))
+
+
+def make_seg(n_2x2, k=8, with_mm=0, row_budget=2048):
+    """apply_fused_segment with n synthetic 2x2s round-robin over the k
+    exposed (top) qubits + optionally with_mm composed real lane matmul
+    groups — the real executor pass at bench structure."""
+    from quest_tpu.ops.pallas_kernels import apply_fused_segment
+    import numpy as np
+
+    high_bits = tuple(range(N - k, N))
+    ops = []
+    rng = np.random.default_rng(7)
+    for g in range(with_mm):
+        q = rng.permutation(128)
+        mr = np.zeros((128, 128), np.float64)
+        mr[np.arange(128), q] = 1.0  # real permutation matrix: 2 dots
+        ops.append(("lanemm", mr, np.zeros((128, 128))))
+    for g in range(n_2x2):
+        t = high_bits[g % k]
+        ops.append(("2x2", t, _h(), 0, -1))
+
+    def fn(re, im):
+        return apply_fused_segment(re, im, tuple(ops), high_bits,
+                                   row_budget=row_budget)
+    return fn
+
+
+def _expand_on_axes(k, rank, m):
+    """Dense (2^k, 2^k) complex matrix of a 2x2 on exposed-space bit
+    position ``rank`` (MSB-first axis order maps exposed bit with
+    ascending rank i to 2^k index bit i — see expmm docstring)."""
+    import numpy as np
+    (ar, ai), (br, bi), (cr, ci), (dr, di) = m
+    u = np.array([[ar + 1j * ai, br + 1j * bi],
+                  [cr + 1j * ci, dr + 1j * di]])
+    t = 1 << rank
+    out = np.zeros((1 << k, 1 << k), dtype=np.complex128)
+    for row in range(1 << k):
+        b = (row >> rank) & 1
+        out[row, row & ~t] = u[b, 0]
+        out[row, row | t] = u[b, 1]
+    return out
+
+
+def make_seg_expmm(n_2x2, k=8, j=8, with_mm=0, complex_u=False):
+    """Same logical content as make_seg(n_2x2) restricted to j of the k
+    exposed axes, composed on the host into ONE expmm (2^j x 2^j)
+    matrix over axes (0..j-1)."""
+    from quest_tpu.ops.pallas_kernels import apply_fused_segment
+    import numpy as np
+
+    high_bits = tuple(range(N - k, N))
+    U = np.eye(1 << j, dtype=np.complex128)
+    for g in range(n_2x2):
+        rank = g % j
+        U = _expand_on_axes(j, rank, _h()) @ U
+    if complex_u:
+        U = U * np.exp(0.3j)
+    ops = []
+    rng = np.random.default_rng(7)
+    for g in range(with_mm):
+        q = rng.permutation(128)
+        mr = np.zeros((128, 128), np.float64)
+        mr[np.arange(128), q] = 1.0
+        ops.append(("lanemm", mr, np.zeros((128, 128))))
+    ops.append(("expmm", tuple(range(j)), U.real.copy(), U.imag.copy()))
+
+    def fn(re, im):
+        return apply_fused_segment(re, im, tuple(ops), high_bits,
+                                   row_budget=2048)
+    return fn
+
+
+def make_seg_direct(seg_ops, high):
+    from quest_tpu.ops.pallas_kernels import apply_fused_segment
+
+    def fn(re, im):
+        return apply_fused_segment(re, im, seg_ops, tuple(high))
+    return fn
+
+
+def bench_sched_variants():
+    """Whole-schedule time (sum of per-seg passes, one jitted chain) for
+    scheduling-knob variants, on the real bench circuit."""
+    import os as _os
+    from quest_tpu import models
+    from quest_tpu.scheduler import schedule_segments
+
+    circ = models.random_circuit(N, depth=22, seed=123)
+    _os.environ["QUEST_EXPMM"] = "0"
+    variants = {
+        "base (lcm2 rcm3)": {},
+        "rcm999 (never rowmm)": {"row_compose_min": 999},
+        "lcm3": {"lane_compose_min": 3},
+        "lcm4": {"lane_compose_min": 4},
+        "lcm999 (never lanemm)": {"lane_compose_min": 999},
+        "lcm3 rcm999": {"lane_compose_min": 3, "row_compose_min": 999},
+    }
+    from quest_tpu.ops.pallas_kernels import apply_fused_segment
+
+    for name, kw in variants.items():
+        segs = schedule_segments(list(circ.ops), N, **kw)
+
+        def fn(re, im, segs=segs):
+            for seg_ops, high in segs:
+                re, im = apply_fused_segment(re, im, seg_ops,
+                                             tuple(high))
+            return re, im
+
+        ms = timeit(f"{name} ({len(segs)} passes)", fn)
+        if ms:
+            print(f"   -> {660.0 / ms * 1e3:7.1f} gates/s", flush=True)
+    _os.environ.pop("QUEST_EXPMM")
+
+
+def bench_segs():
+    """Time each segment of the real bench schedule individually,
+    expmm-folded vs not."""
+    import os as _os
+    from quest_tpu import models
+    from quest_tpu.scheduler import schedule_segments_best
+
+    circ = models.random_circuit(N, depth=22, seed=123)
+    _os.environ["QUEST_EXPMM"] = "0"
+    plain = schedule_segments_best(list(circ.ops), N)
+    _os.environ["QUEST_EXPMM"] = "1"
+    folded = schedule_segments_best(list(circ.ops), N)
+    _os.environ.pop("QUEST_EXPMM")
+    for si, ((pops, phigh), (fops, fhigh)) in enumerate(zip(plain,
+                                                            folded)):
+        t0 = timeit(f"seg{si} plain  ({len(pops)} ops)",
+                    make_seg_direct(pops, phigh))
+        has_fold = any(op[0] == "expmm" for op in fops)
+        if has_fold:
+            t1 = timeit(f"seg{si} folded ({len(fops)} ops)",
+                        make_seg_direct(fops, fhigh))
+            if t0 and t1:
+                print(f"   -> delta {t1 - t0:+7.2f} ms", flush=True)
+
+
+def _main():
+    which = sys.argv[1:] or ["copy", "xla", "copy2d", "seg"]
+    print(f"n={N} rows={ROWS} inner={INNER}", flush=True)
+    for w in which:
+        if w == "copy":
+            for c_blk in (256, 512, 1024, 2048, 4096):
+                vm = 110 if c_blk >= 4096 else 0
+                timeit(f"copy c_blk={c_blk}", make_copy(c_blk, vm))
+        elif w == "copy2d":
+            for k in (0, 6, 8):
+                timeit(f"copy2d k={k}", make_copy2d(k))
+        elif w == "read":
+            for c_blk in (1024, 2048):
+                timeit(f"read c_blk={c_blk}", make_read(c_blk))
+        elif w == "write":
+            for c_blk in (1024, 2048):
+                timeit(f"write c_blk={c_blk}", make_write(c_blk))
+        elif w == "xla":
+            timeit("xla scale", make_xla())
+        elif w == "copybig":
+            for c_blk in (8192, 16384, 32768):
+                timeit(f"copy c_blk={c_blk} vmem110",
+                       make_copy_big(c_blk))
+        elif w == "seg":
+            for n in (0, 1, 2, 4, 8, 16, 24, 32, 40):
+                timeit(f"seg n_2x2={n} k=8", make_seg(n))
+        elif w == "segmm":
+            for mm in (0, 1, 2, 4):
+                timeit(f"seg n_2x2=16 mm={mm}", make_seg(16, with_mm=mm))
+        elif w == "expmm":
+            for j in (7, 8):
+                timeit(f"expmm j={j} real  mm=0", make_seg_expmm(24, j=j))
+                timeit(f"expmm j={j} cplx  mm=0",
+                       make_seg_expmm(24, j=j, complex_u=True))
+                timeit(f"expmm j={j} real  mm=2",
+                       make_seg_expmm(24, j=j, with_mm=2))
+                timeit(f"expmm j={j} real  mm=4",
+                       make_seg_expmm(24, j=j, with_mm=4))
+                timeit(f"expmm j={j} cplx  mm=4",
+                       make_seg_expmm(24, j=j, with_mm=4, complex_u=True))
+        elif w == "benchsegs":
+            bench_segs()
+        elif w == "schedvar":
+            bench_sched_variants()
+        elif w == "segblk":
+            for rb in (1024, 2048, 4096):
+                timeit(f"seg n_2x2=24 rb={rb}",
+                       make_seg(24, row_budget=rb))
+        else:
+            print(f"unknown probe {w}")
+
+
+if __name__ == "__main__":
+    _main()

@@ -18,11 +18,12 @@ DEPTH = int(os.environ.get("MB_DEPTH", "16"))
 circ = models.random_circuit(N, depth=DEPTH, seed=123)
 segs = schedule_segments_best(list(circ.ops), N)
 
-# probe30 costs (ms/pass at 30q, k<=6)
+# probe30/probe50 costs (ms/pass at 30q)
 COST = {"floor": 37.2, "lanemm_real": 12.4, "lanemm_cplx": 18.6,
-        "2x2_exposed": 0.9, "2x2_row": 2.5, "2x2_lane": 7.0,
+        "2x2_exposed": 2.6, "2x2_row": 2.5, "2x2_lane": 7.0,
         "rowmm_real": 12.4, "rowmm_cplx": 18.6,
-        "dtab": 0.3, "diag": 0.3, "2x2pair": 1.2}
+        "dtab": 0.3, "diag": 0.3, "2x2pair": 1.2,
+        "expmm_real": 3.0, "expmm_cplx": 12.0}
 
 total = 0.0
 print(f"n={N} depth={DEPTH} gates={circ.num_gates} passes={len(segs)}")
@@ -40,6 +41,11 @@ for si, (seg_ops, high) in enumerate(segs):
         elif k == "lanemmc":
             hist[f"lanemmc_{len(op[1])}b"] += 1
             est += COST["lanemm_real"]
+        elif k == "expmm":
+            cplx = np.asarray(op[3]).any()
+            key = f"expmm_{'cplx' if cplx else 'real'}"
+            hist[f"{key}_{len(op[1])}ax"] += 1
+            est += COST[key]
         elif k == "2x2":
             t = op[1]
             if t in high:
