@@ -115,10 +115,10 @@ def main():
     num_qubits = int(os.environ.get("QUEST_BENCH_QUBITS", "30"))
     depth = int(os.environ.get("QUEST_BENCH_DEPTH", "22"))
     reps = int(os.environ.get("QUEST_BENCH_REPS", "3"))
-    # 16 chained circuit applications per dispatch: the ~90 ms tunnel
-    # round trip amortises below measurement noise (865.7 vs 854.2
-    # gates/s at 8 on an idle host, round 4)
-    inner = int(os.environ.get("QUEST_BENCH_INNER", "16"))
+    # 32 chained circuit applications per dispatch: the ~90 ms tunnel
+    # round trip amortises below measurement noise (swept 8/16/32;
+    # the sustained figure plateaus at 32, round 5)
+    inner = int(os.environ.get("QUEST_BENCH_INNER", "32"))
 
     # The fused Pallas executor updates the state strictly in place
     # (input_output_aliases through every segment), so only ONE (re, im)

@@ -96,6 +96,14 @@ def _combine_2x2(r, i, pr, pi, bit, m):
 MAX_HIGH_BITS = 10
 
 
+def _os_env_gap() -> int:
+    """MXU/VPU interleave spacing (QUEST_MM_GAP; swept 2-10 on v5e
+    round 4, 6 best)."""
+    import os
+
+    return int(os.environ.get("QUEST_MM_GAP", "6"))
+
+
 def default_max_high(num_vec_bits: int) -> int:
     """Empirically-best exposed-high-bit budget for a state size.
 
@@ -246,8 +254,9 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
                                     np.asarray(mi))[1:])
         elif op[0] == "dtab":
             _, tr, ti = op
+            ti_arr = np.asarray(ti)
             planned.append(("dtab", add_mat(np.asarray(tr)),
-                            add_mat(np.asarray(ti))))
+                            add_mat(ti_arr) if ti_arr.any() else -1))
         elif op[0] == "2x2":
             planned.append(op)
         else:
@@ -274,6 +283,28 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
                     continue
             merged.append(op)
         planned = merged
+    # Fuse CONSECUTIVE 2x2s on the SAME exposed axis (different ctrl
+    # masks — same-(target, ctrl) runs were already host-composed) into
+    # one sliced round: the halves stay live across the run, sharing
+    # the slice + concat data movement that dominates exposed-op cost.
+    if high_axis:
+        merged = []
+        for op in planned:
+            if (op[0] == "2x2" and merged
+                    and op[1] >= lane_bits
+                    and (op[1] - lane_bits) in high_axis):
+                prev = merged[-1]
+                if (prev[0] == "2x2" and prev[1] == op[1]):
+                    merged[-1] = ("2x2run", op[1],
+                                  ((prev[2], prev[3], prev[4]),
+                                   (op[2], op[3], op[4])))
+                    continue
+                if prev[0] == "2x2run" and prev[1] == op[1]:
+                    merged[-1] = ("2x2run", op[1],
+                                  prev[2] + ((op[2], op[3], op[4]),))
+                    continue
+            merged.append(op)
+        planned = merged
     # Interleave MXU matmul ops among the VPU-class ops they commute
     # with: a dense pass ordered [mm, mm, ..., 2x2 x30] costs ~23% more
     # than the same ops alternating (tools/probe40b round-4 probe — the
@@ -283,52 +314,57 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     # lanemmc = lanes + its conditioning bits; moving past an op
     # requires disjoint touch sets.
     _MM = ("lanemm", "lanemmc", "rowmm", "expmm")
+    lane_mask = (1 << lane_bits) - 1
+    row_mask = ((c_blk - 1) << lane_bits)
+
+    def touch_mask(op):
+        kind = op[0]
+        if kind == "lanemm":
+            return lane_mask
+        if kind == "rowmm":
+            return row_mask
+        if kind == "expmm":
+            m = 0
+            for b, a in high_axis.items():
+                if a in op[1]:
+                    m |= 1 << (b + lane_bits)
+            return m
+        if kind == "lanemmc":
+            m = lane_mask
+            for b in op[1]:
+                m |= 1 << b
+            return m
+        if kind == "2x2":
+            return (1 << op[1]) | op[3]
+        if kind == "2x2run":
+            m = 1 << op[1]
+            for _mat, cm, _fx in op[2]:
+                m |= cm
+            return m
+        if kind == "2x2pair":
+            m = 0
+            for ax in (op[1], op[3]):
+                for b, a in high_axis.items():
+                    if a == ax:
+                        m |= 1 << (b + lane_bits)
+            return m
+        if kind == "diag":
+            m = 0
+            for mask, _pr, _pi, _f in op[1]:
+                m |= mask
+            return m
+        if kind == "dtab":
+            return lane_mask | row_mask
+        if kind == "chan":
+            m = 0
+            for b in op[2]:
+                m |= 1 << b
+            return m
+        return ~0  # unknown: commutes with nothing
+
     if any(op[0] in _MM for op in planned) \
             and any(op[0] not in _MM for op in planned):
-        lane_mask = (1 << lane_bits) - 1
-        row_mask = ((c_blk - 1) << lane_bits)
-
-        def touch_mask(op):
-            kind = op[0]
-            if kind == "lanemm":
-                return lane_mask
-            if kind == "rowmm":
-                return row_mask
-            if kind == "expmm":
-                m = 0
-                for b, a in high_axis.items():
-                    if a in op[1]:
-                        m |= 1 << (b + lane_bits)
-                return m
-            if kind == "lanemmc":
-                m = lane_mask
-                for b in op[1]:
-                    m |= 1 << b
-                return m
-            if kind == "2x2":
-                return (1 << op[1]) | op[3]
-            if kind == "2x2pair":
-                m = 0
-                for ax in (op[1], op[3]):
-                    for b, a in high_axis.items():
-                        if a == ax:
-                            m |= 1 << (b + lane_bits)
-                return m
-            if kind == "diag":
-                m = 0
-                for mask, _pr, _pi, _f in op[1]:
-                    m |= mask
-                return m
-            if kind == "dtab":
-                return lane_mask | row_mask
-            if kind == "chan":
-                m = 0
-                for b in op[2]:
-                    m |= 1 << b
-                return m
-            return ~0  # unknown: commutes with nothing
-
-        GAP = 6  # VPU ops to emit between consecutive matmuls (swept 2-10 on v5e; 6 best)
+        GAP = int(_os_env_gap())  # VPU ops between consecutive matmuls
         out_ops: list = []
         held = None       # (op, touch) being delayed
         since_mm = GAP
@@ -350,6 +386,61 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
         if held is not None:
             out_ops.append(held[0])
         planned = out_ops
+
+    # Alternate the two big VPU op classes as well: a chain of
+    # roll-select ops (lane/row partner fetches) then slice ops
+    # (exposed-axis 2x2s) runs ~4.5% slower than the same ops
+    # alternating (round-5 probe).  Reorder WITHIN each mm-free window
+    # only (mm spacing above counts VPU ops, so intra-window shuffles
+    # keep it), commute-checked via disjoint touch sets.
+    def _vpu_class(op):
+        k = op[0]
+        if k in ("2x2run", "2x2pair"):
+            return "slice"
+        if k == "2x2":
+            t = op[1]
+            if t >= lane_bits and (t - lane_bits) in high_axis:
+                return "slice"
+            return "roll"
+        return "other"
+
+    def _alt_window(window):
+        if len(window) < 3:
+            return window
+        out = []
+        rem = list(window)
+        last = None
+        while rem:
+            pick = None
+            blocked = 0
+            for j, op2 in enumerate(rem):
+                t2 = touch_mask(op2)
+                ok = not (t2 & blocked)
+                if ok:
+                    c = _vpu_class(op2)
+                    if c != last:
+                        pick = j
+                        break
+                # every scanned-and-skipped op bars later candidates
+                blocked |= t2
+            if pick is None:
+                pick = 0
+            op2 = rem.pop(pick)
+            out.append(op2)
+            last = _vpu_class(op2)
+        return out
+
+    out2 = []
+    window: list = []
+    for op in planned:
+        if op[0] in _MM:
+            out2.extend(_alt_window(window))
+            window = []
+            out2.append(op)
+        else:
+            window.append(op)
+    out2.extend(_alt_window(window))
+    planned = out2
 
     planned = tuple(planned)
     n_flags = 0 if dev_flags is None else dev_flags.shape[-1]
@@ -477,6 +568,47 @@ class _FusedBits:
         return out
 
 
+def _half_cmul2(e0, e1, r0, i0, r1, i1):
+    """e0*x0 + e1*x1 over sliced halves (complex), skipping zero terms
+    and factoring equal/opposite coefficient pairs (H-type rows)."""
+    (e0r, e0i) = e0
+    (e1r, e1i) = e1
+    outr = outi = None
+
+    def acc(o, term):
+        return term if o is None else o + term
+
+    if e0r != 0.0 and e0r == e1r:
+        outr = acc(outr, e0r * (r0 + r1))
+        outi = acc(outi, e0r * (i0 + i1))
+    elif e0r != 0.0 and e0r == -e1r:
+        outr = acc(outr, e0r * (r0 - r1))
+        outi = acc(outi, e0r * (i0 - i1))
+    else:
+        if e0r != 0.0:
+            outr = acc(outr, e0r * r0)
+            outi = acc(outi, e0r * i0)
+        if e1r != 0.0:
+            outr = acc(outr, e1r * r1)
+            outi = acc(outi, e1r * i1)
+    if e0i != 0.0 and e0i == e1i:
+        outr = acc(outr, -e0i * (i0 + i1))
+        outi = acc(outi, e0i * (r0 + r1))
+    elif e0i != 0.0 and e0i == -e1i:
+        outr = acc(outr, -e0i * (i0 - i1))
+        outi = acc(outi, e0i * (r0 - r1))
+    else:
+        if e0i != 0.0:
+            outr = acc(outr, -e0i * i0)
+            outi = acc(outi, e0i * r0)
+        if e1i != 0.0:
+            outr = acc(outr, -e1i * i1)
+            outi = acc(outi, e1i * r1)
+    zero = jnp.zeros_like(r0)
+    return (zero if outr is None else outr,
+            zero if outi is None else outi)
+
+
 def _xor_partner(x, t: int, bf: _FusedBits, high_axis, lane_bits: int,
                  c_blk: int):
     """``x[i ^ (1 << t)]`` over the fused block value, choosing the
@@ -492,11 +624,15 @@ def _xor_partner(x, t: int, bf: _FusedBits, high_axis, lane_bits: int,
     if t < lane_bits:
         s = 1 << t
         axis = len(shape) - 1
+        if 2 * s == shape[-1]:
+            return pltpu.roll(x, s, axis=axis)  # half-roll == xor swap
         up = pltpu.roll(x, shape[-1] - s, axis=axis)
         dn = pltpu.roll(x, s, axis=axis)
         return jnp.where(bf.bit(t) == 0, up, dn)
     s = 1 << (t - lane_bits)
     assert s < c_blk, (t, c_blk)
+    if 2 * s == c_blk:
+        return pltpu.roll(x, s, axis=len(shape) - 2)
     if s >= 8:
         view = shape[:-2] + (c_blk // (2 * s), 2, s, shape[-1])
         ax = len(view) - 3
@@ -640,6 +776,34 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         return _apply_chan(r, i, op, bf, high_axis, lane_bits, c_blk, dtype)
     if kind == "2x2pair":
         return _apply_2x2_pair(r, i, op)
+    if kind == "2x2run":
+        # consecutive 2x2s on ONE exposed axis: slice the halves once,
+        # chain the per-gate updates on the live halves, concat once —
+        # the slice/concat movement (not arithmetic) dominates exposed
+        # 2x2 cost, so a run of n gates costs ~one op's movement
+        _, t, gates = op
+        axis = high_axis[t - lane_bits]
+        r0 = lax.index_in_dim(r, 0, axis, keepdims=True)
+        r1 = lax.index_in_dim(r, 1, axis, keepdims=True)
+        i0 = lax.index_in_dim(i, 0, axis, keepdims=True)
+        i1 = lax.index_in_dim(i, 1, axis, keepdims=True)
+        for m, cm, fx in gates:
+            if m == _X_MAT and cm == 0 and fx < 0:
+                n0r, n0i, n1r, n1i = r1, i1, r0, i0
+            else:
+                n0r, n0i = _half_cmul2(m[0], m[1], r0, i0, r1, i1)
+                n1r, n1i = _half_cmul2(m[2], m[3], r0, i0, r1, i1)
+            if cm or fx >= 0:
+                keep = bf.bits_all_set(cm)  # cm never contains t
+                if fx >= 0:
+                    keep = jnp.logical_and(keep, flags[0, fx] > 0.5)
+                n0r = jnp.where(keep, n0r, r0)
+                n0i = jnp.where(keep, n0i, i0)
+                n1r = jnp.where(keep, n1r, r1)
+                n1i = jnp.where(keep, n1i, i1)
+            r0, i0, r1, i1 = n0r, n0i, n1r, n1i
+        return (jnp.concatenate([r0, r1], axis),
+                jnp.concatenate([i0, i1], axis))
     hi = _MAT_PRECISION
     shape = r.shape
 
@@ -803,16 +967,19 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
     if kind == "dtab":
         # Host-folded diagonal table over the (low-row x lane) field: an
         # arbitrary RUN of diagonal phases whose masks live below the
-        # high/mid bits costs ONE complex elementwise multiply.
+        # high/mid bits costs ONE complex elementwise multiply — or one
+        # REAL multiply pair when every folded phase is real (Z/CZ).
         _, tr_ix, ti_ix = op
-        tr, ti = mats[tr_ix], mats[ti_ix]
+        tr = mats[tr_ix]
         rt = tr.shape[0]
         view = shape[:-2] + (shape[-2] // rt, rt, shape[-1])
         wr = r.reshape(view)
         wi = i.reshape(view)
         bshape = (1,) * (len(view) - 2) + (rt, shape[-1])
         fr = tr.reshape(bshape)
-        fi = ti.reshape(bshape)
+        if ti_ix < 0:
+            return ((wr * fr).reshape(shape), (wi * fr).reshape(shape))
+        fi = mats[ti_ix].reshape(bshape)
         nr = wr * fr - wi * fi
         ni = wr * fi + wi * fr
         return nr.reshape(shape), ni.reshape(shape)
@@ -825,6 +992,7 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         # multi-controlled variants, QuEST_cpu.c:2666-3010) — half the
         # gates of a Clifford+T stream — collapses to near-zero cost.
         _, phases = op
+        all_real = all(phi == 0.0 for _m, _r, phi, _f in phases)
         dre = jnp.array(1.0, dtype)
         dim = jnp.array(0.0, dtype)
         for sel_mask, phr, phi, flag_ix in phases:
@@ -833,8 +1001,13 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
                 # device-bit part of the mask, resolved per device
                 sel = jnp.logical_and(sel, flags[0, flag_ix] > 0.5)
             fr = jnp.where(sel, jnp.array(phr, dtype), jnp.array(1.0, dtype))
+            if all_real:
+                dre = dre * fr
+                continue
             fi = jnp.where(sel, jnp.array(phi, dtype), jnp.array(0.0, dtype))
             dre, dim = dre * fr - dim * fi, dre * fi + dim * fr
+        if all_real:
+            return r * dre, i * dre
         return r * dre - i * dim, i * dre + r * dim
     if kind == "2x2":
         _, t, m, ctrl_mask, flag_ix = op
@@ -864,31 +1037,10 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
                 if m == _X_MAT:
                     n0r, n0i, n1r, n1i = r1, i1, r0, i0
                 else:
-                    def cmul2(e0r, e0i, e1r, e1i):
-                        """e0*x0 + e1*x1 (complex), skipping zero terms."""
-                        outr = outi = None
-
-                        def acc(o, term):
-                            return term if o is None else o + term
-
-                        if e0r != 0.0:
-                            outr = acc(outr, e0r * r0)
-                            outi = acc(outi, e0r * i0)
-                        if e0i != 0.0:
-                            outr = acc(outr, -e0i * i0)
-                            outi = acc(outi, e0i * r0)
-                        if e1r != 0.0:
-                            outr = acc(outr, e1r * r1)
-                            outi = acc(outi, e1r * i1)
-                        if e1i != 0.0:
-                            outr = acc(outr, -e1i * i1)
-                            outi = acc(outi, e1i * r1)
-                        zero = jnp.zeros_like(r0)
-                        return (zero if outr is None else outr,
-                                zero if outi is None else outi)
-
-                    n0r, n0i = cmul2(ar, ai, br, bi)
-                    n1r, n1i = cmul2(cr, ci, dr, di)
+                    n0r, n0i = _half_cmul2((ar, ai), (br, bi),
+                                           r0, i0, r1, i1)
+                    n1r, n1i = _half_cmul2((cr, ci), (dr, di),
+                                           r0, i0, r1, i1)
                 nr = jnp.concatenate([n0r, n1r], axis)
                 ni = jnp.concatenate([n0i, n1i], axis)
                 if rem_mask or flag_ix >= 0:
@@ -916,18 +1068,24 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
             # single-bit lane partner fetch: paired lane-axis rolls +
             # select, ~3 ms cheaper per gate than a 128x128 xor-perm
             # matmul at bench sizes (the MXU dots are the binding
-            # resource in dense segments; rolls ride the VPU)
+            # resource in dense segments; rolls ride the VPU).  For the
+            # TOP lane bit the cyclic roll by half IS the xor
+            # permutation: one roll, no select.
             s = 1 << t
             lanes_n = shape[-1]
             axis = len(shape) - 1
-            up_r = pltpu.roll(r, lanes_n - s, axis=axis)
-            dn_r = pltpu.roll(r, s, axis=axis)
-            up_i = pltpu.roll(i, lanes_n - s, axis=axis)
-            dn_i = pltpu.roll(i, s, axis=axis)
             bit = bf.bit(t)
-            sel0 = bit == 0
-            pr = jnp.where(sel0, up_r, dn_r)
-            pi = jnp.where(sel0, up_i, dn_i)
+            if 2 * s == lanes_n:
+                pr = pltpu.roll(r, s, axis=axis)
+                pi = pltpu.roll(i, s, axis=axis)
+            else:
+                up_r = pltpu.roll(r, lanes_n - s, axis=axis)
+                dn_r = pltpu.roll(r, s, axis=axis)
+                up_i = pltpu.roll(i, lanes_n - s, axis=axis)
+                dn_i = pltpu.roll(i, s, axis=axis)
+                sel0 = bit == 0
+                pr = jnp.where(sel0, up_r, dn_r)
+                pi = jnp.where(sel0, up_i, dn_i)
         elif (1 << (t - lane_bits)) >= 8:
             # tile-aligned row stride: the XOR partner is one half-swap of
             # a leading-dim-split view (a single VMEM copy via slice +
@@ -954,14 +1112,19 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
             s = 1 << j
             assert s < c_blk, (t, c_blk)
             axis = len(shape) - 2
-            up_r = pltpu.roll(r, c_blk - s, axis=axis)
-            dn_r = pltpu.roll(r, s, axis=axis)
-            up_i = pltpu.roll(i, c_blk - s, axis=axis)
-            dn_i = pltpu.roll(i, s, axis=axis)
             bit = bf.bit(t)
-            sel0 = bit == 0
-            pr = jnp.where(sel0, up_r, dn_r)
-            pi = jnp.where(sel0, up_i, dn_i)
+            if 2 * s == c_blk:
+                # top in-block row bit: cyclic roll by half == xor swap
+                pr = pltpu.roll(r, s, axis=axis)
+                pi = pltpu.roll(i, s, axis=axis)
+            else:
+                up_r = pltpu.roll(r, c_blk - s, axis=axis)
+                dn_r = pltpu.roll(r, s, axis=axis)
+                up_i = pltpu.roll(i, c_blk - s, axis=axis)
+                dn_i = pltpu.roll(i, s, axis=axis)
+                sel0 = bit == 0
+                pr = jnp.where(sel0, up_r, dn_r)
+                pi = jnp.where(sel0, up_i, dn_i)
         if m == _X_MAT:
             # X / CNOT: the update IS the partner fetch — skip the 8-mul
             # combine (the reference's dedicated pauliX/controlledNot

@@ -259,6 +259,12 @@ def apply_segment_xla(re, im, seg_ops: tuple, high_bits: tuple = (),
             _, ax1, m1, ax2, m2 = op
             re, im = _apply_2x2(re, im, lat, axis_to_bit[ax1], m1, None)
             re, im = _apply_2x2(re, im, lat, axis_to_bit[ax2], m2, None)
+        elif kind == "2x2run":
+            _, t, gates = op
+            for m, ctrl_mask, flag_ix in gates:
+                keep = lat.bits_all_set(ctrl_mask) if ctrl_mask else None
+                keep = flag_sel(flag_ix, keep)
+                re, im = _apply_2x2(re, im, lat, t, m, keep)
         elif kind == "chan":
             _, tag, bits, sc = op
             re, im = _chan(re, im, lat, tag, bits, sc, dtype)

@@ -626,11 +626,15 @@ def _fold_groups(seg, lane_bits: int, low_row_bits: int, high: tuple = ()):
         else:
             j += 1
 
+    import os as _os
+
+    fold_cplx = _os.environ.get("QUEST_FOLD_CPLX_LANE", "0") == "1"
     for op_ix, op in enumerate(seg):
         kind, statics, scalars = op
         if kind == "apply_phase":
             (mask,) = statics
-            if (mask & lane_mask_all) and scalars[1] == 0.0 \
+            if (mask & lane_mask_all) \
+                    and (scalars[1] == 0.0 or fold_cplx) \
                     and join_lane_real_phase(
                         mask, complex(scalars[0], scalars[1])):
                 continue
@@ -756,8 +760,12 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
                     # hide behind the HBM stream; the composed dense dot
                     # occupies the MXU and does not (probe30.py).  Folded
                     # diagonals re-emit as free diag entries, preserving
-                    # the in-group order.
-                    for it in entry.items:
+                    # the in-group order; pure-gate groups merge
+                    # same-(target, ctrl) runs first.
+                    items = entry.items
+                    if not cds:
+                        items = _merge_same_target_runs(items)
+                    for it in items:
                         if it[0] == "cd":
                             _, lane_part, cond_bits, phr = it
                             m2 = lane_part
@@ -799,7 +807,10 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
                 cmin = (default_rcm if row_compose_min is None
                         else row_compose_min)
                 if len(entry.items) < cmin:
-                    for rt, scalars, rcm in entry.items:
+                    # per-gate roll-selects, same-(target, ctrl) runs
+                    # composed to one 2x2 each (~2.7 ms/op in context)
+                    for rt, scalars, rcm in _merge_same_target_runs(
+                            entry.items):
                         out.append(("2x2", rt + lane_bits, tuple(scalars),
                                     rcm << lane_bits, -1))
                     continue
@@ -821,7 +832,7 @@ def _plan_seg(seg, lane_bits: int, chunk_bits: int, low_row_bits: int,
         target, ctrl_mask = statics
         out.append(("2x2", target, tuple(scalars), ctrl_mask & chunk_mask,
                     flag_ix(ctrl_mask)))
-    return _fold_expmm(tuple(out), high, lane_bits), tuple(dev_masks)
+    return _fold_expmm(tuple(out), high), tuple(dev_masks)
 
 
 #: Fold a segment's exposed-axis content into one composed 2^j operator
@@ -849,7 +860,7 @@ def _expmm_enabled() -> bool:
     return os.environ.get("QUEST_EXPMM", "0") == "1"
 
 
-def _fold_expmm(seg_ops, high, lane_bits):
+def _fold_expmm(seg_ops, high):
     """Compose the foldable exposed-axis content of a planned segment
     into a single ('expmm', axes, Ur, Ui) op on the MXU.
 
@@ -1084,6 +1095,46 @@ def _fold_expmm(seg_ops, high, lane_bits):
         if kept:
             out.append(("diag", tuple(kept)))
     return tuple(out)
+
+
+def _merge_same_target_runs(items):
+    """Merge a group's 2x2 items into one composed 2x2 per
+    (target, ctrl) run, commute-bubbling items left past entries they
+    commute with (mixing-vs-support, as everywhere).  Used by the
+    per-gate emission paths: with row-matrix composition off at
+    c_blk=8 (round 5), 14-16 row 2x2s per dense pass at ~2.7 ms each
+    were re-emitted unmerged even though only 3 row bits exist — same-
+    target runs compose to one op each."""
+    slots = []  # {tag, mats, bmix, bsup}
+    for it in items:
+        target, scalars, ctrl_mask = it
+        mix = 1 << target
+        sup = mix | ctrl_mask
+        placed = None
+        for sl in slots:
+            if (sl["tag"] == (target, ctrl_mask)
+                    and not (sl["bmix"] & sup)
+                    and not (mix & sl["bsup"])):
+                placed = sl
+                break
+        if placed is None:
+            placed = {"tag": (target, ctrl_mask), "mats": [],
+                      "bmix": 0, "bsup": 0}
+            slots.append(placed)
+        placed["mats"].append(scalars)
+        for sl in slots:
+            if sl is placed:
+                continue
+            sl["bmix"] |= mix
+            sl["bsup"] |= sup
+    out = []
+    for sl in slots:
+        t, cm = sl["tag"]
+        if len(sl["mats"]) == 1:
+            out.append((t, tuple(sl["mats"][0]), cm))
+        else:
+            out.append((t, _compose_2x2(sl["mats"]), cm))
+    return out
 
 
 def _compose_2x2(items):

@@ -286,7 +286,7 @@ def test_expmm_kept_diag_entry_bars_group(monkeypatch):
         ("2x2", 12, H, 0, -1),
     )
     high = (10, 11, 12)
-    folded = _fold_expmm(seg, high, 7)
+    folded = _fold_expmm(seg, high)
     assert any(op[0] == "expmm" for op in folded)
 
     n = 13

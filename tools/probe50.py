@@ -276,28 +276,62 @@ def bench_sched_variants():
     circ = models.random_circuit(N, depth=22, seed=123)
     _os.environ["QUEST_EXPMM"] = "0"
     variants = {
-        "base (lcm2 rcm3)": {},
-        "rcm999 (never rowmm)": {"row_compose_min": 999},
-        "lcm3": {"lane_compose_min": 3},
-        "lcm4": {"lane_compose_min": 4},
-        "lcm999 (never lanemm)": {"lane_compose_min": 999},
-        "lcm3 rcm999": {"lane_compose_min": 3, "row_compose_min": 999},
+        "base": {},
+        "rb4096": {"row_budget": 4096},
+        "rb4096 rcm3": {"row_budget": 4096, "row_compose_min": 3},
+        "rb8192": {"row_budget": 8192},
     }
     from quest_tpu.ops.pallas_kernels import apply_fused_segment
 
     for name, kw in variants.items():
         segs = schedule_segments(list(circ.ops), N, **kw)
+        rb = kw.get("row_budget")
 
-        def fn(re, im, segs=segs):
+        def fn(re, im, segs=segs, rb=rb):
             for seg_ops, high in segs:
                 re, im = apply_fused_segment(re, im, seg_ops,
-                                             tuple(high))
+                                             tuple(high),
+                                             row_budget=rb)
             return re, im
 
         ms = timeit(f"{name} ({len(segs)} passes)", fn)
         if ms:
             print(f"   -> {660.0 / ms * 1e3:7.1f} gates/s", flush=True)
     _os.environ.pop("QUEST_EXPMM")
+
+
+def bench_ablate():
+    """Marginal in-context cost of each op class: time bench segments
+    with one class removed at a time."""
+    from quest_tpu import models
+    from quest_tpu.scheduler import schedule_segments_best
+    from quest_tpu.ops.pallas_kernels import apply_fused_segment
+
+    circ = models.random_circuit(N, depth=22, seed=123)
+    segs = schedule_segments_best(list(circ.ops), N)
+
+    def classify(op, high):
+        k = op[0]
+        if k == "2x2":
+            t = op[1]
+            return ("x2" if t in set(high) else
+                    ("l2" if t < 7 else "r2"))
+        return k
+
+    for si in (1, 3):
+        ops, high = segs[si]
+        classes = sorted({classify(op, high) for op in ops})
+        base = timeit(f"seg{si} full ({len(ops)} ops)",
+                      make_seg_direct(ops, high))
+        for cl in classes:
+            kept = tuple(op for op in ops if classify(op, high) != cl)
+            n_rm = len(ops) - len(kept)
+            ms = timeit(f"seg{si} -{cl} (removed {n_rm})",
+                        make_seg_direct(kept, high))
+            if base and ms:
+                print(f"   -> marginal {base - ms:+7.2f} ms "
+                      f"({(base - ms) / max(n_rm, 1):+6.2f}/op)",
+                      flush=True)
 
 
 def bench_segs():
@@ -369,6 +403,8 @@ def _main():
             bench_segs()
         elif w == "schedvar":
             bench_sched_variants()
+        elif w == "ablate":
+            bench_ablate()
         elif w == "segblk":
             for rb in (1024, 2048, 4096):
                 timeit(f"seg n_2x2=24 rb={rb}",
