@@ -261,58 +261,6 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
             planned.append(op)
         else:
             planned.append(op)
-    # Pair-fuse adjacent uncontrolled 2x2s on DISTINCT exposed axes: the
-    # tensor gate (M1 on axis1) (x) (M2 on axis2) costs one slice+concat
-    # round over the block instead of two — exposed-axis ops are
-    # VMEM-copy-bound, so this halves their cost (same-axis runs were
-    # already composed by the scheduler's T groups).
-    if high_axis:
-        merged = []
-        for op in planned:
-            if (op[0] == "2x2" and merged and merged[-1][0] == "2x2"):
-                prev = merged[-1]
-                t1, t2 = prev[1], op[1]
-                if (prev[3] == 0 and prev[4] < 0 and op[3] == 0
-                        and op[4] < 0 and t1 != t2
-                        and t1 >= lane_bits and t2 >= lane_bits
-                        and (t1 - lane_bits) in high_axis
-                        and (t2 - lane_bits) in high_axis):
-                    merged[-1] = ("2x2pair",
-                                  high_axis[t1 - lane_bits], prev[2],
-                                  high_axis[t2 - lane_bits], op[2])
-                    continue
-            merged.append(op)
-        planned = merged
-    # Fuse CONSECUTIVE 2x2s on the SAME exposed axis (different ctrl
-    # masks — same-(target, ctrl) runs were already host-composed) into
-    # one sliced round: the halves stay live across the run, sharing
-    # the slice + concat data movement that dominates exposed-op cost.
-    if high_axis:
-        merged = []
-        for op in planned:
-            if (op[0] == "2x2" and merged
-                    and op[1] >= lane_bits
-                    and (op[1] - lane_bits) in high_axis):
-                prev = merged[-1]
-                if (prev[0] == "2x2" and prev[1] == op[1]):
-                    merged[-1] = ("2x2run", op[1],
-                                  ((prev[2], prev[3], prev[4]),
-                                   (op[2], op[3], op[4])))
-                    continue
-                if prev[0] == "2x2run" and prev[1] == op[1]:
-                    merged[-1] = ("2x2run", op[1],
-                                  prev[2] + ((op[2], op[3], op[4]),))
-                    continue
-            merged.append(op)
-        planned = merged
-    # Interleave MXU matmul ops among the VPU-class ops they commute
-    # with: a dense pass ordered [mm, mm, ..., 2x2 x30] costs ~23% more
-    # than the same ops alternating (tools/probe40b round-4 probe — the
-    # units overlap when the instruction stream mixes them).  Each mm is
-    # DELAYED until a few commuting VPU ops have been emitted after the
-    # previous mm.  Touch sets: lanemm = lane bits; rowmm = low rows;
-    # lanemmc = lanes + its conditioning bits; moving past an op
-    # requires disjoint touch sets.
     _MM = ("lanemm", "lanemmc", "rowmm", "expmm")
     lane_mask = (1 << lane_bits) - 1
     row_mask = ((c_blk - 1) << lane_bits)
@@ -362,9 +310,86 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
             return m
         return ~0  # unknown: commutes with nothing
 
+    # Fuse 2x2s on the SAME exposed axis (different ctrl masks —
+    # same-(target, ctrl) runs were already host-composed) into one
+    # sliced round: the halves stay live across the run, sharing the
+    # slice + concat data movement that dominates exposed-op cost.  A
+    # later same-axis 2x2 bubbles LEFT across commuting ops (disjoint
+    # touch sets) into the open run for its axis.
+    if high_axis:
+        merged: list = []
+        open_runs: dict = {}  # target -> [merged_index, barrier_mask]
+
+        def _sup_of(op):
+            return (1 << op[1]) | op[3]
+
+        for op in planned:
+            if (op[0] == "2x2" and op[1] >= lane_bits
+                    and (op[1] - lane_bits) in high_axis):
+                t = op[1]
+                sup = _sup_of(op)
+                run = open_runs.get(t)
+                if run is not None and not (sup & run[1]):
+                    idx = run[0]
+                    prev = merged[idx]
+                    gate = (op[2], op[3], op[4])
+                    if prev[0] == "2x2":
+                        merged[idx] = ("2x2run", t,
+                                       ((prev[2], prev[3], prev[4]),
+                                        gate))
+                    else:
+                        merged[idx] = ("2x2run", t, prev[2] + (gate,))
+                    # this op now executes at idx: it bars every run
+                    # OPENED EARLIER (their future members must commute
+                    # past it)
+                    for ot, orun in open_runs.items():
+                        if ot != t and orun[0] < idx:
+                            orun[1] |= sup
+                    continue
+                open_runs[t] = [len(merged), 0]
+                for ot, orun in open_runs.items():
+                    if ot != t:
+                        orun[1] |= sup
+                merged.append(op)
+                continue
+            tm = touch_mask(op)
+            for orun in open_runs.values():
+                orun[1] |= tm
+            merged.append(op)
+        planned = merged
+    # Pair-fuse adjacent uncontrolled 2x2s on DISTINCT exposed axes: the
+    # tensor gate (M1 on axis1) (x) (M2 on axis2) costs one slice+concat
+    # round over the block instead of two — exposed-axis ops are
+    # VMEM-copy-bound, so this halves their cost (same-axis runs were
+    # already composed by the scheduler's T groups).
+    if high_axis:
+        merged = []
+        for op in planned:
+            if (op[0] == "2x2" and merged and merged[-1][0] == "2x2"):
+                prev = merged[-1]
+                t1, t2 = prev[1], op[1]
+                if (prev[3] == 0 and prev[4] < 0 and op[3] == 0
+                        and op[4] < 0 and t1 != t2
+                        and t1 >= lane_bits and t2 >= lane_bits
+                        and (t1 - lane_bits) in high_axis
+                        and (t2 - lane_bits) in high_axis):
+                    merged[-1] = ("2x2pair",
+                                  high_axis[t1 - lane_bits], prev[2],
+                                  high_axis[t2 - lane_bits], op[2])
+                    continue
+            merged.append(op)
+        planned = merged
+    # Interleave MXU matmul ops among the VPU-class ops they commute
+    # with: a dense pass ordered [mm, mm, ..., 2x2 x30] costs ~23% more
+    # than the same ops alternating (tools/probe40b round-4 probe — the
+    # units overlap when the instruction stream mixes them).  Each mm is
+    # DELAYED until a few commuting VPU ops have been emitted after the
+    # previous mm.  Touch sets: lanemm = lane bits; rowmm = low rows;
+    # lanemmc = lanes + its conditioning bits; moving past an op
+    # requires disjoint touch sets.
     if any(op[0] in _MM for op in planned) \
             and any(op[0] not in _MM for op in planned):
-        GAP = int(_os_env_gap())  # VPU ops between consecutive matmuls
+        GAP = _os_env_gap()  # VPU ops between consecutive matmuls
         out_ops: list = []
         held = None       # (op, touch) being delayed
         since_mm = GAP
@@ -411,17 +436,23 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
         rem = list(window)
         last = None
         while rem:
+            # candidates: ops that commute past everything before them;
+            # among them prefer the class with the LARGEST remaining
+            # pool (draining small pools early strands same-class runs
+            # at the end of the window)
+            pools: dict = {}
+            for op2 in rem:
+                c = _vpu_class(op2)
+                pools[c] = pools.get(c, 0) + 1
             pick = None
+            best = -1
             blocked = 0
             for j, op2 in enumerate(rem):
                 t2 = touch_mask(op2)
-                ok = not (t2 & blocked)
-                if ok:
+                if not (t2 & blocked):
                     c = _vpu_class(op2)
-                    if c != last:
-                        pick = j
-                        break
-                # every scanned-and-skipped op bars later candidates
+                    if c != last and pools[c] > best:
+                        pick, best = j, pools[c]
                 blocked |= t2
             if pick is None:
                 pick = 0
@@ -1086,6 +1117,13 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
                 sel0 = bit == 0
                 pr = jnp.where(sel0, up_r, dn_r)
                 pi = jnp.where(sel0, up_i, dn_i)
+        elif 2 * (1 << (t - lane_bits)) == c_blk:
+            # top in-block row bit: cyclic roll by half == xor swap
+            s = 1 << (t - lane_bits)
+            axis = len(shape) - 2
+            bit = bf.bit(t)
+            pr = pltpu.roll(r, s, axis=axis)
+            pi = pltpu.roll(i, s, axis=axis)
         elif (1 << (t - lane_bits)) >= 8:
             # tile-aligned row stride: the XOR partner is one half-swap of
             # a leading-dim-split view (a single VMEM copy via slice +
@@ -1110,21 +1148,16 @@ def _apply_fused_op(r, i, op, bf: _FusedBits, high_axis, lane_bits, c_blk,
         else:
             j = t - lane_bits
             s = 1 << j
-            assert s < c_blk, (t, c_blk)
+            assert s < c_blk, (t, c_blk)  # 2*s == c_blk handled above
             axis = len(shape) - 2
             bit = bf.bit(t)
-            if 2 * s == c_blk:
-                # top in-block row bit: cyclic roll by half == xor swap
-                pr = pltpu.roll(r, s, axis=axis)
-                pi = pltpu.roll(i, s, axis=axis)
-            else:
-                up_r = pltpu.roll(r, c_blk - s, axis=axis)
-                dn_r = pltpu.roll(r, s, axis=axis)
-                up_i = pltpu.roll(i, c_blk - s, axis=axis)
-                dn_i = pltpu.roll(i, s, axis=axis)
-                sel0 = bit == 0
-                pr = jnp.where(sel0, up_r, dn_r)
-                pi = jnp.where(sel0, up_i, dn_i)
+            up_r = pltpu.roll(r, c_blk - s, axis=axis)
+            dn_r = pltpu.roll(r, s, axis=axis)
+            up_i = pltpu.roll(i, c_blk - s, axis=axis)
+            dn_i = pltpu.roll(i, s, axis=axis)
+            sel0 = bit == 0
+            pr = jnp.where(sel0, up_r, dn_r)
+            pi = jnp.where(sel0, up_i, dn_i)
         if m == _X_MAT:
             # X / CNOT: the update IS the partner fetch — skip the 8-mul
             # combine (the reference's dedicated pauliX/controlledNot
