@@ -73,10 +73,14 @@ _X_MAT = ((0.0, 0.0), (1.0, 0.0), (1.0, 0.0), (0.0, 0.0))
 def _combine_2x2(r, i, pr, pi, bit, m):
     (ar, ai), (br, bi), (cr, ci), (dr, di) = m
     is0 = bit == 0
-    sr = jnp.where(is0, ar, dr)
-    si = jnp.where(is0, ai, di)
-    tr = jnp.where(is0, br, cr)
-    ti = jnp.where(is0, bi, ci)
+    # where(bool, py_float, py_float) takes the STRONG default dtype —
+    # f64 under x64 even for f32 state — so pin the coefficients
+    dt = r.dtype
+    c = lambda v: jnp.asarray(v, dt)  # noqa: E731
+    sr = jnp.where(is0, c(ar), c(dr))
+    si = jnp.where(is0, c(ai), c(di))
+    tr = jnp.where(is0, c(br), c(cr))
+    ti = jnp.where(is0, c(bi), c(ci))
     nr = sr * r - si * i + tr * pr - ti * pi
     ni = sr * i + si * r + tr * pi + ti * pr
     return nr, ni

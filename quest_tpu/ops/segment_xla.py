@@ -65,10 +65,14 @@ def _apply_2x2(r, i, lat, t, m, keep):
     else:
         bit = lat.bit(t)
         is0 = bit == 0
-        sr = jnp.where(is0, ar, dr)
-        si = jnp.where(is0, ai, di)
-        tr = jnp.where(is0, br, cr)
-        ti = jnp.where(is0, bi, ci)
+        # pin coefficient dtype: where(bool, py_float, py_float) takes
+        # the strong default — f64 under x64 even for f32 state
+        dt = r.dtype
+        c = lambda v: jnp.asarray(v, dt)  # noqa: E731
+        sr = jnp.where(is0, c(ar), c(dr))
+        si = jnp.where(is0, c(ai), c(di))
+        tr = jnp.where(is0, c(br), c(cr))
+        ti = jnp.where(is0, c(bi), c(ci))
         nr = sr * r - si * i + tr * pr - ti * pi
         ni = sr * i + si * r + tr * pi + ti * pr
     if keep is not None:

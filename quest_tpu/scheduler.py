@@ -1145,5 +1145,8 @@ def _compose_2x2(items):
         g = np.array([[ar + 1j * ai, br + 1j * bi],
                       [cr + 1j * ci, dr + 1j * di]])
         m = g @ m
-    return ((m[0, 0].real, m[0, 0].imag), (m[0, 1].real, m[0, 1].imag),
-            (m[1, 0].real, m[1, 0].imag), (m[1, 1].real, m[1, 1].imag))
+    # PYTHON floats, not numpy scalars: np.float64 coefficients are not
+    # weak-typed and silently promote f32 kernel arithmetic to f64
+    # under x64 (caught by the 20q pallas-vs-xla backend test)
+    return tuple((float(m[r, c].real), float(m[r, c].imag))
+                 for r, c in ((0, 0), (0, 1), (1, 0), (1, 1)))

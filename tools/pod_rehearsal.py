@@ -83,6 +83,38 @@ warm = time.perf_counter() - t0
 q._set(re, im)
 total = qt.calc_total_prob(q)
 
+# Execute one PALLAS-backend segment of the same plan on this
+# process's own chunk data (interpret mode — the kernels that run
+# natively on a pod's chips), asserting equivalence with the XLA
+# segment backend (VERDICT r4 #2: the Pallas path had never executed
+# under the rehearsal flow).  Per-process device-flag values differ
+# (dev = pid * dev_per_proc), so both flag polarities are exercised.
+from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from quest_tpu.ops.segment_xla import apply_segment_xla
+
+segs = [it for it in plan if it[0] == "seg"]
+_, seg_ops, shigh, dev_masks = max(segs, key=lambda s: len(s[1]))
+dev = pid * {dev_per_proc}
+flags = None
+if dev_masks:
+    flags = jnp.asarray([[1.0 if (dev & dm) == dm else 0.0
+                          for dm in dev_masks]], jnp.float32)
+chunk_rows = (1 << (n - dev_bits)) // lanes
+rng = np.random.default_rng(100 + pid)
+cre = jnp.asarray(rng.standard_normal((chunk_rows, lanes)), jnp.float32)
+cim = jnp.asarray(rng.standard_normal((chunk_rows, lanes)), jnp.float32)
+t0 = time.perf_counter()
+pr, pi2 = apply_fused_segment(cre, cim, seg_ops, tuple(shigh),
+                              interpret=True, dev_flags=flags)
+jax.block_until_ready((pr, pi2))
+pallas_seg_s = time.perf_counter() - t0
+xr, xi = apply_segment_xla(cre, cim, seg_ops, tuple(shigh),
+                           dev_flags=flags)
+pallas_vs_xla_err = max(
+    float(np.abs(np.asarray(pr) - np.asarray(xr)).max()),
+    float(np.abs(np.asarray(pi2) - np.asarray(xi)).max()))
+assert pallas_vs_xla_err < 1e-5, pallas_vs_xla_err
+
 chunk_bytes = 2 * (1 << (n - dev_bits)) * 4
 print("RESULT " + json.dumps({{
     "pid": pid, "devices": ndev, "qubits": n,
@@ -94,13 +126,125 @@ print("RESULT " + json.dumps({{
     "plan_swaps": stats["swaps"],
     "plan_chunk_volume": stats["chunk_volume"],
     "exchange_bytes_per_device": int(stats["chunk_volume"] * chunk_bytes),
+    "pallas_segment_ops": len(seg_ops),
+    "pallas_segment_seconds": round(pallas_seg_s, 2),
+    "pallas_vs_xla_err": pallas_vs_xla_err,
 }}), flush=True)
 qt.destroy_env(env)
 """
 
 
+_CHIP_STAGE = """
+import sys, time, json
+sys.path.insert(0, {repo!r})
+which = sys.argv[1]
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from quest_tpu import models
+from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn
+from quest_tpu.ops.lattice import run_kernel, state_shape
+
+n = {n}
+circ = models.random_circuit(n, depth=2, seed=31)
+shape = state_shape(1 << n)
+
+def fetches(re, im):
+    p0 = np.asarray(jax.device_get(run_kernel(
+        (re, im), (), kind="sv_prob_zero_all", statics=(n,),
+        mesh=None, out_kind="scalar")), dtype=np.float64)
+    pre_r = np.asarray(jax.device_get(re[:16]))
+    pre_i = np.asarray(jax.device_get(im[:16]))
+    return p0, pre_r, pre_i
+
+t0 = time.perf_counter()
+if which == "mesh":
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("amp",))
+    fn = as_mesh_fused_fn(list(circ.ops), n, mesh, backend="pallas")
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    re, im = jax.jit(fn, donate_argnums=(0, 1))(re, im)
+    jax.block_until_ready((re, im))
+else:
+    # donated raw-array form (Circuit.run's mutating facade keeps both
+    # input and output pairs live — 16 GiB at 30q; see RANDOM34's
+    # driver for the same pattern)
+    fn = circ.compile(mesh=None, donate=True)
+    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    im = jnp.zeros(shape, jnp.float32)
+    re, im = fn(re, im)
+    jax.block_until_ready((re, im))
+secs = time.perf_counter() - t0
+p0, pre_r, pre_i = fetches(re, im)
+print("STAGE " + json.dumps({{
+    "which": which, "seconds": round(secs, 2),
+    "p0": p0.tolist(),
+    "pre_r": pre_r.tolist(), "pre_i": pre_i.tolist(),
+}}), flush=True)
+"""
+
+
+def real_chip_mesh_pallas(n: int = 30):
+    """Run a schedule_mesh plan through the PALLAS backend under
+    shard_map on the real chip (1-device mesh) at full size: proves the
+    shard_map + Mosaic combination compiles and executes at 30q — the
+    configuration a pod would actually run (VERDICT r4 #2).  Equivalence
+    is checked against the single-device fused executor on the same
+    circuit via the per-qubit probability table and a 2048-amplitude
+    prefix (full-state fetches are tunnel-prohibitive at 8 GiB; each
+    stage runs in its own process so HBM holds exactly one 8 GiB
+    register pair at a time)."""
+    import numpy as np
+
+    try:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return {"ok": False, "skipped": True,
+                    "note": "no TPU attached; stage needs the real chip"}
+    except Exception as e:  # pragma: no cover
+        return {"ok": False, "skipped": True, "note": str(e)[:200]}
+
+    out = {"qubits": n}
+    stage_res = {}
+    for which in ("mesh", "single"):
+        code = _CHIP_STAGE.format(repo=REPO, n=n)
+        try:
+            p = subprocess.run([sys.executable, "-c", code, which],
+                               capture_output=True, text=True, cwd=REPO,
+                               timeout=1800)
+        except subprocess.TimeoutExpired:
+            out["ok"] = False
+            out["error_" + which] = "timed out after 1800 s"
+            return out
+        line = next((ln for ln in p.stdout.splitlines()
+                     if ln.startswith("STAGE ")), None)
+        if p.returncode != 0 or line is None:
+            out["ok"] = False
+            out["error_" + which] = (p.stdout + p.stderr)[-1500:]
+            return out
+        stage_res[which] = json.loads(line[len("STAGE "):])
+    m, s = stage_res["mesh"], stage_res["single"]
+    out["mesh_pallas_compile_plus_run_seconds"] = m["seconds"]
+    out["single_device_fused_seconds"] = s["seconds"]
+    out["prob_table_err"] = float(np.abs(
+        np.array(m["p0"]) - np.array(s["p0"])).max())
+    out["amp_prefix_err"] = float(max(
+        np.abs(np.array(m["pre_r"]) - np.array(s["pre_r"])).max(),
+        np.abs(np.array(m["pre_i"]) - np.array(s["pre_i"])).max()))
+    out["ok"] = (out["prob_table_err"] < 1e-5
+                 and out["amp_prefix_err"] < 1e-5)
+    return out
+
+
 def main():
-    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    # Stage 1: the real-chip shard_map+Mosaic execution at 30q (runs in
+    # THIS process, which sees the attached TPU; the rehearsal workers
+    # below are forced onto virtual CPU devices via their env).
+    chip = real_chip_mesh_pallas()
+    print("real-chip mesh pallas:", json.dumps(chip), flush=True)
     port = 19960 + (os.getpid() % 37)
     worker = _WORKER.format(repo=REPO, port=port, nproc=NPROC,
                             dev_per_proc=DEV_PER_PROC, n=N_QUBITS)
@@ -124,13 +268,21 @@ def main():
     wall = time.perf_counter() - t0
 
     ok = (not errs and len(results) == NPROC
-          and all(abs(r["total_prob"] - 1.0) < 1e-4 for r in results))
+          and all(abs(r["total_prob"] - 1.0) < 1e-4 for r in results)
+          and all(r.get("pallas_vs_xla_err", 1.0) < 1e-5
+                  for r in results)
+          # a deliberately-skipped chip stage (no TPU attached) must not
+          # fail the CPU rehearsal flow
+          and (chip.get("ok", False) or chip.get("skipped", False)))
     art = {
         "config": f"pod launch rehearsal: {NPROC} processes x "
                   f"{DEV_PER_PROC} virtual devices, {N_QUBITS}q "
-                  "fused-mesh plan (XLA segment backend), real "
-                  "cross-process relayout exchanges",
+                  "fused-mesh plan (XLA segment backend + one Pallas "
+                  "segment per process), real cross-process relayout "
+                  "exchanges; plus the 30q shard_map+Mosaic execution "
+                  "on the real chip",
         "ok": ok,
+        "real_chip_mesh_pallas": chip,
         "wall_seconds": round(wall, 2),
         "per_process": results,
         "launch_command": "examples/submissionScripts/"
