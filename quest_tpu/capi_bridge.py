@@ -118,8 +118,13 @@ def init(precision_code: int, platform: str = "cpu") -> int:
     # live): on the tunnelled 1-chip host the ~1-2 s device upload then
     # overlaps the driver's startup + gate recording instead of sitting
     # on the first flush's critical path (CDRIVER_r03 breakdown).
-    from .register import _trace, aot_speculative_preload
+    from .register import (_trace, aot_speculative_preload,
+                           pallas_runtime_warmup)
 
+    # One-time Mosaic runtime init on a microscopic kernel — general
+    # case (no stream assumption); without it the first real stream's
+    # first execution pays ~2.6-3.4 s on the tunnelled host.
+    pallas_runtime_warmup(sync=True)
     aot_speculative_preload()
     _trace("bridge init done (speculative preload started)")
     return 0

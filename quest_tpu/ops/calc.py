@@ -44,15 +44,24 @@ def _prob_table(qureg: Qureg) -> np.ndarray:
                 out_kind="scalar",
             )
         else:
-            vec = run_kernel(
-                (re, im), (), kind="sv_prob_zero_all",
-                statics=(qureg.num_vec_qubits,), mesh=qureg.mesh,
-                out_kind="scalar",
-            )
+            warm = None
+            if qureg.mesh is None:
+                from ..register import readout_warm_get
+
+                warm = readout_warm_get("p0", re.shape, re.dtype,
+                                        qureg.num_vec_qubits)
+            if warm is not None:
+                vec = warm((re, im), ())
+            else:
+                vec = run_kernel(
+                    (re, im), (), kind="sv_prob_zero_all",
+                    statics=(qureg.num_vec_qubits,), mesh=qureg.mesh,
+                    out_kind="scalar",
+                )
         import jax
 
+        _trace("prob table program dispatched")
         tab = np.asarray(jax.device_get(vec), dtype=np.float64)
-        from ..register import _trace
         _trace("prob table fetched")
         qureg._readout["p0"] = tab
     return tab

@@ -123,3 +123,31 @@ def test_nonmatching_alloc_drops_speculation():
                 jnp.zeros(shape, jnp.float32)))
     qt.create_qureg(7, env, dtype=jnp.float32)   # different size
     assert reg._SPEC_EXEC is None
+
+
+def test_warm_mode_never_registers_adoption(monkeypatch, tmp_path):
+    """QUEST_AOT_SPECULATE=warm warms the executable staging but must
+    never offer a result for adoption: _SPEC_EXEC stays None, so every
+    output is computed inside the caller's own flush."""
+    import os
+    import pickle
+
+    monkeypatch.setenv("QUEST_AOT_SPECULATE", "warm")
+    monkeypatch.setenv("QUEST_AOT_CACHE", str(tmp_path))
+    reg._SPEC_AOT = None
+    reg._SPEC_EXEC = None
+    # a fake most-recently-used blob + sidecar (the load will fail
+    # harmlessly on the fake blob; what matters is the adoption key)
+    blob = tmp_path / "stream-deadbeef.pkl"
+    blob.write_bytes(pickle.dumps(("not", "a", "real", "blob")))
+    meta = (("fake-op",), 6, "float32")
+    (tmp_path / "stream-deadbeef.pkl.meta").write_bytes(
+        pickle.dumps(meta))
+    reg.aot_speculative_preload()
+    try:
+        assert reg._SPEC_EXEC is None   # warm mode: nothing to adopt
+        assert not reg._spec_exec_pending(6, "float32", None)
+    finally:
+        if reg._SPEC_AOT is not None:
+            reg._SPEC_AOT[1].join()
+            reg._SPEC_AOT = None
