@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .ops.lattice import run_kernel, state_shape
 from .ops import gates as _g
@@ -427,20 +428,44 @@ class Circuit:
             self._compiled[key] = fn
         return fn
 
-    def sample(self, shots: int, key=None, dtype=None):
+    #: ``sample(mode="auto")`` picks vmap while the concurrent shot
+    #: states fit this many bytes (shots x one (re, im) pair); beyond
+    #: it, the sequential collapse-replay mode keeps memory at ONE
+    #: state regardless of shot count.
+    SAMPLE_VMAP_BYTES = 2 << 30
+
+    def sample(self, shots: int, key=None, dtype=None, mode: str = "auto"):
         """Run ``shots`` independent executions of the circuit from
         |0...0> and return the measurement outcomes as an int32 array of
-        shape (shots, num_measurements).
+        shape (shots, num_measurements).  Memory: ``mode="vmap"`` holds
+        shots x 2^n amplitudes concurrently (fastest for small states);
+        ``mode="sequential"`` holds ONE state pair at any shot count
+        (the state lives in a ``fori_loop`` carry that XLA keeps in
+        place), so it samples at any size a single state fits; ``mode="auto"`` picks vmap only
+        while shots x state fits ``SAMPLE_VMAP_BYTES``.
 
-        TPU-native shot batching the reference cannot express: the shot
-        axis is ``jax.vmap``-ed over PRNG keys, so every shot shares ONE
-        compiled program and the gate kernels batch across shots — the
-        reference re-enters the C API per gate per shot with a host RNG
-        draw at each measurement (measure, QuEST.c:578-590).
+        Two TPU-native shot-batching strategies the reference cannot
+        express (it re-enters the C API per gate per shot with a host
+        RNG draw at each measurement — measure, QuEST.c:578-590):
 
-        Memory scales as shots x 2^n amplitudes (the shots evolve
-        concurrently); intended for small/medium registers.  Requires at
-        least one recorded ``measure``.
+        * ``mode="vmap"``: the shot axis is ``jax.vmap``-ed over PRNG
+          keys — every shot shares ONE compiled program and the gate
+          kernels batch across shots.  Fastest for small states, but
+          memory scales as shots x 2^n amplitudes (the shots evolve
+          concurrently).
+        * ``mode="sequential"``: ONE state pair replayed inside a
+          ``lax.fori_loop`` over shots (the carry stays in place inside
+          the program) — each iteration re-initialises
+          |0...0> in place, runs the circuit (fused Pallas segments on
+          TPU: the state is unbatched, so the fast path applies), draws
+          the outcomes on-device, and stores them.  Memory is one state
+          pair regardless of shot count, so sampling works at any size
+          a single state fits (30 qubits f32 on one v5e) — still with
+          no host sync inside the loop.
+        * ``mode="auto"`` (default): vmap while shots x state fits
+          ``SAMPLE_VMAP_BYTES``, else sequential.
+
+        Requires at least one recorded ``measure``.
         """
         import operator
 
@@ -453,34 +478,81 @@ class Circuit:
             raise _v.QuESTError("Circuit.sample: shots must be an integer")
         if shots < 1:
             raise _v.QuESTError("Circuit.sample: shots must be >= 1")
+        if mode not in ("auto", "vmap", "sequential"):
+            raise _v.QuESTError(
+                "Circuit.sample: mode must be 'auto', 'vmap' or "
+                "'sequential'")
         if key is None:
             from .env import default_measure_key
 
             key = default_measure_key()
         dtype = jnp.dtype(dtype or _prec.default_real_dtype())
+        nvec = self.num_qubits * (2 if self.is_density else 1)
+        shape = state_shape(1 << nvec)
+        if mode == "auto":
+            pair_bytes = 2 * (1 << nvec) * dtype.itemsize
+            mode = ("vmap" if shots * pair_bytes <= self.SAMPLE_VMAP_BYTES
+                    else "sequential")
         # Memoised like compile(): jit caches on function identity, so a
         # fresh closure per call would re-trace and re-compile the whole
-        # vmapped circuit on every sample() call.
-        memo_key = ("sample", tuple(self.ops), dtype.name)
+        # sampler on every sample() call.  The vmap sampler is
+        # shots-polymorphic (the batch is an input); the sequential one
+        # burns the trip count into its fori_loop.
+        memo_key = ("sample", tuple(self.ops), dtype.name, mode,
+                    shots if mode == "sequential" else None)
         sampler = self._compiled.get(memo_key)
         if sampler is None:
-            nvec = self.num_qubits * (2 if self.is_density else 1)
-            shape = state_shape(1 << nvec)
-            # the gate-at-a-time XLA kernels are shape-polymorphic under
-            # vmap; the fused Pallas path is not (block specs assume an
-            # unbatched state), so sample() always uses the kernel path
-            fn = self.as_fn(mesh=None)
+            if mode == "vmap":
+                # the gate-at-a-time XLA kernels are shape-polymorphic
+                # under vmap; the fused Pallas path is not (block specs
+                # assume an unbatched state), so vmap sampling uses the
+                # kernel path
+                fn = self.as_fn(mesh=None)
 
-            def one(k):
-                # flat index 0 is |0...0> for state-vectors and |0><0|
-                # for density matrices alike
-                re0 = jnp.zeros(shape, dtype).at[0, 0].set(1)
-                im0 = jnp.zeros(shape, dtype)
-                return fn(re0, im0, k)[2]
+                def one(k):
+                    # flat index 0 is |0...0> for state-vectors and
+                    # |0><0| for density matrices alike
+                    re0 = jnp.zeros(shape, dtype).at[0, 0].set(1)
+                    im0 = jnp.zeros(shape, dtype)
+                    return fn(re0, im0, k)[2]
 
-            sampler = jax.jit(jax.vmap(one))
-            self._compiled[memo_key] = sampler
-        return sampler(jax.random.split(key, shots))
+                vmapped = jax.jit(jax.vmap(one))
+
+                def call(k, n):
+                    return vmapped(jax.random.split(k, n))
+            else:
+                # sequential collapse-replay: the state is unbatched, so
+                # the fused Pallas executor applies; the pair is a
+                # fori_loop carry XLA keeps in place
+                use_pallas = jax.default_backend() == "tpu"
+                fn = (self.as_fused_fn() if use_pallas
+                      else self.as_fn(mesh=None))
+                n_m = self.num_measurements
+
+                def body(shot, carry):
+                    re, im, outs, k = carry
+                    k, sub = jax.random.split(k)
+                    re = jnp.zeros_like(re).at[0, 0].set(1)
+                    im = jnp.zeros_like(im)
+                    re, im, out = fn(re, im, sub)
+                    return re, im, outs.at[shot].set(out), k
+
+                def seq(k):
+                    re0 = jnp.zeros(shape, dtype)
+                    im0 = jnp.zeros(shape, dtype)
+                    outs0 = jnp.zeros((shots, n_m), jnp.int32)
+                    _, _, outs, _ = lax.fori_loop(
+                        0, shots, body, (re0, im0, outs0, k))
+                    return outs
+
+                jitted = jax.jit(seq)
+
+                def call(k, n):
+                    return jitted(k)
+
+            self._compiled[memo_key] = call
+            sampler = call
+        return sampler(key, shots)
 
     def run(self, qureg, pallas: str = "auto", key=None):
         """Apply to a register (mutating facade, like the eager API).

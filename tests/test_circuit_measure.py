@@ -227,3 +227,41 @@ def test_default_measure_key_follows_agreed_seed():
     assert (k1 == k2).all()          # agreed seed -> agreed key
     assert not (k2 == k3).all()      # successive draws differ
     qt.seed_quest_default()
+
+
+def test_sample_sequential_matches_vmap_statistics():
+    """The sequential collapse-replay sampler (one donated state,
+    fori_loop over shots — VERDICT r4 #4: sampling must scale past
+    shots x state memory) must agree with the vmapped sampler's
+    distribution and correlations on a GHZ circuit, and auto mode must
+    pick it when the batch would not fit SAMPLE_VMAP_BYTES."""
+    import jax
+    import numpy as np
+    from quest_tpu.circuit import Circuit
+
+    c = Circuit(6)
+    c.hadamard(0)
+    for t in range(1, 6):
+        c.cnot(0, t)
+    for t in range(6):
+        c.measure(t)
+    o = np.asarray(c.sample(300, key=jax.random.PRNGKey(7),
+                            mode="sequential"))
+    assert o.shape == (300, 6)
+    # GHZ: all outcomes in a shot identical, halves balanced
+    assert (o == o[:, :1]).all()
+    assert 0.35 < o[:, 0].mean() < 0.65
+    # cross-mode: the vmapped sampler must see the same distribution
+    ov = np.asarray(c.sample(300, key=jax.random.PRNGKey(9),
+                             mode="vmap"))
+    assert (ov == ov[:, :1]).all()
+    assert abs(ov[:, 0].mean() - o[:, 0].mean()) < 0.15
+
+    old = Circuit.SAMPLE_VMAP_BYTES
+    try:
+        Circuit.SAMPLE_VMAP_BYTES = 1  # force auto -> sequential
+        o2 = np.asarray(c.sample(16, key=jax.random.PRNGKey(8)))
+        assert o2.shape == (16, 6)
+        assert (o2 == o2[:, :1]).all()
+    finally:
+        Circuit.SAMPLE_VMAP_BYTES = old

@@ -1,13 +1,14 @@
 """On-chip compiled-measurement throughput: SAMPLE_r{N}.json.
 
-Workload: 20-qubit Bernstein-Vazirani with a full measurement layer
-(20 recorded measures), the round-3 flagship feature — measurement
-compiled INTO the program, outcomes drawn on device
-(quest_tpu.circuit.Circuit.measure).  Records shots/sec at 1, 8 and 64
-shots via ``Circuit.sample`` (vmapped shot batching: one compiled
-program, gate kernels batch across shots) against the eager per-shot
-loop (``Circuit.run`` once per shot — itself already compiled, but one
-dispatch + key per shot), and states the memory bound.
+Workload: Bernstein-Vazirani with a full measurement layer —
+measurement compiled INTO the program, outcomes drawn on device
+(quest_tpu.circuit.Circuit.measure).  Records shots/sec for BOTH
+sampling modes: the 20-qubit vmapped batch (one compiled program, gate
+kernels batch across shots; memory scales with shots) at 1/8/64 shots,
+and the round-5 sequential collapse-replay mode at 26 qubits (one
+state pair in a fori_loop carry at any shot count) — against the eager
+per-shot loop (``Circuit.run`` once per shot), with the memory bounds
+stated.
 
 Reference being beaten: a host RNG draw + full API re-entry per gate
 per shot (measure -> generateMeasurementOutcome, QuEST.c:578-590,
@@ -51,27 +52,50 @@ def main():
         read = (outs * (1 << np.arange(N))).sum(axis=-1)
         assert (read == SECRET).all(), "BV must read the secret"
 
-    # -- Circuit.sample: one vmapped compiled program per shot count
-    sample_rows = []
-    for shots in (1, 8, 64):
-        key = jax.random.PRNGKey(7)
-        outs = circ.sample(shots, key=key)      # compile + run
+    def time_mode(c, shots, checker, key_base, **kw):
+        """Warm-up + best-of-3 timing of one sample() config; a host
+        fetch is the only true sync on the tunnelled host."""
+        outs = c.sample(shots, key=jax.random.PRNGKey(7), **kw)
         jax.block_until_ready(outs)
-        check(outs)
+        checker(outs)
         times = []
         for r in range(3):
-            k = jax.random.PRNGKey(100 + r)
+            k = jax.random.PRNGKey(key_base + r)
             t0 = time.perf_counter()
-            outs = circ.sample(shots, key=k)
-            outs = np.asarray(outs)             # host fetch = real sync
+            outs = np.asarray(c.sample(shots, key=k, **kw))
             times.append(time.perf_counter() - t0)
-        check(outs)
+        checker(outs)
         best = min(times)
-        sample_rows.append({
-            "shots": shots,
-            "seconds": round(best, 4),
-            "shots_per_sec": round(shots / best, 2),
-        })
+        return {"shots": shots, "seconds": round(best, 4),
+                "shots_per_sec": round(shots / best, 2)}
+
+    # -- Circuit.sample: one vmapped compiled program per shot count
+    sample_rows = [time_mode(circ, shots, check, 100)
+                   for shots in (1, 8, 64)]
+
+    # -- sequential collapse-replay mode at LARGE size (round 5): one
+    # donated state in a fori_loop over shots — memory stays at a single
+    # state pair, so sampling works at sizes the vmapped batch cannot
+    # touch (VERDICT r4 #4).  26q f32: one pair = 0.5 GiB; the vmapped
+    # form at 64 shots would need 32 GiB.
+    NSEQ = int(os.environ.get("QUEST_SAMPLE_SEQ_QUBITS", "26"))
+    seq_circ = models.bernstein_vazirani(NSEQ, SECRET)
+    for t in range(NSEQ):
+        seq_circ.measure(t)
+
+    def check_seq(outs):
+        outs = np.asarray(outs)
+        read = (outs * (1 << np.arange(NSEQ, dtype=np.int64))).sum(axis=-1)
+        assert (read == (SECRET & ((1 << NSEQ) - 1))).all()
+
+    import jax.numpy as jnp
+
+    seq_rows = []
+    for shots in (8, 64):
+        row = time_mode(seq_circ, shots, check_seq, 300,
+                        dtype=jnp.float32, mode="sequential")
+        row["qubits"] = NSEQ
+        seq_rows.append(row)
 
     # -- eager per-shot loop: Circuit.run per shot (compiled once, one
     # dispatch + fresh key per shot — the shape of the reference's
@@ -96,6 +120,17 @@ def main():
                   f"({circ.num_gates} gates, {N} measures), f32",
         "device": dev.device_kind,
         "sample_vmapped": sample_rows,
+        "sample_sequential": {
+            "rows": seq_rows,
+            "note": f"mode='sequential' ({NSEQ} qubits): one donated "
+                    "state replayed in a lax.fori_loop over shots with "
+                    "in-place |0...0> re-init and on-device outcome "
+                    "draws — memory is ONE state pair at any shot "
+                    "count, so sampling scales to any size a single "
+                    "state fits (30q f32 on one v5e).  mode='auto' "
+                    "switches to it when shots x state exceeds "
+                    "Circuit.SAMPLE_VMAP_BYTES.",
+        },
         "eager_per_shot": {
             "shots": SHOTS,
             "seconds": round(eager, 4),
