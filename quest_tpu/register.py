@@ -606,6 +606,19 @@ def aot_speculative_preload() -> None:
     except Exception:
         meta = None
 
+    # a blob compiled for another platform must not even be TOUCHED:
+    # deserialising a TPU executable inside a CPU-pinned process hangs
+    # in the plugin (observed: the eager-init ctor deadlocked the
+    # ctypes-in-process harness on exactly this).  Sidecars without a
+    # recorded platform (pre-round-5) are treated as unknown and
+    # skipped — stale blobs just recompile.
+    try:
+        plat = meta[3] if meta is not None and len(meta) >= 4 else None
+    except TypeError:
+        plat = None  # foreign/corrupt sidecar payload: treat as unknown
+    if plat != jax.default_backend():
+        return
+
     exec_holder = {}
 
     def work():
@@ -614,7 +627,7 @@ def aot_speculative_preload() -> None:
         if fn is None or meta is None:
             return
         try:
-            ops, nvec, dtype_str = meta
+            ops, nvec, dtype_str = meta[0], meta[1], meta[2]
             from .ops.lattice import run_kernel, state_shape
 
             shape = state_shape(1 << nvec)
@@ -663,7 +676,7 @@ def aot_speculative_preload() -> None:
     _SPEC_AOT = (path, th, holder)
     if meta is not None and mode != "warm":
         global _SPEC_EXEC
-        ops, nvec, dtype_str = meta
+        ops, nvec, dtype_str = meta[0], meta[1], meta[2]
         _SPEC_EXEC = {"key": (ops, nvec, jnp.dtype(dtype_str)),
                       "holder": exec_holder, "thread": th}
 
@@ -725,7 +738,8 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
         with os.fdopen(fd, "wb") as f:
             pickle.dump((ops, num_vec_qubits,
-                         jnp.dtype(dtype).name), f)
+                         jnp.dtype(dtype).name,
+                         jax.default_backend()), f)
         os.replace(tmp, path + ".meta")
         # bound the cache: blobs are ~20 MB each; keep the newest 32
         # (.meta sidecars travel with their blob, not counted)

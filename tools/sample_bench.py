@@ -33,7 +33,7 @@ SECRET = 0b1011_0111_0110_0101 & ((1 << N) - 1)
 
 
 def main():
-    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 5
 
     import jax
     import numpy as np
