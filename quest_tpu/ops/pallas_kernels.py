@@ -187,7 +187,8 @@ def plan_fused_shapes(rows: int, lanes: int, high_row_bits: tuple[int, ...],
 
 def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
                         *, row_budget: int | None = None,
-                        interpret: bool = False, dev_flags=None):
+                        interpret: bool = False, dev_flags=None,
+                        compute_dtype=None):
     """One in-place pipelined HBM pass applying a run of gates whose 2x2
     targets are lane bits, low row bits (< log2(c_blk)), or one of up to
     ``MAX_HIGH_BITS`` arbitrary ``high_bits`` qubits (phases/controls:
@@ -206,8 +207,19 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     and an op whose control/phase mask touches device bits applies only
     when its flag is 1 — the comm-free SPMD form of the reference's
     global-index control tests (QuEST_cpu.c:1841, :2310).
+
+    ``compute_dtype``: when set, blocks are upcast from the STORAGE
+    dtype on load and rounded back on store — e.g. bf16-stored
+    amplitudes with f32 in-VMEM arithmetic, which is how a 31-qubit
+    register (8 GiB bf16 pair) fits a single 16 GiB chip that a 16 GiB
+    f32 pair cannot (the precision ladder the reference can only move
+    DOWN whole-build, QuEST_precision.h:25-62).  Storage rounding costs
+    ~2^-8 relative per pass; see tools/probe31.py for the measured
+    accuracy statement.
     """
     rows, lanes = re.shape
+    cdtype = (jnp.dtype(compute_dtype) if compute_dtype is not None
+              else re.dtype)
     lane_bits = _ilog2(lanes)
     if row_budget is None:
         row_budget = default_row_budget(len(high_bits))
@@ -224,7 +236,7 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     mat_inputs: list = []
 
     def add_mat(arr) -> int:
-        mat_inputs.append(jnp.asarray(arr, re.dtype))
+        mat_inputs.append(jnp.asarray(arr, cdtype))
         return len(mat_inputs) - 1
 
     planned = []
@@ -508,24 +520,24 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
         else:
             (ro_ref, io_ref), flags = refs, None
         mats = [mr[:] for mr in mat_refs]
-        r = re_ref[:].reshape(vshape)
-        i = im_ref[:].reshape(vshape)
+        r = re_ref[:].reshape(vshape).astype(cdtype)
+        i = im_ref[:].reshape(vshape).astype(cdtype)
         gids = [pl.program_id(a) for a in range(len(grid))]
         fields = make_fields(gids)
 
         bf = _FusedBits(fields, lane_bits, lanes, ndim, c_blk)
         for op in planned:
             r, i = _apply_fused_op(r, i, op, bf, high_axis, lane_bits,
-                                   c_blk, re.dtype, mats, flags)
-        ro_ref[:] = r.reshape(block_shape)
-        io_ref[:] = i.reshape(block_shape)
+                                   c_blk, cdtype, mats, flags)
+        ro_ref[:] = r.reshape(block_shape).astype(re.dtype)
+        io_ref[:] = i.reshape(block_shape).astype(im.dtype)
 
     spec = pl.BlockSpec(block_shape, index_map)
     mat_specs = [pl.BlockSpec(m.shape, lambda *g: (0, 0))
                  for m in mat_inputs]
     flag_inputs, flag_specs = (), []
     if n_flags:
-        flag_inputs = (jnp.asarray(dev_flags, re.dtype),)
+        flag_inputs = (jnp.asarray(dev_flags, cdtype),)
         flag_specs = [pl.BlockSpec((1, n_flags), lambda *g: (0, 0))]
     import os as _os
 

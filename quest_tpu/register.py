@@ -593,31 +593,30 @@ def aot_speculative_preload() -> None:
         return
     if not blobs:
         return
-    path, holder = blobs[0], {}
 
-    # Sidecar metadata (written by _aot_save) enables the speculative
-    # EXECUTION: without it the thread only uploads the executable.
-    meta = None
-    try:
-        import pickle
+    # Newest blob whose sidecar records THIS process's platform: a blob
+    # compiled for another platform must not even be touched
+    # (deserialising a TPU executable in a CPU-pinned process hangs in
+    # the plugin), and in a cache shared by CPU-harness and TPU runs
+    # the newest blob is often the other platform's.  Sidecars without
+    # a platform field (pre-round-5) or with unreadable payloads are
+    # skipped the same way — stale blobs just recompile.
+    import pickle
 
-        with open(path + ".meta", "rb") as f:
-            meta = pickle.load(f)
-    except Exception:
-        meta = None
-
-    # a blob compiled for another platform must not even be TOUCHED:
-    # deserialising a TPU executable inside a CPU-pinned process hangs
-    # in the plugin (observed: the eager-init ctor deadlocked the
-    # ctypes-in-process harness on exactly this).  Sidecars without a
-    # recorded platform (pre-round-5) are treated as unknown and
-    # skipped — stale blobs just recompile.
-    try:
-        plat = meta[3] if meta is not None and len(meta) >= 4 else None
-    except TypeError:
-        plat = None  # foreign/corrupt sidecar payload: treat as unknown
-    if plat != jax.default_backend():
+    path = meta = None
+    backend = jax.default_backend()
+    for cand in blobs[:8]:
+        try:
+            with open(cand + ".meta", "rb") as f:
+                m = pickle.load(f)
+            if len(m) >= 4 and m[3] == backend:
+                path, meta = cand, m
+                break
+        except Exception:
+            continue
+    if path is None:
         return
+    holder = {}
 
     exec_holder = {}
 

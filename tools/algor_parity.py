@@ -68,8 +68,12 @@ def run_stage(libdir: str, patched: bool, tmp: str) -> dict:
     env = dict(os.environ, PYTHONPATH=UTIL, QUEST_CAPI_PLATFORM="cpu")
     env.pop("JAX_PLATFORMS", None)
     tests = ["QFT", "rotate_test"]
+    # each stage gets its own cwd so QuESTLog.log files never mix
+    stage_dir = os.path.join(
+        tmp, f"{os.path.basename(libdir)}-{'p' if patched else 'u'}")
+    os.makedirs(stage_dir, exist_ok=True)
     if patched:
-        wrapper = os.path.join(tmp, "algor_wrapper.py")
+        wrapper = os.path.join(stage_dir, "algor_wrapper.py")
         with open(wrapper, "w") as f:
             f.write(_PATCHED_WRAPPER.format(algor=ALGOR))
         cmd = ["python3", wrapper, libdir, *tests]
@@ -77,13 +81,20 @@ def run_stage(libdir: str, patched: bool, tmp: str) -> dict:
         cmd = ["python3", "-m", "QuESTTest", "-Q", libdir,
                "-p", ALGOR, *tests]
     r = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                       cwd=tmp, timeout=2400)
+                       cwd=stage_dir, timeout=2400)
     out = r.stdout + r.stderr
     m = re.search(r"Passed (\d+) of (\d+) tests, (\d+) failed", out)
     exc = re.search(r"^(\w*Error): (.*)$", out, re.M)
+    failed = []
+    try:
+        with open(os.path.join(stage_dir, "QuESTLog.log")) as f:
+            failed = sorted(set(re.findall(r"Test (.+?) Failed", f.read())))
+    except OSError:
+        pass
     return {
         "returncode": r.returncode,
         "passed": m.group(0) if m else None,
+        "failed_tests": failed,
         "exception": f"{exc.group(1)}: {exc.group(2)}" if exc else None,
         "tail": out[-400:].strip().splitlines()[-3:],
     }
@@ -100,15 +111,17 @@ def main():
                 "unpatched": run_stage(libdir, False, tmp),
                 "patched": run_stage(libdir, True, tmp),
             }
-    same_crash = (res["reference_oracle"]["unpatched"]["exception"]
-                  == res["quest_tpu"]["unpatched"]["exception"]
-                  is not None)
+    ref_exc = res["reference_oracle"]["unpatched"]["exception"]
+    our_exc = res["quest_tpu"]["unpatched"]["exception"]
+    same_crash = ref_exc is not None and ref_exc == our_exc
+    rp = res["reference_oracle"]["patched"]
+    qp = res["quest_tpu"]["patched"]
     patched_identical = (
-        res["reference_oracle"]["patched"]["returncode"]
-        == res["quest_tpu"]["patched"]["returncode"] == 0
-        and res["reference_oracle"]["patched"]["passed"] is not None
-        and res["reference_oracle"]["patched"]["passed"]
-        == res["quest_tpu"]["patched"]["passed"])
+        rp["returncode"] == qp["returncode"] == 0
+        and rp["passed"] is not None
+        and rp["passed"] == qp["passed"]
+        # identical WHICH tests failed, not just how many
+        and rp["failed_tests"] == qp["failed_tests"])
     art = {
         "config": "reference tests/algor (QFT.test, rotate_test.test) "
                   "run via the reference's own QuESTTest harness "
