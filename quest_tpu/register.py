@@ -605,7 +605,7 @@ def aot_speculative_preload() -> None:
 
     path = meta = None
     backend = jax.default_backend()
-    for cand in blobs[:8]:
+    for cand in blobs:  # bounded by the cache's own 32-blob cap
         try:
             with open(cand + ".meta", "rb") as f:
                 m = pickle.load(f)
@@ -671,6 +671,7 @@ def aot_speculative_preload() -> None:
 
     th = threading.Thread(target=work, daemon=True,
                           name="quest-aot-preload")
+    _bg_register(th)
     th.start()
     _SPEC_AOT = (path, th, holder)
     if meta is not None and mode != "warm":
@@ -1165,6 +1166,33 @@ _PREFIX_FETCH_CACHE_MAX = 16
 
 _PALLAS_WARM = {"started": False}
 
+#: In-flight background warm/compile threads, joined at interpreter
+#: exit: a daemon thread still inside an XLA compile when the process
+#: tears down aborts in the C++ layer ("terminate called after
+#: throwing ... FATAL: exception not rethrown").
+_BG_THREADS: list = []
+_BG_ATEXIT = {"registered": False}
+
+
+def _bg_register(th) -> None:
+    import atexit
+
+    _BG_THREADS[:] = [t for t in _BG_THREADS if t.is_alive()]
+    _BG_THREADS.append(th)
+    if not _BG_ATEXIT["registered"]:
+        _BG_ATEXIT["registered"] = True
+
+        def _join_all():
+            import time as _time
+
+            deadline = _time.monotonic() + 60  # shared exit budget
+            for t in _BG_THREADS:
+                if t.is_alive():
+                    t.join(timeout=max(0.0,
+                                       deadline - _time.monotonic()))
+
+        atexit.register(_join_all)
+
 
 def pallas_runtime_warmup(sync: bool = False) -> None:
     """Execute a microscopic Pallas kernel once, on a background
@@ -1214,8 +1242,10 @@ def pallas_runtime_warmup(sync: bool = False) -> None:
     if sync:
         work()
         return
-    threading.Thread(target=work, daemon=True,
-                     name="quest-pallas-warmup").start()
+    th = threading.Thread(target=work, daemon=True,
+                          name="quest-pallas-warmup")
+    _bg_register(th)
+    th.start()
 
 
 #: Background-compiled readout programs keyed by register geometry:
@@ -1270,6 +1300,7 @@ def _readout_prewarm(shape, dtype, nvec: int) -> None:
     th = threading.Thread(target=work, daemon=True,
                           name="quest-readout-prewarm")
     holder["thread"] = th
+    _bg_register(th)
     th.start()
 
 

@@ -88,7 +88,10 @@ def run_stage(libdir: str, patched: bool, tmp: str) -> dict:
     failed = []
     try:
         with open(os.path.join(stage_dir, "QuESTLog.log")) as f:
-            failed = sorted(set(re.findall(r"Test (.+?) Failed", f.read())))
+            # full failure lines, WITH multiplicity and messages, so the
+            # identity comparison cannot be fooled by equal counts of
+            # different (or unnamed) failures
+            failed = re.findall(r"Test (.*?Failed:.*)$", f.read(), re.M)
     except OSError:
         pass
     return {
