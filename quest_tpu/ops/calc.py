@@ -37,27 +37,27 @@ def _prob_table(qureg: Qureg) -> np.ndarray:
     if tab is None:
         from ..register import _trace
         _trace("prob table build start")
-        if qureg.is_density:
+        warm = None
+        if qureg.mesh is None:
+            from ..register import readout_warm_get
+
+            warm = readout_warm_get("p0", re.shape, re.dtype,
+                                    qureg.num_vec_qubits,
+                                    density=qureg.is_density)
+        if warm is not None:
+            vec = warm((re, im), ())
+        elif qureg.is_density:
             vec = run_kernel(
                 (re, im), (), kind="dm_prob_zero_all",
                 statics=(qureg.num_qubits,), mesh=qureg.mesh,
                 out_kind="scalar",
             )
         else:
-            warm = None
-            if qureg.mesh is None:
-                from ..register import readout_warm_get
-
-                warm = readout_warm_get("p0", re.shape, re.dtype,
-                                        qureg.num_vec_qubits)
-            if warm is not None:
-                vec = warm((re, im), ())
-            else:
-                vec = run_kernel(
-                    (re, im), (), kind="sv_prob_zero_all",
-                    statics=(qureg.num_vec_qubits,), mesh=qureg.mesh,
-                    out_kind="scalar",
-                )
+            vec = run_kernel(
+                (re, im), (), kind="sv_prob_zero_all",
+                statics=(qureg.num_vec_qubits,), mesh=qureg.mesh,
+                out_kind="scalar",
+            )
         import jax
 
         _trace("prob table program dispatched")

@@ -799,11 +799,11 @@ def _alloc(num_qubits: int, is_density: bool, env: QuESTEnv, dtype) -> Qureg:
         re, im = build(0)
     q = Qureg(re, im, num_qubits, is_density, env.mesh)
     qasm.setup(q)
-    if (env.mesh is None and not is_density
-            and (1 << nvec) >= (1 << 13)
+    if (env.mesh is None and (1 << nvec) >= (1 << 13)
             and jax.default_backend() == "tpu"):
         pallas_runtime_warmup()  # no-op if bridge init already fired it
-        _readout_prewarm(shape, dtype, nvec)
+        _readout_prewarm(shape, dtype, nvec,
+                         num_qubits if is_density else None)
     return q
 
 
@@ -1249,11 +1249,12 @@ def pallas_runtime_warmup(sync: bool = False) -> None:
 
 
 #: Background-compiled readout programs keyed by register geometry:
-#: {(shape, dtype_name, nvec): {"thread", "p0", "prefix"}}.
+#: {(shape, dtype_name, nvec, is_density): {"thread", "p0", "prefix"}}.
 _READOUT_WARM: dict = {}
 
 
-def _readout_prewarm(shape, dtype, nvec: int) -> None:
+def _readout_prewarm(shape, dtype, nvec: int,
+                     num_qubits: int | None = None) -> None:
     """Compile the end-of-run readout programs (per-qubit probability
     table + amplitude-prefix slice) on a background thread at register
     CREATION.  Their shapes are fixed by the register geometry, and on a
@@ -1264,13 +1265,15 @@ def _readout_prewarm(shape, dtype, nvec: int) -> None:
     stream matching, no state execution, only deterministic program
     builds every driver epilogue needs (the reference driver reads 30
     probabilities and 10 amplitudes, tutorial_example.c:515-533).
-    Opt out with QUEST_READOUT_PREWARM=0."""
+    ``num_qubits`` set (density register) compiles the density table
+    kernel instead.  Opt out with QUEST_READOUT_PREWARM=0."""
     import os
     import threading
 
     if os.environ.get("QUEST_READOUT_PREWARM", "1") == "0":
         return
-    key = (tuple(shape), jnp.dtype(dtype).name, nvec)
+    key = (tuple(shape), jnp.dtype(dtype).name, nvec,
+           num_qubits is not None)
     if key in _READOUT_WARM:
         return
     holder: dict = {}
@@ -1286,9 +1289,16 @@ def _readout_prewarm(shape, dtype, nvec: int) -> None:
             from .ops.lattice import run_kernel
 
             aval = jax.ShapeDtypeStruct(shape, dtype)
-            holder["p0"] = run_kernel.lower(
-                (aval, aval), (), kind="sv_prob_zero_all",
-                statics=(nvec,), mesh=None, out_kind="scalar").compile()
+            if num_qubits is None:
+                holder["p0"] = run_kernel.lower(
+                    (aval, aval), (), kind="sv_prob_zero_all",
+                    statics=(nvec,), mesh=None,
+                    out_kind="scalar").compile()
+            else:
+                holder["p0"] = run_kernel.lower(
+                    (aval, aval), (), kind="dm_prob_zero_all",
+                    statics=(num_qubits,), mesh=None,
+                    out_kind="scalar").compile()
             rows = min(_PREFIX_ROWS, shape[0])
             holder["prefix"] = _prefix_fetch(rows, None).lower(
                 aval, aval).compile()
@@ -1304,12 +1314,13 @@ def _readout_prewarm(shape, dtype, nvec: int) -> None:
     th.start()
 
 
-def readout_warm_get(name: str, shape, dtype, nvec: int):
+def readout_warm_get(name: str, shape, dtype, nvec: int,
+                     density: bool = False):
     """The prewarmed Compiled program for this register geometry, or
     None.  Joins the build thread when it is still running — waiting on
     an in-flight compile is strictly cheaper than starting a fresh
     one."""
-    key = (tuple(shape), jnp.dtype(dtype).name, nvec)
+    key = (tuple(shape), jnp.dtype(dtype).name, nvec, density)
     holder = _READOUT_WARM.get(key)
     if holder is None:
         return None
@@ -1350,9 +1361,10 @@ def _amp_at(qureg: Qureg, index: int):
             re, im = qureg.re, qureg.im  # property read flushes pending
             rows = min(_PREFIX_ROWS, re.shape[0])
             fn = None
-            if qureg.mesh is None and not qureg.is_density:
+            if qureg.mesh is None:
                 fn = readout_warm_get("prefix", re.shape, re.dtype,
-                                      qureg.num_vec_qubits)
+                                      qureg.num_vec_qubits,
+                                      density=qureg.is_density)
             if fn is None:
                 fn = _prefix_fetch(rows, qureg.mesh)
             # one dispatch, one synchronising fetch for both arrays
