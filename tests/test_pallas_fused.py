@@ -379,3 +379,30 @@ def test_bf16_storage_f32_compute(env1):
     b = np.asarray(rb.astype(jnp.float32))
     scale = float(np.abs(a).max())
     assert float(np.abs(a - b).max()) < 0.02 * scale
+
+
+def test_same_axis_run_fusion_fires(env1):
+    """Same-axis 2x2 run fusion must actually FIRE (ops on one exposed
+    axis with different ctrl masks bubble into a single sliced round)
+    and match the per-gate path — the +28 gates/s round-5 lever depends
+    on it, and the numeric suites would silently pass if it stopped
+    firing."""
+    from quest_tpu.ops import pallas_kernels as pk
+
+    seen = {}
+    orig = pk._apply_fused_op
+
+    def spy(r, i, op, *a, **kw):
+        seen[op[0]] = seen.get(op[0], 0) + 1
+        return orig(r, i, op, *a, **kw)
+
+    circ = Circuit(N_HIGH)
+    circ.hadamard(14)
+    circ.controlled_not(0, 14)        # same axis, different ctrl
+    circ.hadamard(14)
+    try:
+        pk._apply_fused_op = spy
+        _compare(env1, circ, n=N_HIGH, seed=91)
+    finally:
+        pk._apply_fused_op = orig
+    assert seen.get("2x2run", 0) >= 1, seen
