@@ -277,9 +277,9 @@ def bench_sched_variants():
     _os.environ["QUEST_EXPMM"] = "0"
     variants = {
         "base": {},
-        "rb4096": {"row_budget": 4096},
-        "rb4096 rcm3": {"row_budget": 4096, "row_compose_min": 3},
-        "rb8192": {"row_budget": 8192},
+        "lcm3": {"lane_compose_min": 3},
+        "rcm3 (rowmm back on)": {"row_compose_min": 3},
+        "k7": {"max_high": 7},
     }
     from quest_tpu.ops.pallas_kernels import apply_fused_segment
 
