@@ -24,11 +24,14 @@ Env overrides: QUEST_BENCH_QUBITS (default 30, auto-falls back on OOM),
 QUEST_BENCH_DEPTH (default 22 layers -> 660 gates at 30q, matching the
 reference driver's 667-gate workload shape), QUEST_BENCH_REPS.
 
-NOTE on ``hbm_gbps``/``roofline_frac``: modelled from SCHEDULED traffic
-(passes x one in-place read+write of the state), not from a hardware
-counter — the figure moves when gates/pass moves, independent of chip
-behaviour.  Cross-check pass-time drift against ``seconds``/``gates``
-directly (round-3 lesson: a denser schedule can mask a slower pass).
+``hbm_gbps``/``roofline_frac`` are derived from the RUN LEDGER
+(quest_tpu.metrics): pass count and per-pass stream bytes recorded by
+the fused executor while the benchmark program was built, not an
+independently recomputed schedule.  ``hbm_gbps_modelled`` retains the
+old schedule-model value for one release so BENCH_r* trajectories stay
+comparable (round-3 lesson the old model note warned about: a denser
+schedule can mask a slower pass — the ledger records what was actually
+built, so the two fields diverging is itself a signal).
 """
 
 import json
@@ -57,21 +60,14 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
     import jax
     import jax.numpy as jnp
     from functools import partial
-    from quest_tpu import models
+    from quest_tpu import metrics, models
     from quest_tpu.ops.lattice import state_shape
 
     circ = models.random_circuit(num_qubits, depth=depth, seed=123)
     # The fused Pallas kernels lower natively only on TPU; other
     # accelerators would need interpret mode, where the XLA path is faster.
     on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        from quest_tpu.scheduler import schedule_segments_best
-
-        apply = circ.as_fused_fn()
-        n_passes = len(schedule_segments_best(list(circ.ops), num_qubits))
-    else:
-        apply = circ.as_fn(mesh=None)
-        n_passes = circ.num_gates  # gate-at-a-time XLA path
+    apply = circ.as_fused_fn() if on_tpu else circ.as_fn(mesh=None)
     shape = state_shape(1 << num_qubits)
 
     # The dispatch round trip to a remote-attached chip costs ~90 ms —
@@ -97,8 +93,33 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
         jax.block_until_ready(arrs)
         return float(arrs[0][0, 0])
 
-    re, im = run_inner(*fresh())  # compile + warm-up
-    sync((re, im))
+    # compile + warm-up under a ledger scope: the fori_loop body traces
+    # the circuit ONCE, so the recorded pallas counters are exactly one
+    # application's pass count / stream bytes — read back below instead
+    # of re-running the scheduler independently (the old model).
+    with metrics.run_ledger("bench_compile"):
+        re, im = run_inner(*fresh())
+        sync((re, im))
+    rec = (metrics.get_run_ledger() or {}).get("counters", {})
+    if on_tpu and rec.get("pallas.segment_builds"):
+        n_passes = int(rec["pallas.segment_builds"])
+        pass_bytes = int(rec["pallas.build_stream_bytes"])
+    else:
+        n_passes = circ.num_gates  # gate-at-a-time XLA path
+        pass_bytes = None  # no recorded traffic: model it in main()
+    # The retained MODEL figure re-derives the pass count from an
+    # INDEPENDENT scheduler invocation, exactly as pre-ledger bench did
+    # — so hbm_gbps (recorded from what the executor built) and
+    # hbm_gbps_modelled CAN diverge, and divergence is the signal that
+    # the model no longer matches the built program.
+    if on_tpu:
+        from quest_tpu.scheduler import schedule_segments_best
+
+        with metrics.suppressed():
+            n_passes_model = len(
+                schedule_segments_best(list(circ.ops), num_qubits))
+    else:
+        n_passes_model = circ.num_gates
 
     times = []
     for _ in range(reps):
@@ -108,7 +129,9 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
         times.append(time.perf_counter() - t0)
     best = min(times)
     n_gates = circ.num_gates * inner
-    return n_gates / best, n_gates, best, n_passes * inner
+    return (n_gates / best, n_gates, best, n_passes * inner,
+            None if pass_bytes is None else pass_bytes * inner,
+            n_passes_model * inner)
 
 
 def main():
@@ -139,8 +162,8 @@ def main():
     retries_at_size = 2
     while num_qubits >= 20:
         try:
-            gates_per_sec, ngates, secs, npasses = run(
-                num_qubits, depth, reps, inner)
+            (gates_per_sec, ngates, secs, npasses, rec_bytes,
+             npasses_model) = run(num_qubits, depth, reps, inner)
             break
         except Exception as e:  # OOM: retry (a just-exited process may
             # still hold HBM for a few seconds), then shrink
@@ -164,7 +187,13 @@ def main():
 
     state_bytes = 2 * (1 << num_qubits) * 4        # re+im, f32
     pass_traffic = 2 * state_bytes                 # read + write, in place
-    hbm_gbps = npasses * pass_traffic / secs / 1e9
+    # modelled figure retained for BENCH_r* trajectory comparability
+    # (independent scheduler pass count, the pre-ledger formula); the
+    # headline hbm_gbps is the LEDGER-recorded traffic when the fused
+    # executor ran (rec_bytes), else the model is all there is.
+    hbm_gbps_modelled = npasses_model * pass_traffic / secs / 1e9
+    hbm_gbps = (rec_bytes / secs / 1e9 if rec_bytes is not None
+                else hbm_gbps_modelled)
     matches = [(len(kind), bw) for kind, bw in _HBM_SPEC.items()
                if dev_kind.startswith(kind)]
     spec_bw = max(matches)[1] if matches else 819e9
@@ -184,6 +213,8 @@ def main():
         "seconds": round(secs, 4),
         "gates_per_pass": round(ngates / npasses, 2),
         "hbm_gbps": round(hbm_gbps, 1),
+        "hbm_gbps_modelled": round(hbm_gbps_modelled, 1),
+        "hbm_source": "ledger" if rec_bytes is not None else "model",
         "roofline_frac": round(hbm_gbps * 1e9 / spec_bw, 3),
         "a100_equiv_gates_per_sec": round(a100_equiv, 1),
         "vs_a100": round(gates_per_sec / a100_equiv, 2),

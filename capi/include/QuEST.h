@@ -88,6 +88,10 @@ void syncQuESTEnv(QuESTEnv env);
 int syncQuESTSuccess(int successCode);
 void reportQuESTEnv(QuESTEnv env);
 void getEnvironmentString(QuESTEnv env, Qureg qureg, char str[200]);
+/* quest_tpu extension: most recent run-ledger record (one JSON line;
+ * "{}" before any run) — counters, spans, exchange-byte accounting for
+ * the last circuit run.  Truncated to maxLen-1 chars + NUL. */
+void getRunLedgerString(QuESTEnv env, char *str, int maxLen);
 void seedQuESTDefault(void);
 void seedQuEST(unsigned long int *seedArray, int numSeeds);
 

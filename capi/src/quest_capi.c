@@ -361,6 +361,24 @@ void getEnvironmentString(QuESTEnv env, Qureg qureg, char str[200]) {
     PyGILState_Release(g);
 }
 
+void getRunLedgerString(QuESTEnv env, char *str, int maxLen) {
+    /* Observability analogue of getEnvironmentString: the most recent
+     * circuit run's ledger record (quest_tpu.metrics) as one JSON line
+     * — "{}" before any run.  Truncated to maxLen-1 chars. */
+    (void)env;
+    if (!str || maxLen <= 0)
+        return;
+    PyObject *r = bcall("getRunLedgerString", "()");
+    PyGILState_STATE g = PyGILState_Ensure();
+    const char *s = PyUnicode_AsUTF8(r);
+    if (!s)
+        fatal("getRunLedgerString");
+    strncpy(str, s, (size_t)maxLen - 1);
+    str[maxLen - 1] = '\0';
+    Py_DECREF(r);
+    PyGILState_Release(g);
+}
+
 void seedQuESTDefault(void) { BVOID("seedQuESTDefault", "()"); }
 
 void seedQuEST(unsigned long int *seedArray, int numSeeds) {

@@ -111,10 +111,14 @@ from .stateio import (
     save_checkpoint,
     restore_checkpoint,
 )
+from . import metrics
 from .reporting import (
     report_qureg_params,
     report_state_to_screen,
     get_environment_string,
+    get_run_ledger,
+    get_run_ledger_string,
+    report_run_ledger,
 )
 from .qasm import (
     start_recording_qasm,
@@ -197,6 +201,7 @@ initStateFromSingleFile = init_state_from_single_file
 reportQuregParams = report_qureg_params
 reportStateToScreen = report_state_to_screen
 getEnvironmentString = get_environment_string
+getRunLedgerString = get_run_ledger_string
 startRecordingQASM = start_recording_qasm
 stopRecordingQASM = stop_recording_qasm
 clearRecordedQASM = clear_recorded_qasm

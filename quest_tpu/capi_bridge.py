@@ -178,6 +178,12 @@ def getEnvironmentString(h: int) -> str:
     return _qt.get_environment_string(_env, _q(h))
 
 
+def getRunLedgerString() -> str:
+    """Most recent run-ledger record as one JSON line (quest_tpu.metrics);
+    the unmodified-C-driver observability hook."""
+    return _qt.get_run_ledger_string()
+
+
 def seedQuESTDefault() -> int:
     _qt.seed_quest_default()
     return 0

@@ -24,6 +24,7 @@ from jax import lax
 
 from .ops.lattice import run_kernel, state_shape
 from .ops import gates as _g
+from . import metrics
 from . import precision as _prec
 from . import validation as _v
 
@@ -332,6 +333,10 @@ class Circuit:
         (one reduction + one elementwise collapse, still inside the same
         compiled program — no host sync)."""
         gate_runs, nu_ops = self._split_runs()
+        # whole-circuit plan stats, accumulated while the mesh executors
+        # are built (the SAME plans that will run) and memoised for
+        # schedule_stats — so run-ledger attribution never re-schedules
+        mesh_stats = {"passes": 0, "relayouts": 0, "exchange_elems": 0}
 
         def run_fn(run_ops):
             if mesh is not None and mesh.devices.size > 1:
@@ -339,6 +344,8 @@ class Circuit:
                 if (1 << nvec) // mesh.devices.size < 2:
                     # no local bits to relabel onto: tiny registers run
                     # the per-gate XLA path (trivially cheap at this size)
+                    mesh_stats["passes"] += len(run_ops)
+
                     def fn(re, im):
                         for kind, statics, scalars in run_ops:
                             re, im = run_kernel((re, im), scalars,
@@ -349,8 +356,11 @@ class Circuit:
                     return fn
                 from .parallel.mesh_exec import as_mesh_fused_fn
 
-                return as_mesh_fused_fn(run_ops, nvec, mesh,
-                                        interpret=interpret)
+                mfn = as_mesh_fused_fn(run_ops, nvec, mesh,
+                                       interpret=interpret)
+                for k in mesh_stats:
+                    mesh_stats[k] += mfn.plan_stats[k]
+                return mfn
 
             from .ops.pallas_kernels import apply_fused_segment
             from .scheduler import schedule_segments_best
@@ -368,6 +378,9 @@ class Circuit:
             return fn
 
         run_fns = [run_fn(r) if r else None for r in gate_runs]
+        if mesh is not None and mesh.devices.size > 1:
+            self._compiled[("sched_stats", mesh, tuple(self.ops))] = \
+                mesh_stats
         if not nu_ops:
             return run_fns[0] or (lambda re, im: (re, im))
 
@@ -419,14 +432,80 @@ class Circuit:
         key = (mesh, donate, use_pallas, tuple(self.ops))
         fn = self._compiled.get(key)
         if fn is None:
-            if use_pallas:
-                interpret = jax.default_backend() != "tpu"
-                raw = self.as_fused_fn(interpret=interpret, mesh=mesh)
-            else:
-                raw = self.as_fn(mesh)
+            metrics.counter_inc("circuit.compile_cache_misses")
+            with metrics.span("schedule"):
+                if use_pallas:
+                    interpret = jax.default_backend() != "tpu"
+                    raw = self.as_fused_fn(interpret=interpret, mesh=mesh)
+                else:
+                    raw = self.as_fn(mesh)
             fn = jax.jit(raw, donate_argnums=(0, 1) if donate else ())
             self._compiled[key] = fn
+        else:
+            metrics.counter_inc("circuit.compile_cache_hits")
         return fn
+
+    def schedule_stats(self, mesh=None) -> dict:
+        """Structural cost of ONE application of this circuit under the
+        fused executor, derived from the SAME scheduler the executor
+        runs (not an independent cost model): streamed ``passes``
+        (fused segments; per-gate count on the tiny-register mesh
+        fallback), relayouts with communication, and
+        ``exchange_elems`` — amplitude elements moved over the
+        interconnect by relayout ppermutes, both arrays, all devices
+        (multiply by the dtype itemsize for bytes).  Memoised per
+        (mesh, ops); the run ledger's per-run attribution source.
+
+        Mesh builds (``as_fused_fn``) pre-populate the memo with the
+        stats of the EXACT plans they built, so the common path never
+        re-schedules; the fallback recompute here runs under
+        ``metrics.suppressed()`` so diagnostic recomputation cannot
+        double-count scheduler activity in the ledger."""
+        key = ("sched_stats", mesh, tuple(self.ops))
+        st = self._compiled.get(key)
+        if st is not None:
+            return st
+        nvec = self.num_qubits * (2 if self.is_density else 1)
+        gate_runs, _nu = self._split_runs()
+        passes = relayouts = exchange_elems = 0
+        with metrics.suppressed():
+            for run_ops in gate_runs:
+                if not run_ops:
+                    continue
+                if mesh is not None and mesh.devices.size > 1 \
+                        and (1 << nvec) // mesh.devices.size >= 2:
+                    from .ops.lattice import _ilog2
+                    from .parallel.mesh_exec import plan_exchange_elems
+                    from .scheduler import schedule_mesh
+
+                    ndev = mesh.devices.size
+                    dev_bits = _ilog2(ndev)
+                    lanes = state_shape(1 << nvec, ndev)[1]
+                    plan = schedule_mesh(list(run_ops), nvec, dev_bits,
+                                         _ilog2(lanes))
+                    passes += sum(1 for it in plan if it[0] == "seg")
+                    r, e = plan_exchange_elems(plan, nvec, dev_bits)
+                    relayouts += r
+                    exchange_elems += e
+                elif mesh is not None and mesh.devices.size > 1:
+                    passes += len(run_ops)  # tiny-register fallback
+                else:
+                    from .ops.lattice import _ilog2
+                    from .scheduler import schedule_segments_best
+
+                    # same lane_bits the executor derives from the real
+                    # state shape (< 7 only for sub-128-amp registers),
+                    # so the recomputed plan matches the built one; the
+                    # recompute itself is memoised per (mesh, ops) and
+                    # host-side-cheap (the scheduler is ~ms at bench
+                    # sizes)
+                    lanes = state_shape(1 << nvec)[1]
+                    passes += len(schedule_segments_best(
+                        list(run_ops), nvec, lane_bits=_ilog2(lanes)))
+        st = {"passes": passes, "relayouts": relayouts,
+              "exchange_elems": exchange_elems}
+        self._compiled[key] = st
+        return st
 
     #: ``sample(mode="auto")`` picks vmap while the concurrent shot
     #: states fit this many bytes (shots x one (re, im) pair); beyond
@@ -560,19 +639,57 @@ class Circuit:
         For circuits with recorded measurements, ``key`` (a jax PRNG key;
         fresh from the entropy pool when omitted) seeds the on-device
         outcome sampling, and the measured outcomes are returned as an
-        int32 array (record order)."""
-        fn = self.compile(mesh=qureg.mesh, donate=False, pallas=pallas)
-        if self._has_nonunitary:
-            draws = self.num_measurements > 0
-            if key is None and draws:
-                from .env import default_measure_key
+        int32 array (record order).
 
-                key = default_measure_key()
-            re, im, outcomes = fn(qureg.re, qureg.im, key)
-            qureg._set(re, im)
-            # collapse-only circuits consume no randomness and yield no
-            # outcomes: keep the mutating-facade contract (return qureg)
-            return outcomes if draws else qureg
-        re, im = fn(qureg.re, qureg.im)
-        qureg._set(re, im)
-        return qureg
+        Each call scopes ONE run-ledger record (quest_tpu.metrics):
+        schedule/compile/execute phases as spans, plus recorded pass,
+        relayout, and exchange-byte attribution from the same schedule
+        the executor builds."""
+        with metrics.run_ledger("circuit_run"):
+            metrics.annotate_run("num_qubits", self.num_qubits)
+            metrics.annotate_run("is_density", self.is_density)
+            metrics.annotate_run(
+                "num_devices",
+                1 if qureg.mesh is None else int(qureg.mesh.devices.size))
+            with metrics.span("compile"):
+                fn = self.compile(mesh=qureg.mesh, donate=False,
+                                  pallas=pallas)
+            self._record_run_stats(qureg, pallas)
+            with metrics.span("execute"):
+                if self._has_nonunitary:
+                    draws = self.num_measurements > 0
+                    if key is None and draws:
+                        from .env import default_measure_key
+
+                        key = default_measure_key()
+                    re, im, outcomes = fn(qureg.re, qureg.im, key)
+                    qureg._set(re, im)
+                    # collapse-only circuits consume no randomness and
+                    # yield no outcomes: keep the mutating-facade
+                    # contract (return qureg)
+                    return outcomes if draws else qureg
+                re, im = fn(qureg.re, qureg.im)
+                qureg._set(re, im)
+                return qureg
+
+    def _record_run_stats(self, qureg, pallas) -> None:
+        """Attribute one application's recorded schedule costs to the
+        active ledger record (gates, passes, stream/exchange bytes)."""
+        metrics.counter_inc("exec.runs")
+        metrics.counter_inc("exec.gates", self.num_gates)
+        itemsize = jnp.dtype(qureg.real_dtype).itemsize
+        if pallas is True or pallas == "auto":
+            st = self.schedule_stats(qureg.mesh)
+        else:  # gate-at-a-time XLA path: one streamed pass per op
+            st = {"passes": len(self.ops), "relayouts": 0,
+                  "exchange_elems": 0}
+        metrics.counter_inc("exec.passes", st["passes"])
+        # one in-place pass streams the state once: read + write of
+        # both (re, im) arrays, summed over every device's chunk
+        nvec = self.num_qubits * (2 if self.is_density else 1)
+        metrics.counter_inc("exec.stream_bytes",
+                            st["passes"] * 2 * 2 * (1 << nvec) * itemsize)
+        if st["relayouts"]:
+            metrics.counter_inc("exec.relayouts", st["relayouts"])
+            metrics.counter_inc("exec.exchange_bytes",
+                                st["exchange_elems"] * itemsize)

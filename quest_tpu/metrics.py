@@ -1,0 +1,264 @@
+"""Run-ledger metrics: process-wide counters, timed spans, and one
+machine-readable ledger record per circuit run.
+
+The reference QuEST has essentially no observability surface beyond
+``reportQuregParams``/``getEnvironmentString`` (SURVEY §5.1).  This
+module is the repo's single instrumentation seam: every hot-path layer —
+the scheduler (segments built, reorder wins), the mesh executor
+(relayouts, exchange bytes actually moved per half-chunk ppermute), the
+fused Pallas executor (passes, state-stream bytes), and the register's
+compile/AOT/speculation machinery — reports here, and every consumer
+(``bench.py``, ``tools/sched_stats.py``, the C API's
+``getRunLedgerString``) reads recorded values back instead of
+re-modelling them from the schedule (the round-3 lesson in bench.py's
+old docstring: a denser schedule can mask a slower pass).
+
+Three primitives:
+
+* ``counter_inc(name, value)`` — monotonic process-wide counters, also
+  attributed as deltas to the active run-ledger record.
+* ``span(name)`` — wall-clock a phase (schedule/compile/execute/
+  readout); doubles as a ``jax.profiler`` trace annotation so
+  TensorBoard/Perfetto timelines line up with the ledger's wall-time
+  attribution.  NOTE: JAX dispatch is asynchronous and the hot path
+  deliberately stays that way, so an ``execute`` span is HOST-side
+  dispatch time; device time shows on the profiler trace, and honest
+  synchronised timing is ``reporting.time_fn``.
+* ``run_ledger(label)`` — scope one *circuit run*: on exit the record
+  (counters delta, spans, trace events, wall time) is finalised,
+  retained for ``get_run_ledger()``, and appended as one JSON line to
+  ``$QUEST_METRICS_FILE`` when that is set.
+
+``trace(msg)`` is the C-driver latency-debugging sink folded in from
+``register._trace``: its ``QUEST_CAPI_TRACE=1`` stderr output is
+byte-compatible with the historical format, and every message is also
+recorded as a timestamped event on the active ledger record.
+
+Instrumentation timing discipline: this module and ``reporting.py`` are
+the ONLY places in ``quest_tpu`` allowed to call ``time.perf_counter``
+or print to stderr (enforced by ``tests/test_metrics.py``'s lint) —
+hot-path timing goes through the ledger, not ad-hoc prints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+
+#: Ledger schema tag, bumped on incompatible record-shape changes.
+SCHEMA = "quest-tpu-run-ledger/1"
+
+#: Retained finalised records (newest last), bounded.
+_RECORDS_MAX = 64
+
+_lock = threading.RLock()
+_counters: dict[str, float] = {}
+_span_totals: dict[str, list] = {}   # name -> [total_s, count]
+_records: list[dict] = []
+
+#: Active (nested) run records, PER THREAD: the register's background
+#: threads (readout prewarm, speculative preload) must neither attribute
+#: their counters to an unrelated run open on the main thread nor have
+#: their own run_ledger scopes swallowed as "nested" by it.  Process
+#: counters stay global; only run-record attribution is thread-scoped.
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+def counter_inc(name: str, value=1) -> None:
+    """Add ``value`` to process counter ``name`` and to this thread's
+    active run record (all nesting levels), if any."""
+    if getattr(_tls, "suppress", False):
+        return
+    v = value if isinstance(value, int) else float(value)
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + v
+        for rec in _stack():
+            c = rec["counters"]
+            c[name] = c.get(name, 0) + v
+
+
+@contextlib.contextmanager
+def suppressed():
+    """No-op all counter attribution on this thread for the scope.
+
+    For read-only diagnostic recomputation (e.g. Circuit.schedule_stats
+    re-deriving a plan the executor already built): the recompute must
+    not double-count scheduler activity in the ledger."""
+    prev = getattr(_tls, "suppress", False)
+    _tls.suppress = True
+    try:
+        yield
+    finally:
+        _tls.suppress = prev
+
+
+def counters() -> dict:
+    """Snapshot of the process-wide counters."""
+    with _lock:
+        return dict(_counters)
+
+
+def annotate_run(name: str, value) -> None:
+    """Attach scalar metadata (qubits, backend, label detail) to this
+    thread's active run records; no-op outside a run.  The innermost
+    record gets overwrite semantics; outer records keep their own value
+    for an already-set key (a nested flush must not clobber the
+    enclosing circuit run's metadata) — so nested-scope metadata still
+    folds into the one record that is actually emitted."""
+    with _lock:
+        s = _stack()
+        if not s:
+            return
+        s[-1]["meta"][name] = value
+        for rec in s[:-1]:
+            rec["meta"].setdefault(name, value)
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Wall-clock a phase.  Accumulates into the active run record and
+    the process span totals, and labels the region on any in-flight
+    ``jax.profiler`` device trace (see ``reporting.trace``) so
+    TensorBoard timelines line up with the ledger."""
+    try:
+        from jax.profiler import TraceAnnotation
+
+        ann = TraceAnnotation(f"quest:{name}")
+    except Exception:  # pragma: no cover - profiler unavailable
+        ann = contextlib.nullcontext()
+    t0 = _now()
+    try:
+        with ann:
+            yield
+    finally:
+        dt = _now() - t0
+        if not getattr(_tls, "suppress", False):
+            with _lock:
+                tot = _span_totals.setdefault(name, [0.0, 0])
+                tot[0] += dt
+                tot[1] += 1
+                for rec in _stack():
+                    s = rec["spans"].setdefault(name, [0.0, 0])
+                    s[0] += dt
+                    s[1] += 1
+
+
+def span_totals() -> dict:
+    """Process-wide ``{name: {"seconds", "count"}}`` span accumulators."""
+    with _lock:
+        return {k: {"seconds": v[0], "count": v[1]}
+                for k, v in _span_totals.items()}
+
+
+def trace(msg: str) -> None:
+    """Phase-timing sink (folded in from ``register._trace``).
+
+    With ``QUEST_CAPI_TRACE=1`` prints the historical byte-compatible
+    stderr line (wall-clock since process start) — the C-driver latency
+    debugging knob.  Independently, the message is recorded as a
+    timestamped event on the active run-ledger record."""
+    t = time.perf_counter()
+    if os.environ.get("QUEST_CAPI_TRACE") == "1":
+        print(f"[quest-trace {t:.3f}] {msg}", file=sys.stderr, flush=True)
+    with _lock:
+        # all active records, like counter_inc: an event inside a nested
+        # flush must also reach the OUTERMOST record — the only one that
+        # is finalised and emitted
+        for rec in _stack():
+            rec["events"].append([round(t, 6), msg])
+
+
+@contextlib.contextmanager
+def run_ledger(label: str = "run"):
+    """Scope one circuit run; nested scopes (on the same thread)
+    produce nested attribution but only the OUTERMOST scope
+    emits/retains a record (one circuit run -> one ledger record;
+    inner flushes fold into it)."""
+    rec = {
+        "schema": SCHEMA,
+        "label": label,
+        "counters": {},
+        "spans": {},
+        "events": [],
+        "meta": {},
+    }
+    t0 = _now()
+    with _lock:
+        stack = _stack()
+        outermost = not stack
+        stack.append(rec)
+    try:
+        yield rec
+    finally:
+        wall = _now() - t0
+        with _lock:
+            s = _stack()
+            # remove by IDENTITY: nested records of the same label are
+            # dict-EQUAL while empty, and list.remove would pop the
+            # wrong (outer) one, crashing the outer scope's exit
+            for i in range(len(s) - 1, -1, -1):
+                if s[i] is rec:
+                    del s[i]
+                    break
+        if outermost:
+            _finalize(rec, wall)
+
+
+def _finalize(rec: dict, wall: float) -> None:
+    rec["wall_s"] = round(wall, 6)
+    rec["spans"] = {k: {"seconds": round(v[0], 6), "count": v[1]}
+                    for k, v in rec["spans"].items()}
+    with _lock:
+        _records.append(rec)
+        del _records[:-_RECORDS_MAX]
+    path = os.environ.get("QUEST_METRICS_FILE")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        except OSError:
+            pass  # a broken sink must never fail the run itself
+
+
+def get_run_ledger() -> dict | None:
+    """The most recent finalised run record (a copy), or None."""
+    with _lock:
+        return json.loads(json.dumps(_records[-1])) if _records else None
+
+
+def run_ledger_json() -> str:
+    """The most recent finalised run record as one JSON line (``"{}"``
+    when no run has completed) — the payload of the C API's
+    ``getRunLedgerString``."""
+    with _lock:
+        rec = _records[-1] if _records else None
+    return json.dumps(rec if rec is not None else {}, sort_keys=True)
+
+
+def recent_records(n: int = _RECORDS_MAX) -> list[dict]:
+    """Up to ``n`` most recent finalised records, oldest first."""
+    with _lock:
+        return json.loads(json.dumps(_records[-n:]))
+
+
+def reset() -> None:
+    """Zero all counters/spans and drop retained records (test hook)."""
+    with _lock:
+        _counters.clear()
+        _span_totals.clear()
+        _records.clear()

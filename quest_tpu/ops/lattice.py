@@ -217,6 +217,22 @@ class Lattice:
         return lax.all_gather(x, self.axis, tiled=True)
 
 
+def shard_map_compat(body, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: the top-level spelling
+    (with ``check_vma``) landed after 0.4.x; older versions expose it as
+    ``jax.experimental.shard_map.shard_map`` (with ``check_rep``).  The
+    replication check is disabled either way — pallas_call's out_shape
+    carries no varying-mesh-axes annotation, and every output here is
+    trivially per-shard."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def _dispatch(body, arrays, scalars, mesh: Mesh | None, out_kind: str):
     """Run ``body(lat, arrays, scalars)`` locally, or as ONE shard_map
     region over ``mesh``.  ``out_kind`` is ``"arrays"`` (amp arrays back,
@@ -233,7 +249,7 @@ def _dispatch(body, arrays, scalars, mesh: Mesh | None, out_kind: str):
                     scalars)
 
     out_specs = {"arrays": P(axis), "scalar": P()}[out_kind]
-    return jax.shard_map(
+    return shard_map_compat(
         shbody,
         mesh=mesh,
         in_specs=(P(axis), P()),

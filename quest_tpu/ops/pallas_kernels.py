@@ -35,6 +35,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import metrics
 from .lattice import _ilog2
 
 
@@ -218,6 +219,14 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     accuracy statement.
     """
     rows, lanes = re.shape
+    # Run-ledger accounting: one fused segment = one in-place streamed
+    # pass over the state — read + write of both (re, im) arrays.  These
+    # fire at BUILD/TRACE time (once per compiled program, not per
+    # execution); executed-pass attribution is the caller's
+    # (Circuit.run / mesh_exec record per execution from the schedule).
+    metrics.counter_inc("pallas.segment_builds")
+    metrics.counter_inc("pallas.build_stream_bytes",
+                        2 * 2 * rows * lanes * jnp.dtype(re.dtype).itemsize)
     cdtype = (jnp.dtype(compute_dtype) if compute_dtype is not None
               else re.dtype)
     lane_bits = _ilog2(lanes)

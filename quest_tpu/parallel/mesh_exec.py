@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.lattice import Lattice, state_shape, _ilog2
+from .. import metrics
+from ..ops.lattice import Lattice, shard_map_compat, state_shape, _ilog2
 from ..ops.pallas_kernels import apply_fused_segment
 
 
@@ -107,6 +108,22 @@ def _item_key(obj):
     return obj
 
 
+def _swap_comm_class(item, chunk_bits: int) -> str | None:
+    """Communication class of a plan item: None (not a swap),
+    ``"local"`` (in-chunk relabel, comm-free), ``"half"`` (device<->
+    local half-chunk ppermute on every device), or ``"full"``
+    (device<->device whole-chunk exchange on the half of the devices
+    whose coordinate bits differ).  Single classifier shared by the
+    cost model (plan_comm_stats) and the ledger (plan_exchange_elems)
+    so the two can never silently desynchronise."""
+    if item[0] != "swap":
+        return None
+    a, b = sorted(item[1:])
+    if b < chunk_bits:
+        return "local"
+    return "full" if a >= chunk_bits else "half"
+
+
 def plan_comm_stats(plan, num_vec_bits: int, dev_bits: int):
     """Communication volume of a mesh plan, in units of one device's
     chunk (per device): half-exchanges count 0.5, device-device swaps 1.
@@ -115,14 +132,44 @@ def plan_comm_stats(plan, num_vec_bits: int, dev_bits: int):
     vol = 0.0
     swaps = 0
     for item in plan:
-        if item[0] != "swap":
+        cls = _swap_comm_class(item, chunk_bits)
+        if cls is None:
             continue
         swaps += 1
-        a, b = sorted(item[1:])
-        if b < chunk_bits:
+        if cls == "local":
             continue  # local swap: no comm
-        vol += 1.0 if a >= chunk_bits else 0.5
+        vol += 1.0 if cls == "full" else 0.5
     return {"swaps": swaps, "chunk_volume": vol}
+
+
+def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int):
+    """Amplitude-array ELEMENTS a plan's relayouts actually move over
+    the interconnect, summed over every device and BOTH (re, im) arrays
+    (multiply by the dtype itemsize for bytes — the run ledger's
+    ``exec.exchange_bytes``).
+
+    Per ``bitswap_chunk``: a device<->local swap is a HALF-chunk
+    ppermute on every device (each sends chunk/2 elements per array); a
+    device<->device swap moves the WHOLE chunk, but only for the half of
+    the devices whose two coordinate bits differ; local<->local swaps
+    are comm-free.  Returns (relayouts_with_comm, elems)."""
+    ndev = 1 << dev_bits
+    chunk = (1 << num_vec_bits) // ndev
+    chunk_bits = num_vec_bits - dev_bits
+    relayouts = 0
+    elems = 0
+    for item in plan:
+        cls = _swap_comm_class(item, chunk_bits)
+        if cls is None or cls == "local":
+            continue  # local<->local: in-chunk permutation, no comm
+        relayouts += 1
+        if cls == "full":
+            elems += (ndev // 2) * chunk * 2       # full chunk, half the
+            #                                        devices, re + im
+        else:
+            elems += ndev * (chunk // 2) * 2       # half chunk, every
+            #                                        device, re + im
+    return relayouts, elems
 
 
 def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
@@ -162,6 +209,25 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
     chunk_bits = num_vec_bits - dev_bits
     plan = schedule_mesh(list(ops), num_vec_bits, dev_bits, lane_bits)
 
+    # Ledger accounting for one application of the plan, computed once
+    # here; the returned fn records per EXECUTION (skipped under an
+    # outer jit trace, where Circuit.run attributes from the same plan
+    # stats instead — see Circuit.schedule_stats).
+    n_passes = sum(1 for it in plan if it[0] == "seg")
+    n_relayouts, exch_elems = plan_exchange_elems(plan, num_vec_bits,
+                                                  dev_bits)
+    plan_stats = {"passes": n_passes, "relayouts": n_relayouts,
+                  "exchange_elems": exch_elems}
+
+    def _record_execution(re):
+        if isinstance(re, jax.core.Tracer):
+            return
+        metrics.counter_inc("mesh.executions")
+        metrics.counter_inc("mesh.passes", n_passes)
+        metrics.counter_inc("mesh.relayouts", n_relayouts)
+        metrics.counter_inc("mesh.exchange_bytes",
+                            exch_elems * re.dtype.itemsize)
+
     def item_body(item, re, im):
         dev = lax.axis_index(axis)
         if item[0] == "seg":
@@ -185,14 +251,13 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
         return re, im
 
     def shmap(body):
-        # check_vma=False: pallas_call's out_shape carries no varying-
-        # mesh-axes annotation, and every output here is trivially
-        # per-shard (specs are all P(axis)).
-        return jax.shard_map(
+        # replication checks disabled (see shard_map_compat): pallas_call's
+        # out_shape carries no varying-mesh-axes annotation, and every
+        # output here is trivially per-shard (specs are all P(axis)).
+        return shard_map_compat(
             body, mesh=mesh,
             in_specs=(P(axis), P(axis)),
             out_specs=(P(axis), P(axis)),
-            check_vma=False,
         )
 
     if per_item:
@@ -216,10 +281,12 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
             item_fns.append(f)
 
         def fn(re, im):
+            _record_execution(re)
             for f in item_fns:
                 re, im = f(re, im)
             return re, im
 
+        fn.plan_stats = plan_stats
         return fn
 
     def body(re, im):
@@ -228,6 +295,8 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
         return re, im
 
     def fn(re, im):
+        _record_execution(re)
         return shmap(body)(re, im)
 
+    fn.plan_stats = plan_stats
     return fn

@@ -23,6 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import metrics
 from . import precision
 from . import qasm
 from .env import QuESTEnv
@@ -142,6 +143,17 @@ class Qureg:
             self._re, self._im = build(0)
 
     def _flush(self) -> None:
+        # One deferred-stream flush = one "circuit run" of the eager /
+        # C-driver path: scope a run-ledger record for it (nested scopes
+        # — e.g. a flush forced inside Circuit.run's property reads —
+        # fold into the outermost record instead of emitting their own).
+        with metrics.run_ledger("flush"):
+            metrics.annotate_run("num_vec_qubits", self.num_vec_qubits)
+            metrics.counter_inc("flush.runs")
+            metrics.counter_inc("flush.ops", len(self._pending))
+            self._flush_inner()
+
+    def _flush_inner(self) -> None:
         import jax
 
         from .ops.lattice import run_kernel_chain, run_kernel_donated
@@ -171,6 +183,10 @@ class Qureg:
                 chain.append(self._pending.pop(0))
             if chain:
                 self._materialize()
+                # ledger: non-gate kernels (channels, collapse) — XLA
+                # fuses adjacent elementwise steps, so passes are at
+                # most one per op (counted per op for simplicity)
+                metrics.counter_inc("exec.chain_ops", len(chain))
             while chain:
                 sub = chain[:CHAIN_MAX_STEPS]
                 steps = tuple((kind, statics) for kind, statics, _ in sub)
@@ -251,6 +267,7 @@ class Qureg:
                 adopted = _spec_exec_take(ops, self.num_vec_qubits,
                                           self._re.dtype)
                 if adopted is not None:
+                    metrics.counter_inc("spec.adopted")
                     _trace("speculative stream result ADOPTED")
                     (self._re, self._im), readout = adopted
                     # install the pre-warmed readout caches ONLY when
@@ -271,7 +288,9 @@ class Qureg:
                 fn = _stream_fn(ops, self.num_vec_qubits, self.mesh,
                                 self._re.dtype)
                 _trace("stream dispatch")
-                self._re, self._im = fn(self._re, self._im)
+                metrics.counter_inc("exec.gates", len(ops))
+                with metrics.span("execute"):
+                    self._re, self._im = fn(self._re, self._im)
                 _trace("stream dispatched (async)")
             except Exception:
                 # Requeue so the gates aren't silently dropped: a retry
@@ -285,16 +304,20 @@ class Qureg:
             # is popped only after its kernel ran, so a failure requeues
             # exactly the unapplied tail (plus whatever remains queued).
             self._materialize()
-            while run:
-                kind, statics, scalars = run[0]
-                try:
-                    self._re, self._im = run_kernel_donated(
-                        (self._re, self._im), scalars, kind=kind,
-                        statics=statics, mesh=self.mesh)
-                except Exception:
-                    self._pending = run + self._pending
-                    raise
-                del run[0]
+            # ledger: one streamed pass over the state per gate here
+            metrics.counter_inc("exec.gates", len(run))
+            metrics.counter_inc("exec.passes", len(run))
+            with metrics.span("execute"):
+                while run:
+                    kind, statics, scalars = run[0]
+                    try:
+                        self._re, self._im = run_kernel_donated(
+                            (self._re, self._im), scalars, kind=kind,
+                            statics=statics, mesh=self.mesh)
+                    except Exception:
+                        self._pending = run + self._pending
+                        raise
+                    del run[0]
 
     # -- shape bookkeeping ----------------------------------------------
     @property
@@ -377,16 +400,11 @@ def _is_sweep(qureg, ops) -> bool:
     return prev is not _MISSING and prev != scalars
 
 
-def _trace(msg: str) -> None:
-    """Phase timing to stderr when QUEST_CAPI_TRACE=1 (wall-clock since
-    process start) — the C-driver latency debugging knob."""
-    import os
-    import sys
-    import time
-
-    if os.environ.get("QUEST_CAPI_TRACE") == "1":
-        print(f"[quest-trace {time.perf_counter():.3f}] {msg}",
-              file=sys.stderr, flush=True)
+#: Phase timing when QUEST_CAPI_TRACE=1 (wall-clock since process
+#: start, stderr output byte-compatible with the historical format) —
+#: the C-driver latency debugging knob, now a quest_tpu.metrics sink
+#: that also records each message on the active run-ledger record.
+_trace = metrics.trace
 
 
 def _stream_fn(ops: tuple, num_vec_qubits: int, mesh, dtype=jnp.float32):
@@ -394,22 +412,26 @@ def _stream_fn(ops: tuple, num_vec_qubits: int, mesh, dtype=jnp.float32):
 
     def build():
         _trace(f"stream build start ({len(ops)} ops)")
-        fn = mesh is None and _aot_load(ops, num_vec_qubits, dtype)
-        if fn:
-            _trace("stream AOT-loaded")
-        if not fn:
-            from .circuit import Circuit  # deferred: avoids import cycle
+        metrics.counter_inc("stream.cache_misses")
+        with metrics.span("compile"):
+            fn = mesh is None and _aot_load(ops, num_vec_qubits, dtype)
+            if fn:
+                _trace("stream AOT-loaded")
+            if not fn:
+                from .circuit import Circuit  # deferred: avoids cycle
 
-            c = Circuit(num_vec_qubits)
-            c.ops = list(ops)
-            fn = c.compile(mesh=mesh, donate=True, pallas=True)
-            if mesh is None:
-                fn = _aot_save(fn, ops, num_vec_qubits, dtype) or fn
-            _trace("stream compiled+saved")
+                c = Circuit(num_vec_qubits)
+                c.ops = list(ops)
+                fn = c.compile(mesh=mesh, donate=True, pallas=True)
+                if mesh is None:
+                    fn = _aot_save(fn, ops, num_vec_qubits, dtype) or fn
+                _trace("stream compiled+saved")
         return fn
 
-    return lru_get(_STREAM_CACHE, (ops, num_vec_qubits, mesh, dtype),
-                   _STREAM_CACHE_MAX, build)
+    key = (ops, num_vec_qubits, mesh, dtype)
+    if key in _STREAM_CACHE:
+        metrics.counter_inc("stream.cache_hits")
+    return lru_get(_STREAM_CACHE, key, _STREAM_CACHE_MAX, build)
 
 
 def _aot_path(ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
@@ -544,6 +566,7 @@ def _spec_exec_take(ops: tuple, nvec: int, dtype):
     readout = _SPEC_EXEC["holder"].get("sv_readout")
     _SPEC_EXEC = None
     if result is None or key != (ops, nvec, jnp.dtype(dtype)):
+        metrics.counter_inc("spec.rejected")
         return None
     return result, readout
 
@@ -701,6 +724,7 @@ def _aot_load(ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
     if fn is None:
         fn = _aot_load_path(path)
     if fn is not None:
+        metrics.counter_inc("aot.loads")
         try:
             os.utime(path)  # keep most-recently-USED ordering fresh
         except OSError:
@@ -726,6 +750,7 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
         compiled = jit_fn.lower(aval, aval).compile()
     except Exception:
         return None  # explicit AOT compile unsupported: plain jit serves
+    metrics.counter_inc("aot.saves")
     try:
         from jax.experimental.serialize_executable import serialize
 
@@ -1302,6 +1327,7 @@ def _readout_prewarm(shape, dtype, nvec: int,
             rows = min(_PREFIX_ROWS, shape[0])
             holder["prefix"] = _prefix_fetch(rows, None).lower(
                 aval, aval).compile()
+            metrics.counter_inc("readout.prewarm_builds")
             _trace("readout prewarm done")
         except Exception:
             holder.pop("p0", None)
@@ -1327,7 +1353,10 @@ def readout_warm_get(name: str, shape, dtype, nvec: int,
     th = holder.get("thread")
     if th is not None:
         th.join()
-    return holder.get(name)
+    fn = holder.get(name)
+    if fn is not None:
+        metrics.counter_inc("readout.warm_hits")
+    return fn
 
 
 def _prefix_fetch(rows: int, mesh):
@@ -1368,7 +1397,9 @@ def _amp_at(qureg: Qureg, index: int):
             if fn is None:
                 fn = _prefix_fetch(rows, qureg.mesh)
             # one dispatch, one synchronising fetch for both arrays
-            pre = jax.device_get(fn(re, im))
+            metrics.counter_inc("readout.prefix_fetches")
+            with metrics.span("readout"):
+                pre = jax.device_get(fn(re, im))
             pre = (np.asarray(pre[0]), np.asarray(pre[1]))
             qureg._readout["amp_prefix"] = pre
         return pre[0][row, lane], pre[1][row, lane]

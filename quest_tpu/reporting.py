@@ -6,6 +6,14 @@ getEnvironmentString QuEST_cpu.c:1276-1282) and adds the tracing the
 reference lacks (SURVEY §5.1): ``trace`` wraps ``jax.profiler`` so a
 circuit's XLA/Pallas execution can be inspected in TensorBoard/Perfetto,
 and ``time_fn`` gives honest per-op wall times by forcing a host sync.
+
+Run-ledger export (quest_tpu.metrics): every circuit run records one
+structured ledger record — ``get_run_ledger_string`` returns the most
+recent one as JSON (the payload of the C API's ``getRunLedgerString``),
+and ``report_run_ledger`` prints it.  The metrics spans already carry
+``jax.profiler`` trace annotations, so a ``with reporting.trace(dir):``
+capture shows the same schedule/compile/execute/readout phases the
+ledger attributes wall time to.
 """
 
 from __future__ import annotations
@@ -16,6 +24,7 @@ import time
 import numpy as np
 import jax
 
+from . import metrics
 from .env import QuESTEnv
 from .register import Qureg
 
@@ -39,7 +48,11 @@ def report_state_to_screen(qureg: Qureg, env: QuESTEnv | None = None,
                            report_rank: int = 0) -> None:
     """Print all amplitudes, gated to small registers like the reference
     (statevec_reportStateToScreen prints <=5 qubits only,
-    QuEST_cpu.c:1252-1275)."""
+    QuEST_cpu.c:1252-1275).
+
+    ``env`` determines the per-rank chunking when given (one printed
+    chunk per environment device, the reference's one-chunk-per-rank
+    serialisation); without it the register's own mesh is used."""
     if qureg.num_vec_qubits > 5:
         # same gate and message as the reference (QuEST_cpu.c:1252-1275)
         print("Error: reportStateToScreen will not print output for "
@@ -55,7 +68,16 @@ def report_state_to_screen(qureg: Qureg, env: QuESTEnv | None = None,
     # (statevec_reportStateToScreen QuEST_cpu.c:1252-1275,
     # QuEST_precision.h:30/43)
     digits = 8 if qureg.real_dtype == np.float32 else 14
-    ndev = 1 if qureg.mesh is None else qureg.mesh.devices.size
+    if env is not None:
+        ndev = env.num_devices
+    else:
+        ndev = 1 if qureg.mesh is None else qureg.mesh.devices.size
+    # clamp: an env with more devices than the register has amplitudes
+    # (possible only for registers created outside that env) must not
+    # round the chunk to zero and print no rows at all.  Both counts
+    # are powers of two (create_env and create_qureg enforce this), so
+    # the clamped ndev always divides num_amps exactly.
+    ndev = max(1, min(ndev, qureg.num_amps))
     chunk = qureg.num_amps // ndev
     for rank in range(ndev):
         if report_rank:
@@ -101,6 +123,24 @@ def trace(log_dir: str):
 def annotate(name: str):
     """Label a region so it shows up named on the trace timeline."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def get_run_ledger_string() -> str:
+    """The most recent run-ledger record as one JSON line (``"{}"``
+    before any run) — the Python payload behind the C API's
+    ``getRunLedgerString`` (capi/src/quest_capi.c), the observability
+    analogue of ``getEnvironmentString``."""
+    return metrics.run_ledger_json()
+
+
+def get_run_ledger() -> dict | None:
+    """The most recent run-ledger record as a dict (quest_tpu.metrics)."""
+    return metrics.get_run_ledger()
+
+
+def report_run_ledger() -> None:
+    """Print the most recent run-ledger record as JSON."""
+    print(get_run_ledger_string())
 
 
 def time_fn(fn, *args, reps: int = 5, **kwargs) -> dict:

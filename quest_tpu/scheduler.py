@@ -36,6 +36,7 @@ import bisect
 
 import numpy as np
 
+from . import metrics
 from .ops.pallas_kernels import (
     default_max_high,
     default_row_budget,
@@ -212,6 +213,7 @@ def _partition_chunk(ops, low_cov: int, max_high: int):
     """Greedy commute-slide partition into (seg_ops_list, high_set)."""
     remaining = list(ops)
     parts = []
+    reorder_wins = 0
     while remaining:
         seg, high, skipped = [], [], []
         for op in remaining:
@@ -219,12 +221,17 @@ def _partition_chunk(ops, low_cov: int, max_high: int):
                       if t not in high]
             addable = len(high) + len(needed) <= max_high
             if addable and all(_commutes(op, s) for s in skipped):
+                if skipped:
+                    # the op slid past >= 1 skipped op into this segment
+                    reorder_wins += 1
                 high.extend(needed)
                 seg.append(op)
             else:
                 skipped.append(op)
         parts.append((seg, high))
         remaining = skipped
+    if reorder_wins:
+        metrics.counter_inc("sched.reorder_wins", reorder_wins)
     return parts
 
 
@@ -277,6 +284,7 @@ def _tail_merge(parts, low_cov: int, max_high: int):
                 ehigh[:] = trial_high[e]
             parts.pop()
             changed = True
+            metrics.counter_inc("sched.tail_merge_saved_passes")
     out = []
     for s, _h in parts:
         high = []
@@ -327,13 +335,17 @@ def schedule_segments(ops, num_vec_bits: int, lane_bits: int = 7,
         max_high = default_max_high(num_vec_bits)
     if row_budget is None:
         row_budget = default_row_budget(max_high)
-    return [
+    segments = [
         (seg_ops, high)
         for seg_ops, high, _ in _schedule_chunk(
             normalize_diag(ops), num_vec_bits, lane_bits, row_budget,
             max_high, lane_compose_min=lane_compose_min,
             row_compose_min=row_compose_min)
     ]
+    metrics.counter_inc("sched.schedules")
+    metrics.counter_inc("sched.gates_in", len(ops))
+    metrics.counter_inc("sched.segments", len(segments))
+    return segments
 
 
 def schedule_segments_best(ops, num_vec_bits: int, lane_bits: int = 7,
@@ -466,6 +478,12 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
         anchor = local[0] if local else cyc[0]
         while inv[anchor] != anchor:
             do_swap(anchor, inv[anchor])
+    metrics.counter_inc("sched.mesh_plans")
+    metrics.counter_inc("sched.gates_in", len(ops))
+    metrics.counter_inc("sched.segments",
+                        sum(1 for it in plan if it[0] == "seg"))
+    metrics.counter_inc("sched.relayout_swaps",
+                        sum(1 for it in plan if it[0] == "swap"))
     return plan
 
 

@@ -25,7 +25,12 @@ if "host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # jax >= 0.4.34 spelling; older versions only honour the XLA_FLAGS
+    # --xla_force_host_platform_device_count flag set above.
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

@@ -16,9 +16,14 @@ _SUB = """
 import os, sys
 sys.path.insert(0, {repo!r})
 os.environ["QUEST_AOT_CACHE"] = {cache!r}
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+try:  # jax >= 0.4.34 spelling; older versions use the XLA_FLAGS above
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
 import numpy as np
 import jax.numpy as jnp
 from quest_tpu import models, register

@@ -134,6 +134,7 @@ def lib():
     L.writeRecordedQASMToFile.argtypes = [Qureg, ct.c_char_p]
     L.startRecordingQASM.argtypes = [Qureg]
     L.getEnvironmentString.argtypes = [QuESTEnv, Qureg, ct.c_char * 200]
+    L.getRunLedgerString.argtypes = [QuESTEnv, ct.c_char_p, ct.c_int]
     return L
 
 
@@ -268,6 +269,23 @@ def test_environment_string(lib, cenv):
     lib.getEnvironmentString(cenv, q, buf)
     s = buf.value.decode()
     assert s.startswith("5qubits_")
+    lib.destroyQureg(q, cenv)
+
+
+def test_run_ledger_string(lib, cenv):
+    """The observability hook: after a gate stream flushes, the ledger
+    record crosses the C ABI as one JSON line (quest_tpu.metrics)."""
+    import json
+
+    q = lib.createQureg(4, cenv)
+    lib.hadamard(q, 0)
+    lib.controlledNot(q, 0, 1)
+    lib.getProbAmp(q, 0)  # state read: flushes the deferred stream
+    buf = ct.create_string_buffer(65536)
+    lib.getRunLedgerString(cenv, buf, 65536)
+    rec = json.loads(buf.value.decode())
+    assert rec.get("schema") == "quest-tpu-run-ledger/1"
+    assert rec["counters"].get("flush.runs", 0) >= 1
     lib.destroyQureg(q, cenv)
 
 

@@ -1,0 +1,219 @@
+"""Run-ledger metrics subsystem (quest_tpu.metrics).
+
+Covers the ISSUE-1 acceptance criteria: (a) a mesh run's ledger
+exchange-byte total equals the analytic half-chunk formula evaluated on
+the relayout plan, (b) compile-cache hit/miss counters are deterministic
+across identical runs, (c) QUEST_METRICS_FILE emits valid JSONL — plus
+the instrumentation-discipline lint (no ad-hoc perf_counter / stderr
+prints outside quest_tpu/metrics.py and quest_tpu/reporting.py).
+"""
+
+import json
+import os
+import re as regex
+
+import numpy as np
+
+import quest_tpu as qt
+from quest_tpu import metrics
+from quest_tpu.circuit import Circuit
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _mesh_circuit(n):
+    """Gates with mixing targets on device bits -> relayout exchanges."""
+    c = Circuit(n)
+    for t in range(n):
+        c.hadamard(t)
+    c.controlled_not(n - 1, 0)
+    c.t_gate(n - 1)
+    c.rotate_y(n - 2, 0.37)
+    c.controlled_not(n - 2, 1)
+    return c
+
+
+def test_mesh_exchange_bytes_match_plan(env8):
+    """(a) ledger exchange bytes == analytic half-chunk formula over the
+    relayout plan of a 12-qubit run on the 8-device mesh."""
+    n = 12
+    circ = _mesh_circuit(n)
+    q = qt.create_qureg(n, env8)
+    circ.run(q)
+    led = metrics.get_run_ledger()
+    assert led is not None and led["label"] == "circuit_run"
+    assert led["meta"]["num_devices"] == 8
+
+    from quest_tpu.ops.lattice import state_shape, _ilog2
+    from quest_tpu.scheduler import schedule_mesh
+
+    ndev = env8.num_devices
+    dev_bits = _ilog2(ndev)
+    chunk_bits = n - dev_bits
+    chunk = (1 << n) // ndev
+    itemsize = np.dtype(q.real_dtype).itemsize
+    plan = schedule_mesh(list(circ.ops), n, dev_bits,
+                         _ilog2(state_shape(1 << n, ndev)[1]))
+    expected = 0
+    for item in plan:
+        if item[0] != "swap":
+            continue
+        a, b = sorted(item[1:])
+        if b < chunk_bits:
+            continue  # local<->local relabel: communication-free
+        if a >= chunk_bits:
+            # device<->device: whole chunk, for the half of the devices
+            # whose two coordinate bits differ; re and im both move
+            expected += (ndev // 2) * chunk * 2 * itemsize
+        else:
+            # device<->local HALF-chunk ppermute: every device sends
+            # chunk/2 elements of re and of im
+            expected += ndev * (chunk // 2) * 2 * itemsize
+    assert expected > 0, "workload must force at least one relayout"
+    assert led["counters"]["exec.exchange_bytes"] == expected
+    assert led["counters"]["exec.relayouts"] >= 1
+    assert led["counters"]["exec.passes"] >= 1
+
+
+def test_mesh_run_emits_single_record(env8):
+    """One circuit run on the mesh -> exactly ONE new ledger record
+    (inner flushes nest into the circuit_run scope)."""
+    q = qt.create_qureg(10, env8)
+    circ = _mesh_circuit(10)
+    metrics.reset()  # clean slate: the retained-record ring is bounded
+    circ.run(q)
+    records = metrics.recent_records()
+    assert len(records) == 1
+    assert records[-1]["label"] == "circuit_run"
+    for phase in ("compile", "execute"):
+        assert records[-1]["spans"][phase]["count"] >= 1
+
+
+def test_compile_cache_counters_deterministic(env1):
+    """(b) hit/miss counters are identical across two identical runs."""
+    circ = Circuit(5)
+    circ.hadamard(0).controlled_not(0, 1).t_gate(2).rotate_y(3, 0.5)
+    ledgers = []
+    for _ in range(3):
+        q = qt.create_qureg(5, env1)
+        circ.run(q)
+        led = metrics.get_run_ledger()["counters"]
+        ledgers.append((led.get("circuit.compile_cache_hits", 0),
+                        led.get("circuit.compile_cache_misses", 0)))
+    assert ledgers[0] == (0, 1)  # first run compiles
+    assert ledgers[1] == ledgers[2] == (1, 0)  # identical runs hit
+
+
+def test_metrics_file_jsonl(env1, tmp_path, monkeypatch):
+    """(c) QUEST_METRICS_FILE collects one valid JSON line per run
+    (this suite runs under JAX_PLATFORMS=cpu, see conftest)."""
+    path = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("QUEST_METRICS_FILE", str(path))
+    circ = Circuit(4)
+    circ.hadamard(0).hadamard(1).controlled_not(0, 2)
+    q = qt.create_qureg(4, env1)
+    circ.run(q)
+    # eager path: deferred gates flush on first state read -> a record
+    q2 = qt.create_qureg(4, env1)
+    qt.hadamard(q2, 0)
+    qt.get_state_vector(q2)
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) >= 2
+    labels = set()
+    for ln in lines:
+        rec = json.loads(ln)  # every line parses
+        assert rec["schema"] == metrics.SCHEMA
+        assert "counters" in rec and "wall_s" in rec
+        labels.add(rec["label"])
+    assert "circuit_run" in labels
+    assert "flush" in labels
+
+
+def test_run_ledger_string_export(env1):
+    """reporting/getRunLedgerString payload is one JSON object line."""
+    q = qt.create_qureg(3, env1)
+    Circuit(3).hadamard(0).run(q)
+    rec = json.loads(qt.get_run_ledger_string())
+    assert rec["schema"] == metrics.SCHEMA
+    assert rec == json.loads(qt.getRunLedgerString())
+
+
+def test_trace_sink_byte_compatible(capfd, monkeypatch):
+    """QUEST_CAPI_TRACE=1 output keeps the historical format (the
+    C-driver latency-debugging contract folded into metrics.trace)."""
+    monkeypatch.setenv("QUEST_CAPI_TRACE", "1")
+    from quest_tpu.register import _trace
+
+    _trace("hello ledger")
+    err = capfd.readouterr().err
+    assert regex.fullmatch(r"\[quest-trace \d+\.\d{3}\] hello ledger\n",
+                           err), repr(err)
+
+
+def test_trace_records_ledger_event(monkeypatch):
+    monkeypatch.delenv("QUEST_CAPI_TRACE", raising=False)
+    with metrics.run_ledger("evt") as rec:
+        metrics.trace("inside")
+    assert [e[1] for e in rec["events"]] == ["inside"]
+
+
+def test_counters_attribute_to_nested_scopes():
+    with metrics.run_ledger("outer") as outer:
+        metrics.counter_inc("t.x", 2)
+        with metrics.run_ledger("inner") as inner:
+            metrics.counter_inc("t.x", 3)
+    assert inner["counters"]["t.x"] == 3
+    assert outer["counters"]["t.x"] == 5
+    # only the OUTERMOST scope emitted a record
+    assert metrics.recent_records(1)[-1]["label"] == "outer"
+
+
+def test_nested_equal_label_scopes():
+    """Same-label nesting must exit cleanly (records are removed by
+    identity — dict-equal empty records once crashed the outer exit)
+    and fold events/meta into the emitted outermost record."""
+    with metrics.run_ledger("x") as outer:
+        with metrics.run_ledger("x"):
+            pass
+        metrics.counter_inc("t.y")
+        with metrics.run_ledger("flushlike"):
+            metrics.trace("nested event")
+            metrics.annotate_run("who", "inner")
+    assert outer["counters"]["t.y"] == 1
+    emitted = metrics.recent_records(1)[-1]
+    assert emitted["label"] == "x"
+    assert [e[1] for e in emitted["events"]] == ["nested event"]
+    assert emitted["meta"]["who"] == "inner"
+
+
+# ---------------------------------------------------------------------------
+# Instrumentation-discipline lint
+# ---------------------------------------------------------------------------
+
+#: The only quest_tpu modules allowed to read the wall clock or print to
+#: stderr: hot-path timing goes through the run ledger, not ad-hoc
+#: perf_counter()/stderr instrumentation.
+_INSTRUMENTATION_MODULES = {"metrics.py", "reporting.py"}
+
+_FORBIDDEN = regex.compile(r"perf_counter\s*\(|sys\.stderr")
+
+
+def test_no_adhoc_instrumentation_outside_metrics():
+    pkg = os.path.join(REPO, "quest_tpu")
+    offenders = []
+    for root, _dirs, files in os.walk(pkg):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(root, fname), pkg)
+            if rel in _INSTRUMENTATION_MODULES:
+                continue
+            with open(os.path.join(root, fname)) as f:
+                for lineno, line in enumerate(f, 1):
+                    if _FORBIDDEN.search(line):
+                        offenders.append(
+                            f"quest_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw wall-clock/stderr instrumentation outside quest_tpu/"
+        "metrics.py and quest_tpu/reporting.py — route it through the "
+        "run ledger (quest_tpu.metrics):\n" + "\n".join(offenders))

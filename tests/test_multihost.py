@@ -22,9 +22,15 @@ _WORKER = """
 import sys
 sys.path.insert(0, {repo!r})
 pid = int(sys.argv[1])
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:  # jax >= 0.4.34 spelling; older versions use the XLA_FLAGS above
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass
 import quest_tpu as qt
 qt.init_distributed("localhost:{port}", {nproc}, pid)
 assert jax.process_count() == {nproc}
@@ -47,9 +53,15 @@ _FUSED_WORKER = """
 import sys
 sys.path.insert(0, {repo!r})
 pid = int(sys.argv[1])
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:  # jax >= 0.4.34 spelling; older versions use the XLA_FLAGS above
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass
 import numpy as np
 import quest_tpu as qt
 from quest_tpu import models

@@ -35,12 +35,18 @@ NPROC = 2
 DEV_PER_PROC = 4
 
 _WORKER = """
-import sys, time, json
+import os, sys, time, json
 sys.path.insert(0, {repo!r})
 pid = int(sys.argv[1])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count={dev_per_proc}")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", {dev_per_proc})
+try:  # jax >= 0.4.34 spelling; older versions use the XLA_FLAGS above
+    jax.config.update("jax_num_cpu_devices", {dev_per_proc})
+except AttributeError:
+    pass
 import numpy as np
 import jax.numpy as jnp
 import quest_tpu as qt

@@ -159,10 +159,16 @@ def run_virtual_mesh(n: int = 26, ndev: int = 8):
     are accounted per-swap (exact bytes at this chunk size) against the
     reference's full-chunk-per-gate exchange scheme."""
     code = f"""
-import json, math, time
+import json, math, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count={ndev}")
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", {ndev})
+try:  # jax >= 0.4.34 spelling; older versions use the XLA_FLAGS above
+    jax.config.update("jax_num_cpu_devices", {ndev})
+except AttributeError:
+    pass
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

@@ -1,7 +1,12 @@
 """Dump the fused-schedule op histogram for the bench workload, with a
 per-pass cost model from the round-3 probe numbers (tools/probe30*.py),
-so scheduler changes can be sanity-costed before touching the chip."""
+so scheduler changes can be sanity-costed before touching the chip.
 
+Schedule-level figures (segments, gates/pass, reorder wins, tail-merge
+saves) are read back from the RUN LEDGER the scheduler itself records
+(quest_tpu.metrics), not recomputed here."""
+
+import json
 import os
 import sys
 from collections import Counter
@@ -9,14 +14,16 @@ from collections import Counter
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
 import numpy as np
 
-from quest_tpu import models
+from quest_tpu import metrics, models
 from quest_tpu.scheduler import schedule_segments_best
 
 N = int(os.environ.get("MB_QUBITS", "30"))
 DEPTH = int(os.environ.get("MB_DEPTH", "16"))
 
 circ = models.random_circuit(N, depth=DEPTH, seed=123)
-segs = schedule_segments_best(list(circ.ops), N)
+with metrics.run_ledger("sched_stats"):
+    segs = schedule_segments_best(list(circ.ops), N)
+led = metrics.get_run_ledger()["counters"]
 
 # probe30/probe50 costs (ms/pass at 30q)
 COST = {"floor": 37.2, "lanemm_real": 12.4, "lanemm_cplx": 18.6,
@@ -26,7 +33,10 @@ COST = {"floor": 37.2, "lanemm_real": 12.4, "lanemm_cplx": 18.6,
         "expmm_real": 3.0, "expmm_cplx": 12.0}
 
 total = 0.0
-print(f"n={N} depth={DEPTH} gates={circ.num_gates} passes={len(segs)}")
+print(f"n={N} depth={DEPTH} gates={circ.num_gates} "
+      f"passes={led['sched.segments']}")
+print("ledger: " + json.dumps(
+    {k: led[k] for k in sorted(led) if k.startswith("sched.")}))
 for si, (seg_ops, high) in enumerate(segs):
     hist = Counter()
     est = COST["floor"]
@@ -62,4 +72,6 @@ for si, (seg_ops, high) in enumerate(segs):
             est += COST.get(k, 0.3)
     total += est
     print(f"  seg{si}: high={high} est={est:6.1f}ms  {dict(hist)}")
+gates_per_pass = led["sched.gates_in"] / max(led["sched.segments"], 1)
+print(f"gates/pass (ledger) {gates_per_pass:.2f}")
 print(f"est total {total:.0f} ms/loop -> est {circ.num_gates/total*1000:.0f} gates/s")
