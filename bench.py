@@ -202,6 +202,27 @@ def main():
     # (QuEST_precision.h:38-47).
     a100_equiv = _A100_BW / (2 * 2 * (1 << num_qubits) * 8)
 
+    # Mesh-plan comm trajectory: exchange bytes of the 30-qubit
+    # distributed QFT plan over an 8-device mesh, as the run ledger
+    # records them.  The plan is built host-side (scheduling needs no
+    # devices), so the metric tracks the relayout-fusion win in every
+    # BENCH_*.json alongside gate throughput regardless of the attached
+    # accelerator; bytes at f32 (the bench precision).
+    from quest_tpu import metrics, models
+    from quest_tpu.ops.lattice import state_shape, _ilog2
+    from quest_tpu.parallel.mesh_exec import plan_exchange_elems
+    from quest_tpu.scheduler import schedule_mesh
+
+    qft_n, qft_dev_bits = 30, 3
+    qft_lane_bits = _ilog2(state_shape(1 << qft_n, 1 << qft_dev_bits)[1])
+    with metrics.run_ledger("bench_mesh_plan"):
+        plan = schedule_mesh(list(models.qft(qft_n).ops), qft_n,
+                             qft_dev_bits, qft_lane_bits)
+        _, exch_elems = plan_exchange_elems(plan, qft_n, qft_dev_bits)
+        metrics.counter_inc("mesh.exchange_bytes", exch_elems * 4)
+    mesh_led = (metrics.get_run_ledger() or {}).get("counters", {})
+    mesh_exchange_bytes = int(mesh_led.get("mesh.exchange_bytes", 0))
+
     # Reference's only in-repo figure: 667 gates in 3783.93 s (30 qubits).
     baseline = 667.0 / 3783.93
     print(json.dumps({
@@ -218,6 +239,7 @@ def main():
         "roofline_frac": round(hbm_gbps * 1e9 / spec_bw, 3),
         "a100_equiv_gates_per_sec": round(a100_equiv, 1),
         "vs_a100": round(gates_per_sec / a100_equiv, 2),
+        "mesh_exchange_bytes_qft30": mesh_exchange_bytes,
         "device": dev_kind,
     }))
 

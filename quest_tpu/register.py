@@ -1110,8 +1110,15 @@ def _row_window_update(shape: tuple[int, int], dtype, mesh):
     sh = amp_sharding(mesh)
 
     def upd(re, im, pre, pim, r0):
-        return (jax.lax.dynamic_update_slice(re, pre, (r0, 0)),
-                jax.lax.dynamic_update_slice(im, pim, (r0, 0)))
+        # s32 index: under x64 a Python-int row index arrives as s64 and
+        # the SPMD partitioner's shard-offset comparison then mixes
+        # s64/s32 operands, which the HLO verifier rejects on the
+        # sharded path ("Binary op compare with different element
+        # types"); the row count always fits s32.
+        r0 = jnp.asarray(r0, jnp.int32)
+        c0 = jnp.zeros((), jnp.int32)
+        return (jax.lax.dynamic_update_slice(re, pre, (r0, c0)),
+                jax.lax.dynamic_update_slice(im, pim, (r0, c0)))
 
     kw = {} if sh is None else {"out_shardings": (sh, sh)}
     return jax.jit(upd, donate_argnums=(0, 1), **kw)

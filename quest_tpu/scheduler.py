@@ -360,7 +360,8 @@ def schedule_segments_best(ops, num_vec_bits: int, lane_bits: int = 7,
 
 def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
                   row_budget: int | None = None,
-                  max_high: int | None = None):
+                  max_high: int | None = None,
+                  fuse_relayouts: bool = True):
     """Mesh scheduling with qubit relabeling.
 
     Returns a plan: a list of
@@ -369,7 +370,23 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
         masks resolved per device into the kernel's flag operand;
       ("swap", phys_a, phys_b) — relayout exchanging global index bits
         ``phys_a`` and ``phys_b`` (device<->local swaps cost a half-chunk
-        ppermute; local<->local swaps are comm-free).
+        ppermute; local<->local swaps are comm-free);
+      ("relayout", perm) — a fused multi-bit relayout: the composed bit
+        permutation of a whole swap run, executed as ONE sub-block
+        exchange by ``mesh_exec.apply_relayout`` (a k-bit device<->local
+        relayout moves chunk*(2^k-1)/2^k per device where k serial
+        half-swaps move k*chunk/2 — 42% less at k=3, 53% at k=4).
+
+    With ``fuse_relayouts`` (default), two layers produce the fused
+    items: ``localise`` *prefetches* — when one sharded qubit must be
+    relabelled local, every other device-resident qubit with an upcoming
+    mixing use joins the same swap run (guarded so prefetch never evicts
+    hotter data than it brings in) — and a post-pass coalesces each
+    maximal run of adjacent swaps into a single ("relayout", perm) item.
+    The canonical-restore epilogue is one such run by construction.
+    ``fuse_relayouts=False`` keeps the PR-1 one-swap-at-a-time plan (the
+    comparison baseline for ``tools/sched_stats.py`` and the comm-volume
+    pin tests).
 
     The plan ends with relayouts restoring the canonical (identity)
     layout, so the produced state is bit-compatible with every other
@@ -429,16 +446,43 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     def localise(q: int, i: int, keep=()):
         """Relabel logical qubit ``q``'s bit into the chunk if sharded.
         ``keep``: logical qubits that must stay local (the current op's
-        other bits — already-localised partners must not be evicted)."""
-        if pos[q] >= chunk_bits:
+        other bits — already-localised partners must not be evicted).
+
+        Relayout prefetch (``fuse_relayouts``): other device-resident
+        qubits with an upcoming mixing use join the same swap run —
+        the post-pass fuses the run into one multi-bit relayout whose
+        exchange moves (2^k-1)/2^k of the chunk where the k separate
+        half-swaps it replaces move k/2."""
+        if pos[q] < chunk_bits:
+            return
+        batch = [q]
+        if fuse_relayouts:
+            batch += sorted(
+                (inv[p] for p in range(chunk_bits, num_vec_bits)
+                 if inv[p] != q and next_mix_use(inv[p], i) < len(ops)),
+                key=lambda qq: next_mix_use(qq, i))
+        noevict = set(keep) | set(batch)
+        for qq in batch:
+            if pos[qq] < chunk_bits:
+                continue  # an earlier batch member's swap localised it
             # evict the local bit whose logical qubit mixes farthest in
             # the future (ties: prefer high row bits, keeping lanes free
             # for matmul runs)
-            victim = max(
-                (p for p in range(chunk_bits) if inv[p] not in keep),
-                key=lambda p: (next_mix_use(inv[p], i), p),
-            )
-            do_swap(pos[q], victim)
+            cands = [p for p in range(chunk_bits) if inv[p] not in noevict]
+            if not cands:
+                if qq != q:
+                    continue
+                # tiny chunks: the batch covers every local bit — the
+                # REQUIRED qubit may still evict a prefetched one (and
+                # an unsatisfiable keep set fails loudly, as before)
+                cands = [p for p in range(chunk_bits)
+                         if inv[p] not in keep]
+            victim = max(cands,
+                         key=lambda p: (next_mix_use(inv[p], i), p))
+            if qq != q and \
+                    next_mix_use(inv[victim], i) <= next_mix_use(qq, i):
+                continue  # prefetch must not evict hotter data
+            do_swap(pos[qq], victim)
 
     for i, op in enumerate(ops):
         kind, statics, scalars = op
@@ -478,13 +522,62 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
         anchor = local[0] if local else cyc[0]
         while inv[anchor] != anchor:
             do_swap(anchor, inv[anchor])
+    n_swaps = sum(1 for it in plan if it[0] == "swap")
+    if fuse_relayouts:
+        plan = _fuse_swap_runs(plan, num_vec_bits)
     metrics.counter_inc("sched.mesh_plans")
     metrics.counter_inc("sched.gates_in", len(ops))
     metrics.counter_inc("sched.segments",
                         sum(1 for it in plan if it[0] == "seg"))
-    metrics.counter_inc("sched.relayout_swaps",
-                        sum(1 for it in plan if it[0] == "swap"))
+    metrics.counter_inc("sched.relayout_swaps", n_swaps)
+    n_fused = sum(1 for it in plan if it[0] == "relayout")
+    if n_fused:
+        metrics.counter_inc("sched.fused_relayouts", n_fused)
     return plan
+
+
+def compose_swap_perm(run, num_vec_bits: int, perm=None):
+    """Composed bit-permutation of a swap run, in execution order.
+
+    Executing the run leaves ``new[i] = old[j]`` with bit ``b`` of ``j``
+    equal to bit ``perm[b]`` of ``i``.  A later swap composes onto the
+    prefix by VALUE relabel (``total = swap . prefix``); starting from
+    ``perm`` when given (composing additional swaps onto an existing
+    relayout)."""
+    perm = list(range(num_vec_bits)) if perm is None else list(perm)
+    for _, a, b in run:
+        perm = [b if v == a else a if v == b else v for v in perm]
+    return tuple(perm)
+
+
+def _fuse_swap_runs(plan, num_vec_bits: int):
+    """Coalesce each maximal run of adjacent ("swap", a, b) items (no
+    intervening "seg") into a single ("relayout", perm) item carrying
+    the composed bit permutation.  Single swaps stay "swap" (the
+    executor's pairwise path moves the same half chunk, with the re/im
+    payload stacked either way); runs whose composed permutation is the
+    identity vanish."""
+    out, run = [], []
+
+    def emit():
+        if not run:
+            return
+        if len(run) == 1:
+            out.append(run[0])
+        else:
+            perm = compose_swap_perm(run, num_vec_bits)
+            if any(p != b for b, p in enumerate(perm)):
+                out.append(("relayout", perm))
+        run.clear()
+
+    for item in plan:
+        if item[0] == "swap":
+            run.append(item)
+        else:
+            emit()
+            out.append(item)
+    emit()
+    return out
 
 
 class _Group:

@@ -13,7 +13,9 @@ EXAMPLES = os.path.join(REPO, "examples")
 
 @pytest.mark.parametrize("name,expect", [
     ("tutorial.py", "Probability amplitude of |111>: 0.498751"),
-    ("bernstein_vazirani.py", "solution reached with probability 1.000000"),
+    # 4 decimals: the exact f32 tail varies with fused-segment packing
+    # (the example itself asserts |p - 1| < 1e-5)
+    ("bernstein_vazirani.py", "solution reached with probability 1.0000"),
     ("damping.py", "rho00"),
     ("distributed_qft.py", "ok"),
     ("sampled_bv.py", "every shot read the secret exactly"),

@@ -64,7 +64,8 @@ def test_device_bit_controls_and_phases(env8, env1):
     plan = schedule_mesh(list(circ.ops), N, 3,
                          _ilog2(state_shape(1 << N, 8)[1]))
     # only the three initial hadamards on 8/7 mix device bits; the
-    # controls/phases must not add swaps beyond those + restore
+    # controls/phases must not add relayout items beyond those + restore
+    # (batched+fused, the forced pair and the restore are one item each)
     stats = plan_comm_stats(plan, N, 3)
     assert stats["swaps"] <= 2 * 2 + 1  # 2 forced + restore
     _compare_sharded(env8, env1, circ)
@@ -115,19 +116,27 @@ def test_half_exchange_comm_volume():
 
 def test_plan_restores_canonical_layout():
     """Every plan ends in the identity layout: applying the plan twice
-    equals applying the circuit twice."""
+    equals applying the circuit twice.  Checked on the fused plan
+    (relayout items compose their whole bit permutation) and the
+    unfused one."""
     n = 9
     circ = Circuit(n)
     circ.hadamard(8).cnot(8, 0).rotate_z(7, 0.4).hadamard(6)
-    plan = schedule_mesh(list(circ.ops), n, 3,
-                         _ilog2(state_shape(1 << n, 8)[1]))
-    # net permutation of all swaps must be identity
-    perm = list(range(n))
-    for item in plan:
-        if item[0] == "swap":
-            _, a, b = item
-            perm[a], perm[b] = perm[b], perm[a]
-    assert perm == list(range(n))
+    for fuse in (True, False):
+        plan = schedule_mesh(list(circ.ops), n, 3,
+                             _ilog2(state_shape(1 << n, 8)[1]),
+                             fuse_relayouts=fuse)
+        # net permutation of all relayout items must be identity
+        # (composition by value relabel: executing P after the prefix
+        # leaves total[c] = P[total[c]])
+        perm = list(range(n))
+        for item in plan:
+            if item[0] == "swap":
+                _, a, b = item
+                perm = [b if v == a else a if v == b else v for v in perm]
+            elif item[0] == "relayout":
+                perm = [item[1][v] for v in perm]
+        assert perm == list(range(n)), (fuse, plan)
 
 
 def test_26q_sharded_vs_local_xla(env8, env1):

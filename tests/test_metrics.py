@@ -56,20 +56,32 @@ def test_mesh_exchange_bytes_match_plan(env8):
                          _ilog2(state_shape(1 << n, ndev)[1]))
     expected = 0
     for item in plan:
-        if item[0] != "swap":
-            continue
-        a, b = sorted(item[1:])
-        if b < chunk_bits:
-            continue  # local<->local relabel: communication-free
-        if a >= chunk_bits:
-            # device<->device: whole chunk, for the half of the devices
-            # whose two coordinate bits differ; re and im both move
-            expected += (ndev // 2) * chunk * 2 * itemsize
-        else:
-            # device<->local HALF-chunk ppermute: every device sends
-            # chunk/2 elements of re and of im
-            expected += ndev * (chunk // 2) * 2 * itemsize
+        if item[0] == "swap":
+            a, b = sorted(item[1:])
+            if b < chunk_bits:
+                continue  # local<->local relabel: communication-free
+            if a >= chunk_bits:
+                # device<->device: whole chunk, for the half of the
+                # devices whose two coordinate bits differ; re and im
+                # both move
+                expected += (ndev // 2) * chunk * 2 * itemsize
+            else:
+                # device<->local HALF-chunk ppermute: every device
+                # sends chunk/2 elements of re and of im
+                expected += ndev * (chunk // 2) * 2 * itemsize
+        elif item[0] == "relayout":
+            # fused multi-bit relayout: the shared accounting helper —
+            # its round structure is independently pinned against
+            # closed-form volumes and the serial executor in
+            # tests/test_mesh_relayout.py, so this assertion checks the
+            # ledger WIRING without duplicating the formula here
+            from quest_tpu.parallel.mesh_exec import relayout_comm_elems
+
+            expected += relayout_comm_elems(item[1], n,
+                                            dev_bits) * itemsize
     assert expected > 0, "workload must force at least one relayout"
+    assert any(item[0] == "relayout" for item in plan), \
+        "workload must exercise the FUSED relayout item class"
     assert led["counters"]["exec.exchange_bytes"] == expected
     assert led["counters"]["exec.relayouts"] >= 1
     assert led["counters"]["exec.passes"] >= 1

@@ -221,9 +221,17 @@ for k in (0, 1, 5, (1 << n) - 1, (1 << (n - 1)) + 3):
 lane_bits = (lanes - 1).bit_length()
 chunk_bits = n - dev_bits
 chunk_bytes = 2 * (1 << chunk_bits) * 4       # re+im f32 per device
+from quest_tpu.parallel.mesh_exec import relayout_comm_elems
 plan = schedule_mesh(list(circ.ops), n, dev_bits, lane_bits)
 swaps = []
 for step in plan:
+    if step[0] == "relayout":
+        # fused multi-bit relayout: exact sub-block accounting (both
+        # arrays ride one stacked payload); average bytes per device
+        elems = relayout_comm_elems(step[1], n, dev_bits)
+        swaps.append({{"perm": list(step[1]), "kind": "fused-relayout",
+                       "bytes_per_device": elems * 4 // ndev}})
+        continue
     if step[0] != "swap":
         continue
     a, b = sorted(step[1:])

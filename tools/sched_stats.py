@@ -75,3 +75,40 @@ for si, (seg_ops, high) in enumerate(segs):
 gates_per_pass = led["sched.gates_in"] / max(led["sched.segments"], 1)
 print(f"gates/pass (ledger) {gates_per_pass:.2f}")
 print(f"est total {total:.0f} ms/loop -> est {circ.num_gates/total*1000:.0f} gates/s")
+
+# ---------------------------------------------------------------------------
+# Mesh plan: relayout comm volume before/after fusion
+# ---------------------------------------------------------------------------
+# The same workload scheduled over a 2^MB_DEV_BITS-device mesh, unfused
+# (PR-1 one-swap-at-a-time) vs fused (prefetch-batched localisations +
+# coalesced swap runs); exchange volumes from the shared classifier
+# (plan_exchange_elems), bytes at f32.
+
+from quest_tpu.ops.lattice import state_shape, _ilog2  # noqa: E402
+from quest_tpu.parallel.mesh_exec import plan_exchange_elems  # noqa: E402
+from quest_tpu.scheduler import schedule_mesh  # noqa: E402
+
+DEV_BITS = int(os.environ.get("MB_DEV_BITS", "3"))
+lane_bits = _ilog2(state_shape(1 << N, 1 << DEV_BITS)[1])
+mesh_report = {}
+with metrics.suppressed():  # diagnostic recompute: keep the ledger clean
+    for fuse in (False, True):
+        plan = schedule_mesh(list(circ.ops), N, DEV_BITS, lane_bits,
+                             fuse_relayouts=fuse)
+        nrel, elems = plan_exchange_elems(plan, N, DEV_BITS)
+        mesh_report["fused" if fuse else "unfused"] = {
+            "plan_items": len(plan),
+            "segments": sum(1 for it in plan if it[0] == "seg"),
+            "swap_items": sum(1 for it in plan if it[0] == "swap"),
+            "fused_relayouts": sum(1 for it in plan
+                                   if it[0] == "relayout"),
+            "relayouts_with_comm": nrel,
+            "exchange_elems": elems,
+            "exchange_bytes_f32": elems * 4,
+        }
+u, f = mesh_report["unfused"], mesh_report["fused"]
+saved = 1.0 - f["exchange_elems"] / max(u["exchange_elems"], 1)
+print(f"mesh plan (dev_bits={DEV_BITS}): "
+      + json.dumps(mesh_report, sort_keys=True))
+print(f"relayout fusion saves {saved:.1%} exchange volume "
+      f"({u['exchange_elems']} -> {f['exchange_elems']} elems)")
