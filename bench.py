@@ -24,6 +24,11 @@ Env overrides: QUEST_BENCH_QUBITS (default 30, auto-falls back on OOM),
 QUEST_BENCH_DEPTH (default 22 layers -> 660 gates at 30q, matching the
 reference driver's 667-gate workload shape), QUEST_BENCH_REPS.
 
+``--gate BENCH_prev.json`` compares this run against a previous record
+via ``tools/ledger_diff.py`` (exchange bytes, pass counts, device time)
+and exits nonzero on a regression — the enforced-trajectory mode
+``tools/record_all.py`` runs as a tier-2 smoke.
+
 ``hbm_gbps``/``roofline_frac`` are derived from the RUN LEDGER
 (quest_tpu.metrics): pass count and per-pass stream bytes recorded by
 the fused executor while the benchmark program was built, not an
@@ -122,12 +127,17 @@ def run(num_qubits: int, depth: int, reps: int, inner: int):
         n_passes_model = circ.num_gates
 
     times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        re, im = run_inner(re, im)
-        sync((re, im))
-        times.append(time.perf_counter() - t0)
-    best = min(times)
+    with metrics.run_ledger("bench_measure"):
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            re, im = run_inner(re, im)
+            sync((re, im))
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        # bench numbers and ledger numbers are one artifact: the honest
+        # synced reps land on the measurement's own ledger record
+        metrics.record_timing(f"bench_inner_x{inner}", reps, best,
+                              sum(times) / len(times))
     n_gates = circ.num_gates * inner
     return (n_gates / best, n_gates, best, n_passes * inner,
             None if pass_bytes is None else pass_bytes * inner,
@@ -225,7 +235,7 @@ def main():
 
     # Reference's only in-repo figure: 667 gates in 3783.93 s (30 qubits).
     baseline = 667.0 / 3783.93
-    print(json.dumps({
+    record = {
         "metric": f"gate_ops_per_sec_{num_qubits}q",
         "value": round(gates_per_sec, 3),
         "unit": "gates/s",
@@ -241,7 +251,34 @@ def main():
         "vs_a100": round(gates_per_sec / a100_equiv, 2),
         "mesh_exchange_bytes_qft30": mesh_exchange_bytes,
         "device": dev_kind,
-    }))
+    }
+    print(json.dumps(record))
+
+    # --gate PREV.json: regression gate against a previous BENCH record
+    # (tools/ledger_diff.py rules: exchange bytes, pass counts, device
+    # time) — BENCH_*.json becomes an enforced trajectory, not a log.
+    # Perf rules auto-skip when PREV describes a different config (the
+    # "metric" field disagrees, e.g. a small-qubit smoke); the QFT-30
+    # mesh exchange bytes gate at ANY bench size.
+    if "--gate" in sys.argv:
+        try:
+            prev_path = sys.argv[sys.argv.index("--gate") + 1]
+        except IndexError:
+            print("bench: --gate needs a previous BENCH_*.json path")
+            sys.exit(2)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import ledger_diff
+
+        try:
+            prev = ledger_diff.load_record(prev_path)
+        except (OSError, ValueError) as e:
+            print(f"bench: --gate: {e}")
+            sys.exit(2)
+        violations, checked, skipped = ledger_diff.gate(prev, record)
+        ledger_diff.report(violations, checked, skipped)
+        if violations:
+            sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -92,6 +92,16 @@ void getEnvironmentString(QuESTEnv env, Qureg qureg, char str[200]);
  * "{}" before any run) — counters, spans, exchange-byte accounting for
  * the last circuit run.  Truncated to maxLen-1 chars + NUL. */
 void getRunLedgerString(QuESTEnv env, char *str, int maxLen);
+/* quest_tpu extension: per-item device-time timeline capture.  Between
+ * start and stop, every executed plan item (fused pass, relayout
+ * exchange, deferred gate stream) is walled with a device sync and
+ * recorded with honest device time, item kind, target qubits and
+ * exchange bytes.  stop writes a Chrome-trace / Perfetto-loadable
+ * JSON file to `path` (skipped when NULL or empty) and returns the
+ * captured event count.  Capture serialises dispatch — a diagnostic
+ * mode, not for production timing. */
+void startTimelineCapture(QuESTEnv env);
+int stopTimelineCapture(QuESTEnv env, char *path);
 void seedQuESTDefault(void);
 void seedQuEST(unsigned long int *seedArray, int numSeeds);
 

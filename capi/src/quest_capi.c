@@ -379,6 +379,18 @@ void getRunLedgerString(QuESTEnv env, char *str, int maxLen) {
     PyGILState_Release(g);
 }
 
+void startTimelineCapture(QuESTEnv env) {
+    (void)env;
+    BVOID("startTimelineCapture", "()");
+}
+
+int stopTimelineCapture(QuESTEnv env, char *path) {
+    (void)env;
+    return (int)as_longlong(bcall("stopTimelineCapture", "(s)",
+                                  path ? path : ""),
+                            "stopTimelineCapture");
+}
+
 void seedQuESTDefault(void) { BVOID("seedQuESTDefault", "()"); }
 
 void seedQuEST(unsigned long int *seedArray, int numSeeds) {

@@ -112,6 +112,7 @@ from .stateio import (
     restore_checkpoint,
 )
 from . import metrics
+from . import reporting
 from .reporting import (
     report_qureg_params,
     report_state_to_screen,
@@ -119,6 +120,8 @@ from .reporting import (
     get_run_ledger,
     get_run_ledger_string,
     report_run_ledger,
+    stopwatch,
+    time_fn,
 )
 from .qasm import (
     start_recording_qasm,

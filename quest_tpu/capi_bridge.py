@@ -184,6 +184,25 @@ def getRunLedgerString() -> str:
     return _qt.get_run_ledger_string()
 
 
+def startTimelineCapture() -> int:
+    """Begin per-item timeline capture (quest_tpu.metrics): subsequent
+    flushes / circuit runs wall each executed item with
+    ``block_until_ready`` and record honest device time per item."""
+    from . import metrics
+
+    metrics.start_timeline()
+    return 0
+
+
+def stopTimelineCapture(path: str) -> int:
+    """End the capture, dumping Chrome-trace JSON (Perfetto-loadable)
+    to ``path`` when non-empty; returns the captured event count."""
+    from . import metrics
+
+    doc = metrics.stop_timeline(path or None)
+    return len(doc["traceEvents"])
+
+
 def seedQuESTDefault() -> int:
     _qt.seed_quest_default()
     return 0

@@ -29,6 +29,96 @@ from . import precision as _prec
 from . import validation as _v
 
 
+def _observing(re, item_hook) -> bool:
+    """True when per-item observation applies right now: timeline
+    capture or a health hook is on AND the state is concrete (never
+    under a jit trace, where walls and probes would be meaningless)."""
+    return (not isinstance(re, jax.core.Tracer)
+            and (metrics.timeline_active() or item_hook is not None))
+
+
+def measure_state_weight(re, im, is_density: bool, num_qubits: int,
+                         mesh) -> float:
+    """Norm (state-vector) / trace (density matrix) of a state — the
+    conserved quantity the health probes track."""
+    if is_density:
+        return float(run_kernel((re, im), (), kind="dm_total_prob",
+                                statics=(num_qubits,), mesh=mesh,
+                                out_kind="scalar"))
+    return float(run_kernel((re, im), (), kind="sv_total_prob",
+                            statics=(), mesh=mesh, out_kind="scalar"))
+
+
+def check_state_health(re, im, *, is_density: bool, num_qubits: int,
+                       mesh, before: float | None, n_ops: int,
+                       structural: bool = True):
+    """The ONE health check both probe seams share (``QUEST_HEALTH_EVERY``
+    — circuit._HealthProbe per plan item, register._health_probe per
+    flushed run), so bounds, checks, and reason strings can never
+    diverge between the two paths.
+
+    Checks, in order: NaN/Inf (layout-invariant, always valid); norm /
+    trace drift against ``before`` within the ``64 * n_ops * eps``
+    roundoff allowance (as in ``Qureg._norm_check``); hermiticity drift
+    for density matrices.  ``structural=False`` limits the probe to the
+    NaN/Inf scan — for boundaries where the density U (x) U* pair may
+    be half-applied or the mesh layout non-canonical, where trace and
+    hermiticity are legitimately "wrong".
+
+    Returns ``(reason, after)``: ``reason`` is None when healthy;
+    ``after`` is the measured norm/trace when computed (the caller's
+    next drift anchor)."""
+    import math as _math
+
+    eps = _prec.real_eps(re.dtype)
+    # generous per-op roundoff allowance: only genuine kernel bugs /
+    # injected garbage should trip
+    bound = 64 * max(n_ops, 1) * eps
+    if not (bool(jnp.isfinite(re).all())
+            and bool(jnp.isfinite(im).all())):
+        return "non-finite amplitudes (NaN/Inf)", None
+    if not structural:
+        return None, None
+    after = measure_state_weight(re, im, is_density, num_qubits, mesh)
+    if before is not None:
+        drift = abs(after - before)
+        lim = bound * max(abs(before), 1.0)
+        if not _math.isfinite(after) or drift > lim:
+            what = "trace" if is_density else "norm"
+            return (f"{what} drift {drift:.3e} exceeds bound {lim:.3e} "
+                    f"({before!r} -> {after!r})"), after
+    if is_density:
+        # max |rho - rho^H|: the flat (rows, lanes) storage reshapes to
+        # the (dim, dim) matrix (flat index = col * dim + row, see
+        # register.get_density_amp); the check is symmetric in the
+        # index convention
+        dim = 1 << num_qubits
+        mr = re.reshape(dim, dim)
+        mi = im.reshape(dim, dim)
+        hd = float(jnp.maximum(jnp.abs(mr - mr.T).max(),
+                               jnp.abs(mi + mi.T).max()))
+        if not _math.isfinite(hd) or hd > bound:
+            return (f"hermiticity drift {hd:.3e} exceeds bound "
+                    f"{bound:.3e}"), after
+    return None, after
+
+
+def _op_targets(op) -> list[int]:
+    """Qubit bits an op touches, for timeline/flight tagging: the 2x2
+    target plus control-mask bits, a phase term's selection bits, or a
+    channel's qubits."""
+    kind, statics, _ = op
+    if kind == "apply_2x2":
+        t, mask = statics
+        return [t] + [b for b in range(mask.bit_length()) if mask >> b & 1]
+    if kind == "apply_phase":
+        (mask,) = statics
+        return [b for b in range(mask.bit_length()) if mask >> b & 1]
+    if kind == "dm_chan":
+        return list(statics[1:])
+    return list(statics[:1])
+
+
 @dataclass
 class Circuit:
     """A recorded gate sequence over ``num_qubits`` qubits (state-vector
@@ -289,7 +379,7 @@ class Circuit:
     def _has_nonunitary(self) -> bool:
         return any(kind in ("measure", "collapse") for kind, _, _ in self.ops)
 
-    def as_fn(self, mesh=None):
+    def as_fn(self, mesh=None, item_hook=None):
         """A pure function applying the circuit gate-at-a-time via the XLA
         kernel path; jit-compatible, correct for single-device or
         mesh-sharded arrays.
@@ -297,19 +387,43 @@ class Circuit:
         Signature is ``(re, im) -> (re, im)``; when the circuit records
         ``measure`` or ``collapse`` ops it is ``(re, im, key) ->
         (re, im, outcomes)`` with ``key`` a jax PRNG key and ``outcomes``
-        an int32 vector of the recorded measurements in record order."""
+        an int32 vector of the recorded measurements in record order.
+
+        When timeline capture is active (or ``item_hook`` — the health
+        probe seam — is given) and the arrays are concrete, each gate
+        kernel is walled/probed as its own ``xla-segment`` timeline
+        item; under a jit trace the instrumentation vanishes."""
         ops = list(self.ops)
         has_nu = self._has_nonunitary
+        _nu = ("measure", "collapse")
+        # gate ops that close a gate run (next op is non-unitary or the
+        # stream ends): the density-pair / canonical-layout boundary
+        # where trace/hermiticity health checks are meaningful
+        last_in_run = {i for i, op in enumerate(ops)
+                       if op[0] not in _nu
+                       and (i + 1 == len(ops) or ops[i + 1][0] in _nu)}
 
         def fn(re, im, key=None):
             outcomes = []
-            for op in ops:
+            for i, op in enumerate(ops):
                 kind, statics, scalars = op
                 if kind in ("measure", "collapse"):
                     re, im, out, _ = self._nonunitary_step(
                         re, im, key, len(outcomes), op, mesh)
                     if out is not None:
                         outcomes.append(out)
+                elif _observing(re, item_hook):
+                    from .parallel.mesh_exec import observe_item
+
+                    re, im = observe_item(
+                        lambda r, j, _op=op: run_kernel(
+                            (r, j), _op[2], kind=_op[0], statics=_op[1],
+                            mesh=mesh),
+                        re, im,
+                        {"kind": "xla-segment", "index": i, "ops": 1,
+                         "op": kind, "targets": _op_targets(op),
+                         "last_in_run": i in last_in_run},
+                        hook=item_hook)
                 else:
                     re, im = run_kernel((re, im), scalars, kind=kind,
                                         statics=statics, mesh=mesh)
@@ -320,7 +434,8 @@ class Circuit:
 
         return fn
 
-    def as_fused_fn(self, interpret: bool = False, mesh=None):
+    def as_fused_fn(self, interpret: bool = False, mesh=None,
+                    per_item: bool = False, item_hook=None):
         """A pure function applying the circuit as scheduled fused Pallas
         segments — each segment is ONE in-place pass over the state (see
         quest_tpu.scheduler).  With a mesh, the segments run per-chunk
@@ -331,7 +446,16 @@ class Circuit:
         Signature as in :meth:`as_fn`: measure/collapse ops split the
         gate stream into fused runs and execute on-device between them
         (one reduction + one elementwise collapse, still inside the same
-        compiled program — no host sync)."""
+        compiled program — no host sync).
+
+        ``per_item``/``item_hook``: the observability surface (see
+        :meth:`run`).  ``per_item`` routes a mesh plan through per-item
+        jitted programs (non-donating here, so a tripped probe never
+        bricks the caller's register); ``item_hook(re, im, meta)`` runs
+        after every executed item when the state is concrete, and active
+        timeline capture walls each item with ``block_until_ready``.
+        Measure/collapse steps between gate runs are not separate
+        timeline items (they execute between the instrumented runs)."""
         gate_runs, nu_ops = self._split_runs()
         # whole-circuit plan stats, accumulated while the mesh executors
         # are built (the SAME plans that will run) and memoised for
@@ -347,17 +471,40 @@ class Circuit:
                     mesh_stats["passes"] += len(run_ops)
 
                     def fn(re, im):
-                        for kind, statics, scalars in run_ops:
-                            re, im = run_kernel((re, im), scalars,
-                                                kind=kind, statics=statics,
-                                                mesh=mesh)
+                        for i, (kind, statics, scalars) in \
+                                enumerate(run_ops):
+                            if _observing(re, item_hook):
+                                from .parallel.mesh_exec import \
+                                    observe_item
+
+                                re, im = observe_item(
+                                    lambda r, j, _o=(kind, statics,
+                                                     scalars):
+                                    run_kernel((r, j), _o[2], kind=_o[0],
+                                               statics=_o[1], mesh=mesh),
+                                    re, im,
+                                    {"kind": "xla-segment", "index": i,
+                                     "ops": 1, "op": kind,
+                                     "targets": _op_targets(
+                                         (kind, statics, scalars)),
+                                     "last_in_run":
+                                         i + 1 == len(run_ops)},
+                                    hook=item_hook)
+                            else:
+                                re, im = run_kernel((re, im), scalars,
+                                                    kind=kind,
+                                                    statics=statics,
+                                                    mesh=mesh)
                         return re, im
 
                     return fn
                 from .parallel.mesh_exec import as_mesh_fused_fn
 
                 mfn = as_mesh_fused_fn(run_ops, nvec, mesh,
-                                       interpret=interpret)
+                                       interpret=interpret,
+                                       per_item=per_item,
+                                       donate=not per_item,
+                                       item_hook=item_hook)
                 for k in mesh_stats:
                     mesh_stats[k] += mfn.plan_stats[k]
                 return mfn
@@ -369,10 +516,26 @@ class Circuit:
                 lanes = re.shape[1]
                 lane_bits = lanes.bit_length() - 1
                 nbits = (re.shape[0] * lanes).bit_length() - 1
-                for seg_ops, high in schedule_segments_best(
-                        run_ops, nbits, lane_bits=lane_bits):
-                    re, im = apply_fused_segment(re, im, seg_ops, high,
-                                                 interpret=interpret)
+                segs = schedule_segments_best(run_ops, nbits,
+                                              lane_bits=lane_bits)
+                for i, (seg_ops, high) in enumerate(segs):
+                    if _observing(re, item_hook):
+                        from .parallel.mesh_exec import observe_item
+
+                        re, im = observe_item(
+                            lambda r, j, _s=seg_ops, _h=high:
+                            apply_fused_segment(r, j, _s, _h,
+                                                interpret=interpret),
+                            re, im,
+                            {"kind": "pallas-pass", "index": i,
+                             "ops": len(seg_ops),
+                             "high_bits": sorted(high),
+                             "last_in_run": i + 1 == len(segs)},
+                            hook=item_hook)
+                    else:
+                        re, im = apply_fused_segment(re, im, seg_ops,
+                                                     high,
+                                                     interpret=interpret)
                 return re, im
 
             return fn
@@ -633,6 +796,37 @@ class Circuit:
             sampler = call
         return sampler(key, shots)
 
+    def _observed_fn(self, qureg, pallas):
+        """Per-item EAGER executor for observed runs — timeline capture
+        (``QUEST_TIMELINE=1`` / ``startTimelineCapture``) or health
+        probes (``QUEST_HEALTH_EVERY=k``).  Each plan item dispatches
+        separately so it can be walled with ``block_until_ready``
+        (honest device time, not async dispatch latency) and probed at
+        its boundary; the whole-program jit of :meth:`compile` is
+        bypassed, so observed runs trade throughput for attribution —
+        a diagnostic mode, never the default path.  Memoised per
+        (mesh, pallas, ops) like compile(); the probe's drift baseline
+        re-anchors on the register's CURRENT state each run."""
+        use_pallas = pallas is True or pallas == "auto"
+        key = ("observed", qureg.mesh, use_pallas, tuple(self.ops))
+        ent = self._compiled.get(key)
+        if ent is None:
+            probe = _HealthProbe(self, qureg.mesh)
+            if use_pallas:
+                interpret = jax.default_backend() != "tpu"
+                fn = self.as_fused_fn(interpret=interpret,
+                                      mesh=qureg.mesh, per_item=True,
+                                      item_hook=probe)
+            else:
+                fn = self.as_fn(qureg.mesh, item_hook=probe)
+            ent = (fn, probe)
+            self._compiled[key] = ent
+        fn, probe = ent
+        probe.reset()
+        if metrics.health_every():
+            probe.baseline(qureg.re, qureg.im)
+        return fn
+
     def run(self, qureg, pallas: str = "auto", key=None):
         """Apply to a register (mutating facade, like the eager API).
 
@@ -644,16 +838,29 @@ class Circuit:
         Each call scopes ONE run-ledger record (quest_tpu.metrics):
         schedule/compile/execute phases as spans, plus recorded pass,
         relayout, and exchange-byte attribution from the same schedule
-        the executor builds."""
+        the executor builds.
+
+        Observability modes (quest_tpu.metrics): with timeline capture
+        active (``QUEST_TIMELINE=1``, ``metrics.start_timeline`` or the
+        C API's ``startTimelineCapture``) or health probes enabled
+        (``QUEST_HEALTH_EVERY=k``), the run executes per plan item —
+        each item walled/probed — instead of as one jitted program."""
         with metrics.run_ledger("circuit_run"):
             metrics.annotate_run("num_qubits", self.num_qubits)
             metrics.annotate_run("is_density", self.is_density)
             metrics.annotate_run(
                 "num_devices",
                 1 if qureg.mesh is None else int(qureg.mesh.devices.size))
+            observed = (metrics.timeline_active()
+                        or metrics.health_every() > 0)
+            if observed:
+                metrics.annotate_run("observed", True)
             with metrics.span("compile"):
-                fn = self.compile(mesh=qureg.mesh, donate=False,
-                                  pallas=pallas)
+                if observed:
+                    fn = self._observed_fn(qureg, pallas)
+                else:
+                    fn = self.compile(mesh=qureg.mesh, donate=False,
+                                      pallas=pallas)
             self._record_run_stats(qureg, pallas)
             with metrics.span("execute"):
                 if self._has_nonunitary:
@@ -693,3 +900,75 @@ class Circuit:
             metrics.counter_inc("exec.relayouts", st["relayouts"])
             metrics.counter_inc("exec.exchange_bytes",
                                 st["exchange_elems"] * itemsize)
+
+
+class _HealthProbe:
+    """Numerical health probes at plan-item boundaries of an observed
+    :meth:`Circuit.run` (``QUEST_HEALTH_EVERY=k``).
+
+    Every k-th executed item, checks the produced state for NaN/Inf and
+    for norm drift (state-vectors) or trace + hermiticity drift
+    (density matrices) — the compiled-circuit generalisation of the
+    eager path's ``QUEST_DEBUG_NORM`` guardrail in ``register.py``.  A
+    tripped probe dumps the flight recorder (``metrics.flight_dump``)
+    with the offending item identified — with k=1 the exact injecting
+    item, else the k-item window since the last healthy probe — and
+    raises, so a poisoned state is caught at the item where it appears
+    instead of thousands of ops later in a soak run.  Each probe costs
+    one or two reductions (plus a transpose for hermiticity); the knob
+    is opt-in for exactly that reason."""
+
+    def __init__(self, circuit: "Circuit", mesh):
+        self._c = circuit
+        self._mesh = mesh
+        self.reset()
+
+    def reset(self) -> None:
+        self._count = 0
+        self._ops_since = 0
+        self._ref = None          # norm/trace at the last healthy probe
+        self._last_healthy = None
+
+    def baseline(self, re, im) -> None:
+        """Anchor the drift reference on the register's pre-run state
+        (a run may start from any state, not just norm 1)."""
+        self._ref = measure_state_weight(re, im, self._c.is_density,
+                                         self._c.num_qubits, self._mesh)
+
+    def __call__(self, re, im, meta: dict) -> None:
+        k = metrics.health_every()
+        if not k:
+            return
+        self._count += 1
+        self._ops_since += int(meta.get("ops", 1))
+        if self._count % k:
+            return
+        # Trace and hermiticity are only meaningful where the density
+        # U (x) U* pair is complete AND the mesh layout is canonical —
+        # the last item of a gate run.  NaN/Inf (and sv norm, which is
+        # permutation-invariant and preserved by every unitary segment)
+        # probe at ANY item boundary.
+        structural = (not self._c.is_density) \
+            or bool(meta.get("last_in_run"))
+        reason, val = check_state_health(
+            re, im, is_density=self._c.is_density,
+            num_qubits=self._c.num_qubits, mesh=self._mesh,
+            before=self._ref, n_ops=self._ops_since,
+            structural=structural)
+        if reason is None:
+            if structural:
+                self._ref = val if val is not None else self._ref
+                self._ops_since = 0
+            self._last_healthy = {"index": meta.get("index"),
+                                  "kind": meta.get("kind")}
+            return
+        offending = {"item": dict(meta),
+                     "window_items": k,
+                     "last_healthy": self._last_healthy}
+        path = metrics.flight_dump(f"health probe tripped: {reason}",
+                                   offending=offending)
+        raise _v.QuESTError(
+            f"QUEST_HEALTH_EVERY probe tripped after plan item "
+            f"{meta.get('index')} ({meta.get('kind')}): {reason}"
+            + (f"; flight recorder dumped to {path}" if path else
+               " (flight-recorder dump failed; see metrics.sink_errors)"))

@@ -34,10 +34,36 @@ Three primitives:
 byte-compatible with the historical format, and every message is also
 recorded as a timestamped event on the active ledger record.
 
+Two further subsystems extend the ledger from a counter sink into a
+timeline + health surface:
+
+* **Per-item timeline** (``QUEST_TIMELINE=1``, or programmatic
+  ``start_timeline``/``stop_timeline`` — the C API's
+  ``startTimelineCapture``/``stopTimelineCapture``): the executors wall
+  each plan item with ``block_until_ready`` and record HONEST device
+  time per item as a Chrome-trace complete event (``ph: "X"``, ts/dur
+  in microseconds), tagged with the item kind (``pallas-pass`` /
+  ``xla-segment`` / ``bitswap`` / ``relayout``), target qubits, comm
+  class and exchange bytes.  ``write_timeline``/``stop_timeline`` emit
+  a Perfetto-loadable ``timeline.json``; ``tools/trace_view.py`` prints
+  the top-k table.  Capture serialises dispatch (one sync per item), so
+  it is a diagnostic mode, never the default.
+* **Flight recorder**: a bounded ring of the last N executed items
+  (shapes, dtypes, donation, comm bytes) via ``flight_record``; the
+  opt-in health probes (``QUEST_HEALTH_EVERY=k`` — NaN/Inf, norm /
+  density trace + hermiticity drift at segment boundaries in
+  ``register.py``/``circuit.py``) call ``flight_dump`` when tripped, so
+  the dump names the offending item instead of a soak run failing
+  thousands of ops later.
+
 Instrumentation timing discipline: this module and ``reporting.py`` are
 the ONLY places in ``quest_tpu`` allowed to call ``time.perf_counter``
-or print to stderr (enforced by ``tests/test_metrics.py``'s lint) —
-hot-path timing goes through the ledger, not ad-hoc prints.
+or print to stderr (enforced by ``tests/test_metrics.py``'s lint, which
+also covers ``tools/``) — hot-path timing goes through the ledger, not
+ad-hoc prints.  Every file sink here (``$QUEST_METRICS_FILE``, timeline
+and flight-recorder dumps) degrades to a one-shot stderr warning plus a
+``metrics.sink_errors`` counter on I/O failure: a broken sink must
+never fail the run it was observing.
 """
 
 from __future__ import annotations
@@ -219,6 +245,32 @@ def run_ledger(label: str = "run"):
             _finalize(rec, wall)
 
 
+#: Sinks that already warned once (a full disk must not spam one line
+#: per run; the ``metrics.sink_errors`` counter keeps the exact count).
+_SINK_WARNED: set = set()
+
+
+def _sink_write(kind: str, path: str, text: str, mode: str = "a") -> bool:
+    """Write ``text`` to a metrics sink, degrading on failure.
+
+    An unwritable / disappearing sink file (or a full disk) must never
+    crash the run it was observing: the failure becomes a one-shot
+    stderr warning per sink kind plus a ``metrics.sink_errors``
+    process counter, and the caller's run proceeds untouched."""
+    try:
+        with open(path, mode) as f:
+            f.write(text)
+        return True
+    except (OSError, ValueError) as e:  # ValueError: write to closed fd
+        counter_inc("metrics.sink_errors")
+        if kind not in _SINK_WARNED:
+            _SINK_WARNED.add(kind)
+            print(f"quest-tpu: {kind} sink {path!r} failed ({e}); "
+                  "degrading silently (metrics.sink_errors counts "
+                  "further failures)", file=sys.stderr, flush=True)
+        return False
+
+
 def _finalize(rec: dict, wall: float) -> None:
     rec["wall_s"] = round(wall, 6)
     rec["spans"] = {k: {"seconds": round(v[0], 6), "count": v[1]}
@@ -228,11 +280,7 @@ def _finalize(rec: dict, wall: float) -> None:
         del _records[:-_RECORDS_MAX]
     path = os.environ.get("QUEST_METRICS_FILE")
     if path:
-        try:
-            with open(path, "a") as f:
-                f.write(json.dumps(rec, sort_keys=True) + "\n")
-        except OSError:
-            pass  # a broken sink must never fail the run itself
+        _sink_write("ledger", path, json.dumps(rec, sort_keys=True) + "\n")
 
 
 def get_run_ledger() -> dict | None:
@@ -256,9 +304,204 @@ def recent_records(n: int = _RECORDS_MAX) -> list[dict]:
         return json.loads(json.dumps(_records[-n:]))
 
 
+def record_timing(label: str, reps: int, best: float, mean: float) -> None:
+    """Attach one honest synchronised timing (``reporting.time_fn``) to
+    this thread's active run record(s), so bench numbers and ledger
+    numbers are one artifact.  No-op outside a run scope."""
+    entry = {"label": label, "reps": int(reps),
+             "best_s": round(best, 9), "mean_s": round(mean, 9)}
+    with _lock:
+        for rec in _stack():
+            rec.setdefault("timings", []).append(dict(entry))
+
+
+# ---------------------------------------------------------------------------
+# Per-item timeline (Chrome trace format)
+# ---------------------------------------------------------------------------
+
+#: Retained timeline events, bounded: env-var capture (QUEST_TIMELINE=1)
+#: has no explicit stop, so an unbounded soak must not leak host memory.
+TIMELINE_MAX_EVENTS = 65536
+
+_timeline = {"on": False, "events": [], "t0": None, "dropped": 0}
+
+
+def timeline_active() -> bool:
+    """True when per-item timeline capture is on — via the env knob
+    (``QUEST_TIMELINE=1``) or a programmatic/C-API ``start_timeline``.
+    The executors consult this at EXECUTION time (never under a jit
+    trace) and wall each plan item with ``block_until_ready``."""
+    return _timeline["on"] or os.environ.get("QUEST_TIMELINE") == "1"
+
+
+def start_timeline() -> None:
+    """Begin a capture: clears the event buffer and re-bases timestamps
+    (C API: ``startTimelineCapture``)."""
+    with _lock:
+        _timeline["on"] = True
+        _timeline["events"] = []
+        _timeline["t0"] = None
+        _timeline["dropped"] = 0
+
+
+def timeline_event(name: str, t0: float, dur_s: float,
+                   args: dict | None = None, tid: int = 0) -> None:
+    """Record one walled item as a Chrome-trace complete event.
+
+    ``t0`` is a ``perf_counter`` reading (the capture's first event
+    defines ts=0); ts/dur are emitted in microseconds as the trace
+    format requires."""
+    with _lock:
+        if _timeline["t0"] is None:
+            _timeline["t0"] = t0
+        if len(_timeline["events"]) >= TIMELINE_MAX_EVENTS:
+            _timeline["dropped"] += 1
+            return
+        _timeline["events"].append({
+            "name": name,
+            "cat": "quest",
+            "ph": "X",
+            "ts": round((t0 - _timeline["t0"]) * 1e6, 3),
+            "dur": round(dur_s * 1e6, 3),
+            "pid": os.getpid(),
+            "tid": tid,
+            "args": dict(args) if args else {},
+        })
+
+
+@contextlib.contextmanager
+def timeline_span(name: str, args: dict | None = None, tid: int = 0):
+    """Wall one executed plan item for the timeline.  The body must
+    force completion itself (``jax.block_until_ready`` on the item's
+    outputs) — that is what makes the duration honest DEVICE time
+    rather than async dispatch latency."""
+    t0 = _now()
+    try:
+        yield
+    finally:
+        timeline_event(name, t0, _now() - t0, args=args, tid=tid)
+
+
+def timeline_events() -> list[dict]:
+    """Snapshot of the captured events (a copy)."""
+    with _lock:
+        return json.loads(json.dumps(_timeline["events"]))
+
+
+def timeline_trace() -> dict:
+    """The capture as a Chrome-trace/Perfetto document."""
+    with _lock:
+        return {
+            "traceEvents": json.loads(json.dumps(_timeline["events"])),
+            "displayTimeUnit": "ms",
+            "otherData": {"schema": "quest-tpu-timeline/1",
+                          "dropped_events": _timeline["dropped"]},
+        }
+
+
+def write_timeline(path: str) -> bool:
+    """Dump the capture as Chrome-trace JSON (Perfetto /
+    ``chrome://tracing`` loadable); sink failures degrade like every
+    metrics sink.  Does not stop an active capture."""
+    return _sink_write("timeline", path,
+                       json.dumps(timeline_trace()), mode="w")
+
+
+def stop_timeline(path: str | None = None) -> dict:
+    """End a programmatic capture, optionally dumping to ``path`` (C
+    API: ``stopTimelineCapture``).  Returns the trace document; the
+    event buffer is retained for ``timeline_events`` until the next
+    ``start_timeline``."""
+    doc = timeline_trace()
+    if path:
+        _sink_write("timeline", path, json.dumps(doc), mode="w")
+    with _lock:
+        _timeline["on"] = False
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder + health-probe knob
+# ---------------------------------------------------------------------------
+
+#: Default ring size; override with QUEST_FLIGHT_N.
+FLIGHT_MAX_DEFAULT = 64
+
+_flight: list = []
+_flight_seq = [0]
+
+
+def _flight_max() -> int:
+    try:
+        return max(1, int(os.environ.get("QUEST_FLIGHT_N",
+                                         str(FLIGHT_MAX_DEFAULT))))
+    except ValueError:
+        return FLIGHT_MAX_DEFAULT
+
+
+def health_every() -> int:
+    """The ``QUEST_HEALTH_EVERY=k`` knob: probe NaN/Inf and norm/trace
+    drift every k executed items (0 = off)."""
+    try:
+        return max(0, int(os.environ.get("QUEST_HEALTH_EVERY", "0")))
+    except ValueError:
+        return 0
+
+
+def flight_record(kind: str, **info) -> dict:
+    """Append one executed-item entry to the bounded flight ring
+    (shapes, dtypes, donation, comm bytes — whatever the executor
+    knows).  Returns the entry (with its monotonic ``seq``)."""
+    entry = {"seq": 0, "t": round(_now(), 6), "kind": kind}
+    entry.update(info)
+    with _lock:
+        _flight_seq[0] += 1
+        entry["seq"] = _flight_seq[0]
+        _flight.append(entry)
+        del _flight[:-_flight_max()]
+    return entry
+
+
+def flight_entries() -> list[dict]:
+    """Snapshot of the ring, oldest first (a copy)."""
+    with _lock:
+        return json.loads(json.dumps(_flight))
+
+
+def flight_dump(reason: str, offending: dict | None = None,
+                path: str | None = None) -> str | None:
+    """Dump the flight ring (tripped health probe, or on demand).
+
+    ``offending`` names the item the tripping probe just walled; the
+    dump also carries the ring (the last N executed items leading up to
+    it) and a process-counter snapshot.  Written to ``path``, else
+    ``$QUEST_FLIGHT_FILE``, else ``quest-flight-<pid>.json`` in the
+    working directory; returns the path (None if the sink failed)."""
+    path = path or os.environ.get("QUEST_FLIGHT_FILE") \
+        or f"quest-flight-{os.getpid()}.json"
+    doc = {
+        "schema": "quest-tpu-flight/1",
+        "reason": reason,
+        "offending": offending,
+        "items": flight_entries(),
+        "counters": counters(),
+    }
+    counter_inc("metrics.flight_dumps")
+    if _sink_write("flight", path, json.dumps(doc, indent=1), mode="w"):
+        return os.path.abspath(path)
+    return None
+
+
 def reset() -> None:
-    """Zero all counters/spans and drop retained records (test hook)."""
+    """Zero all counters/spans and drop retained records, timeline
+    events, and flight entries (test hook)."""
     with _lock:
         _counters.clear()
         _span_totals.clear()
         _records.clear()
+        _timeline["on"] = False
+        _timeline["events"] = []
+        _timeline["t0"] = None
+        _timeline["dropped"] = 0
+        del _flight[:]
+        _SINK_WARNED.clear()

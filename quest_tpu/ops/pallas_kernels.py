@@ -227,6 +227,11 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     metrics.counter_inc("pallas.segment_builds")
     metrics.counter_inc("pallas.build_stream_bytes",
                         2 * 2 * rows * lanes * jnp.dtype(re.dtype).itemsize)
+    # flight-recorder breadcrumb: segment builds often immediately
+    # precede the failure a dump is read for (fresh kernel, fresh shape)
+    metrics.flight_record("pallas-build", ops=len(seg_ops),
+                          shape=[rows, lanes], dtype=str(re.dtype),
+                          high_bits=sorted(high_bits))
     cdtype = (jnp.dtype(compute_dtype) if compute_dtype is not None
               else re.dtype)
     lane_bits = _ilog2(lanes)

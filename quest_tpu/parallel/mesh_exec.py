@@ -341,6 +341,56 @@ def apply_relayout(re, im, perm, dev, axis: str, ndev: int,
     return z[0], z[1]
 
 
+def item_timeline_meta(item, num_vec_bits: int, dev_bits: int,
+                       backend: str = "pallas") -> dict:
+    """Static timeline/flight-recorder tags for one plan item: kind
+    (``pallas-pass`` / ``xla-segment`` / ``bitswap`` / ``relayout``),
+    target bits, comm class, and the exchange-element attribution —
+    computed by the SAME accounting the run ledger records
+    (``plan_exchange_elems``), so a timeline's relayout bytes and the
+    ledger's ``exec.exchange_bytes`` can never disagree."""
+    chunk_bits = num_vec_bits - dev_bits
+    if item[0] == "seg":
+        _, seg_ops, high, _dev_masks = item
+        return {"kind": "pallas-pass" if backend == "pallas"
+                else "xla-segment",
+                "ops": len(seg_ops), "high_bits": sorted(high)}
+    cls = _swap_comm_class(item, chunk_bits)
+    _, elems = plan_exchange_elems([item], num_vec_bits, dev_bits)
+    if item[0] == "relayout":
+        targets = sorted(b for b, p in enumerate(item[1]) if p != b)
+    else:
+        targets = sorted(item[1:])
+    return {"kind": "relayout" if item[0] == "relayout" else "bitswap",
+            "targets": targets, "comm_class": cls,
+            "exchange_elems": elems}
+
+
+def observe_item(f, re, im, meta: dict, hook=None):
+    """Execute one plan item under observation: wall it for the
+    timeline (``block_until_ready`` makes the duration honest device
+    time), append a flight-recorder entry, and invoke the caller's
+    health ``hook`` on the produced state.  Only reached when the
+    caller verified the arrays are concrete (never under a trace)."""
+    itemsize = jnp.dtype(re.dtype).itemsize
+    args = dict(meta)
+    kind = args.pop("kind")
+    elems = args.pop("exchange_elems", 0)
+    if elems or meta.get("comm_class") is not None:
+        args["exchange_bytes"] = elems * itemsize
+    metrics.flight_record(kind, shape=list(re.shape),
+                          dtype=str(re.dtype), **args)
+    if metrics.timeline_active():
+        with metrics.timeline_span(kind, args=args):
+            re, im = f(re, im)
+            jax.block_until_ready((re, im))
+    else:
+        re, im = f(re, im)
+    if hook is not None:
+        hook(re, im, dict(meta, exchange_bytes=elems * itemsize))
+    return re, im
+
+
 def _item_key(obj):
     """Hashable structural key for a plan item: ndarray leaves become
     (shape, dtype, raw bytes); containers recurse; everything else must
@@ -451,7 +501,8 @@ def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int):
 
 def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
                      interpret: bool = False, backend: str = "pallas",
-                     per_item: bool = False):
+                     per_item: bool = False, donate: bool = True,
+                     item_hook=None):
     """A pure (re, im) -> (re, im) function running the recorded ops as
     fused segments inside shard_map over ``mesh``, with relayout
     half-exchanges for sharded-qubit gates.  Input and output arrays are
@@ -472,13 +523,25 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
     inputs (one live (re, im) pair instead of two per step), so the
     arrays passed to a ``per_item`` function — the caller's included —
     are consumed; rebind to the returned pair and never reuse the
-    originals."""
+    originals.  ``donate=False`` keeps them alive (the observed
+    Circuit.run path, which must not brick the register on a tripped
+    health probe).
+
+    ``per_item`` is also the OBSERVABILITY granularity: when timeline
+    capture (``metrics.timeline_active``) is on at execution time, each
+    item is walled with ``block_until_ready`` and recorded as a
+    Chrome-trace event (kind / targets / comm class / exchange bytes,
+    from the same ``plan_exchange_elems`` accounting the ledger uses),
+    plus a flight-recorder entry; ``item_hook(re, im, meta)`` — the
+    health-probe seam — runs after every item."""
     return _mesh_plan_fn(ops, num_vec_bits, mesh, interpret, backend,
-                         per_item=per_item)
+                         per_item=per_item, donate=donate,
+                         item_hook=item_hook)
 
 
 def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
-                  backend: str, per_item: bool):
+                  backend: str, per_item: bool, donate: bool = True,
+                  item_hook=None):
     from ..scheduler import schedule_mesh
     from ..ops.segment_xla import apply_segment_xla
 
@@ -560,14 +623,30 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
             f = unique.get(key)
             if f is None:
                 f = jax.jit(shmap(functools.partial(item_body, item)),
-                            donate_argnums=(0, 1))
+                            donate_argnums=(0, 1) if donate else ())
                 unique[key] = f
             item_fns.append(f)
+        metas = [dict(item_timeline_meta(item, num_vec_bits, dev_bits,
+                                         backend), index=i)
+                 for i, item in enumerate(plan)]
+        if metas:
+            # the plan's final item restores the canonical layout and
+            # completes any density U (x) U* pair: the only point where
+            # trace/hermiticity health checks are meaningful (norm and
+            # NaN checks are layout-invariant and probe anywhere)
+            metas[-1]["last_in_run"] = True
 
         def fn(re, im):
             _record_execution(re)
-            for f in item_fns:
-                re, im = f(re, im)
+            observe = (not isinstance(re, jax.core.Tracer)
+                       and (metrics.timeline_active()
+                            or item_hook is not None))
+            for i, f in enumerate(item_fns):
+                if observe:
+                    re, im = observe_item(f, re, im, metas[i],
+                                           hook=item_hook)
+                else:
+                    re, im = f(re, im)
             return re, im
 
         fn.plan_stats = plan_stats

@@ -237,12 +237,61 @@ class Qureg:
                     f"{norm!r}) — kernel regression?")
         return norm
 
+    def _health_measure(self) -> float:
+        """Norm (state-vector) / trace (density) of the current state;
+        a still-lazy |0...0> is exactly 1 without forcing allocation
+        (materialising here would forfeit speculative adoption)."""
+        if isinstance(self._re, _LazyZero):
+            return 1.0
+        from .circuit import measure_state_weight  # deferred: cycle
+
+        return measure_state_weight(self._re, self._im, self.is_density,
+                                    self.num_qubits, self.mesh)
+
+    def _health_probe(self, before: float | None, n_ops: int) -> None:
+        """``QUEST_HEALTH_EVERY=k`` on the eager/C-driver path: every
+        k-th flushed gate run (the flush-path segment boundary), run
+        the SHARED health check (``circuit.check_state_health`` —
+        NaN/Inf, norm/trace drift, density hermiticity; generalising
+        the ``QUEST_DEBUG_NORM`` guardrail, which stays norm-only and
+        every-flush).  A trip dumps the flight recorder with this flush
+        identified and raises (quest_tpu.circuit's observed-run probe
+        is the per-plan-item seam of the same check)."""
+        if before is None:
+            return
+        from .circuit import check_state_health  # deferred: cycle
+
+        # flush boundaries are always structural: gate runs carry
+        # complete density pairs and end in the canonical layout
+        reason, _after = check_state_health(
+            self._re, self._im, is_density=self.is_density,
+            num_qubits=self.num_qubits, mesh=self.mesh,
+            before=before, n_ops=n_ops)
+        if reason is None:
+            return
+        offending = {"item": {"kind": "flush", "ops": n_ops,
+                              "num_vec_qubits": self.num_vec_qubits}}
+        path = metrics.flight_dump(f"health probe tripped: {reason}",
+                                   offending=offending)
+        raise QuESTError(
+            f"QUEST_HEALTH_EVERY probe tripped after a flushed run of "
+            f"{n_ops} gate ops: {reason}"
+            + (f"; flight recorder dumped to {path}" if path else
+               " (flight-recorder dump failed; see metrics.sink_errors)"))
+
     def _run_gates(self, jax, run, run_kernel_donated) -> None:
         n_run = len(run)
         norm0 = self._norm_check(jax, "gate", n_run, None)
+        h_before = None
+        k = metrics.health_every()
+        if k:
+            _HEALTH_FLUSHES[0] += 1
+            if _HEALTH_FLUSHES[0] % k == 0:
+                h_before = self._health_measure()
         self._run_gates_inner(jax, run, run_kernel_donated)
         if norm0 is not None:
             self._norm_check(jax, "gate", n_run, norm0)
+        self._health_probe(h_before, n_run)
 
     def _run_gates_inner(self, jax, run, run_kernel_donated) -> None:
         # Fused Pallas needs tile-aligned (>= (8, 128)) chunks and f32
@@ -289,8 +338,20 @@ class Qureg:
                                 self._re.dtype)
                 _trace("stream dispatch")
                 metrics.counter_inc("exec.gates", len(ops))
+                metrics.flight_record(
+                    "stream", ops=len(ops), shape=list(self._re.shape),
+                    dtype=str(self._re.dtype), donated=True)
                 with metrics.span("execute"):
-                    self._re, self._im = fn(self._re, self._im)
+                    if metrics.timeline_active():
+                        # walled capture: the one deliberate sync of
+                        # the deferred-stream hot path — honest device
+                        # time for the whole fused stream as one item
+                        with metrics.timeline_span(
+                                "stream", args={"ops": len(ops)}):
+                            self._re, self._im = fn(self._re, self._im)
+                            jax.block_until_ready((self._re, self._im))
+                    else:
+                        self._re, self._im = fn(self._re, self._im)
                 _trace("stream dispatched (async)")
             except Exception:
                 # Requeue so the gates aren't silently dropped: a retry
@@ -307,17 +368,29 @@ class Qureg:
             # ledger: one streamed pass over the state per gate here
             metrics.counter_inc("exec.gates", len(run))
             metrics.counter_inc("exec.passes", len(run))
+            metrics.flight_record(
+                "xla-stream", ops=len(run), shape=list(self._re.shape),
+                dtype=str(self._re.dtype), donated=True)
             with metrics.span("execute"):
-                while run:
-                    kind, statics, scalars = run[0]
-                    try:
-                        self._re, self._im = run_kernel_donated(
-                            (self._re, self._im), scalars, kind=kind,
-                            statics=statics, mesh=self.mesh)
-                    except Exception:
-                        self._pending = run + self._pending
-                        raise
-                    del run[0]
+                import contextlib as _ctx
+
+                wall = (metrics.timeline_span("xla-stream",
+                                              args={"ops": len(run)})
+                        if metrics.timeline_active()
+                        else _ctx.nullcontext())
+                with wall:
+                    while run:
+                        kind, statics, scalars = run[0]
+                        try:
+                            self._re, self._im = run_kernel_donated(
+                                (self._re, self._im), scalars, kind=kind,
+                                statics=statics, mesh=self.mesh)
+                        except Exception:
+                            self._pending = run + self._pending
+                            raise
+                        del run[0]
+                    if metrics.timeline_active():
+                        jax.block_until_ready((self._re, self._im))
 
     # -- shape bookkeeping ----------------------------------------------
     @property
@@ -378,6 +451,10 @@ _GATE_KINDS = ("apply_2x2", "apply_phase", "dm_chan")
 #: Per-register sweep-history bound (see Qureg._struct_history).
 _STRUCT_HISTORY_MAX = 256
 _MISSING = object()
+
+#: Process-wide flushed-gate-run counter driving the QUEST_HEALTH_EVERY
+#: probe cadence on the eager/C-driver path (see Qureg._run_gates).
+_HEALTH_FLUSHES = [0]
 
 
 def _is_sweep(qureg, ops) -> bool:

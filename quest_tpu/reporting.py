@@ -143,13 +143,55 @@ def report_run_ledger() -> None:
     print(get_run_ledger_string())
 
 
-def time_fn(fn, *args, reps: int = 5, **kwargs) -> dict:
+class Stopwatch:
+    """A running wall-clock started at construction (the sanctioned
+    timing primitive for ``tools/``: the instrumentation lint forbids
+    raw ``time.perf_counter`` outside this module and ``metrics.py``,
+    so ad-hoc tool timings share one auditable clock).
+
+    ``.seconds`` reads the elapsed time without stopping; ``.stop(name)``
+    additionally records the reading on the active run-ledger record's
+    ``timings`` list (``metrics.record_timing``), so a tool timing taken
+    inside a ``metrics.run_ledger`` scope lands in the same record as
+    the counters it explains."""
+
+    __slots__ = ("_t0",)
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+
+    @property
+    def seconds(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def restart(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, name: str | None = None) -> float:
+        dt = self.seconds
+        if name:
+            metrics.record_timing(name, 1, dt, dt)
+        return dt
+
+
+def stopwatch() -> Stopwatch:
+    """Start a :class:`Stopwatch` (``sw = stopwatch(); ...;
+    sw.seconds``)."""
+    return Stopwatch()
+
+
+def time_fn(fn, *args, reps: int = 5, label: str | None = None,
+            **kwargs) -> dict:
     """Wall-clock a device computation honestly: each rep blocks on the
     result (the per-gate timing hook SURVEY §5.1 calls for; analogue of
     mytimer.hpp + tests/benchmarks/rotate_benchmark.test:42-47).
 
     Returns {"best", "mean", "times"} in seconds; the first (compile)
-    call is excluded."""
+    call is excluded.  The reps/best/mean are also recorded on the
+    active run-ledger record (``metrics.record_timing``, under the
+    record's ``timings`` key) so bench numbers and ledger numbers are
+    one artifact; ``label`` names the entry (default: the function's
+    ``__name__``)."""
     out = fn(*args, **kwargs)
     jax.block_until_ready(out)
     times = []
@@ -158,5 +200,8 @@ def time_fn(fn, *args, reps: int = 5, **kwargs) -> dict:
         out = fn(*args, **kwargs)
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
-    return {"best": min(times), "mean": sum(times) / len(times),
-            "times": times}
+    best = min(times)
+    mean = sum(times) / len(times)
+    metrics.record_timing(label or getattr(fn, "__name__", "time_fn"),
+                          reps, best, mean)
+    return {"best": best, "mean": mean, "times": times}

@@ -135,6 +135,9 @@ def lib():
     L.startRecordingQASM.argtypes = [Qureg]
     L.getEnvironmentString.argtypes = [QuESTEnv, Qureg, ct.c_char * 200]
     L.getRunLedgerString.argtypes = [QuESTEnv, ct.c_char_p, ct.c_int]
+    L.startTimelineCapture.argtypes = [QuESTEnv]
+    L.stopTimelineCapture.restype = ct.c_int
+    L.stopTimelineCapture.argtypes = [QuESTEnv, ct.c_char_p]
     return L
 
 
@@ -286,6 +289,37 @@ def test_run_ledger_string(lib, cenv):
     rec = json.loads(buf.value.decode())
     assert rec.get("schema") == "quest-tpu-run-ledger/1"
     assert rec["counters"].get("flush.runs", 0) >= 1
+    lib.destroyQureg(q, cenv)
+
+
+def test_timeline_capture_roundtrip(lib, cenv, tmp_path):
+    """startTimelineCapture / stopTimelineCapture(path): a C driver's
+    gate stream is captured per executed item and dumped as a
+    Chrome-trace (Perfetto-loadable) JSON file whose event count the
+    stop call returns."""
+    import json
+
+    lib.startTimelineCapture(cenv)
+    q = lib.createQureg(4, cenv)
+    lib.hadamard(q, 0)
+    lib.controlledNot(q, 0, 1)
+    lib.getProbAmp(q, 0)  # state read: flushes the deferred stream
+    path = tmp_path / "timeline.json"
+    n = lib.stopTimelineCapture(cenv, str(path).encode())
+    assert n >= 1
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    assert len(events) == n
+    for e in events:
+        for field in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert field in e, f"missing {field}"
+        assert e["ph"] == "X"
+    # capture is OFF again: further ops record nothing
+    lib.pauliX(q, 0)
+    lib.getProbAmp(q, 0)
+    from quest_tpu import metrics
+
+    assert len(metrics.timeline_events()) == n
     lib.destroyQureg(q, cenv)
 
 

@@ -96,7 +96,7 @@ qt.destroy_env(env)
 
 @pytest.mark.skipif(os.environ.get("QUEST_SKIP_MULTIHOST") == "1",
                     reason="multihost test disabled")
-def test_multi_process_fused_mesh(tmp_path):
+def test_multi_process_fused_mesh(tmp_path, multiprocess_collectives):
     """The fused-mesh executor (schedule_mesh plan: per-chunk Pallas
     segments + half-chunk relayout ppermutes) crossing a REAL process
     boundary: 2 processes x 2 devices, 16 qubits, amplitudes checked
@@ -130,7 +130,7 @@ def test_multi_process_fused_mesh(tmp_path):
 @pytest.mark.skipif(os.environ.get("QUEST_SKIP_MULTIHOST") == "1",
                     reason="multihost test disabled")
 @pytest.mark.parametrize("nproc", [2, 4])
-def test_multi_process_mesh(tmp_path, nproc):
+def test_multi_process_mesh(tmp_path, nproc, multiprocess_collectives):
     port = 19700 + (os.getpid() % 100) + 100 * (nproc // 4)
     src = tmp_path / "worker.py"
     src.write_text(_WORKER.format(repo=REPO, port=port, nproc=nproc))

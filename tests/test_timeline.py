@@ -1,0 +1,304 @@
+"""Per-item timeline capture, flight recorder / health probes, and the
+ledger regression gate (ISSUE-4 acceptance criteria).
+
+Covers: (a) Chrome-trace JSON schema validity of a capture
+(pid/tid/ts/dur/ph on every event, Perfetto-loadable document shape),
+(b) per-item device-time sums consistent with the run's ``execute``
+span under capture, (c) relayout items carrying the EXACT exchange-byte
+attribution the run ledger records (both sides read
+``plan_exchange_elems``), (d) an injected NaN caught by
+``QUEST_HEALTH_EVERY`` with the offending plan item named in the
+flight-recorder dump — on both the compiled-circuit and the
+eager-flush paths, (e) ``tools/ledger_diff.py`` golden comparisons and
+exit semantics, (f) ``tools/trace_view.py`` summarising a capture.
+"""
+
+import json
+import math
+import os
+import sys
+
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics
+from quest_tpu.circuit import Circuit
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import ledger_diff  # noqa: E402
+import trace_view  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _timeline_cleanup():
+    """Never leak an active capture into other tests (capture walls
+    every executed item — it would silently serialise the suite)."""
+    yield
+    metrics.stop_timeline()
+
+
+def _mesh_circuit(n):
+    """Gates with mixing targets on device bits -> relayout exchanges."""
+    c = Circuit(n)
+    for t in range(n):
+        c.hadamard(t)
+    c.controlled_not(n - 1, 0)
+    c.t_gate(n - 1)
+    c.rotate_y(n - 2, 0.37)
+    c.controlled_not(n - 2, 1)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# (a) Chrome-trace schema
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_chrome_trace_schema(env1, tmp_path):
+    metrics.start_timeline()
+    q = qt.create_qureg(8, env1)
+    circ = Circuit(8)
+    for t in range(8):
+        circ.hadamard(t)
+    circ.controlled_phase_shift(0, 7, 0.25)
+    circ.run(q)
+    path = tmp_path / "timeline.json"
+    doc = metrics.stop_timeline(str(path))
+    assert doc["traceEvents"], "capture recorded no items"
+    for e in doc["traceEvents"]:
+        for field in ("name", "ph", "ts", "dur", "pid", "tid", "args"):
+            assert field in e, f"missing {field}: {e}"
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0 and e["ts"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    # the dumped file is the same loadable document
+    on_disk = json.loads(path.read_text())
+    assert on_disk["traceEvents"] == doc["traceEvents"]
+    assert on_disk["otherData"]["schema"].startswith("quest-tpu-timeline")
+
+
+def test_timeline_env_knob(env1, monkeypatch):
+    """QUEST_TIMELINE=1 alone (no programmatic start) activates
+    capture; without it the run records nothing."""
+    monkeypatch.setenv("QUEST_TIMELINE", "1")
+    metrics.start_timeline()  # clear buffer; env knob keeps it live
+    metrics.stop_timeline()
+    q = qt.create_qureg(6, env1)
+    Circuit(6).hadamard(0).hadamard(3).run(q)
+    assert metrics.timeline_events()
+
+
+# ---------------------------------------------------------------------------
+# (b) + (c) device-time sums and exchange-byte attribution
+# ---------------------------------------------------------------------------
+
+
+def test_device_time_sums_match_execute_span(env8):
+    n = 12
+    circ = _mesh_circuit(n)
+    q = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    circ.run(q)
+    ev = metrics.timeline_events()
+    metrics.stop_timeline()
+    led = metrics.get_run_ledger()
+    assert led["label"] == "circuit_run" and led["meta"].get("observed")
+    item_s = sum(e["dur"] for e in ev) / 1e6
+    exe_s = led["spans"]["execute"]["seconds"]
+    # every item wall runs INSIDE the execute span; the span adds only
+    # python glue between items, so the two must closely agree
+    assert item_s <= exe_s * 1.02 + 0.005
+    assert item_s >= exe_s * 0.5
+    kinds = {e["name"] for e in ev}
+    assert "relayout" in kinds or "bitswap" in kinds
+    assert "pallas-pass" in kinds
+
+
+def test_timeline_exchange_bytes_match_ledger(env8):
+    """Relayout/bitswap timeline items carry the exact exchange-byte
+    attribution the ledger records — both read plan_exchange_elems, so
+    the totals must be EQUAL, not merely close."""
+    n = 12
+    circ = _mesh_circuit(n)
+    q = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    circ.run(q)
+    ev = metrics.timeline_events()
+    metrics.stop_timeline()
+    led = metrics.get_run_ledger()
+    tl_bytes = sum(e["args"].get("exchange_bytes", 0) for e in ev)
+    assert tl_bytes > 0
+    assert tl_bytes == led["counters"]["exec.exchange_bytes"]
+    # correctness under observation: the per-item observed path must
+    # produce the same state as the unobserved jitted program
+    import numpy as np
+
+    got = qt.get_state_vector(q)
+    q2 = qt.create_qureg(n, env8)
+    circ.run(q2)  # capture stopped: normal compiled path
+    assert np.abs(got - qt.get_state_vector(q2)).max() < 1e-12
+
+
+def test_flight_ring_bounded(env1):
+    for i in range(3 * metrics.FLIGHT_MAX_DEFAULT):
+        metrics.flight_record("unit", index=i)
+    entries = metrics.flight_entries()
+    assert len(entries) <= metrics.FLIGHT_MAX_DEFAULT
+    assert entries[-1]["index"] == 3 * metrics.FLIGHT_MAX_DEFAULT - 1
+
+
+# ---------------------------------------------------------------------------
+# (d) health probes: injected NaN -> flight-recorder dump names the item
+# ---------------------------------------------------------------------------
+
+
+def test_health_probe_names_injecting_item(env1, tmp_path, monkeypatch):
+    monkeypatch.setenv("QUEST_HEALTH_EVERY", "1")
+    monkeypatch.setenv("QUEST_FLIGHT_FILE", str(tmp_path / "flight.json"))
+    circ = Circuit(6)
+    circ.hadamard(0).hadamard(1)
+    circ.collapse_to_outcome(0, 0)          # forces a second gate run
+    circ.phase_shift(2, float("nan"))       # the injecting gate
+    circ.hadamard(3)
+    q = qt.create_qureg(6, env1)
+    with pytest.raises(qt.QuESTError, match="non-finite"):
+        circ.run(q)
+    dump = json.loads((tmp_path / "flight.json").read_text())
+    assert dump["schema"].startswith("quest-tpu-flight")
+    assert "non-finite" in dump["reason"]
+    item = dump["offending"]["item"]
+    # k=1: the exact injecting item — the first fused segment of the
+    # post-collapse run (which carries the NaN phase gate)
+    assert item["kind"] == "pallas-pass" and item["index"] == 0
+    assert dump["items"], "ring must hold the items leading up to it"
+    # the register was NOT bricked: observed runs never donate, so the
+    # input state survives a tripped probe
+    assert qt.calc_total_prob(q) == pytest.approx(1.0, abs=1e-12)
+
+
+def test_health_probe_healthy_run_clean(env8, monkeypatch):
+    monkeypatch.setenv("QUEST_HEALTH_EVERY", "2")
+    q = qt.create_qureg(10, env8)
+    _mesh_circuit(10).run(q)  # probes every 2nd item, none trip
+    assert qt.calc_total_prob(q) == pytest.approx(1.0, abs=1e-10)
+
+
+def test_health_probe_eager_flush_path(env1, tmp_path, monkeypatch):
+    """The register.py seam: QUEST_HEALTH_EVERY catches a NaN injected
+    through the eager/C-driver deferred-gate stream."""
+    monkeypatch.setenv("QUEST_HEALTH_EVERY", "1")
+    monkeypatch.setenv("QUEST_FLIGHT_FILE",
+                       str(tmp_path / "flight_eager.json"))
+    q = qt.create_qureg(5, env1)
+    qt.hadamard(q, 0)
+    qt.phase_shift(q, 1, float("nan"))
+    with pytest.raises(qt.QuESTError, match="non-finite"):
+        qt.get_state_vector(q)  # read flushes the stream -> probe trips
+    dump = json.loads((tmp_path / "flight_eager.json").read_text())
+    assert dump["offending"]["item"]["kind"] == "flush"
+
+
+def test_health_probe_density_trace_and_hermiticity(env1, monkeypatch):
+    """Density registers probe trace + hermiticity drift (a healthy
+    channel-bearing run passes both)."""
+    monkeypatch.setenv("QUEST_HEALTH_EVERY", "1")
+    rho = qt.create_density_qureg(3, env1)
+    circ = Circuit(3, is_density=True)
+    circ.hadamard(0).controlled_not(0, 1).rotate_y(2, 0.7)
+    circ.run(rho)
+    assert qt.calc_total_prob(rho) == pytest.approx(1.0, abs=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# (e) ledger_diff golden comparison
+# ---------------------------------------------------------------------------
+
+_OLD = {"metric": "gate_ops_per_sec_30q", "value": 1000.0,
+        "seconds": 10.0, "gates_per_pass": 50.0,
+        "mesh_exchange_bytes_qft30": 1000000,
+        "counters": {"exec.passes": 7, "exec.exchange_bytes": 4096}}
+
+
+def test_ledger_diff_clean_and_regressed(tmp_path):
+    new_ok = json.loads(json.dumps(_OLD))
+    new_ok["value"] = 990.0  # within the -25% perf allowance
+    new_bad = json.loads(json.dumps(_OLD))
+    new_bad["mesh_exchange_bytes_qft30"] = 1200000   # +20% comm bloat
+    new_bad["counters"]["exec.passes"] = 9           # +2 passes
+
+    v, checked, _ = ledger_diff.gate(_OLD, new_ok)
+    assert v == [] and checked
+
+    v, _, _ = ledger_diff.gate(_OLD, new_bad)
+    keys = {x["key"] for x in v}
+    assert "mesh_exchange_bytes_qft30" in keys
+    assert "counters.exec.passes" in keys
+
+    # exit-code semantics through main()
+    old_p, ok_p, bad_p = (tmp_path / n for n in
+                          ("old.json", "ok.json", "bad.json"))
+    old_p.write_text(json.dumps(_OLD))
+    ok_p.write_text(json.dumps(new_ok))
+    bad_p.write_text(json.dumps(new_bad))
+    assert ledger_diff.main([str(old_p), str(ok_p)]) == 0
+    assert ledger_diff.main([str(old_p), str(bad_p)]) == 1
+    assert ledger_diff.main([str(old_p)]) == 2  # usage
+
+
+def test_ledger_diff_config_mismatch_skips_perf_rules(tmp_path):
+    """A 20q smoke gated against a 30q record: perf rules skip, the
+    config-independent exchange metric still gates."""
+    new = json.loads(json.dumps(_OLD))
+    new["metric"] = "gate_ops_per_sec_20q"
+    new["value"] = 1.0          # catastrophic but config-bound: skipped
+    new["mesh_exchange_bytes_qft30"] = 2000000  # still caught
+    v, _, skipped = ledger_diff.gate(_OLD, new)
+    assert {x["key"] for x in v} == {"mesh_exchange_bytes_qft30"}
+    assert any(why == "config mismatch" for _, why in skipped)
+
+
+def test_ledger_diff_custom_rule_and_jsonl(tmp_path):
+    jl = tmp_path / "ledger.jsonl"
+    with open(jl, "w") as f:
+        f.write(json.dumps({"label": "a", "counters": {"x": 1}}) + "\n")
+        f.write(json.dumps({"label": "b", "counters": {"x": 5}}) + "\n")
+    rec = ledger_diff.load_record(str(jl))
+    assert rec["counters"]["x"] == 5  # last record wins
+    assert ledger_diff.load_record(str(jl), label="a")["counters"]["x"] == 1
+    old = tmp_path / "o.json"
+    new = tmp_path / "n.json"
+    old.write_text(json.dumps({"counters": {"x": 100}}))
+    new.write_text(json.dumps({"counters": {"x": 120}}))
+    assert ledger_diff.main([str(old), str(new)]) == 0  # no default rule
+    assert ledger_diff.main(["--rule", "counters.x=+0.1",
+                             str(old), str(new)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# (f) trace_view top-k table
+# ---------------------------------------------------------------------------
+
+
+def test_trace_view_summarises_capture(env8, tmp_path, capsys):
+    n = 12
+    q = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    _mesh_circuit(n).run(q)
+    path = tmp_path / "timeline.json"
+    metrics.stop_timeline(str(path))
+    assert trace_view.main([str(path), "-k", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "total device time" in out
+    assert "relayout" in out or "bitswap" in out
+    assert "exchange bytes" in out
+
+
+def test_timeline_event_buffer_bounded():
+    metrics.start_timeline()
+    for i in range(metrics.TIMELINE_MAX_EVENTS + 10):
+        metrics.timeline_event("x", float(i), 0.0)
+    doc = metrics.stop_timeline()
+    assert len(doc["traceEvents"]) == metrics.TIMELINE_MAX_EVENTS
+    assert doc["otherData"]["dropped_events"] == 10

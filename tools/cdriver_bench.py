@@ -59,10 +59,12 @@ def run_once(exe: str, cache_dir: str | None = None,
         env["QUEST_CAPI_COMPILE_CACHE"] = cache_dir
     if extra_env:
         env.update(extra_env)
-    t0 = time.perf_counter()
+    # time.time, not quest_tpu.reporting: this parent must stay
+    # jax-free so the driver subprocess owns the accelerator alone
+    t0 = time.time()
     r = subprocess.run([exe], capture_output=True, text=True, env=env,
                        cwd=os.path.dirname(exe), timeout=3600)
-    wall = time.perf_counter() - t0
+    wall = time.time() - t0
     if r.returncode != 0:
         raise RuntimeError(f"driver failed rc={r.returncode}:\n"
                            f"{r.stderr[-2000:]}")

@@ -15,11 +15,11 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+from quest_tpu import reporting  # noqa: E402
 
 N = int(os.environ.get("DENSITY_BENCH_QUBITS", "14"))
 ROUNDS = 4
@@ -62,10 +62,10 @@ def main():
     n_gates = n_channels = 0
     one_round(False)  # warm-up: compiles every (kernel, target) combo
 
-    t0 = time.perf_counter()
+    t0 = reporting.stopwatch()
     for r in range(ROUNDS):
         one_round(True)
-    secs_synced = time.perf_counter() - t0
+    secs_synced = t0.seconds
 
     # The same workload DEFERRED: all rounds queue into one stream, one
     # flush, ONE host sync at the end — the natural eager-API usage when
@@ -76,11 +76,11 @@ def main():
     for r in range(ROUNDS):           # warm-up: compile the 4-round
         one_round(False, do_sync=False)  # deferred stream once
     sync()
-    t0 = time.perf_counter()
+    t0 = reporting.stopwatch()
     for r in range(ROUNDS):
         one_round(False, do_sync=False)
     sync()
-    secs_deferred = time.perf_counter() - t0
+    secs_deferred = t0.seconds
 
     trace = qt.calc_total_prob(rho)
     purity = qt.calc_purity(rho)

@@ -1,0 +1,187 @@
+"""Compare two run-ledger / BENCH records and FAIL on regressions.
+
+Turns the repo's recorded artifacts (``BENCH_r*.json``, run-ledger
+JSONL files from ``QUEST_METRICS_FILE``, single ledger records) from a
+log into an enforced trajectory: ``bench.py --gate BENCH_prev.json``
+and the tier-2 smoke in ``tools/record_all.py`` call :func:`gate` and
+exit nonzero when a configured metric regressed — exchange bytes, pass
+counts, device time.
+
+Usage::
+
+    python tools/ledger_diff.py OLD NEW [--rule KEY=LIMIT ...]
+                                [--no-defaults] [--verbose]
+
+``LIMIT`` is a signed fraction: ``+0.05`` fails when NEW exceeds OLD by
+more than 5% (costs: bytes, passes, seconds), ``-0.05`` fails when NEW
+falls more than 5% below OLD (rates: gates/s, gates/pass).  Keys are
+dot-paths into the flattened record (``counters.exec.exchange_bytes``,
+``spans.execute.seconds``, ``mesh_exchange_bytes_qft30``).  Rules whose
+key is missing on either side are skipped (reported with ``--verbose``)
+— artifacts evolve, and a gate must never fail on a field that does
+not exist yet.
+
+Perf-noisy rules (wall seconds, gates/s) are additionally skipped when
+the two records describe different configs (the BENCH ``metric`` field
+disagrees, e.g. a 20-qubit smoke gated against a 30-qubit record);
+structural metrics like the QFT-30 mesh exchange bytes are
+config-independent by construction and always gate.
+
+Exit status: 0 clean, 1 regression(s), 2 usage / unreadable record.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+#: (key, signed limit fraction, config_bound) — config_bound rules only
+#: apply when both records describe the same workload config.
+DEFAULT_RULES = [
+    # structural / communication metrics: tight, config-independent
+    ("mesh_exchange_bytes_qft30", +0.01, False),
+    ("counters.exec.exchange_bytes", +0.01, False),
+    ("counters.mesh.exchange_bytes", +0.01, False),
+    ("counters.exec.relayouts", +0.0, False),
+    ("counters.exec.passes", +0.0, True),
+    ("counters.exec.stream_bytes", +0.01, True),
+    ("gates_per_pass", -0.01, True),
+    # device / wall time: loose (measurement noise), config-bound
+    ("value", -0.25, True),
+    ("seconds", +0.25, True),
+    ("spans.execute.seconds", +0.25, True),
+    ("hbm_gbps", -0.25, True),
+]
+
+
+def flatten(rec: dict, prefix: str = "") -> dict:
+    """Numeric leaves of a nested record as dot-keyed floats."""
+    out = {}
+    for k, v in rec.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten(v, key + "."))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def load_record(path: str, label: str | None = None) -> dict:
+    """Load one record from ``path``: a JSON object file (BENCH_*.json,
+    a flight/timeline dump, a single ledger record) or a run-ledger
+    JSONL stream, where the LAST record wins (optionally the last with
+    the given ``label``)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            return doc
+    except ValueError:
+        pass
+    picked = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and (label is None
+                                      or rec.get("label") == label):
+            picked = rec
+    if picked is None:
+        raise ValueError(f"{path}: no JSON record"
+                         + (f" with label {label!r}" if label else ""))
+    return picked
+
+
+def gate(old: dict, new: dict, rules=None):
+    """Apply regression rules; returns (violations, checked, skipped).
+
+    Each violation is a dict {key, old, new, change, limit}; ``change``
+    is the signed fractional change new/old - 1."""
+    rules = DEFAULT_RULES if rules is None else rules
+    fo, fn_ = flatten(old), flatten(new)
+    same_config = old.get("metric") == new.get("metric")
+    violations, checked, skipped = [], [], []
+    for key, limit, config_bound in rules:
+        if key not in fo or key not in fn_:
+            skipped.append((key, "missing"))
+            continue
+        if config_bound and not same_config:
+            skipped.append((key, "config mismatch"))
+            continue
+        ov, nv = fo[key], fn_[key]
+        if ov == 0:
+            # no baseline to scale against: any appearance of a nonzero
+            # cost where there was none is itself a regression for
+            # tight "+0"-style cost rules, otherwise skip
+            if limit >= 0 and nv > 0:
+                violations.append({"key": key, "old": ov, "new": nv,
+                                   "change": float("inf"),
+                                   "limit": limit})
+            else:
+                skipped.append((key, "zero baseline"))
+            continue
+        change = nv / ov - 1.0
+        bad = (change > limit) if limit >= 0 else (change < limit)
+        (violations if bad else checked).append(
+            {"key": key, "old": ov, "new": nv,
+             "change": round(change, 6), "limit": limit})
+    return violations, checked, skipped
+
+
+def report(violations, checked, skipped, verbose: bool = False) -> None:
+    for v in violations:
+        print(f"REGRESSION {v['key']}: {v['old']:g} -> {v['new']:g} "
+              f"({v['change']:+.2%} vs limit {v['limit']:+.2%})")
+    if verbose:
+        for c in checked:
+            print(f"ok         {c['key']}: {c['old']:g} -> {c['new']:g} "
+                  f"({c['change']:+.2%})")
+        for key, why in skipped:
+            print(f"skipped    {key}: {why}")
+    print(f"ledger-diff: {len(violations)} regression(s), "
+          f"{len(checked)} ok, {len(skipped)} skipped")
+
+
+def parse_rule(spec: str):
+    key, _, lim = spec.partition("=")
+    if not key or not lim:
+        raise ValueError(f"bad --rule {spec!r} (want KEY=+0.05)")
+    return (key, float(lim), False)
+
+
+def main(argv) -> int:
+    args = list(argv)
+    verbose = "--verbose" in args
+    no_defaults = "--no-defaults" in args
+    args = [a for a in args if a not in ("--verbose", "--no-defaults")]
+    rules = [] if no_defaults else list(DEFAULT_RULES)
+    while "--rule" in args:
+        i = args.index("--rule")
+        try:
+            rules.append(parse_rule(args[i + 1]))
+        except (IndexError, ValueError) as e:
+            print(f"ledger-diff: {e}")
+            return 2
+        del args[i:i + 2]
+    if len(args) != 2:
+        print(__doc__)
+        return 2
+    try:
+        old = load_record(args[0])
+        new = load_record(args[1])
+    except (OSError, ValueError) as e:
+        print(f"ledger-diff: {e}")
+        return 2
+    violations, checked, skipped = gate(old, new, rules)
+    report(violations, checked, skipped, verbose=verbose)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

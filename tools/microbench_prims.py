@@ -5,12 +5,12 @@ INNER-times chained jit (overhead-corrected), printing ms/pass deltas vs
 the empty pass.
 """
 
-import time
 from functools import partial
 
 import numpy as np
 import sys
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
+from quest_tpu import reporting  # noqa: E402
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -59,10 +59,10 @@ def run_kernel(label, kern, extra_inputs=(), extra_specs=()):
         float(jnp.sum(re[:1]))
         ts = []
         for _ in range(3):
-            t0 = time.perf_counter()
+            t0 = reporting.stopwatch()
             re, im = run(re, im)
             float(jnp.sum(re[:1]))
-            ts.append(time.perf_counter() - t0)
+            ts.append(t0.seconds)
         best = (min(ts) * 1e3 - 90) / INNER
         print(f"{label:52s} {best:7.2f} ms/pass")
     except Exception as e:

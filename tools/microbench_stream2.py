@@ -1,11 +1,11 @@
 """Isolate the bandwidth limiter: reads vs writes vs aliasing vs loop."""
 
 import os
-import time
 from functools import partial
 
 import sys
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
+from quest_tpu import reporting  # noqa: E402
 import jax
 import jax.numpy as jnp
 
@@ -25,10 +25,10 @@ def bench(label, fn, *args, gib_moved=1.0, reps=5, donate=()):
     for _ in range(reps):
         # when donating, refresh args each reps iteration is impossible;
         # instead donate-free by default
-        t0 = time.perf_counter()
+        t0 = reporting.stopwatch()
         out = jfn(*args)
         jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
+        times.append(t0.seconds)
     best = min(times)
     print(f"{label:46s} {best*1e3:8.2f} ms  {gib_moved/best:7.1f} GB/s")
 
@@ -57,10 +57,10 @@ def one_pass():
     jax.block_until_ready(x)
     times = []
     for _ in range(6):
-        t0 = time.perf_counter()
+        t0 = reporting.stopwatch()
         x = f(x)
         jax.block_until_ready(x)
-        times.append(time.perf_counter() - t0)
+        times.append(t0.seconds)
     best = min(times)
     print(f"{'donated single-array copy':46s} {best*1e3:8.2f} ms  "
           f"{2*GIB1/best:7.1f} GB/s")

@@ -24,18 +24,18 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+from quest_tpu import reporting  # noqa: E402
 
 N_QUBITS = int(os.environ.get("QUEST_REHEARSE_QUBITS", "20"))
 NPROC = 2
 DEV_PER_PROC = 4
 
 _WORKER = """
-import os, sys, time, json
+import os, sys, json
 sys.path.insert(0, {repo!r})
 pid = int(sys.argv[1])
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -50,17 +50,17 @@ except AttributeError:
 import numpy as np
 import jax.numpy as jnp
 import quest_tpu as qt
-from quest_tpu import models
+from quest_tpu import models, reporting
 from quest_tpu.parallel import to_host
 from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn, plan_comm_stats
 from quest_tpu.scheduler import schedule_mesh
 from quest_tpu.ops.lattice import state_shape
 
-t_init = time.perf_counter()
+t_init = reporting.stopwatch()
 qt.init_distributed("localhost:{port}", {nproc}, pid)
 env = qt.create_env()
 assert env.num_devices == {nproc} * {dev_per_proc}
-init_s = time.perf_counter() - t_init
+init_s = t_init.seconds
 
 n = {n}
 ndev = env.num_devices
@@ -77,15 +77,15 @@ stats = plan_comm_stats(plan, n, dev_bits)
 q = qt.create_qureg(n, env)
 qt.init_zero_state(q)
 fn = jax.jit(as_mesh_fused_fn(list(circ.ops), n, q.mesh, backend="xla"))
-t0 = time.perf_counter()
+t0 = reporting.stopwatch()
 re, im = fn(q.re, q.im)
 jax.block_until_ready((re, im))
-compile_plus_run = time.perf_counter() - t0
+compile_plus_run = t0.seconds
 q._set(re, im)
-t0 = time.perf_counter()
+t0 = reporting.stopwatch()
 re, im = fn(q.re, q.im)
 jax.block_until_ready((re, im))
-warm = time.perf_counter() - t0
+warm = t0.seconds
 q._set(re, im)
 total = qt.calc_total_prob(q)
 
@@ -109,11 +109,11 @@ chunk_rows = (1 << (n - dev_bits)) // lanes
 rng = np.random.default_rng(100 + pid)
 cre = jnp.asarray(rng.standard_normal((chunk_rows, lanes)), jnp.float32)
 cim = jnp.asarray(rng.standard_normal((chunk_rows, lanes)), jnp.float32)
-t0 = time.perf_counter()
+t0 = reporting.stopwatch()
 pr, pi2 = apply_fused_segment(cre, cim, seg_ops, tuple(shigh),
                               interpret=True, dev_flags=flags)
 jax.block_until_ready((pr, pi2))
-pallas_seg_s = time.perf_counter() - t0
+pallas_seg_s = t0.seconds
 xr, xi = apply_segment_xla(cre, cim, seg_ops, tuple(shigh),
                            dev_flags=flags)
 pallas_vs_xla_err = max(
@@ -141,14 +141,14 @@ qt.destroy_env(env)
 
 
 _CHIP_STAGE = """
-import sys, time, json
+import sys, json
 sys.path.insert(0, {repo!r})
 which = sys.argv[1]
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
-from quest_tpu import models
+from quest_tpu import models, reporting
 from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn
 from quest_tpu.ops.lattice import run_kernel, state_shape
 
@@ -164,7 +164,7 @@ def fetches(re, im):
     pre_i = np.asarray(jax.device_get(im[:16]))
     return p0, pre_r, pre_i
 
-t0 = time.perf_counter()
+t0 = reporting.stopwatch()
 if which == "mesh":
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("amp",))
     fn = as_mesh_fused_fn(list(circ.ops), n, mesh, backend="pallas")
@@ -181,7 +181,7 @@ else:
     im = jnp.zeros(shape, jnp.float32)
     re, im = fn(re, im)
     jax.block_until_ready((re, im))
-secs = time.perf_counter() - t0
+secs = t0.seconds
 p0, pre_r, pre_i = fetches(re, im)
 print("STAGE " + json.dumps({{
     "which": which, "seconds": round(secs, 2),
@@ -256,7 +256,7 @@ def main():
                             dev_per_proc=DEV_PER_PROC, n=N_QUBITS)
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
-    t0 = time.perf_counter()
+    t0 = reporting.stopwatch()
     procs = [subprocess.Popen([sys.executable, "-c", worker, str(i)],
                               stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True, env=env,
@@ -271,7 +271,7 @@ def main():
             errs.append(out[-1500:])
         else:
             results.append(json.loads(line[len("RESULT "):]))
-    wall = time.perf_counter() - t0
+    wall = t0.seconds
 
     ok = (not errs and len(results) == NPROC
           and all(abs(r["total_prob"] - 1.0) < 1e-4 for r in results)

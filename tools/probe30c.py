@@ -3,10 +3,10 @@
 
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
+from quest_tpu import reporting  # noqa: E402
 import jax
 import jax.numpy as jnp
 
@@ -46,11 +46,11 @@ def timed_segs(label, segs, n_gates, row_budget=1024):
         return None
     times = []
     for _ in range(REPS):
-        t0 = time.perf_counter()
+        t0 = reporting.stopwatch()
         re, im = run(re, im)
         jax.block_until_ready((re, im))
         float(re[0, 0])
-        times.append((time.perf_counter() - t0) / INNER)
+        times.append((t0.seconds) / INNER)
     best = min(times)
     npass = max(len(segs), 1)
     print(f"{label:46s} {best*1e3:8.1f} ms  {n_gates/best if n_gates else 0:7.1f} gates/s"

@@ -3,10 +3,10 @@ and the raw MXU dot precision ladder at bench shapes."""
 
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
+from quest_tpu import reporting  # noqa: E402
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,11 +39,11 @@ def timed_fn(label, fn, units=1.0):
         return
     times = []
     for _ in range(REPS):
-        t0 = time.perf_counter()
+        t0 = reporting.stopwatch()
         re, im = run(re, im)
         jax.block_until_ready((re, im))
         float(re[0, 0])
-        times.append((time.perf_counter() - t0) / INNER)
+        times.append((t0.seconds) / INNER)
     best = min(times)
     print(f"{label:44s} {best*1e3:8.1f} ms  ({units/best:.1f}/s)",
           flush=True)

@@ -13,10 +13,10 @@ Usage: [MB_QUBITS=30] [MB_INNER=16] python tools/probe40.py base split3 ...
 
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
+from quest_tpu import reporting  # noqa: E402
 import jax
 import jax.numpy as jnp
 
@@ -54,11 +54,11 @@ def timed(label, segs, row_budget=None):
         return
     times = []
     for _ in range(REPS):
-        t0 = time.perf_counter()
+        t0 = reporting.stopwatch()
         re, im = run(re, im)
         jax.block_until_ready((re, im))
         float(re[0, 0])
-        times.append((time.perf_counter() - t0) / INNER)
+        times.append((t0.seconds) / INNER)
     best = min(times)
     ng = N * DEPTH
     print(f"{label:28s} {ng/best:7.1f} gates/s  ({len(segs)} passes, "

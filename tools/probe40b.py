@@ -11,10 +11,10 @@ their sum.  probe30 measured the std path strictly additive.
 
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
+from quest_tpu import reporting  # noqa: E402
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -49,11 +49,11 @@ def run_one(label, make_fn):
             float(re[0, 0])
             times = []
             for _ in range(2):
-                t0 = time.perf_counter()
+                t0 = reporting.stopwatch()
                 re, im = run(re, im)
                 jax.block_until_ready((re, im))
                 float(re[0, 0])
-                times.append((time.perf_counter() - t0) / INNER)
+                times.append((t0.seconds) / INNER)
             print(f"{label} ndots={nd:2d}  {min(times)*1e3:7.2f} ms/pass",
                   flush=True)
         except Exception as e:

@@ -24,10 +24,10 @@ Usage: python tools/probe50.py [probe ...]   (env: MB_QUBITS, MB_INNER)
 
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
+from quest_tpu import reporting  # noqa: E402
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -56,11 +56,11 @@ def timeit(label, fn, *args, reps=2, inner=INNER, donate=True):
         float(re[0, 0])
         times = []
         for _ in range(reps):
-            t0 = time.perf_counter()
+            t0 = reporting.stopwatch()
             re, im = run(re, im)
             jax.block_until_ready((re, im))
             float(re[0, 0])
-            times.append((time.perf_counter() - t0) / inner)
+            times.append((t0.seconds) / inner)
         ms = min(times) * 1e3
         gbps = 2 * 2 * ROWS * LANES * 4 / (ms / 1e3) / 1e9  # r+w, re+im
         print(f"{label:34s} {ms:8.2f} ms/pass  ({gbps:6.1f} GB/s rw)",

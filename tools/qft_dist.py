@@ -31,7 +31,6 @@ import math
 import os
 import subprocess
 import sys
-import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 if REPO not in sys.path:
@@ -56,7 +55,7 @@ def run_real_chip(max_qubits: int = 30):
     import jax
     import jax.numpy as jnp
 
-    from quest_tpu import models
+    from quest_tpu import models, reporting
     from quest_tpu.ops.lattice import state_shape
 
     dev = jax.devices()[0]
@@ -82,18 +81,18 @@ def run_real_chip(max_qubits: int = 30):
         return re, jnp.zeros(shape, jnp.float32)
 
     re, im = fresh()
-    t0 = time.perf_counter()
+    sw = reporting.stopwatch()
     re, im = fn(re, im)
     _ = float(re[0, 0])  # host read = real sync under the axon tunnel
-    compile_s = time.perf_counter() - t0
+    compile_s = sw.seconds
 
     # Warm timing: re-apply on the same donated buffers (same compiled
     # program; input state is irrelevant to gate timing) so only ONE
     # (re, im) pair ever lives in HBM.
-    t0 = time.perf_counter()
+    sw = reporting.stopwatch()
     re, im = fn(re, im)
     _ = float(re[0, 0])
-    run_s = time.perf_counter() - t0
+    run_s = sw.seconds
 
     # Sustained on-chip throughput: amortise the ~90 ms tunnel dispatch
     # over INNER chained applications inside one compiled call (the
@@ -116,10 +115,10 @@ def run_real_chip(max_qubits: int = 30):
     _ = float(sre[0, 0])
     best = None
     for _rep in range(2):
-        t0 = time.perf_counter()
+        sw = reporting.stopwatch()
         sre, sim = spin(sre, sim)
         _ = float(sre[0, 0])
-        dt = (time.perf_counter() - t0) / inner
+        dt = sw.seconds / inner
         best = dt if best is None else min(best, dt)
     sustained = circ.num_gates / best
     del sre, sim
@@ -148,7 +147,7 @@ def run_real_chip(max_qubits: int = 30):
     }
 
 
-def run_virtual_mesh(n: int = 26, ndev: int = 8):
+def run_virtual_mesh(n: int | None = None, ndev: int = 8):
     """Sharded QFT on a virtual CPU mesh EXECUTING the fused-mesh plan
     itself — relabeling segments plus real ``bitswap_chunk`` relayout
     exchanges — via the XLA segment backend (``as_mesh_fused_fn(...,
@@ -157,9 +156,22 @@ def run_virtual_mesh(n: int = 26, ndev: int = 8):
     subprocess so the CPU platform config never touches this process's
     real-TPU backend.  Alongside the executed run, the plan's relayouts
     are accounted per-swap (exact bytes at this chunk size) against the
-    reference's full-chunk-per-gate exchange scheme."""
+    reference's full-chunk-per-gate exchange scheme.
+
+    With ``QUEST_TIMELINE=1`` the WARM run is captured per plan item
+    (quest_tpu.metrics timeline): each item walled with
+    ``block_until_ready``, a Perfetto-loadable ``timeline.json`` written
+    to the repo root (view with ``tools/trace_view.py``), and the
+    RESULT carries the per-item device-time sum against the walled run
+    time plus the relayout exchange-byte attribution — which must equal
+    the plan's ledger accounting exactly, both sides reading
+    ``plan_exchange_elems``.  ``QUEST_QFT_VIRTUAL_N`` overrides the
+    register size (default 26: one physical core time-slices all 8
+    device threads; 30 works but multiplies the wait)."""
+    if n is None:
+        n = int(os.environ.get("QUEST_QFT_VIRTUAL_N", "26"))
     code = f"""
-import json, math, os, time
+import json, math, os
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault(
     "XLA_FLAGS", "--xla_force_host_platform_device_count={ndev}")
@@ -172,7 +184,7 @@ except AttributeError:
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from quest_tpu import models
+from quest_tpu import metrics, models, reporting
 from quest_tpu.env import AMP_AXIS
 from quest_tpu.ops.lattice import state_shape
 from quest_tpu.scheduler import schedule_mesh
@@ -187,6 +199,8 @@ circ = models.qft(n)
 # and the planned bitswap_chunk half-exchanges actually performed.
 # per_item: one giant XLA:CPU program over the whole 26q plan takes
 # tens of minutes to compile; per-item programs compile in seconds.
+# per_item is ALSO the timeline granularity: under QUEST_TIMELINE=1
+# every item is walled and tagged (kind, targets, exchange bytes).
 fn = as_mesh_fused_fn(list(circ.ops), n, mesh, backend="xla",
                       per_item=True)
 shape = state_shape(1 << n, ndev)
@@ -195,17 +209,42 @@ x = (0b1011 << (n - 8)) | 0b1101
 re = jax.device_put(jnp.zeros(shape, jnp.float32).at[x // lanes, x % lanes]
                     .set(1.0), sh)
 im = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
-t0 = time.perf_counter()
+sw = reporting.stopwatch()
 re, im = fn(re, im)
 jax.block_until_ready((re, im))
-compile_plus_run = time.perf_counter() - t0
+compile_plus_run = sw.seconds
+timeline = os.environ.get("QUEST_TIMELINE") == "1"
+if timeline:
+    # capture ONLY the warm run: the cold pass above interleaves
+    # per-item XLA compiles with execution, which would swamp the
+    # device-time attribution the timeline is for
+    metrics.start_timeline()
 re2 = jax.device_put(jnp.zeros(shape, jnp.float32)
                      .at[x // lanes, x % lanes].set(1.0), sh)
 im2 = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
-t0 = time.perf_counter()
+sw = reporting.stopwatch()
 re, im = fn(re2, im2)
 jax.block_until_ready((re, im))
-warm_run = time.perf_counter() - t0
+warm_run = sw.seconds
+timeline_summary = None
+if timeline:
+    tl_path = os.path.join({REPO!r}, "timeline.json")
+    doc = metrics.stop_timeline(tl_path)
+    events = doc["traceEvents"]
+    items_s = sum(e["dur"] for e in events) / 1e6
+    tl_exch = sum(e["args"].get("exchange_bytes", 0) for e in events)
+    plan_exch = fn.plan_stats["exchange_elems"] * 4  # f32, == ledger
+    timeline_summary = {{
+        "path": tl_path,
+        "events": len(events),
+        "kinds": sorted(set(e["name"] for e in events)),
+        "per_item_device_s": round(items_s, 3),
+        "walled_run_s": round(warm_run, 3),
+        "device_time_ratio": round(items_s / warm_run, 4),
+        "exchange_bytes": tl_exch,
+        "ledger_exchange_bytes": plan_exch,
+        "exchange_bytes_match": tl_exch == plan_exch,
+    }}
 
 norm = 2.0 ** (-n / 2.0)
 err = 0.0
@@ -262,6 +301,7 @@ print("RESULT " + json.dumps({{
     "plan_bytes_moved_per_device": moved,
     "reference_full_chunk_exchanges": ref_exchanges,
     "reference_bytes_moved_per_device": ref_exchanges * chunk_bytes,
+    "timeline": timeline_summary,
 }}))
 """
     env = dict(os.environ)
@@ -301,8 +341,13 @@ def pod_memory_model(n: int = 34):
 def main():
     rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 2
     # QUEST_QFT_PARTS=virtual (etc.) runs a subset while debugging, so a
-    # retry never re-burns the ~5 min real-chip phase.
-    parts = os.environ.get("QUEST_QFT_PARTS", "real,virtual,model")
+    # retry never re-burns the ~5 min real-chip phase.  Timeline capture
+    # (QUEST_TIMELINE=1) targets the sharded virtual-mesh run — per-item
+    # device times of the executed plan — so it defaults to that part
+    # alone; override with an explicit QUEST_QFT_PARTS.
+    default_parts = ("virtual" if os.environ.get("QUEST_TIMELINE") == "1"
+                     else "real,virtual,model")
+    parts = os.environ.get("QUEST_QFT_PARTS", default_parts)
     art = {"config": "QFT 34 qubits, distributed state-vector sharded "
                      "across pod (BASELINE.json configs[4])"}
     # partial runs UPDATE this round's existing artifact (so a quick

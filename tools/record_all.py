@@ -38,6 +38,40 @@ RECORDERS = [
 ]
 
 
+def bench_gate_smoke(summary) -> None:
+    """Tier-2 smoke: a small, fast bench run gated against the newest
+    recorded BENCH_*.json (``bench.py --gate``, tools/ledger_diff.py
+    rules).  Config-bound perf rules auto-skip at the smoke size; the
+    config-independent metrics (QFT-30 mesh exchange bytes) must not
+    regress, so a scheduler/executor change that bloats communication
+    fails the recording round immediately instead of at review."""
+    import glob
+
+    benches = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not benches:
+        print("SKIP bench_gate (no BENCH_r*.json to gate against)")
+        return
+    env = dict(os.environ)
+    env.update(QUEST_BENCH_QUBITS="20", QUEST_BENCH_DEPTH="4",
+               QUEST_BENCH_REPS="1", QUEST_BENCH_INNER="1")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--gate", benches[-1]],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=1800)
+        ok, out, err = r.returncode == 0, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        ok, out, err = False, "", f"TIMEOUT after {e.timeout}s"
+    secs = time.time() - t0
+    summary.append(("bench_gate", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'bench_gate':22s} {secs:7.1f}s")
+    if not ok:
+        print(out[-1500:])
+        print(err[-1500:])
+
+
 def main():
     rnd = sys.argv[1] if len(sys.argv) > 1 else "2"
     summary = []
@@ -64,6 +98,7 @@ def main():
         if not ok:
             print(out[-1500:])
             print(err[-1500:])
+    bench_gate_smoke(summary)
     n_fail = sum(1 for _, ok, _ in summary if not ok)
     print(f"{len(summary)} recorders, {n_fail} failed")
     sys.exit(1 if n_fail else 0)

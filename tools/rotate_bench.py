@@ -27,11 +27,11 @@ import math
 import os
 import statistics
 import sys
-import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+from quest_tpu import reporting  # noqa: E402
 
 N_QUBITS = int(os.environ.get("ROTATE_BENCH_QUBITS", "29"))
 N_TRIALS = int(os.environ.get("ROTATE_BENCH_TRIALS", "20"))
@@ -62,9 +62,9 @@ def main():
     sync()
     rtts = []
     for _ in range(10):
-        t0 = time.perf_counter()
+        t0 = reporting.stopwatch()
         sync()
-        rtts.append(time.perf_counter() - t0)
+        rtts.append(t0.seconds)
     tunnel_rtt_ms = round(statistics.mean(rtts) * 1e3, 2)
 
     per_target = []
@@ -74,17 +74,17 @@ def main():
         sync()
         synced = []
         for _ in range(N_TRIALS):
-            t0 = time.perf_counter()
+            t0 = reporting.stopwatch()
             qt.compact_unitary(q, target, alpha, beta)
             sync()
-            synced.append(time.perf_counter() - t0)
+            synced.append(t0.seconds)
         best = None
         for rep in range(2):  # rep 0 compiles the batched stream; time rep 1
-            t0 = time.perf_counter()
+            t0 = reporting.stopwatch()
             for _ in range(N_TRIALS):
                 qt.compact_unitary(q, target, alpha, beta)
             sync()
-            best = (time.perf_counter() - t0) / N_TRIALS
+            best = (t0.seconds) / N_TRIALS
         streamed = best
         per_target.append({
             "target": target,

@@ -1,0 +1,81 @@
+"""Top-k table over a per-item timeline capture (``timeline.json``).
+
+Summarises a Chrome-trace file produced by ``QUEST_TIMELINE=1`` /
+``stopTimelineCapture`` / ``metrics.write_timeline``: total walled
+device time, the per-kind aggregate (count, total, share), the
+exchange-byte attribution carried on relayout/bitswap items, and the
+top-k slowest individual items with their tags — the "which plan item
+is slow on device" answer without opening Perfetto.
+
+Usage: python tools/trace_view.py timeline.json [-k N]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def summarize(events: list[dict], top_k: int = 10) -> str:
+    total_us = sum(e.get("dur", 0.0) for e in events)
+    by_kind: dict = defaultdict(lambda: {"count": 0, "us": 0.0,
+                                         "bytes": 0})
+    for e in events:
+        k = by_kind[e.get("name", "?")]
+        k["count"] += 1
+        k["us"] += e.get("dur", 0.0)
+        k["bytes"] += int(e.get("args", {}).get("exchange_bytes", 0))
+    lines = [f"{len(events)} items, total device time "
+             f"{total_us / 1e6:.3f} s"]
+    lines.append(f"{'kind':<14}{'count':>7}{'total ms':>12}"
+                 f"{'share':>8}{'exch MB':>10}")
+    for name, k in sorted(by_kind.items(), key=lambda kv: -kv[1]["us"]):
+        share = k["us"] / total_us if total_us else 0.0
+        lines.append(f"{name:<14}{k['count']:>7}{k['us'] / 1e3:>12.2f}"
+                     f"{share:>8.1%}{k['bytes'] / 1e6:>10.2f}")
+    exch = sum(k["bytes"] for k in by_kind.values())
+    lines.append(f"exchange bytes (all items): {exch}")
+    lines.append(f"top {min(top_k, len(events))} items by device time:")
+    for e in sorted(events, key=lambda e: -e.get("dur", 0.0))[:top_k]:
+        args = e.get("args", {})
+        tags = ", ".join(f"{k}={args[k]}" for k in
+                         ("index", "ops", "targets", "high_bits",
+                          "comm_class", "exchange_bytes") if k in args)
+        lines.append(f"  {e.get('dur', 0.0) / 1e3:>10.2f} ms  "
+                     f"{e.get('name', '?'):<12} {tags}")
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    args = list(argv)
+    top_k = 10
+    if "-k" in args:
+        i = args.index("-k")
+        try:
+            top_k = int(args[i + 1])
+        except (IndexError, ValueError):
+            print(__doc__)
+            return 2
+        del args[i:i + 2]
+    if len(args) != 1:
+        print(__doc__)
+        return 2
+    try:
+        events = load_events(args[0])
+    except (OSError, ValueError, KeyError) as e:
+        print(f"trace-view: {args[0]}: {e}")
+        return 2
+    print(summarize(events, top_k=top_k))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
