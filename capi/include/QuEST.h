@@ -102,6 +102,24 @@ void getRunLedgerString(QuESTEnv env, char *str, int maxLen);
  * mode, not for production timing. */
 void startTimelineCapture(QuESTEnv env);
 int stopTimelineCapture(QuESTEnv env, char *path);
+/* quest_tpu extension: mid-run checkpointing (quest_tpu.resilience).
+ * setCheckpointEvery arms a process-wide policy: every `every`-th
+ * flushed gate run (the deferred-stream boundary an unmodified C
+ * driver naturally produces), the register state is snapshotted into
+ * `directory` after a passing health check — a two-slot
+ * write-temp-then-atomic-rename rotation, so a crash at any moment
+ * leaves one complete, checksummed snapshot.  every=0 or a NULL/empty
+ * directory disarms.  One directory serves ONE register: the rotation
+ * binds to the first register that snapshots into it; other
+ * registers' flushes are skipped (arm a directory per register).
+ * resumeRun restores the last-good snapshot into
+ * `qureg` (falling back to the older slot if the newest fails its
+ * integrity check) and returns the recorded position — the count of
+ * flushed gate runs already applied — so the driver can skip
+ * re-submitting them; exits with an error (like every QuEST
+ * validation failure) when no restorable snapshot exists. */
+void setCheckpointEvery(QuESTEnv env, const char *directory, int every);
+long long int resumeRun(Qureg qureg, const char *directory);
 void seedQuESTDefault(void);
 void seedQuEST(unsigned long int *seedArray, int numSeeds);
 

@@ -391,6 +391,20 @@ int stopTimelineCapture(QuESTEnv env, char *path) {
                             "stopTimelineCapture");
 }
 
+void setCheckpointEvery(QuESTEnv env, const char *directory, int every) {
+    (void)env;
+    BVOID("setCheckpointEvery", "(si)", directory ? directory : "",
+          every);
+}
+
+long long int resumeRun(Qureg qureg, const char *directory) {
+    long long pos = as_longlong(bcall("resumeRun", "(ls)", qh(qureg),
+                                      directory ? directory : ""),
+                                "resumeRun");
+    mirror(qureg); /* restore mutates the device state */
+    return pos;
+}
+
 void seedQuESTDefault(void) { BVOID("seedQuESTDefault", "()"); }
 
 void seedQuEST(unsigned long int *seedArray, int numSeeds) {

@@ -112,6 +112,15 @@ from .stateio import (
     restore_checkpoint,
 )
 from . import metrics
+from . import resilience
+from .resilience import (
+    set_fault_plan,
+    clear_fault_plan,
+    with_retries,
+    resume_run,
+    resume_state,
+    set_checkpoint_policy,
+)
 from . import reporting
 from .reporting import (
     report_qureg_params,
@@ -205,6 +214,8 @@ reportQuregParams = report_qureg_params
 reportStateToScreen = report_state_to_screen
 getEnvironmentString = get_environment_string
 getRunLedgerString = get_run_ledger_string
+setCheckpointEvery = set_checkpoint_policy
+resumeRun = resume_state
 startRecordingQASM = start_recording_qasm
 stopRecordingQASM = stop_recording_qasm
 clearRecordedQASM = clear_recorded_qasm

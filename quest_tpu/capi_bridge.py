@@ -203,6 +203,31 @@ def stopTimelineCapture(path: str) -> int:
     return len(doc["traceEvents"])
 
 
+def setCheckpointEvery(directory: str, every: int) -> int:
+    """Arm (or with every=0 / empty directory, disarm) the process-wide
+    mid-run checkpoint policy (quest_tpu.resilience): every k-th
+    flushed gate run snapshots the register into ``directory`` after a
+    passing health check — the C-driver face of
+    ``Circuit.run(checkpoint_dir=..., checkpoint_every=...)``."""
+    from . import resilience
+
+    resilience.set_checkpoint_policy(directory or None, every)
+    return 0
+
+
+def resumeRun(h: int, directory: str) -> int:
+    """Restore the last-good snapshot under ``directory`` into the
+    register (two-slot fallback on integrity failure) and return the
+    recorded position — flushed gate runs already applied — so the
+    driver can skip re-submitting them."""
+    from . import resilience
+
+    # only flush-kind snapshots reach here (resume_state refuses
+    # mid-run circuit snapshots), and only those carry flush_index
+    pos = resilience.resume_state(_q(h), directory)
+    return int(pos.get("flush_index", 0))
+
+
 def seedQuESTDefault() -> int:
     _qt.seed_quest_default()
     return 0

@@ -343,6 +343,24 @@ class Circuit:
                                 mesh=mesh)
         return re, im
 
+    def _nonunitary_observed(self, re, im, key, outcomes, op, mesh, cur):
+        """One measure/collapse step under an observed run's resume
+        cursor (quest_tpu.resilience): a step the cursor SKIPS was
+        already applied before the checkpoint being resumed, so the
+        restored state carries its collapse — a skipped ``measure``
+        replays its recorded outcome from the sidecar instead of
+        re-drawing, keeping both the outcomes vector and the fold-in
+        index (= len(outcomes)) identical to the uninterrupted run."""
+        if cur is not None and not cur.take():
+            if op[0] == "measure":
+                outcomes.append(jnp.asarray(cur.stored.pop(0), jnp.int32))
+            return re, im
+        re, im, out, _ = self._nonunitary_step(re, im, key,
+                                               len(outcomes), op, mesh)
+        if out is not None:
+            outcomes.append(out)
+        return re, im
+
     def _nonunitary_step(self, re, im, key, meas_ix, op, mesh):
         """Dispatch one recorded measure/collapse op; returns
         (re, im, outcome-or-None, consumed_randomness)."""
@@ -404,14 +422,16 @@ class Circuit:
                        and (i + 1 == len(ops) or ops[i + 1][0] in _nu)}
 
         def fn(re, im, key=None):
-            outcomes = []
+            cur = None
+            if item_hook is not None \
+                    and not isinstance(re, jax.core.Tracer):
+                cur = getattr(item_hook, "cursor", None)
+            outcomes = cur.outcomes if cur is not None else []
             for i, op in enumerate(ops):
                 kind, statics, scalars = op
                 if kind in ("measure", "collapse"):
-                    re, im, out, _ = self._nonunitary_step(
-                        re, im, key, len(outcomes), op, mesh)
-                    if out is not None:
-                        outcomes.append(out)
+                    re, im = self._nonunitary_observed(
+                        re, im, key, outcomes, op, mesh, cur)
                 elif _observing(re, item_hook):
                     from .parallel.mesh_exec import observe_item
 
@@ -548,15 +568,17 @@ class Circuit:
             return run_fns[0] or (lambda re, im: (re, im))
 
         def fn(re, im, key=None):
-            outcomes = []
+            cur = None
+            if item_hook is not None \
+                    and not isinstance(re, jax.core.Tracer):
+                cur = getattr(item_hook, "cursor", None)
+            outcomes = cur.outcomes if cur is not None else []
             for i, op in enumerate(nu_ops + [None]):
                 if run_fns[i] is not None:
                     re, im = run_fns[i](re, im)
                 if op is not None:
-                    re, im, out, _ = self._nonunitary_step(
-                        re, im, key, len(outcomes), op, mesh)
-                    if out is not None:
-                        outcomes.append(out)
+                    re, im = self._nonunitary_observed(
+                        re, im, key, outcomes, op, mesh, cur)
             return re, im, (jnp.stack(outcomes) if outcomes
                             else jnp.zeros((0,), jnp.int32))
 
@@ -796,20 +818,29 @@ class Circuit:
             sampler = call
         return sampler(key, shots)
 
-    def _observed_fn(self, qureg, pallas):
+    def _observed_fn(self, qureg, pallas, ckpt=None, resume=None,
+                     key=None):
         """Per-item EAGER executor for observed runs — timeline capture
-        (``QUEST_TIMELINE=1`` / ``startTimelineCapture``) or health
-        probes (``QUEST_HEALTH_EVERY=k``).  Each plan item dispatches
+        (``QUEST_TIMELINE=1`` / ``startTimelineCapture``), health
+        probes (``QUEST_HEALTH_EVERY=k``), or mid-run checkpointing /
+        resume (quest_tpu.resilience).  Each plan item dispatches
         separately so it can be walled with ``block_until_ready``
         (honest device time, not async dispatch latency) and probed at
         its boundary; the whole-program jit of :meth:`compile` is
         bypassed, so observed runs trade throughput for attribution —
         a diagnostic mode, never the default path.  Memoised per
         (mesh, pallas, ops) like compile(); the probe's drift baseline
-        re-anchors on the register's CURRENT state each run."""
+        re-anchors on the register's CURRENT state each run.
+
+        ``ckpt`` is the run's checkpoint config
+        (``{"directory", "every", "fingerprint"}``) and ``resume`` a
+        restored ``run_position`` sidecar: the run's cursor then skips
+        the already-applied items and replays recorded measurement
+        outcomes; ``key`` is the run's PRNG key, recorded into every
+        snapshot so the resumed run draws identical outcomes."""
         use_pallas = pallas is True or pallas == "auto"
-        key = ("observed", qureg.mesh, use_pallas, tuple(self.ops))
-        ent = self._compiled.get(key)
+        memo_key = ("observed", qureg.mesh, use_pallas, tuple(self.ops))
+        ent = self._compiled.get(memo_key)
         if ent is None:
             probe = _HealthProbe(self, qureg.mesh)
             if use_pallas:
@@ -820,14 +851,25 @@ class Circuit:
             else:
                 fn = self.as_fn(qureg.mesh, item_hook=probe)
             ent = (fn, probe)
-            self._compiled[key] = ent
+            self._compiled[memo_key] = ent
         fn, probe = ent
         probe.reset()
-        if metrics.health_every():
+        cursor = _RunCursor(
+            skip=int(resume["item_index"]) if resume else 0,
+            stored_outcomes=resume.get("outcomes", ()) if resume else (),
+            key=key)
+        probe.configure(ckpt=ckpt, cursor=cursor)
+        if resume:
+            # the restored slot is the run's current last-good snapshot
+            probe._last_snapshot = resume.get("slot")
+        if metrics.health_every() or ckpt is not None:
             probe.baseline(qureg.re, qureg.im)
         return fn
 
-    def run(self, qureg, pallas: str = "auto", key=None):
+    def run(self, qureg, pallas: str = "auto", key=None, *,
+            checkpoint_dir: str | None = None,
+            checkpoint_every: int | None = None,
+            _resume: dict | None = None):
         """Apply to a register (mutating facade, like the eager API).
 
         For circuits with recorded measurements, ``key`` (a jax PRNG key;
@@ -844,7 +886,41 @@ class Circuit:
         active (``QUEST_TIMELINE=1``, ``metrics.start_timeline`` or the
         C API's ``startTimelineCapture``) or health probes enabled
         (``QUEST_HEALTH_EVERY=k``), the run executes per plan item —
-        each item walled/probed — instead of as one jitted program."""
+        each item walled/probed — instead of as one jitted program.
+
+        Mid-run checkpointing (quest_tpu.resilience): with
+        ``checkpoint_dir`` + ``checkpoint_every=k`` (or the
+        ``QUEST_CKPT_DIR`` / ``QUEST_CKPT_EVERY`` env knobs /
+        ``setCheckpointEvery`` C API), the run also executes per plan
+        item and snapshots the state at every k-th item boundary after
+        a passing health check — a two-slot atomic rotation with a
+        ``run_position`` sidecar, so a run killed mid-plan resumes
+        bit-identically via ``resilience.resume_run`` (which supplies
+        ``_resume``, the restored position — not a user argument)."""
+        from . import resilience
+
+        ck_dir = (checkpoint_dir if checkpoint_dir is not None
+                  else resilience.checkpoint_dir())
+        ck_every = (checkpoint_every if checkpoint_every is not None
+                    else resilience.checkpoint_every())
+        # an EXPLICIT half-configuration must not silently run without
+        # checkpoints — that is the data-loss outcome the feature
+        # exists to prevent (env-only knobs stay lenient: a globally
+        # exported QUEST_CKPT_DIR with no cadence means "off")
+        if checkpoint_dir is not None and not ck_every:
+            raise _v.QuESTError(
+                "Circuit.run: checkpoint_dir given without a cadence — "
+                "pass checkpoint_every=k (or set QUEST_CKPT_EVERY)")
+        if checkpoint_every and not ck_dir:
+            raise _v.QuESTError(
+                "Circuit.run: checkpoint_every given without a "
+                "directory — pass checkpoint_dir (or set "
+                "QUEST_CKPT_DIR)")
+        ckpt = None
+        if ck_dir and ck_every:
+            ckpt = {"directory": ck_dir, "every": int(ck_every),
+                    "fingerprint": resilience.plan_fingerprint(
+                        self, qureg, pallas)}
         with metrics.run_ledger("circuit_run"):
             metrics.annotate_run("num_qubits", self.num_qubits)
             metrics.annotate_run("is_density", self.is_density)
@@ -852,23 +928,30 @@ class Circuit:
                 "num_devices",
                 1 if qureg.mesh is None else int(qureg.mesh.devices.size))
             observed = (metrics.timeline_active()
-                        or metrics.health_every() > 0)
+                        or metrics.health_every() > 0
+                        or ckpt is not None or _resume is not None)
             if observed:
                 metrics.annotate_run("observed", True)
+            draws = self._has_nonunitary and self.num_measurements > 0
+            if draws and key is None:
+                if _resume is not None and _resume.get("key") is not None:
+                    # continue with the interrupted run's exact key so
+                    # the remaining measurements draw identically
+                    key = resilience.decode_prng_key(_resume["key"])
+                else:
+                    from .env import default_measure_key
+
+                    key = default_measure_key()
             with metrics.span("compile"):
                 if observed:
-                    fn = self._observed_fn(qureg, pallas)
+                    fn = self._observed_fn(qureg, pallas, ckpt=ckpt,
+                                           resume=_resume, key=key)
                 else:
                     fn = self.compile(mesh=qureg.mesh, donate=False,
                                       pallas=pallas)
             self._record_run_stats(qureg, pallas)
             with metrics.span("execute"):
                 if self._has_nonunitary:
-                    draws = self.num_measurements > 0
-                    if key is None and draws:
-                        from .env import default_measure_key
-
-                        key = default_measure_key()
                     re, im, outcomes = fn(qureg.re, qureg.im, key)
                     qureg._set(re, im)
                     # collapse-only circuits consume no randomness and
@@ -902,25 +985,68 @@ class Circuit:
                                 st["exchange_elems"] * itemsize)
 
 
-class _HealthProbe:
-    """Numerical health probes at plan-item boundaries of an observed
-    :meth:`Circuit.run` (``QUEST_HEALTH_EVERY=k``).
+class _RunCursor:
+    """Deterministic item cursor of one observed run
+    (quest_tpu.resilience checkpoint/resume).
 
-    Every k-th executed item, checks the produced state for NaN/Inf and
-    for norm drift (state-vectors) or trace + hermiticity drift
-    (density matrices) — the compiled-circuit generalisation of the
-    eager path's ``QUEST_DEBUG_NORM`` guardrail in ``register.py``.  A
-    tripped probe dumps the flight recorder (``metrics.flight_dump``)
-    with the offending item identified — with k=1 the exact injecting
-    item, else the k-item window since the last healthy probe — and
-    raises, so a poisoned state is caught at the item where it appears
-    instead of thousands of ops later in a soak run.  Each probe costs
-    one or two reductions (plus a transpose for hermiticity); the knob
-    is opt-in for exactly that reason."""
+    Every executed unit — gate-run plan items (via
+    ``mesh_exec.observe_item``) and measure/collapse steps (via
+    ``Circuit._nonunitary_observed``) — passes through :meth:`take`
+    exactly once, in the executor's deterministic order, so
+    ``executed`` IS the run position a snapshot records.  On resume the
+    first ``skip`` takes return False: those items were applied before
+    the checkpoint and must pass through untouched, with skipped
+    measurements replaying their recorded outcomes from ``stored``.
+    ``outcomes`` is the run's LIVE outcomes list (the checkpoint hook
+    snapshots it into the sidecar); ``key`` the run's PRNG key."""
+
+    __slots__ = ("executed", "skip", "stored", "outcomes", "key")
+
+    def __init__(self, skip: int = 0, stored_outcomes=(), key=None):
+        self.executed = 0
+        self.skip = int(skip)
+        self.stored = [int(x) for x in stored_outcomes]
+        self.outcomes: list = []
+        self.key = key
+
+    def take(self) -> bool:
+        """Count this item; True when it should actually execute."""
+        i = self.executed
+        self.executed += 1
+        return i >= self.skip
+
+
+class _HealthProbe:
+    """Numerical health probes — and mid-run checkpoints — at plan-item
+    boundaries of an observed :meth:`Circuit.run`.
+
+    Health (``QUEST_HEALTH_EVERY=k``): every k-th executed item, checks
+    the produced state for NaN/Inf and for norm drift (state-vectors)
+    or trace + hermiticity drift (density matrices) — the
+    compiled-circuit generalisation of the eager path's
+    ``QUEST_DEBUG_NORM`` guardrail in ``register.py``.  A tripped probe
+    dumps the flight recorder (``metrics.flight_dump``) with the
+    offending item identified — with k=1 the exact injecting item, else
+    the k-item window since the last healthy probe — and raises, so a
+    poisoned state is caught at the item where it appears instead of
+    thousands of ops later in a soak run.  Each probe costs one or two
+    reductions (plus a transpose for hermiticity); the knob is opt-in
+    for exactly that reason.
+
+    Checkpointing (``Circuit.run(checkpoint_dir=..., checkpoint_every=
+    k)`` / ``QUEST_CKPT_EVERY``): every k-th item boundary ALSO runs
+    the shared health check and, only when it passes, writes a two-slot
+    snapshot (``resilience.snapshot``) with the run position sidecar —
+    a poisoned state must never overwrite a good checkpoint.  On a
+    checkpointed run, a tripped probe names the last-good snapshot in
+    its error so the operator knows exactly where to resume from."""
 
     def __init__(self, circuit: "Circuit", mesh):
         self._c = circuit
         self._mesh = mesh
+        self.cursor = None
+        self._ckpt = None
+        self._last_snapshot = None
         self.reset()
 
     def reset(self) -> None:
@@ -929,19 +1055,55 @@ class _HealthProbe:
         self._ref = None          # norm/trace at the last healthy probe
         self._last_healthy = None
 
+    def configure(self, ckpt: dict | None = None,
+                  cursor: "_RunCursor | None" = None) -> None:
+        """Per-run resilience config (set by ``Circuit.run`` before
+        execution): ``ckpt`` = ``{"directory", "every", "fingerprint"}``
+        or None, ``cursor`` = the run's :class:`_RunCursor`."""
+        self._ckpt = ckpt
+        self.cursor = cursor
+        self._last_snapshot = None
+
     def baseline(self, re, im) -> None:
         """Anchor the drift reference on the register's pre-run state
         (a run may start from any state, not just norm 1)."""
         self._ref = measure_state_weight(re, im, self._c.is_density,
                                          self._c.num_qubits, self._mesh)
 
+    def _snapshot(self, re, im) -> None:
+        from . import resilience
+
+        ck = self._ckpt
+        cur = self.cursor
+        pos = {
+            "format_version": 1,
+            "kind": "circuit_run",
+            "fingerprint": ck["fingerprint"],
+            "item_index": cur.executed if cur is not None else self._count,
+            "every": ck["every"],
+            "key": resilience.encode_prng_key(
+                None if cur is None else cur.key),
+            "outcomes": [int(x) for x in
+                         (cur.outcomes if cur is not None else [])],
+        }
+        path = resilience.snapshot(
+            re, im, num_qubits=self._c.num_qubits,
+            is_density=self._c.is_density, mesh=self._mesh,
+            directory=ck["directory"], position=pos,
+            owner=f"circuit:{ck['fingerprint']}")
+        if path is not None:  # None: directory owned by another writer
+            self._last_snapshot = path
+
     def __call__(self, re, im, meta: dict) -> None:
         k = metrics.health_every()
-        if not k:
+        ck = self._ckpt
+        if not k and ck is None:
             return
         self._count += 1
         self._ops_since += int(meta.get("ops", 1))
-        if self._count % k:
+        probe_due = bool(k) and self._count % k == 0
+        ckpt_due = ck is not None and self._count % ck["every"] == 0
+        if not (probe_due or ckpt_due):
             return
         # Trace and hermiticity are only meaningful where the density
         # U (x) U* pair is complete AND the mesh layout is canonical —
@@ -961,14 +1123,23 @@ class _HealthProbe:
                 self._ops_since = 0
             self._last_healthy = {"index": meta.get("index"),
                                   "kind": meta.get("kind")}
+            if ckpt_due:
+                self._snapshot(re, im)
             return
         offending = {"item": dict(meta),
-                     "window_items": k,
+                     "window_items": k or ck["every"],
                      "last_healthy": self._last_healthy}
         path = metrics.flight_dump(f"health probe tripped: {reason}",
                                    offending=offending)
-        raise _v.QuESTError(
+        msg = (
             f"QUEST_HEALTH_EVERY probe tripped after plan item "
             f"{meta.get('index')} ({meta.get('kind')}): {reason}"
             + (f"; flight recorder dumped to {path}" if path else
                " (flight-recorder dump failed; see metrics.sink_errors)"))
+        if ck is not None:
+            msg += (f"; last-good checkpoint: {self._last_snapshot} "
+                    "(resume with resilience.resume_run)"
+                    if self._last_snapshot else
+                    f"; no checkpoint written yet under "
+                    f"{ck['directory']}")
+        raise _v.QuESTError(msg)

@@ -245,29 +245,62 @@ def run_ledger(label: str = "run"):
             _finalize(rec, wall)
 
 
-#: Sinks that already warned once (a full disk must not spam one line
-#: per run; the ``metrics.sink_errors`` counter keeps the exact count).
+#: Warning kinds already emitted once (a full disk must not spam one
+#: line per run; counters keep the exact counts).
 _SINK_WARNED: set = set()
 
 
-def _sink_write(kind: str, path: str, text: str, mode: str = "a") -> bool:
-    """Write ``text`` to a metrics sink, degrading on failure.
+def warn_once(kind: str, msg: str) -> None:
+    """Print ``msg`` to stderr AT MOST ONCE per ``kind`` for the
+    process.  The sanctioned degradation warning for every subsystem
+    under the instrumentation lint (metrics sinks, corrupt AOT cache
+    artifacts): repeated failures are counted, not printed."""
+    with _lock:
+        if kind in _SINK_WARNED:
+            return
+        _SINK_WARNED.add(kind)
+    print(f"quest-tpu: {msg}", file=sys.stderr, flush=True)
 
+
+def _sink_write(kind: str, path: str, text: str, mode: str = "a") -> bool:
+    """Write ``text`` to a metrics sink, retrying then degrading.
+
+    Transient OPEN failures get the bounded deterministic retry of the
+    ``sink_write`` seam (``resilience.with_retries`` — also the hook
+    scripted sink faults inject through).  The write itself is never
+    retried: an append that failed mid-write may already have landed a
+    partial line, and re-appending would glue a fragment to a
+    duplicate full record — with_retries is for idempotent I/O only.
     An unwritable / disappearing sink file (or a full disk) must never
-    crash the run it was observing: the failure becomes a one-shot
+    crash the run it was observing, so any failure becomes a one-shot
     stderr warning per sink kind plus a ``metrics.sink_errors``
-    process counter, and the caller's run proceeds untouched."""
+    process counter, and the caller's run proceeds untouched.  A sink
+    that ALREADY degraded gets one plain attempt per write — no retry
+    budget, no backoff sleeps: a full disk must not tax every
+    subsequent run, but a recovered sink resumes immediately."""
+    from . import resilience  # deferred: resilience imports metrics
+
     try:
-        with open(path, mode) as f:
+        if kind in _SINK_WARNED:
+            f = open(path, mode)
+        else:
+            f = resilience.with_retries(lambda: open(path, mode),
+                                        seam="sink_write",
+                                        retry_on=(OSError, ValueError))
+        try:
             f.write(text)
+        finally:
+            f.close()
         return True
-    except (OSError, ValueError) as e:  # ValueError: write to closed fd
+    except Exception as e:
+        # broader than (OSError, ValueError) — ValueError covers a
+        # closed fd, but a scripted 'runtime' fault at the sink_write
+        # seam (or any exotic I/O failure) must ALSO degrade: a sink
+        # must never crash the run it was observing
         counter_inc("metrics.sink_errors")
-        if kind not in _SINK_WARNED:
-            _SINK_WARNED.add(kind)
-            print(f"quest-tpu: {kind} sink {path!r} failed ({e}); "
+        warn_once(kind, f"{kind} sink {path!r} failed ({e}); "
                   "degrading silently (metrics.sink_errors counts "
-                  "further failures)", file=sys.stderr, flush=True)
+                  "further failures)")
         return False
 
 

@@ -371,7 +371,31 @@ def observe_item(f, re, im, meta: dict, hook=None):
     timeline (``block_until_ready`` makes the duration honest device
     time), append a flight-recorder entry, and invoke the caller's
     health ``hook`` on the produced state.  Only reached when the
-    caller verified the arrays are concrete (never under a trace)."""
+    caller verified the arrays are concrete (never under a trace).
+
+    Two resilience integrations (quest_tpu.resilience):
+
+    * **Resume cursor** — a ``hook`` carrying a ``cursor`` has every
+      item pass through ``cursor.take()`` in deterministic plan order;
+      an item the cursor says to SKIP (already applied before the
+      checkpoint being resumed) returns the state untouched, with no
+      flight/timeline/hook activity.
+    * **Fault seams** — ``run_item`` fires on every observed item (the
+      only seam supporting ``nan`` injection: the scripted item's
+      output amplitude [0, 0] is poisoned AFTER it executes, upstream
+      of the health hook that should catch it), and ``mesh_exchange``
+      additionally fires on items that move data over the interconnect
+      (comm class half/full/relayout)."""
+    from .. import resilience
+
+    cur = getattr(hook, "cursor", None) if hook is not None else None
+    if cur is not None and not cur.take():
+        return re, im
+    poison = None
+    if resilience.fault_active():
+        if meta.get("comm_class") in ("half", "full", "relayout"):
+            resilience.fault_point("mesh_exchange")
+        poison = resilience.fault_point("run_item")
     itemsize = jnp.dtype(re.dtype).itemsize
     args = dict(meta)
     kind = args.pop("kind")
@@ -386,6 +410,8 @@ def observe_item(f, re, im, meta: dict, hook=None):
             jax.block_until_ready((re, im))
     else:
         re, im = f(re, im)
+    if poison == "nan":
+        re = re.at[(0,) * re.ndim].set(float("nan"))
     if hook is not None:
         hook(re, im, dict(meta, exchange_bytes=elems * itemsize))
     return re, im

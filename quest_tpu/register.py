@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from . import metrics
 from . import precision
 from . import qasm
+from . import resilience
 from .env import QuESTEnv
 from .ops.lattice import amp_sharding, lru_get, state_shape
 from .validation import (
@@ -75,7 +76,7 @@ class Qureg:
     """
 
     __slots__ = ("_re", "_im", "num_qubits", "is_density", "mesh", "qasm",
-                 "_pending", "_readout", "_struct_history")
+                 "_pending", "_readout", "_struct_history", "_res_uid")
 
     def __init__(self, re, im, num_qubits: int, is_density: bool, mesh):
         self._re = re
@@ -84,6 +85,7 @@ class Qureg:
         self.is_density = is_density
         self.mesh = mesh
         self.qasm = None  # attached by quest_tpu.qasm on creation
+        self._res_uid = None  # lazily assigned by quest_tpu.resilience
         self._pending = []
         # Sweep-detection history (see _is_sweep), hung off the instance
         # so a recycled id() can never inherit another register's history.
@@ -292,6 +294,11 @@ class Qureg:
         if norm0 is not None:
             self._norm_check(jax, "gate", n_run, norm0)
         self._health_probe(h_before, n_run)
+        # Eager-path checkpoint cadence (setCheckpointEvery /
+        # QUEST_CKPT_EVERY + QUEST_CKPT_DIR): every k-th flushed gate
+        # run snapshots the register after its own health check — the
+        # C-driver analogue of Circuit.run's per-item checkpointing.
+        resilience.maybe_eager_checkpoint(self)
 
     def _run_gates_inner(self, jax, run, run_kernel_donated) -> None:
         # Fused Pallas needs tile-aligned (>= (8, 128)) chunks and f32
@@ -337,6 +344,7 @@ class Qureg:
                 fn = _stream_fn(ops, self.num_vec_qubits, self.mesh,
                                 self._re.dtype)
                 _trace("stream dispatch")
+                resilience.fault_point("stream_dispatch")
                 metrics.counter_inc("exec.gates", len(ops))
                 metrics.flight_record(
                     "stream", ops=len(ops), shape=list(self._re.shape),
@@ -357,6 +365,12 @@ class Qureg:
                 # Requeue so the gates aren't silently dropped: a retry
                 # either succeeds or raises jax's deleted-donated-buffer
                 # error, never silently yields the pre-gate state.
+                # Deliberately NOT resilience.with_retries: a failed
+                # donated dispatch may have consumed its input buffers,
+                # so blind re-execution is unsafe — requeue-and-raise is
+                # the correct semantics here (the retryable seams are
+                # the idempotent I/O ones; tests/test_resilience.py
+                # pins this contract via the stream_dispatch seam).
                 self._pending = list(ops) + self._pending
                 raise
         else:
@@ -382,10 +396,13 @@ class Qureg:
                     while run:
                         kind, statics, scalars = run[0]
                         try:
+                            resilience.fault_point("stream_dispatch")
                             self._re, self._im = run_kernel_donated(
                                 (self._re, self._im), scalars, kind=kind,
                                 statics=statics, mesh=self.mesh)
                         except Exception:
+                            # requeue the unapplied tail — same no-retry
+                            # policy as the fused branch above
                             self._pending = run + self._pending
                             raise
                         del run[0]
@@ -562,19 +579,69 @@ def _code_fingerprint() -> str:
     return _CODE_FP
 
 
+def _aot_quarantine(path: str, why: str) -> None:
+    """A corrupt/stale AOT cache artifact must never crash (or silently
+    slow) the run: warn once, count it, remove the blob + sidecar so
+    the next save rebuilds them, and let the caller fall through to a
+    fresh compile."""
+    metrics.counter_inc("aot.corrupt_artifacts")
+    metrics.warn_once(
+        "aot_corrupt",
+        f"corrupt AOT cache artifact {path!r} ({why}); rebuilding — "
+        "aot.corrupt_artifacts counts further ones")
+    import os
+
+    for victim in (path, path + ".meta"):
+        try:
+            os.remove(victim)
+        except OSError:
+            pass
+
+
 def _aot_load_path(path: str):
-    """Deserialize + device-load one blob file, or None on any failure."""
+    """Deserialize + device-load one blob file, or None on any failure.
+
+    Transient read errors get the bounded ``aot_load`` retry seam —
+    but a MISSING blob is a deterministic cache miss (another process's
+    32-blob trim can race the caller's existence check), not a
+    transient fault, so it returns immediately with no backoff sleeps.
+    An unreadable-after-retries file degrades silently (recompile
+    serves), while a CORRUPT artifact (unpicklable, or one the runtime
+    cannot deserialize) is quarantined — warned once, counted, removed
+    — instead of crashing the run or resurfacing every process start."""
     import pickle
 
+    class _Missing(Exception):
+        pass
+
+    def read():
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError as e:
+            raise _Missing from e  # cache miss: never retried
+        with f:
+            return pickle.load(f)
+
+    try:
+        blob, in_tree, out_tree = resilience.with_retries(
+            read, seam="aot_load")
+    except _Missing:
+        return None  # trimmed from under us: a plain miss, recompile
+    except OSError:
+        return None  # transient I/O exhausted its budget: recompile
+    except Exception as e:
+        _aot_quarantine(path, f"unreadable pickle: {type(e).__name__}")
+        return None
     try:
         from jax.experimental.serialize_executable import (
             deserialize_and_load,
         )
 
-        blob, in_tree, out_tree = pickle.load(open(path, "rb"))
         return deserialize_and_load(blob, in_tree, out_tree)
-    except Exception:
-        return None  # stale/incompatible blob: fall through to compile
+    except Exception as e:
+        _aot_quarantine(path, f"undeserializable executable: "
+                        f"{type(e).__name__}")
+        return None
 
 
 #: (path, thread, holder) of an in-flight speculative blob load.
@@ -832,17 +899,27 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
         from jax.experimental.serialize_executable import serialize
 
         blob, in_tree, out_tree = serialize(compiled)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump((blob, in_tree, out_tree), f)
-        os.replace(tmp, path)
-        # sidecar enabling speculative re-EXECUTION next process run
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump((ops, num_vec_qubits,
-                         jnp.dtype(dtype).name,
-                         jax.default_backend()), f)
-        os.replace(tmp, path + ".meta")
+
+        def write_blob():
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump((blob, in_tree, out_tree), f)
+            os.replace(tmp, path)
+
+        def write_meta():
+            # sidecar enabling speculative re-EXECUTION next process run
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump((ops, num_vec_qubits,
+                             jnp.dtype(dtype).name,
+                             jax.default_backend()), f)
+            os.replace(tmp, path + ".meta")
+
+        # cache writes are idempotent temp+rename: transient I/O gets
+        # the bounded aot_save retry seam before the outer best-effort
+        # degradation swallows a persistent failure
+        resilience.with_retries(write_blob, seam="aot_save")
+        resilience.with_retries(write_meta, seam="aot_save")
         # bound the cache: blobs are ~20 MB each; keep the newest 32
         # (.meta sidecars travel with their blob, not counted)
         d = os.path.dirname(path)

@@ -1,0 +1,674 @@
+"""Resilience: deterministic fault injection, bounded retries, and
+mid-run checkpoint/resume.
+
+The detection half of fault tolerance landed with the observability
+layer (health probes, flight recorder — ``quest_tpu.metrics``,
+``docs/OBSERVABILITY.md``).  This module is the RECOVERY half: the
+checkpoint/restore/retry discipline JAX training stacks rely on
+(Orbax-style atomic, sharding-preserving snapshots), applied to the
+QuEST execution model — because on a pod, preemption is routine and a
+34-qubit register is minutes of accumulated unitary work that must not
+die with the process.
+
+Three subsystems:
+
+* **Deterministic fault injection** — ``fault_point(name)`` seams at
+  every recoverable I/O boundary (see :data:`SEAMS`), scripted by a
+  fault *plan* (``QUEST_FAULT_PLAN`` env var or
+  :func:`set_fault_plan`).  Each plan entry names a seam, the hit index
+  at which it fires, and the fault kind (``io`` -> :class:`OSError`,
+  ``runtime`` -> :class:`RuntimeError`, ``nan`` -> NaN injected into
+  the state at the ``run_item`` seam).  No randomness anywhere: a seam
+  fires on exactly the scripted invocation, so every chaos drill is
+  bit-reproducible.  Disabled (the default), a seam is one dict lookup
+  — nothing on the jitted hot path ever calls one.
+
+* **Bounded deterministic retries** — :func:`with_retries` wraps the
+  IDEMPOTENT I/O seams only (AOT cache load/save, checkpoint I/O,
+  metrics sinks) with a fixed exponential backoff (no jitter) and the
+  ``resilience.retries`` / ``resilience.gave_up`` ledger counters.
+  Donated-buffer gate dispatch is explicitly NOT retried: a failed
+  stream dispatch may have consumed its donated buffers, so the correct
+  semantics is the existing requeue in ``Qureg._run_gates_inner``
+  (quest_tpu/register.py) — the ops stay queued and the next flush
+  either applies them or raises jax's deleted-buffer error, never
+  silently yielding the pre-gate state.
+
+* **Mid-run checkpoint/resume** — ``QUEST_CKPT_EVERY=k`` (or
+  ``Circuit.run(checkpoint_dir=..., checkpoint_every=k)``) snapshots
+  the state at every k-th plan-item boundary of an observed run, after
+  a passing health check: a two-slot write-temp-then-atomic-rename
+  rotation (:func:`snapshot`), a ``run_position`` sidecar (plan
+  fingerprint, item index, RNG key, measurement outcomes so far) and
+  per-array checksums in the ``qureg.json`` metadata
+  (``quest_tpu.stateio``, format_version 2).  :func:`resume_run`
+  validates the fingerprint against the circuit and register, restores
+  the last-good slot (falling back to the other slot when the latest
+  fails its integrity check) and replays ONLY the remaining items —
+  bit-identical to the uninterrupted run, which ``tools/chaos_drill.py``
+  asserts under a whole fault matrix.
+
+NOTE mid-run snapshots are RESUME POSITIONS, not canonical states: on a
+mesh, a plan item boundary may hold the register in a relabelled qubit
+layout that only the remaining plan items restore.  Resume them with
+:func:`resume_run` (which replays those items); only the eager-path
+snapshots (flush boundaries, always canonical) are safe to restore as
+final states via :func:`resume_state`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+
+from . import metrics
+from .validation import QuESTError
+
+#: Every fault seam wired into the codebase.  The instrumentation lint
+#: (tests/test_metrics.py) asserts the call sites reference EXACTLY
+#: this set, so a typo'd seam name — or a declared seam nothing calls —
+#: fails the suite.
+SEAMS = frozenset({
+    "aot_load",        # register._aot_load_path: AOT blob read
+    "aot_save",        # register._aot_save: AOT blob/sidecar write
+    "ckpt_save",       # stateio._write_snapshot: orbax save + metadata
+    "ckpt_load",       # stateio.restore_checkpoint: orbax restore
+    "sink_write",      # metrics._sink_write: ledger/timeline/flight sinks
+    "mesh_exchange",   # mesh_exec.observe_item: items with communication
+    "run_item",        # mesh_exec.observe_item: every observed plan item
+    "stream_dispatch",  # register._run_gates_inner: donated gate dispatch
+})
+
+#: Fault kinds a plan entry may script.
+KINDS = ("io", "runtime", "nan")
+
+#: Per-seam bounded retry budget (attempts AFTER the first).  Sinks are
+#: best-effort (they already degrade), so one retry; checkpoint I/O is
+#: the recovery path itself, so it tries hardest.  This table IS the
+#: retry policy — docs/ROBUSTNESS.md reproduces it.
+RETRY_POLICY = {
+    "aot_load": 2,
+    "aot_save": 2,
+    "ckpt_save": 3,
+    "ckpt_load": 3,
+    "sink_write": 1,
+}
+
+#: Backoff base delay in seconds; attempt i sleeps base * 2^(i-1) —
+#: deterministic, no jitter (a drill must reproduce exactly).
+RETRY_BASE_DELAY = 0.02
+
+#: Two-slot rotation directory names inside a checkpoint directory.
+SLOTS = ("slot-0", "slot-1")
+_POINTER = "latest"
+
+_lock = threading.Lock()
+_plan: list[tuple[str, int, str]] | None = None     # programmatic plan
+_env_plan: tuple[str, list] | None = None            # (raw, parsed) cache
+_hits: dict[str, int] = {}
+
+#: Process-wide checkpoint policy set by the C API's setCheckpointEvery
+#: (env vars QUEST_CKPT_DIR / QUEST_CKPT_EVERY serve unmodified
+#: drivers; the programmatic policy wins when set).
+_policy = {"directory": None, "every": 0}
+
+#: Eager-path checkpoint bookkeeping (register._run_gates ->
+#: maybe_eager_checkpoint): flush counts are PER REGISTER (a lazily
+#: assigned uid on the Qureg instance).
+_uid_counter = [0]
+_eager_flush_counts: dict[int, int] = {}
+
+#: Checkpoint-directory ownership: each directory is BOUND to the
+#: first owner token that snapshots into it (an eager register's uid,
+#: or a Circuit.run plan fingerprint).  Two writers — two same-geometry
+#: registers under one armed policy, or an eager driver plus a
+#: Circuit.run sharing QUEST_CKPT_DIR — must never interleave their
+#: states into one two-slot rotation, where a later resume would
+#: restore whichever happened to write last (or find a rotation whose
+#: two slots refuse under different resume paths).
+_dir_owners: dict[str, str] = {}
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection
+# ---------------------------------------------------------------------------
+
+
+def _parse_plan(spec) -> list[tuple[str, int, str]]:
+    """Normalise a fault plan: a ``"seam:hit:kind[,...]"`` string (the
+    ``QUEST_FAULT_PLAN`` format; ``;`` also separates entries) or an
+    iterable of ``(seam, hit, kind)`` triples / dicts."""
+    entries = []
+    if isinstance(spec, str):
+        parts = [p for chunk in spec.split(";") for p in chunk.split(",")]
+        for part in parts:
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            if len(bits) != 3:
+                raise QuESTError(
+                    f"bad fault-plan entry {part!r}: want seam:hit:kind")
+            entries.append((bits[0], bits[1], bits[2]))
+    else:
+        for e in spec:
+            if isinstance(e, dict):
+                entries.append((e.get("seam"), e.get("hit"), e.get("kind")))
+            else:
+                entries.append(tuple(e))
+    plan = []
+    for seam, hit, kind in entries:
+        if seam not in SEAMS:
+            raise QuESTError(
+                f"unknown fault seam {seam!r}; seams: {sorted(SEAMS)}")
+        if kind not in KINDS:
+            raise QuESTError(
+                f"unknown fault kind {kind!r}; kinds: {list(KINDS)}")
+        try:
+            hit = int(hit)
+        except (TypeError, ValueError):
+            raise QuESTError(f"fault hit index must be an integer, got "
+                             f"{hit!r}")
+        if hit < 0:
+            raise QuESTError(f"fault hit index must be >= 0, got {hit}")
+        plan.append((seam, hit, kind))
+    return plan
+
+
+def set_fault_plan(plan) -> None:
+    """Install a scripted fault plan (see :func:`fault_point`) and zero
+    the per-seam hit counters, so drills are reproducible from a known
+    origin.  ``plan`` is a spec string or an iterable of
+    ``(seam, hit, kind)``; ``None`` clears."""
+    global _plan
+    parsed = None if plan is None else _parse_plan(plan)
+    with _lock:
+        _plan = parsed
+        _hits.clear()
+
+
+def clear_fault_plan() -> None:
+    """Remove any programmatic fault plan and zero the hit counters
+    (the ``QUEST_FAULT_PLAN`` env var, if set, stays live)."""
+    set_fault_plan(None)
+
+
+def fault_active() -> bool:
+    """True when any fault plan (programmatic or env) is installed —
+    the cheap gate callers may use to skip per-item seam bookkeeping."""
+    return _plan is not None or bool(os.environ.get("QUEST_FAULT_PLAN"))
+
+
+def fault_hits() -> dict:
+    """Snapshot of the per-seam invocation counters (test hook)."""
+    with _lock:
+        return dict(_hits)
+
+
+def _current_plan() -> list:
+    global _env_plan
+    if _plan is not None:
+        return _plan
+    raw = os.environ.get("QUEST_FAULT_PLAN", "")
+    if not raw:
+        return []
+    if _env_plan is None or _env_plan[0] != raw:
+        # a NEW env plan re-anchors the hit counters, so the scripted
+        # hit indices always count from the plan's installation
+        parsed = _parse_plan(raw)
+        with _lock:
+            _env_plan = (raw, parsed)
+            _hits.clear()
+    return _env_plan[1]
+
+
+def fault_point(name: str) -> str | None:
+    """One deterministic fault seam.
+
+    Counts this invocation of seam ``name``; when the active fault plan
+    scripts a fault at exactly this hit index, it fires:
+    ``io`` raises :class:`OSError`, ``runtime`` raises
+    :class:`RuntimeError` (both naming the seam and hit), and ``nan``
+    RETURNS ``"nan"`` — the caller poisons the state it owns (only the
+    ``run_item`` seam supports injection; other seams treat it as
+    ``runtime``).  With no plan installed this is a single dict lookup
+    and returns None."""
+    if _plan is None and not os.environ.get("QUEST_FAULT_PLAN"):
+        return None
+    plan = _current_plan()
+    with _lock:
+        idx = _hits.get(name, 0)
+        _hits[name] = idx + 1
+    fired = None
+    for seam, hit, kind in plan:
+        if seam == name and hit == idx:
+            fired = kind
+            break
+    if fired is None:
+        return None
+    metrics.counter_inc("resilience.faults_injected")
+    metrics.trace(f"fault injected at seam {name!r} hit {idx} ({fired})")
+    if fired == "nan" and name == "run_item":
+        return "nan"
+    if fired == "io":
+        raise OSError(f"scripted fault at seam {name!r} (hit {idx})")
+    raise RuntimeError(f"scripted fault at seam {name!r} (hit {idx})")
+
+
+# ---------------------------------------------------------------------------
+# Bounded deterministic retries (idempotent I/O seams only)
+# ---------------------------------------------------------------------------
+
+
+def with_retries(fn, *, seam: str, retries: int | None = None,
+                 base_delay: float | None = None,
+                 retry_on: tuple = (OSError,)):
+    """Run ``fn`` with up to ``retries`` retried attempts and a fixed
+    exponential backoff (``base_delay * 2^(i-1)`` before retry i — no
+    jitter, so failure drills reproduce exactly).
+
+    Every attempt first passes ``fault_point(seam)``, so a scripted
+    transient fault exercises the retry path deterministically.  Each
+    retry bumps the ``resilience.retries`` counter; exhausting the
+    budget bumps ``resilience.gave_up`` and re-raises the last error.
+
+    ONLY for idempotent I/O (the :data:`RETRY_POLICY` seams): re-running
+    a file read/write is safe, re-running a donated-buffer gate dispatch
+    is not (see the module docstring — that path requeues instead)."""
+    if seam not in SEAMS:
+        raise QuESTError(f"unknown retry seam {seam!r}")
+    n = RETRY_POLICY.get(seam, 2) if retries is None else int(retries)
+    base = RETRY_BASE_DELAY if base_delay is None else float(base_delay)
+    last = None
+    for attempt in range(n + 1):
+        if attempt:
+            metrics.counter_inc("resilience.retries")
+            time.sleep(base * (1 << (attempt - 1)))
+        try:
+            fault_point(seam)
+            return fn()
+        except retry_on as e:
+            last = e
+    metrics.counter_inc("resilience.gave_up")
+    raise last
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint policy + two-slot snapshot rotation
+# ---------------------------------------------------------------------------
+
+
+def set_checkpoint_policy(directory: str | None, every: int) -> None:
+    """Process-wide mid-run checkpoint policy (the C API's
+    ``setCheckpointEvery``): snapshot every ``every``-th boundary into
+    ``directory``.  ``every=0`` or an empty directory disables.  The
+    env knobs ``QUEST_CKPT_DIR`` / ``QUEST_CKPT_EVERY`` serve the same
+    role for unmodified drivers; the programmatic policy wins."""
+    _policy["directory"] = directory or None
+    _policy["every"] = max(0, int(every)) if directory else 0
+
+
+def checkpoint_dir() -> str | None:
+    """The active checkpoint directory (programmatic policy, else
+    ``QUEST_CKPT_DIR``), or None."""
+    return _policy["directory"] or os.environ.get("QUEST_CKPT_DIR") or None
+
+
+def checkpoint_every() -> int:
+    """The active snapshot cadence in plan items / flushed gate runs
+    (programmatic policy, else ``QUEST_CKPT_EVERY``; 0 = off)."""
+    if _policy["directory"]:
+        return _policy["every"]
+    try:
+        return max(0, int(os.environ.get("QUEST_CKPT_EVERY", "0")))
+    except ValueError:
+        return 0
+
+
+def _read_pointer(directory: str) -> str | None:
+    try:
+        with open(os.path.join(directory, _POINTER)) as f:
+            name = f.read().strip()
+        return name if name in SLOTS else None
+    except OSError:
+        return None
+
+
+def _write_json_atomic(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def snapshot(re, im, *, num_qubits: int, is_density: bool, mesh,
+             directory: str, position: dict,
+             owner: str | None = None) -> str | None:
+    """Write one mid-run snapshot into the two-slot rotation under
+    ``directory`` and return the slot path.
+
+    Protocol: the slot NOT named by the ``latest`` pointer is rebuilt
+    in a temp directory (orbax arrays + checksummed ``qureg.json`` via
+    ``stateio._write_snapshot``, plus the ``run_position.json``
+    sidecar), atomically renamed into place, and only then does the
+    pointer flip — so a crash at ANY point leaves ``latest`` naming a
+    complete, verified snapshot.  Checkpoint I/O runs under the
+    ``ckpt_save`` retry seam.
+
+    ``owner`` (an eager register uid or a run-plan fingerprint) claims
+    the directory on first write; a snapshot under a DIFFERENT owner is
+    skipped — ``resilience.ckpt_dir_conflicts`` counter, one-shot
+    warning, return None — so two writers can never interleave their
+    states into one rotation."""
+    from . import stateio
+
+    directory = os.path.abspath(directory)
+    if owner is not None:
+        bound = _dir_owners.setdefault(directory, owner)
+        if bound != owner:
+            metrics.counter_inc("resilience.ckpt_dir_conflicts")
+            metrics.warn_once(
+                "ckpt_dir_conflict",
+                f"checkpoint directory {directory!r} is already bound "
+                f"to another register/run; this snapshot is SKIPPED — "
+                "arm one directory per register or run "
+                "(setCheckpointEvery / QUEST_CKPT_DIR / "
+                "Circuit.run(checkpoint_dir=...))")
+            return None
+    os.makedirs(directory, exist_ok=True)
+    latest = _read_pointer(directory)
+    nxt = SLOTS[1] if latest == SLOTS[0] else SLOTS[0]
+    tmp = os.path.join(directory, "." + nxt + ".tmp")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    meta = stateio.checkpoint_meta(
+        num_qubits=num_qubits, is_density=is_density, dtype=re.dtype,
+        num_devices=1 if mesh is None else int(mesh.devices.size))
+    stateio._write_snapshot(re, im, meta, tmp)
+    with_retries(
+        lambda: _write_json_atomic(os.path.join(tmp, stateio._POSITION),
+                                   position),
+        seam="ckpt_save")
+    dst = os.path.join(directory, nxt)
+
+    def promote():
+        if os.path.isdir(dst):
+            shutil.rmtree(dst)
+        os.replace(tmp, dst)
+
+    with_retries(promote, seam="ckpt_save")
+
+    def flip():
+        with open(os.path.join(directory, _POINTER + ".tmp"), "w") as f:
+            f.write(nxt)
+        os.replace(os.path.join(directory, _POINTER + ".tmp"),
+                   os.path.join(directory, _POINTER))
+
+    with_retries(flip, seam="ckpt_save")
+    metrics.counter_inc("resilience.checkpoints")
+    metrics.trace(f"checkpoint written: {dst} (item "
+                  f"{position.get('item_index', position.get('flush_index'))})")
+    return dst
+
+
+def load_snapshot(qureg, directory: str) -> dict:
+    """Restore the last-good snapshot under ``directory`` into
+    ``qureg`` and return its ``run_position`` sidecar (with the slot
+    path added under ``"slot"``).
+
+    Tries the ``latest`` slot first; on an integrity failure (missing
+    arrays, corrupt shard, checksum mismatch — all surfaced as
+    :class:`QuESTError` by ``stateio.restore_checkpoint``) falls back
+    to the OTHER slot, bumping ``resilience.slot_fallbacks``.  A plain
+    ``save_checkpoint`` directory (no slots) restores directly.  With
+    no restorable snapshot at all, raises a :class:`QuESTError` that
+    names every slot's failure."""
+    from . import stateio
+
+    directory = os.path.abspath(directory)
+    latest = _read_pointer(directory)
+    order = ([latest] if latest else []) + \
+        [s for s in SLOTS if s != latest]
+    candidates = [s for s in order
+                  if os.path.isdir(os.path.join(directory, s))]
+    if not candidates:
+        # no rotation: a flat save_checkpoint directory
+        stateio.restore_checkpoint(qureg, directory)
+        pos = _read_position(directory)
+        pos["slot"] = directory
+        return pos
+    errors = []
+    fell_back = False
+    for slot in candidates:
+        path = os.path.join(directory, slot)
+        try:
+            # the sidecar is integrity-bearing for rotation slots:
+            # every snapshot writes one, and restoring a slot whose
+            # position is unreadable could hand a mid-run (possibly
+            # relabelled-layout) state to a caller with no way to tell
+            # — validated BEFORE the restore so a bad slot never
+            # touches the register
+            pos = _read_position(path, required=True)
+            stateio.restore_checkpoint(qureg, path)
+        except QuESTError as e:
+            errors.append(f"{slot}: {e}")
+            fell_back = True
+            continue
+        if fell_back:
+            metrics.counter_inc("resilience.slot_fallbacks")
+            metrics.trace(f"checkpoint slot fallback: {errors[-1]}; "
+                          f"restored {slot}")
+        pos["slot"] = path
+        return pos
+    raise QuESTError(
+        f"no restorable checkpoint under {directory}: " + "; ".join(errors))
+
+
+def _read_position(path: str, required: bool = False) -> dict:
+    """The ``run_position.json`` sidecar of one snapshot directory.
+
+    ``required=True`` (rotation slots, which ALWAYS carry one) turns a
+    missing or unreadable sidecar into a :class:`QuESTError` naming the
+    file — the caller treats the slot as corrupt and falls back;
+    ``required=False`` serves flat ``save_checkpoint`` directories,
+    which legitimately have none."""
+    from . import stateio
+
+    p = os.path.join(path, stateio._POSITION)
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        if required:
+            raise QuESTError(
+                f"snapshot at {path} is missing its run_position "
+                f"sidecar ({p}) — treating the slot as corrupt")
+        return {}
+    except (OSError, ValueError) as e:
+        if required:
+            raise QuESTError(
+                f"run_position sidecar at {p} is unreadable "
+                f"({type(e).__name__}: {e}) — treating the slot as "
+                "corrupt")
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# Resume
+# ---------------------------------------------------------------------------
+
+
+def encode_prng_key(key):
+    """JSON-serialisable form of a jax PRNG key for the run-position
+    sidecar: handles both raw ``PRNGKey`` uint32 arrays and new-style
+    typed key arrays (``jax.random.key`` — ``np.asarray`` on those
+    raises, so the raw key data is extracted instead)."""
+    if key is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        typed = jnp.issubdtype(key.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        typed = False
+    data = np.asarray(jax.random.key_data(key) if typed else key)
+    return {"data": data.ravel().tolist(), "typed": bool(typed)}
+
+
+def decode_prng_key(payload):
+    """Inverse of :func:`encode_prng_key`.  Also accepts the plain-list
+    form earlier sidecars recorded.  Typed keys re-wrap under the
+    default PRNG implementation (the one ``jax.random.key`` uses)."""
+    if payload is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(payload, dict):
+        data = jnp.asarray(payload["data"], dtype=jnp.uint32)
+        if payload.get("typed"):
+            return jax.random.wrap_key_data(data)
+        return data
+    return jnp.asarray(payload, dtype=jnp.uint32)
+
+
+def plan_fingerprint(circuit, qureg, pallas: str = "auto") -> str:
+    """Identity of one (circuit, register geometry, mesh, backend) run
+    plan: resuming under a different fingerprint would replay the wrong
+    items against the wrong mid-plan layout, so :func:`resume_run`
+    refuses.  Ops are hashable tuples of statics and scalars (the same
+    property ``Circuit.compile`` keys its memo on), so the fingerprint
+    is deterministic across processes; the pallas flag is folded in
+    because it selects the item decomposition (fused segments vs
+    per-gate kernels)."""
+    import hashlib
+
+    ndev = 1 if qureg.mesh is None else int(qureg.mesh.devices.size)
+    use_pallas = pallas is True or pallas == "auto"
+    tag = repr((tuple(circuit.ops), circuit.num_qubits,
+                circuit.is_density, str(qureg.real_dtype), ndev,
+                use_pallas))
+    return hashlib.sha256(tag.encode()).hexdigest()[:16]
+
+
+def resume_state(qureg, directory: str) -> dict:
+    """Restore the last-good snapshot into ``qureg`` and return its
+    position sidecar — the eager/C-driver resume path (the C API's
+    ``resumeRun`` returns the position index so an unmodified driver
+    can skip the gate batches already applied).
+
+    Refuses mid-circuit (``Circuit.run``) snapshots: those are resume
+    POSITIONS, not canonical states — on a mesh the qubit layout may be
+    relabelled at the recorded item boundary, so restoring one as a
+    final state would silently yield permuted amplitudes.  They resume
+    through :func:`resume_run`, which replays the remaining items (the
+    inverse refusal — ``resume_run`` on a flush snapshot — is guarded
+    the same way).  The refusal is decided from the position sidecars
+    BEFORE any restore, so a refused call leaves ``qureg`` untouched."""
+    directory = os.path.abspath(directory)
+    for slot in (os.path.join(directory, s) for s in SLOTS):
+        peek = _read_position(slot)
+        if peek.get("kind") == "circuit_run":
+            raise QuESTError(
+                f"checkpoint at {slot} is a mid-run Circuit.run "
+                f"snapshot (item {peek.get('item_index')}): not a "
+                "canonical final state — resume it with "
+                "resilience.resume_run(circuit, qureg, directory)")
+    pos = load_snapshot(qureg, directory)
+    metrics.counter_inc("resilience.resumes")
+    return pos
+
+
+def resume_run(circuit, qureg, directory: str, pallas: str = "auto"):
+    """Resume an interrupted ``Circuit.run``: restore the last-good
+    snapshot under ``directory`` into ``qureg``, validate the plan
+    fingerprint, and replay ONLY the remaining plan items (skipped
+    items pass through untouched; already-drawn measurement outcomes
+    are replayed from the sidecar, and the run continues with the SAME
+    RNG key) — so the resumed amplitudes are bit-identical to the
+    uninterrupted run, which ``tools/chaos_drill.py`` asserts.
+    Checkpointing continues into the same directory at the recorded
+    cadence.  Returns what ``Circuit.run`` returns."""
+    pos = load_snapshot(qureg, directory)
+    if "item_index" not in pos:
+        raise QuESTError(
+            f"checkpoint at {pos.get('slot', directory)} carries no "
+            "mid-run position (an eager-path or plain save_checkpoint "
+            "snapshot); restore it with resilience.resume_state")
+    want = plan_fingerprint(circuit, qureg, pallas)
+    got = pos.get("fingerprint")
+    if got != want:
+        raise QuESTError(
+            f"checkpoint at {pos['slot']} was written by a different run "
+            f"plan (fingerprint {got} != {want}): resume_run needs the "
+            "same circuit ops, register geometry, dtype and device mesh")
+    metrics.counter_inc("resilience.resumes")
+    every = int(pos.get("every") or 0)
+    return circuit.run(qureg, pallas=pallas,
+                       checkpoint_dir=directory if every else None,
+                       checkpoint_every=every, _resume=pos)
+
+
+def maybe_eager_checkpoint(qureg) -> None:
+    """Eager/C-driver checkpoint cadence: every k-th flushed gate run
+    OF THIS REGISTER (``setCheckpointEvery`` / ``QUEST_CKPT_EVERY``
+    with ``QUEST_CKPT_DIR``), snapshot the register after a passing
+    health check.  Flush boundaries are always canonical layout, so
+    these snapshots restore as plain final states
+    (:func:`resume_state`).
+
+    One directory serves ONE writer: the rotation is bound to the
+    first owner that snapshots into it (see :func:`snapshot`), and
+    cadence-due flushes of any other register are skipped
+    (``resilience.ckpt_dir_conflicts`` counter, one-shot warning) —
+    interleaving two registers' states into one two-slot rotation
+    would let resumeRun silently restore the wrong one."""
+    every = checkpoint_every()
+    directory = checkpoint_dir()
+    if not every or not directory:
+        return
+    uid = getattr(qureg, "_res_uid", None)
+    if uid is None:
+        _uid_counter[0] += 1
+        uid = _uid_counter[0]
+        qureg._res_uid = uid
+    n = _eager_flush_counts.get(uid, 0) + 1
+    _eager_flush_counts[uid] = n
+    if n % every:
+        return
+    from .circuit import check_state_health  # deferred: import cycle
+
+    reason, _ = check_state_health(
+        qureg._re, qureg._im, is_density=qureg.is_density,
+        num_qubits=qureg.num_qubits, mesh=qureg.mesh, before=None,
+        n_ops=1)
+    if reason is not None:
+        raise QuESTError(
+            f"checkpoint health check failed at flush {n}: {reason} — "
+            "snapshot NOT written (the previous checkpoint, if any, is "
+            "the last good state)")
+    snapshot(qureg._re, qureg._im, num_qubits=qureg.num_qubits,
+             is_density=qureg.is_density, mesh=qureg.mesh,
+             directory=directory, owner=f"register:{uid}",
+             position={"format_version": 1, "kind": "flush",
+                       "flush_index": n, "register_uid": uid})
+
+
+def reset() -> None:
+    """Clear fault plans, hit counters, checkpoint policy and the
+    eager flush counter (test hook)."""
+    global _plan, _env_plan
+    with _lock:
+        _plan = None
+        _env_plan = None
+        _hits.clear()
+    _policy["directory"] = None
+    _policy["every"] = 0
+    _eager_flush_counts.clear()
+    _dir_owners.clear()

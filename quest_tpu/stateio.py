@@ -13,7 +13,10 @@ provide the TPU-native equivalent the reference lacks: an orbax
 checkpoint of the sharded amplitude arrays plus a metadata sidecar, so a
 34-qubit register distributed over a pod restores with its sharding
 intact and device buffers written directly (no host round-trip of the
-full state).
+full state).  The metadata carries per-array checksums
+(format_version 2) and every restore failure surfaces as a
+``QuESTError`` naming the offending path; ``quest_tpu.resilience``
+builds its two-slot mid-run snapshot rotation on these primitives.
 """
 
 from __future__ import annotations
@@ -26,11 +29,19 @@ import jax
 
 from .register import Qureg
 from .validation import QuESTError
-from .ops.lattice import amp_sharding
+from .ops.lattice import amp_sharding, state_shape
 
 #: Metadata sidecar name inside a checkpoint directory.
 _META = "qureg.json"
 _ARRAYS = "arrays"
+#: Mid-run position sidecar written by quest_tpu.resilience snapshots.
+_POSITION = "run_position.json"
+
+#: Current checkpoint metadata format.  v2 adds per-array CRC32
+#: checksums (``"checksums": {"re": ..., "im": ...}``) so a corrupt or
+#: truncated shard is caught at restore instead of silently poisoning
+#: the register; v1 checkpoints (no checksums) remain readable.
+_FORMAT_VERSION = 2
 
 
 # ---------------------------------------------------------------------------
@@ -96,39 +107,130 @@ def init_state_from_single_file(qureg: Qureg, filename: str) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def save_checkpoint(qureg: Qureg, directory: str) -> None:
-    """Checkpoint the register to ``directory`` (created if missing):
-    orbax-managed sharded arrays plus a JSON metadata sidecar."""
+def checkpoint_meta(*, num_qubits: int, is_density: bool, dtype,
+                    num_devices: int) -> dict:
+    """The ``qureg.json`` metadata skeleton (no checksums yet — those
+    are computed from the arrays by :func:`_write_snapshot`).
+
+    ``num_devices`` records the SAVING topology for the human reading
+    the sidecar; restore ignores it — arrays land in the RESTORING
+    register's sharding (see :func:`restore_checkpoint`)."""
+    return {
+        "format_version": _FORMAT_VERSION,
+        "num_qubits": int(num_qubits),
+        "is_density": bool(is_density),
+        "dtype": str(np.dtype(dtype)),
+        "num_devices": int(num_devices),
+    }
+
+
+def _array_checksum(arr) -> str:
+    """CRC32 of the array's row-major bytes, computed per addressable
+    shard in row order — no full-state host gather.  The amplitude mesh
+    shards rows contiguously (``amp_sharding``), so concatenating
+    shards in row order IS the row-major buffer, making the checksum
+    invariant under the saving/restoring topology (an 8-device
+    checkpoint verifies identically on a 1-device restore)."""
+    import zlib
+
+    crc = 0
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: (s.index[0].start or 0) if s.index else 0)
+    seen = set()
+    for s in shards:
+        key = (s.index[0].start or 0) if s.index else 0
+        if key in seen:  # replicated shards: hash each row block once
+            continue
+        seen.add(key)
+        crc = zlib.crc32(np.ascontiguousarray(s.data).tobytes(), crc)
+    return f"{crc:08x}"
+
+
+def _write_snapshot(re, im, meta: dict, directory: str) -> None:
+    """Write one checkpoint (orbax arrays + checksummed ``qureg.json``)
+    into ``directory``.  The orbax save and the metadata write run
+    under the ``ckpt_save`` retry seam (``resilience.with_retries``);
+    the metadata lands via write-temp-then-rename so a crash never
+    leaves a truncated sidecar next to complete arrays."""
     import orbax.checkpoint as ocp
+
+    from . import resilience
 
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.join(directory, _ARRAYS),
-                   {"re": qureg.re, "im": qureg.im}, force=True)
-    meta = {
-        "format_version": 1,
-        "num_qubits": qureg.num_qubits,
-        "is_density": qureg.is_density,
-        "dtype": str(np.dtype(qureg.real_dtype)),
-        "num_devices": 1 if qureg.mesh is None else int(qureg.mesh.devices.size),
-    }
-    with open(os.path.join(directory, _META), "w") as f:
-        json.dump(meta, f, indent=1)
+
+    def save_arrays():
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(directory, _ARRAYS),
+                       {"re": re, "im": im}, force=True)
+
+    resilience.with_retries(save_arrays, seam="ckpt_save")
+    doc = dict(meta)
+    doc["shape"] = list(re.shape)
+    doc["checksums"] = {"re": _array_checksum(re),
+                        "im": _array_checksum(im)}
+
+    resilience.with_retries(
+        lambda: resilience._write_json_atomic(
+            os.path.join(directory, _META), doc),
+        seam="ckpt_save")
+
+
+def save_checkpoint(qureg: Qureg, directory: str) -> None:
+    """Checkpoint the register to ``directory`` (created if missing):
+    orbax-managed sharded arrays plus a checksummed JSON metadata
+    sidecar (format_version 2; see :func:`restore_checkpoint` for the
+    integrity and topology guarantees)."""
+    _write_snapshot(
+        qureg.re, qureg.im,
+        checkpoint_meta(
+            num_qubits=qureg.num_qubits, is_density=qureg.is_density,
+            dtype=qureg.real_dtype,
+            num_devices=(1 if qureg.mesh is None
+                         else int(qureg.mesh.devices.size))),
+        directory)
 
 
 def restore_checkpoint(qureg: Qureg, directory: str) -> None:
     """Restore amplitudes saved by :func:`save_checkpoint` into ``qureg``
-    (which must match in kind, qubit count and dtype).  The arrays are
-    restored directly into the register's sharding layout."""
+    (which must match in kind, qubit count and dtype).
+
+    CROSS-TOPOLOGY: the arrays are restored directly into the
+    RESTORING register's sharding layout — the sidecar's
+    ``num_devices`` records the saving topology but does not constrain
+    the restore, so a checkpoint written under an 8-device mesh loads
+    into a 1-device register and vice versa (orbax reshards row blocks
+    on the way in; pinned in ``tests/test_resilience.py``).
+
+    INTEGRITY: every failure mode surfaces as a :class:`QuESTError`
+    naming the offending path — a missing/garbled ``qureg.json``, a
+    missing ``arrays`` directory, an orbax load failure (corrupt or
+    truncated shard data), or a format_version-2 per-array checksum
+    mismatch.  Transient I/O errors are first retried under the
+    ``ckpt_load`` seam.  v1 checkpoints (no checksums) restore without
+    verification."""
     import orbax.checkpoint as ocp
 
+    from . import resilience
+
     directory = os.path.abspath(directory)
+    meta_path = os.path.join(directory, _META)
     try:
-        with open(os.path.join(directory, _META)) as f:
+        with open(meta_path) as f:
             meta = json.load(f)
     except FileNotFoundError:
         raise QuESTError(f"no checkpoint at {directory}")
+    except (OSError, ValueError) as e:
+        raise QuESTError(
+            f"checkpoint metadata at {meta_path} is unreadable "
+            f"({type(e).__name__}: {e})")
+    for field in ("num_qubits", "is_density", "dtype"):
+        if field not in meta:
+            # a raw KeyError would escape the slot-fallback loop in
+            # resilience.load_snapshot (which catches QuESTError only)
+            raise QuESTError(
+                f"checkpoint metadata at {meta_path} is missing "
+                f"{field!r} — damaged sidecar")
     if meta["num_qubits"] != qureg.num_qubits or meta["is_density"] != qureg.is_density:
         raise QuESTError(
             f"checkpoint holds a {meta['num_qubits']}-qubit "
@@ -141,13 +243,64 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
             f"checkpoint precision is {meta['dtype']}; register is "
             f"{np.dtype(qureg.real_dtype)} — restoring would silently cast"
         )
+    arrays_dir = os.path.join(directory, _ARRAYS)
+    if not os.path.isdir(arrays_dir):
+        raise QuESTError(
+            f"checkpoint at {directory} is missing its arrays directory "
+            f"({arrays_dir})")
     sh = amp_sharding(qureg.mesh)
     if sh is None:
         sh = jax.sharding.SingleDeviceSharding(
             list(qureg.re.devices())[0])
-    target = jax.ShapeDtypeStruct(qureg.state_shape, qureg.real_dtype,
-                                  sharding=sh)
-    with ocp.StandardCheckpointer() as ckptr:
-        out = ckptr.restore(os.path.join(directory, _ARRAYS),
-                            {"re": target, "im": target})
+    # The stored 2-D (rows, lanes) shape depends on the SAVING device
+    # count for tiny registers (state_shape caps lanes at the chunk).
+    # Flat index = row * lanes + lane is shape-invariant, so a
+    # cross-topology restore loads under the saved shape and reshapes;
+    # the common same-shape case restores straight into the register's
+    # sharding with no intermediate copy (orbax silently mis-restores
+    # into a mismatched target shape — the checksum caught exactly that
+    # during development, hence this explicit two-shape path).
+    saved_shape = tuple(meta.get("shape")
+                        or state_shape(qureg.num_amps,
+                                       int(meta.get("num_devices", 1))))
+    same_shape = saved_shape == tuple(qureg.state_shape)
+    if same_shape:
+        target = jax.ShapeDtypeStruct(qureg.state_shape, qureg.real_dtype,
+                                      sharding=sh)
+    else:
+        dev0 = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        target = jax.ShapeDtypeStruct(saved_shape, qureg.real_dtype,
+                                      sharding=dev0)
+
+    def load():
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(arrays_dir, {"re": target, "im": target})
+
+    try:
+        out = resilience.with_retries(load, seam="ckpt_load")
+    except Exception as e:
+        # orbax surfaces corrupt/truncated shards as assorted exception
+        # types; all of them mean "this checkpoint is unusable" — wrap,
+        # name the path, and let the caller (resilience.load_snapshot)
+        # fall back to the other slot
+        raise QuESTError(
+            f"failed to restore checkpoint arrays from {arrays_dir}: "
+            f"{type(e).__name__}: {e}") from e
+    checksums = meta.get("checksums") or {}
+    if meta.get("format_version", 1) >= 2 and checksums:
+        for name in ("re", "im"):
+            want = checksums.get(name)
+            if want is None:
+                continue
+            got = _array_checksum(out[name])
+            if got != want:
+                raise QuESTError(
+                    f"checkpoint array {name!r} under {arrays_dir} failed "
+                    f"its integrity check (checksum {got} != recorded "
+                    f"{want}) — the shard data is corrupt")
+    if not same_shape:
+        import jax.numpy as jnp
+
+        out = {k: jax.device_put(jnp.reshape(v, qureg.state_shape), sh)
+               for k, v in out.items()}
     qureg._set(out["re"], out["im"])

@@ -138,6 +138,9 @@ def lib():
     L.startTimelineCapture.argtypes = [QuESTEnv]
     L.stopTimelineCapture.restype = ct.c_int
     L.stopTimelineCapture.argtypes = [QuESTEnv, ct.c_char_p]
+    L.setCheckpointEvery.argtypes = [QuESTEnv, ct.c_char_p, ct.c_int]
+    L.resumeRun.restype = ct.c_longlong
+    L.resumeRun.argtypes = [Qureg, ct.c_char_p]
     return L
 
 
@@ -321,6 +324,33 @@ def test_timeline_capture_roundtrip(lib, cenv, tmp_path):
 
     assert len(metrics.timeline_events()) == n
     lib.destroyQureg(q, cenv)
+
+
+def test_checkpoint_resume_c_api(lib, cenv, tmp_path):
+    """setCheckpointEvery / resumeRun: an unmodified C driver's flushed
+    gate runs are snapshotted at the armed cadence, and a fresh
+    register restores the last-good snapshot, returning the recorded
+    position (the count of flushed runs already applied)."""
+    d = str(tmp_path / "ck").encode()
+    lib.setCheckpointEvery(cenv, d, 1)
+    try:
+        q = lib.createQureg(4, cenv)
+        lib.hadamard(q, 0)
+        lib.controlledNot(q, 0, 1)
+        ref0 = lib.getProbAmp(q, 0)  # state read flushes -> snapshot
+        ref3 = lib.getProbAmp(q, 3)
+    finally:
+        lib.setCheckpointEvery(cenv, b"", 0)  # disarm for later tests
+    q2 = lib.createQureg(4, cenv)
+    pos = lib.resumeRun(q2, d)
+    assert pos >= 1
+    assert lib.getProbAmp(q2, 0) == pytest.approx(ref0, abs=1e-15)
+    assert lib.getProbAmp(q2, 3) == pytest.approx(ref3, abs=1e-15)
+    from quest_tpu import metrics
+
+    assert metrics.counters().get("resilience.resumes", 0) >= 1
+    lib.destroyQureg(q, cenv)
+    lib.destroyQureg(q2, cenv)
 
 
 def test_precision_code(lib):

@@ -286,3 +286,81 @@ def test_no_adhoc_instrumentation_outside_metrics():
         "metrics.py and quest_tpu/reporting.py — route it through the "
         "run ledger (quest_tpu.metrics) or reporting.stopwatch/"
         "time_fn:\n" + "\n".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# Fault-seam / retry discipline lint (quest_tpu.resilience)
+# ---------------------------------------------------------------------------
+
+_SEAM_CALL = regex.compile(
+    r"(?P<qual>[\w.]+\.)?(?P<fn>fault_point|with_retries)\s*\(")
+_SEAM_NAME = regex.compile(
+    r'fault_point\(\s*"([a-z_]+)"|seam="([a-z_]+)"')
+#: Any except clause — bare, single-name, ``as``-bound, or tuple form
+#: (``except (OSError, ValueError):``) — so no spelling evades the
+#: no-swallow check below.
+_EXCEPT_PASS = regex.compile(r"except\b[^:]*:\s*(#.*)?$")
+
+
+def test_fault_seams_only_through_resilience():
+    """Fault seams and retries are reachable ONLY through
+    quest_tpu.resilience: every ``fault_point``/``with_retries`` call
+    site outside resilience.py must be spelled
+    ``resilience.fault_point(...)`` / ``resilience.with_retries(...)``
+    (no ad-hoc copies of the machinery), and the seam-name literals
+    wired across the codebase must be exactly ``resilience.SEAMS`` —
+    a typo'd seam, or a declared seam nothing calls, fails here.
+
+    Additionally, the modules hosting the NEW recoverable-I/O paths
+    (resilience.py, stateio.py) must not swallow failures with a bare
+    ``except: pass`` — failures there either retry through the seam or
+    surface as a QuESTError naming the path."""
+    from quest_tpu import resilience
+
+    seams_wired: set[str] = set()
+    offenders = []
+    swallowers = []
+    no_swallow = {"quest_tpu/resilience.py", "quest_tpu/stateio.py"}
+    for tree in ("quest_tpu", "tools"):
+        pkg = os.path.join(REPO, tree)
+        for root, _dirs, files in os.walk(pkg):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                rel = f"{tree}/{os.path.relpath(path, pkg)}"
+                in_resilience = rel == "quest_tpu/resilience.py"
+                with open(path) as f:
+                    lines = f.readlines()
+                for lineno, line in enumerate(lines, 1):
+                    for a, b in _SEAM_NAME.findall(line):
+                        seams_wired.add(a or b)
+                    if in_resilience:
+                        continue
+                    for m in _SEAM_CALL.finditer(line):
+                        if line.lstrip().startswith(("def ", "#")):
+                            continue
+                        if (m.group("qual") or "").rstrip(".") \
+                                .split(".")[-1] != "resilience":
+                            offenders.append(
+                                f"{rel}:{lineno}: {line.strip()}")
+                if rel in no_swallow:
+                    for lineno, line in enumerate(lines, 1):
+                        nxt = lines[lineno].strip() \
+                            if lineno < len(lines) else ""
+                        if _EXCEPT_PASS.search(line.strip()) \
+                                and nxt == "pass":
+                            swallowers.append(
+                                f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "fault seams / retries must go through quest_tpu.resilience "
+        "(resilience.fault_point / resilience.with_retries):\n"
+        + "\n".join(offenders))
+    assert seams_wired == set(resilience.SEAMS), (
+        f"wired seam names {sorted(seams_wired)} != declared "
+        f"resilience.SEAMS {sorted(resilience.SEAMS)} — either a typo "
+        "at a call site or a declared seam nothing exercises")
+    assert not swallowers, (
+        "the recoverable-I/O modules must not silently swallow "
+        "failures (retry through a seam or raise a QuESTError naming "
+        "the path):\n" + "\n".join(swallowers))

@@ -1,0 +1,645 @@
+"""Resilience subsystem (quest_tpu.resilience) — ISSUE-5 acceptance.
+
+Covers: (a) deterministic fault plans (env + programmatic) firing at
+exactly the scripted seam/hit, (b) bounded deterministic retries with
+``resilience.retries`` / ``resilience.gave_up`` ledger counters, (c) a
+run killed mid-plan resuming from the last-good two-slot checkpoint
+with BIT-IDENTICAL amplitudes (state-vector, mesh, and
+measurement-bearing circuits — recorded outcomes and the RNG key
+replay), (d) slot fallback when the newest checkpoint is corrupted,
+(e) ``stateio.restore_checkpoint`` integrity failures surfacing as
+``QuESTError`` naming the offending path (missing arrays, corrupt
+shard data, checksum mismatch), (f) cross-topology restore (8-device
+checkpoint into a 1-device register and back), (g) the requeue-on-
+failure contract of the eager gate stream (quest_tpu/register.py —
+explicitly NOT retried), (h) the eager/C-driver checkpoint cadence
+(``setCheckpointEvery`` policy + ``resume_state``), and (i) corrupt
+AOT cache artifacts quarantined (warn once + rebuild) instead of
+crashing the run.
+"""
+
+import json
+import os
+import pickle
+import sys
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics, models, register, resilience
+from quest_tpu.circuit import Circuit
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+# the drill and this suite must corrupt checkpoints the same way (the
+# tensorstore file layout is an implementation detail both depend on)
+from chaos_drill import corrupt_slot_arrays as _corrupt_slot_arrays  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience(monkeypatch):
+    """No fault plan, checkpoint policy, or hit counters may leak
+    between tests (a leftover plan would fire in an unrelated test's
+    I/O path)."""
+    monkeypatch.delenv("QUEST_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("QUEST_CKPT_DIR", raising=False)
+    monkeypatch.delenv("QUEST_CKPT_EVERY", raising=False)
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+def _qft_ref(n, env, pallas):
+    q = qt.create_qureg(n, env)
+    models.qft(n).run(q, pallas=pallas)
+    return qt.get_state_vector(q)
+
+
+# ---------------------------------------------------------------------------
+# (a) deterministic fault plans
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_fires_at_scripted_hit():
+    resilience.set_fault_plan([("sink_write", 2, "io")])
+    assert resilience.fault_point("sink_write") is None  # hit 0
+    assert resilience.fault_point("sink_write") is None  # hit 1
+    with pytest.raises(OSError, match="seam 'sink_write' \\(hit 2\\)"):
+        resilience.fault_point("sink_write")
+    assert resilience.fault_point("sink_write") is None  # hit 3: once
+    assert resilience.fault_hits()["sink_write"] == 4
+    assert metrics.counters().get("resilience.faults_injected", 0) >= 1
+
+
+def test_fault_plan_env_var(monkeypatch):
+    monkeypatch.setenv("QUEST_FAULT_PLAN",
+                       "stream_dispatch:0:runtime;ckpt_load:1:io")
+    assert resilience.fault_active()
+    with pytest.raises(RuntimeError, match="stream_dispatch"):
+        resilience.fault_point("stream_dispatch")
+    assert resilience.fault_point("ckpt_load") is None
+    with pytest.raises(OSError):
+        resilience.fault_point("ckpt_load")
+
+
+def test_fault_plan_validation():
+    with pytest.raises(qt.QuESTError, match="unknown fault seam"):
+        resilience.set_fault_plan([("nope", 0, "io")])
+    with pytest.raises(qt.QuESTError, match="unknown fault kind"):
+        resilience.set_fault_plan([("sink_write", 0, "explode")])
+    with pytest.raises(qt.QuESTError, match="seam:hit:kind"):
+        resilience.set_fault_plan("sink_write:io")
+
+
+def test_fault_point_zero_cost_when_disabled():
+    assert not resilience.fault_active()
+    assert resilience.fault_point("run_item") is None
+    # disabled seams must not even count hits (pure fast path)
+    assert resilience.fault_hits() == {}
+
+
+# ---------------------------------------------------------------------------
+# (b) bounded deterministic retries
+# ---------------------------------------------------------------------------
+
+
+def test_with_retries_absorbs_transient_fault():
+    resilience.set_fault_plan([("aot_load", 0, "io")])
+    before = metrics.counters().get("resilience.retries", 0)
+    assert resilience.with_retries(lambda: 7, seam="aot_load",
+                                   base_delay=0.001) == 7
+    assert metrics.counters()["resilience.retries"] == before + 1
+
+
+def test_with_retries_gives_up_and_reraises():
+    calls = []
+
+    def always_fail():
+        calls.append(1)
+        raise OSError("disk on fire")
+
+    before = metrics.counters().get("resilience.gave_up", 0)
+    with pytest.raises(OSError, match="disk on fire"):
+        resilience.with_retries(always_fail, seam="sink_write",
+                                retries=2, base_delay=0.001)
+    assert len(calls) == 3  # initial + 2 retries, bounded
+    assert metrics.counters()["resilience.gave_up"] == before + 1
+
+
+def test_with_retries_does_not_retry_non_io():
+    """A scripted RuntimeError is not in retry_on: it must propagate
+    immediately (retries are for transient I/O only)."""
+    resilience.set_fault_plan([("aot_save", 0, "runtime")])
+    before = metrics.counters().get("resilience.retries", 0)
+    with pytest.raises(RuntimeError):
+        resilience.with_retries(lambda: 1, seam="aot_save")
+    assert metrics.counters().get("resilience.retries", 0) == before
+
+
+def test_sink_write_retries_then_lands(env1, tmp_path, monkeypatch):
+    """A transient scripted sink fault is retried and the ledger line
+    still lands (metrics._sink_write routes through the seam)."""
+    sink = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("QUEST_METRICS_FILE", str(sink))
+    resilience.set_fault_plan([("sink_write", 0, "io")])
+    q = qt.create_qureg(4, env1)
+    Circuit(4).hadamard(0).run(q)
+    resilience.clear_fault_plan()
+    lines = sink.read_text().strip().splitlines()
+    assert len(lines) >= 1 and json.loads(lines[-1])["schema"]
+    assert metrics.counters().get("resilience.retries", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# (c) kill mid-plan -> resume bit-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["local", "sharded"])
+def test_kill_and_resume_bit_identical(mode, env1, env8, tmp_path):
+    env = env1 if mode == "local" else env8
+    # single-device: per-gate path (a fused QFT-8 is ONE segment — no
+    # mid-plan boundary to kill at); mesh: the fused per-item plan
+    pallas = False if mode == "local" else "auto"
+    n = 8
+    ref = _qft_ref(n, env, pallas)
+    circ = models.qft(n)
+    d = str(tmp_path / "ck")
+    before = metrics.counters()
+    q = qt.create_qureg(n, env)
+    resilience.set_fault_plan([("run_item", 5, "runtime")])
+    with pytest.raises(RuntimeError, match="run_item"):
+        circ.run(q, pallas=pallas, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    # the failed run never called qureg._set: the register still holds
+    # its pre-run state, never a half-applied one
+    assert qt.get_state_vector(q)[0] == pytest.approx(1.0)
+    resilience.resume_run(circ, q, d, pallas=pallas)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+    c = metrics.counters()
+    assert c.get("resilience.checkpoints", 0) \
+        - before.get("resilience.checkpoints", 0) >= 1
+    assert c.get("resilience.resumes", 0) \
+        - before.get("resilience.resumes", 0) == 1
+
+
+def test_resume_with_measurements_replays_outcomes(env1, tmp_path):
+    import jax
+
+    n = 6
+    circ = Circuit(n)
+    for t in range(n):
+        circ.hadamard(t)
+    circ.measure(0)
+    for t in range(n):
+        circ.rotate_y(t, 0.31)
+    circ.measure(1).measure(2)
+    key = jax.random.PRNGKey(11)
+    qref = qt.create_qureg(n, env1)
+    outs_ref = np.asarray(circ.run(qref, pallas=False, key=key))
+    ref = qt.get_state_vector(qref)
+
+    d = str(tmp_path / "ckm")
+    q = qt.create_qureg(n, env1)
+    resilience.set_fault_plan([("run_item", 9, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, key=key, checkpoint_dir=d,
+                 checkpoint_every=3)
+    resilience.clear_fault_plan()
+    outs = np.asarray(resilience.resume_run(circ, q, d, pallas=False))
+    # outcomes vector: replayed prefix from the sidecar + live suffix
+    # drawn from the SAME stored key — identical to the clean run
+    assert np.array_equal(outs, outs_ref)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+
+
+def test_resume_fingerprint_mismatch_raises(env1, tmp_path):
+    n = 6
+    circ = models.qft(n)
+    d = str(tmp_path / "ckf")
+    q = qt.create_qureg(n, env1)
+    resilience.set_fault_plan([("run_item", 5, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    other = models.ghz(n)  # different ops -> different fingerprint
+    with pytest.raises(qt.QuESTError, match="different run plan"):
+        resilience.resume_run(other, q, d, pallas=False)
+    # same circuit, different backend decomposition: also refused
+    with pytest.raises(qt.QuESTError, match="different run plan"):
+        resilience.resume_run(circ, q, d, pallas="auto")
+
+
+def test_tripped_probe_names_last_good_checkpoint(env1, tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("QUEST_HEALTH_EVERY", "1")
+    monkeypatch.setenv("QUEST_FLIGHT_FILE", str(tmp_path / "f.json"))
+    d = str(tmp_path / "cknan")
+    circ = models.qft(6)
+    q = qt.create_qureg(6, env1)
+    resilience.set_fault_plan([("run_item", 5, "nan")])
+    with pytest.raises(qt.QuESTError) as ei:
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    msg = str(ei.value)
+    assert "non-finite" in msg
+    assert "after plan item 5" in msg
+    assert "last-good checkpoint" in msg and "slot-" in msg
+    # observed runs never donate: the register is NOT bricked
+    assert qt.calc_total_prob(q) == pytest.approx(1.0, abs=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# (d) slot fallback on corruption + (e) integrity QuESTErrors
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_latest_slot_falls_back(env1, tmp_path):
+    n = 8
+    ref = _qft_ref(n, env1, False)
+    circ = models.qft(n)
+    d = str(tmp_path / "ckc")
+    q = qt.create_qureg(n, env1)
+    resilience.set_fault_plan([("run_item", 5, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    with open(os.path.join(d, "latest")) as f:
+        latest = f.read().strip()
+    assert _corrupt_slot_arrays(os.path.join(d, latest)) > 0
+    resilience.resume_run(circ, q, d, pallas=False)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+    assert metrics.counters().get("resilience.slot_fallbacks", 0) >= 1
+
+
+def test_corrupt_position_sidecar_falls_back(env1, tmp_path):
+    """A rotation slot whose run_position.json is truncated is treated
+    as CORRUPT (sidecars are integrity-bearing): resume falls back to
+    the other slot instead of restoring a mid-run state it can no
+    longer classify — the silent-wrong-state outcome the subsystem
+    promises never to produce."""
+    n = 8
+    ref = _qft_ref(n, env1, False)
+    circ = models.qft(n)
+    d = str(tmp_path / "ckp")
+    q = qt.create_qureg(n, env1)
+    resilience.set_fault_plan([("run_item", 5, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    with open(os.path.join(d, "latest")) as f:
+        latest = f.read().strip()
+    sidecar = os.path.join(d, latest, "run_position.json")
+    with open(sidecar, "w") as f:
+        f.write('{"kind": "circuit_r')  # truncated mid-write
+    before = metrics.counters().get("resilience.slot_fallbacks", 0)
+    resilience.resume_run(circ, q, d, pallas=False)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+    assert metrics.counters()["resilience.slot_fallbacks"] == before + 1
+    # with BOTH sidecars gone (the resumed run refreshed the rotation,
+    # so strip every slot), nothing is restorable — named error, never
+    # a classification-free restore
+    for slot in ("slot-0", "slot-1"):
+        p = os.path.join(d, slot, "run_position.json")
+        if os.path.exists(p):
+            os.remove(p)
+    with pytest.raises(qt.QuESTError, match="no restorable checkpoint"):
+        resilience.load_snapshot(qt.create_qureg(n, env1), d)
+
+
+def test_sink_runtime_fault_degrades_not_crashes(env1, tmp_path,
+                                                 monkeypatch, capfd):
+    """A scripted 'runtime'-kind fault at the sink_write seam is not
+    retryable I/O — it must still DEGRADE (warn + sink_errors), never
+    crash the run the sink was observing."""
+    # a previously degraded 'ledger' sink (earlier tests) would route
+    # this write down the warned-once fast path, skipping the seam
+    metrics.reset()
+    monkeypatch.setenv("QUEST_METRICS_FILE", str(tmp_path / "l.jsonl"))
+    resilience.set_fault_plan([("sink_write", 0, "runtime")])
+    before = metrics.counters().get("metrics.sink_errors", 0)
+    q = qt.create_qureg(4, env1)
+    Circuit(4).hadamard(0).run(q)  # must not raise
+    resilience.clear_fault_plan()
+    assert metrics.counters()["metrics.sink_errors"] == before + 1
+    assert "sink" in capfd.readouterr().err
+
+
+def test_restore_errors_name_offending_path(env, tmp_path):
+    import shutil
+
+    q = qt.create_qureg(4, env)
+    qt.hadamard(q, 0)
+    # missing arrays directory
+    d1 = str(tmp_path / "c1")
+    qt.save_checkpoint(q, d1)
+    shutil.rmtree(os.path.join(d1, "arrays"))
+    with pytest.raises(qt.QuESTError, match="missing its arrays"):
+        qt.restore_checkpoint(qt.create_qureg(4, env), d1)
+    # corrupt shard data -> wrapped orbax failure naming the path
+    d2 = str(tmp_path / "c2")
+    qt.save_checkpoint(q, d2)
+    assert _corrupt_slot_arrays(d2) > 0
+    with pytest.raises(qt.QuESTError,
+                       match="failed to restore checkpoint arrays"):
+        qt.restore_checkpoint(qt.create_qureg(4, env), d2)
+    # checksum mismatch (metadata says different bytes)
+    d3 = str(tmp_path / "c3")
+    qt.save_checkpoint(q, d3)
+    meta_path = os.path.join(d3, "qureg.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    assert meta["format_version"] == 2
+    meta["checksums"]["re"] = "00000000"
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(qt.QuESTError, match="integrity check"):
+        qt.restore_checkpoint(qt.create_qureg(4, env), d3)
+    # unreadable metadata
+    with open(meta_path, "w") as f:
+        f.write("{not json")
+    with pytest.raises(qt.QuESTError, match="unreadable"):
+        qt.restore_checkpoint(qt.create_qureg(4, env), d3)
+
+
+def test_v1_checkpoint_still_readable(env1, tmp_path):
+    """A pre-checksum (format_version 1) sidecar restores without
+    verification — old checkpoints stay loadable."""
+    psi_q = qt.create_qureg(4, env1)
+    qt.hadamard(psi_q, 1)
+    ref = qt.get_state_vector(psi_q)
+    d = str(tmp_path / "v1")
+    qt.save_checkpoint(psi_q, d)
+    meta_path = os.path.join(d, "qureg.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["format_version"] = 1
+    for k in ("checksums", "shape"):
+        meta.pop(k, None)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    q2 = qt.create_qureg(4, env1)
+    qt.restore_checkpoint(q2, d)
+    assert np.array_equal(qt.get_state_vector(q2), ref)
+
+
+# ---------------------------------------------------------------------------
+# (f) cross-topology restore
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("direction", ["8to1", "1to8"])
+def test_cross_topology_restore(direction, env1, env8, tmp_path):
+    """A checkpoint saved under an 8-device mesh restores into a
+    1-device register and vice versa: the arrays land in the RESTORING
+    register's sharding (and storage shape), bit-identically."""
+    src_env, dst_env = ((env8, env1) if direction == "8to1"
+                        else (env1, env8))
+    n = 5  # small enough that the two topologies store DIFFERENT shapes
+    q = qt.create_qureg(n, src_env)
+    qt.hadamard(q, 0)
+    qt.hadamard(q, n - 1)
+    qt.controlled_phase_shift(q, 0, n - 1, 0.4)
+    ref = qt.get_state_vector(q)
+    d = str(tmp_path / "x")
+    qt.save_checkpoint(q, d)
+    q2 = qt.create_qureg(n, dst_env)
+    qt.restore_checkpoint(q2, d)
+    assert np.array_equal(qt.get_state_vector(q2), ref)
+    from quest_tpu.ops.lattice import amp_sharding
+
+    want = amp_sharding(q2.mesh)
+    if want is not None:
+        assert q2.re.sharding == want
+
+
+# ---------------------------------------------------------------------------
+# (g) eager gate-stream requeue (register.py: explicitly NOT retried)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_dispatch_failure_requeues_not_drops(env):
+    """A faulted stream dispatch leaves the ops QUEUED: the read that
+    triggered the flush raises, and the next read applies them exactly
+    once — never a silent pre-gate state (the documented requeue
+    contract at quest_tpu/register.py, Qureg._run_gates_inner)."""
+    q = qt.create_qureg(4, env)
+    qref = qt.create_qureg(4, env)
+    qt.hadamard(qref, 0)
+    qt.hadamard(qref, 2)
+    ref = qt.get_state_vector(qref)
+
+    qt.hadamard(q, 0)
+    qt.hadamard(q, 2)
+    assert q._pending, "gates must still be deferred"
+    resilience.set_fault_plan([("stream_dispatch", 0, "runtime")])
+    with pytest.raises(RuntimeError, match="stream_dispatch"):
+        qt.get_state_vector(q)  # read flushes -> scripted fault
+    # the gates were REQUEUED, not dropped and not half-applied
+    assert q._pending, "failed dispatch must requeue the ops"
+    resilience.clear_fault_plan()
+    assert np.array_equal(qt.get_state_vector(q), ref)
+    # applied exactly once: norm is 1 and state matches the oracle
+    assert qt.calc_total_prob(q) == pytest.approx(1.0, abs=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# (h) eager-path checkpoint policy (the C API's setCheckpointEvery)
+# ---------------------------------------------------------------------------
+
+
+def test_eager_checkpoint_policy_and_resume_state(env1, tmp_path):
+    d = str(tmp_path / "eager")
+    qt.set_checkpoint_policy(d, 1)
+    try:
+        q = qt.create_qureg(5, env1)
+        qt.hadamard(q, 0)
+        qt.hadamard(q, 3)
+        ref = qt.get_state_vector(q)  # read flushes -> snapshot
+    finally:
+        qt.set_checkpoint_policy(None, 0)
+    q2 = qt.create_qureg(5, env1)
+    pos = qt.resume_state(q2, d)
+    assert pos.get("flush_index", 0) >= 1
+    assert np.array_equal(qt.get_state_vector(q2), ref)
+    # a flush snapshot carries no mid-circuit position: resume_run
+    # refuses instead of replaying the wrong items
+    with pytest.raises(qt.QuESTError, match="resume_state"):
+        resilience.resume_run(models.ghz(5), q2, d, pallas=False)
+
+
+def test_resume_state_refuses_midrun_snapshot(env1, tmp_path):
+    """The symmetric refusal: a mid-run Circuit.run snapshot may hold a
+    relabelled layout, so resume_state rejects it — BEFORE touching the
+    register — and points at resume_run."""
+    d = str(tmp_path / "mid")
+    circ = models.qft(6)
+    q = qt.create_qureg(6, env1)
+    resilience.set_fault_plan([("run_item", 5, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    q2 = qt.create_qureg(6, env1)
+    with pytest.raises(qt.QuESTError, match="resume_run"):
+        resilience.resume_state(q2, d)
+    # the refused register was never mutated: still |0...0>
+    assert qt.get_state_vector(q2)[0] == pytest.approx(1.0)
+
+
+def test_eager_checkpoint_binds_one_register(env1, tmp_path, capfd):
+    """Two same-geometry registers flushing under one armed policy must
+    not interleave into one rotation: the directory binds to the first
+    register that snapshots, the other's flushes are skipped."""
+    d = str(tmp_path / "bind")
+    qt.set_checkpoint_policy(d, 1)
+    try:
+        qa = qt.create_qureg(5, env1)
+        qb = qt.create_qureg(5, env1)
+        qt.hadamard(qa, 0)
+        ref_a = qt.get_state_vector(qa)  # flush: qa binds the rotation
+        qt.pauli_x(qb, 4)
+        qt.get_state_vector(qb)          # flush: qb is SKIPPED
+        qt.hadamard(qa, 2)
+        ref_a = qt.get_state_vector(qa)  # qa keeps checkpointing
+    finally:
+        qt.set_checkpoint_policy(None, 0)
+    assert metrics.counters().get("resilience.ckpt_dir_conflicts", 0) >= 1
+    assert "bound to another register" in capfd.readouterr().err
+    q2 = qt.create_qureg(5, env1)
+    pos = qt.resume_state(q2, d)
+    # the rotation holds qa's states only — never qb's
+    assert np.array_equal(qt.get_state_vector(q2), ref_a)
+    assert pos.get("flush_index") == 2  # qa's OWN flush count
+
+
+# ---------------------------------------------------------------------------
+# (i) corrupt AOT artifacts: warn + rebuild, never crash
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_aot_artifact_quarantined(tmp_path, capfd):
+    blob = tmp_path / "stream-deadbeef.pkl"
+    blob.write_bytes(b"this is not a pickle")
+    (tmp_path / "stream-deadbeef.pkl.meta").write_bytes(b"junk")
+    before = metrics.counters().get("aot.corrupt_artifacts", 0)
+    assert register._aot_load_path(str(blob)) is None  # no crash
+    assert metrics.counters()["aot.corrupt_artifacts"] == before + 1
+    assert not blob.exists(), "corrupt blob must be quarantined"
+    assert not (tmp_path / "stream-deadbeef.pkl.meta").exists()
+    err = capfd.readouterr().err
+    assert "corrupt AOT cache artifact" in err
+    # an UNPICKLABLE-but-valid pickle that is not an executable: the
+    # deserialize stage quarantines the same way
+    blob2 = tmp_path / "stream-cafe.pkl"
+    with open(blob2, "wb") as f:
+        pickle.dump(("not", "an", "executable"), f)
+    assert register._aot_load_path(str(blob2)) is None
+    assert not blob2.exists()
+
+
+def test_resume_with_typed_prng_key(env1, tmp_path):
+    """New-style typed key arrays (jax.random.key) checkpoint and
+    resume identically to raw PRNGKey arrays (np.asarray on a typed
+    key raises, so the sidecar stores the extracted key data)."""
+    import jax
+
+    n = 5
+    circ = Circuit(n)
+    for t in range(n):
+        circ.hadamard(t)
+    circ.measure(0).measure(1)
+    key = jax.random.key(21)
+    qref = qt.create_qureg(n, env1)
+    outs_ref = np.asarray(circ.run(qref, pallas=False, key=key))
+    ref = qt.get_state_vector(qref)
+    d = str(tmp_path / "typed")
+    q = qt.create_qureg(n, env1)
+    resilience.set_fault_plan([("run_item", 4, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, key=jax.random.key(21),
+                 checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    outs = np.asarray(resilience.resume_run(circ, q, d, pallas=False))
+    assert np.array_equal(outs, outs_ref)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+
+
+def test_run_rejects_half_checkpoint_config(env1, tmp_path):
+    """An explicit checkpoint_dir without a cadence (or vice versa)
+    must error, not silently run uncheckpointed — the data-loss
+    outcome the feature exists to prevent."""
+    q = qt.create_qureg(4, env1)
+    with pytest.raises(qt.QuESTError, match="without a cadence"):
+        models.ghz(4).run(q, checkpoint_dir=str(tmp_path / "x"))
+    with pytest.raises(qt.QuESTError, match="without a directory"):
+        models.ghz(4).run(q, checkpoint_every=2)
+
+
+def test_meta_missing_key_triggers_slot_fallback(env1, tmp_path):
+    """A slot whose qureg.json parses but lost a required field is a
+    QuESTError (not a KeyError), so the fallback loop still reaches
+    the other slot."""
+    n = 8
+    ref = _qft_ref(n, env1, False)
+    circ = models.qft(n)
+    d = str(tmp_path / "ckm2")
+    q = qt.create_qureg(n, env1)
+    resilience.set_fault_plan([("run_item", 5, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    with open(os.path.join(d, "latest")) as f:
+        latest = f.read().strip()
+    meta_path = os.path.join(d, latest, "qureg.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta["num_qubits"]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    before = metrics.counters().get("resilience.slot_fallbacks", 0)
+    resilience.resume_run(circ, q, d, pallas=False)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+    assert metrics.counters()["resilience.slot_fallbacks"] == before + 1
+
+
+def test_snapshot_owner_conflict_skips(env1, tmp_path, capfd):
+    """A Circuit.run snapshot into a directory owned by another writer
+    is skipped (counter + one-shot warning), never interleaved."""
+    d = str(tmp_path / "own")
+    q = qt.create_qureg(4, env1)
+    assert resilience.snapshot(
+        q.re, q.im, num_qubits=4, is_density=False, mesh=q.mesh,
+        directory=d, owner="register:1",
+        position={"kind": "flush", "flush_index": 1}) is not None
+    before = metrics.counters().get("resilience.ckpt_dir_conflicts", 0)
+    assert resilience.snapshot(
+        q.re, q.im, num_qubits=4, is_density=False, mesh=q.mesh,
+        directory=d, owner="circuit:abcd",
+        position={"kind": "circuit_run", "item_index": 2}) is None
+    assert metrics.counters()["resilience.ckpt_dir_conflicts"] == before + 1
+    # the rotation still holds ONLY the first owner's snapshot kinds
+    q2 = qt.create_qureg(4, env1)
+    pos = resilience.resume_state(q2, d)
+    assert pos.get("kind") == "flush"
+
+
+def test_snapshot_rotation_alternates_slots(env1, tmp_path):
+    """Consecutive snapshots rotate between slot-0 and slot-1 and the
+    pointer always names the newest complete one."""
+    d = str(tmp_path / "rot")
+    q = qt.create_qureg(4, env1)
+    slots = []
+    for i in range(3):
+        path = resilience.snapshot(
+            q.re, q.im, num_qubits=4, is_density=False, mesh=q.mesh,
+            directory=d, position={"item_index": i, "fingerprint": "x",
+                                   "every": 1, "outcomes": [],
+                                   "key": None})
+        slots.append(os.path.basename(path))
+        with open(os.path.join(d, "latest")) as f:
+            assert f.read().strip() == slots[-1]
+    assert slots[0] != slots[1] and slots[0] == slots[2]
+    # the sidecar of the latest slot carries the newest position
+    pos = resilience.load_snapshot(qt.create_qureg(4, env1), d)
+    assert pos["item_index"] == 2

@@ -1,0 +1,322 @@
+"""Chaos drill: prove the recovery paths actually work.
+
+Runs a QFT workload under a scripted fault matrix (quest_tpu.resilience
+— every fault is deterministic, no randomness anywhere) and asserts
+each scenario's recovery contract:
+
+* ``kill_resume``     — the run is killed at a scripted plan item; the
+  resumed run (``resilience.resume_run`` from the last-good two-slot
+  checkpoint) must produce amplitudes BIT-IDENTICAL to an
+  uninterrupted run.
+* ``corrupt_slot``    — the newest checkpoint slot's array data is
+  corrupted on disk; resume must fall back to the older slot
+  (``resilience.slot_fallbacks``) and still finish bit-identical.
+* ``transient_aot``   — scripted transient I/O failures on the AOT
+  executable cache load AND save paths; the bounded retry
+  (``resilience.retries``) must absorb them and the cache round trip
+  still succeed (runs in a 1-device subprocess — the AOT fast path's
+  own guard disables it on multi-device hosts).
+* ``sink_failure``    — a scripted transient fault on the metrics sink
+  is retried and the ledger line still lands; a persistently
+  unwritable sink degrades (``metrics.sink_errors``) without failing
+  the observed run.
+* ``injected_nan``    — a scripted NaN is injected into the state at a
+  plan item; the health probe must trip AT that item, name it and the
+  last-good checkpoint, and leave the register unbricked.
+
+Every scenario must end in either a clean recovery (with the
+resilience counters recorded) or a ``QuESTError`` naming the seam —
+never a silent wrong state.  Prints one PASS/FAIL line per scenario and
+writes ``CHAOS_r{N}.json``.  Wired into ``tools/record_all.py`` as a
+tier-2 smoke.
+
+Usage: python tools/chaos_drill.py [round]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# exercise the sharded executor (relayout exchanges -> the
+# mesh_exchange seam) even on a CPU-only host: 8 virtual devices,
+# exactly as the test suite and tools/qft_dist.py do
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+import numpy as np  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+from quest_tpu import metrics, models, resilience  # noqa: E402
+from quest_tpu.reporting import stopwatch  # noqa: E402
+
+N_QUBITS = int(os.environ.get("QUEST_CHAOS_QUBITS", "10"))
+#: Scripted hit index for the mid-plan kill / NaN injection.
+KILL_AT = int(os.environ.get("QUEST_CHAOS_KILL_AT", "5"))
+CKPT_EVERY = 2
+
+results = []
+
+
+def record(name: str, ok: bool, **info):
+    entry = {"scenario": name, "ok": bool(ok)}
+    entry.update(info)
+    results.append(entry)
+    print(f"{'PASS' if ok else 'FAIL'} {name:18s} "
+          + " ".join(f"{k}={v}" for k, v in info.items()))
+
+
+def counters_delta(before: dict, keys) -> dict:
+    after = metrics.counters()
+    return {k: after.get(k, 0) - before.get(k, 0) for k in keys}
+
+
+def make_env():
+    import jax
+
+    ndev = 8 if len(jax.devices()) >= 8 else 1
+    return qt.create_env(num_devices=ndev), ndev
+
+
+def reference_state(circ, env, pallas):
+    q = qt.create_qureg(N_QUBITS, env)
+    circ.run(q, pallas=pallas)
+    return qt.get_state_vector(q)
+
+
+def corrupt_slot_arrays(slot_dir: str) -> int:
+    """Flip every byte of the slot's tensorstore files, returning the
+    count flipped.  OCDBT inlines small arrays in its manifests, so
+    BOTH the manifests and the ``d/`` data files are targeted — the
+    drill (and the tests, which import this helper) must not depend on
+    where tensorstore put this state's bytes."""
+    flipped = 0
+    for path in glob.glob(os.path.join(slot_dir, "arrays", "**"),
+                          recursive=True):
+        if os.path.isfile(path) and (path.endswith(".ocdbt")
+                                     or os.sep + "d" + os.sep in path):
+            with open(path, "rb") as f:
+                raw = bytearray(f.read())
+            for i in range(len(raw)):
+                raw[i] ^= 0xFF
+            with open(path, "wb") as f:
+                f.write(bytes(raw))
+            flipped += 1
+    return flipped
+
+
+def drill_kill_resume(circ, env, pallas, ref):
+    d = tempfile.mkdtemp(prefix="chaos-kill-")
+    before = metrics.counters()
+    q = qt.create_qureg(N_QUBITS, env)
+    resilience.set_fault_plan([("run_item", KILL_AT, "runtime")])
+    killed = False
+    try:
+        circ.run(q, pallas=pallas, checkpoint_dir=d,
+                 checkpoint_every=CKPT_EVERY)
+    except RuntimeError:
+        killed = True
+    finally:
+        resilience.clear_fault_plan()
+    resilience.resume_run(circ, q, d, pallas=pallas)
+    got = qt.get_state_vector(q)
+    delta = counters_delta(before, ("resilience.checkpoints",
+                                    "resilience.resumes",
+                                    "resilience.faults_injected"))
+    ok = killed and bool(np.array_equal(got, ref))
+    record("kill_resume", ok, killed=killed,
+           bit_identical=bool(np.array_equal(got, ref)), **delta)
+    return d
+
+
+def drill_corrupt_slot(circ, env, pallas, ref):
+    # fresh checkpointed run killed mid-plan, then the NEWEST slot's
+    # array data is flipped on disk: resume must fall back to the older
+    # slot, replay more items, and still land bit-identical
+    d = tempfile.mkdtemp(prefix="chaos-corrupt-")
+    before = metrics.counters()
+    q = qt.create_qureg(N_QUBITS, env)
+    resilience.set_fault_plan([("run_item", KILL_AT, "runtime")])
+    try:
+        circ.run(q, pallas=pallas, checkpoint_dir=d,
+                 checkpoint_every=CKPT_EVERY)
+    except RuntimeError:
+        pass
+    finally:
+        resilience.clear_fault_plan()
+    with open(os.path.join(d, "latest")) as f:
+        latest = f.read().strip()
+    flipped = corrupt_slot_arrays(os.path.join(d, latest))
+    resilience.resume_run(circ, q, d, pallas=pallas)
+    got = qt.get_state_vector(q)
+    delta = counters_delta(before, ("resilience.slot_fallbacks",
+                                    "resilience.resumes"))
+    ok = (flipped > 0 and delta["resilience.slot_fallbacks"] >= 1
+          and bool(np.array_equal(got, ref)))
+    record("corrupt_slot", ok, flipped_files=flipped,
+           bit_identical=bool(np.array_equal(got, ref)), **delta)
+    shutil.rmtree(d, ignore_errors=True)
+
+
+_AOT_CHILD = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["QUEST_AOT_CACHE"] = {cache!r}
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["QUEST_FAULT_PLAN"] = "aot_save:0:io,aot_load:0:io"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
+import numpy as np
+import jax.numpy as jnp
+from quest_tpu import metrics, models, register
+from quest_tpu.ops.lattice import state_shape
+
+n = 8
+circ = models.qft(n)
+ops = tuple(circ.ops)
+jit_fn = circ.compile(mesh=None, donate=False, pallas=False)
+compiled = register._aot_save(jit_fn, ops, n)
+assert compiled is not None, "aot save failed under transient fault"
+loaded = register._aot_load(ops, n)
+assert loaded is not None, "aot load failed under transient fault"
+shape = state_shape(1 << n)
+re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+im = jnp.zeros(shape, jnp.float32)
+r1, i1 = jit_fn(re, im)
+r2, i2 = loaded(re, im)
+assert np.array_equal(np.asarray(r1), np.asarray(r2))
+retries = metrics.counters().get("resilience.retries", 0)
+assert retries >= 2, f"expected >=2 retries, saw {{retries}}"
+print("AOT_DRILL_OK retries=%d" % retries)
+"""
+
+
+def drill_transient_aot():
+    # the AOT fast path guards itself off on multi-device hosts, so the
+    # scripted transient-I/O round trip runs in a 1-device subprocess
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "child.py")
+        with open(src, "w") as f:
+            f.write(_AOT_CHILD.format(repo=REPO, cache=td))
+        r = subprocess.run([sys.executable, src], capture_output=True,
+                           text=True, timeout=600)
+        ok = r.returncode == 0 and "AOT_DRILL_OK" in r.stdout
+        record("transient_aot", ok,
+               detail=(r.stdout.strip().splitlines()[-1]
+                       if r.stdout.strip() else r.stderr[-200:]))
+
+
+def drill_sink_failure(circ, env, pallas):
+    before = metrics.counters()
+    with tempfile.TemporaryDirectory() as td:
+        sink = os.path.join(td, "ledger.jsonl")
+        os.environ["QUEST_METRICS_FILE"] = sink
+        try:
+            # (a) transient scripted sink fault: retried, line written
+            resilience.set_fault_plan([("sink_write", 0, "io")])
+            q = qt.create_qureg(N_QUBITS, env)
+            circ.run(q, pallas=pallas)
+            resilience.clear_fault_plan()
+            with open(sink) as f:
+                wrote = len(f.read().strip().splitlines()) >= 1
+            # (b) persistently unwritable sink: degrade, run clean
+            os.environ["QUEST_METRICS_FILE"] = os.path.join(
+                td, "no-such-dir", "ledger.jsonl")
+            q2 = qt.create_qureg(N_QUBITS, env)
+            circ.run(q2, pallas=pallas)
+            norm_ok = abs(qt.calc_total_prob(q2) - 1.0) < 1e-6
+        finally:
+            resilience.clear_fault_plan()
+            os.environ.pop("QUEST_METRICS_FILE", None)
+    delta = counters_delta(before, ("resilience.retries",
+                                    "metrics.sink_errors"))
+    ok = wrote and norm_ok and delta["resilience.retries"] >= 1 \
+        and delta["metrics.sink_errors"] >= 1
+    record("sink_failure", ok, line_written=wrote, run_clean=norm_ok,
+           **delta)
+
+
+def drill_injected_nan(circ, env, pallas):
+    d = tempfile.mkdtemp(prefix="chaos-nan-")
+    os.environ["QUEST_HEALTH_EVERY"] = "1"
+    resilience.set_fault_plan([("run_item", KILL_AT, "nan")])
+    q = qt.create_qureg(N_QUBITS, env)
+    caught = named_item = named_ckpt = False
+    try:
+        circ.run(q, pallas=pallas, checkpoint_dir=d,
+                 checkpoint_every=CKPT_EVERY)
+    except qt.QuESTError as e:
+        caught = "non-finite" in str(e)
+        named_item = f"after plan item {KILL_AT}" in str(e)
+        named_ckpt = "checkpoint" in str(e)
+    finally:
+        resilience.clear_fault_plan()
+        os.environ.pop("QUEST_HEALTH_EVERY", None)
+    # observed runs never donate: the register survives the trip
+    unbricked = abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+    shutil.rmtree(d, ignore_errors=True)
+    ok = caught and named_item and named_ckpt and unbricked
+    record("injected_nan", ok, caught=caught, named_item=named_item,
+           named_last_good=named_ckpt, register_unbricked=unbricked)
+
+
+def main():
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    sw = stopwatch()
+    resilience.reset()
+    env, ndev = make_env()
+    # a mesh plan has relayout exchanges between segments; a 1-device
+    # fused plan can collapse to one item, so the single-device drill
+    # uses the per-gate path for fine-grained kill points
+    pallas = "auto" if ndev > 1 else False
+    circ = models.qft(N_QUBITS)
+    ref = reference_state(circ, env, pallas)
+
+    kill_dir = drill_kill_resume(circ, env, pallas, ref)
+    shutil.rmtree(kill_dir, ignore_errors=True)
+    drill_corrupt_slot(circ, env, pallas, ref)
+    drill_transient_aot()
+    drill_sink_failure(circ, env, pallas)
+    drill_injected_nan(circ, env, pallas)
+
+    n_fail = sum(1 for r in results if not r["ok"])
+    doc = {
+        "artifact": "chaos-drill",
+        "round": rnd,
+        "qubits": N_QUBITS,
+        "num_devices": ndev,
+        "kill_at_item": KILL_AT,
+        "checkpoint_every": CKPT_EVERY,
+        "scenarios": results,
+        "failures": n_fail,
+        "seconds": round(sw.seconds, 2),
+        "counters": {k: v for k, v in metrics.counters().items()
+                     if k.startswith("resilience.")
+                     or k == "metrics.sink_errors"},
+    }
+    out = os.path.join(REPO, f"CHAOS_r{rnd:02d}.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"{len(results)} scenarios, {n_fail} failed, "
+          f"{doc['seconds']}s -> {out}")
+    sys.exit(1 if n_fail else 0)
+
+
+if __name__ == "__main__":
+    main()

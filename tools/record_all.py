@@ -38,6 +38,32 @@ RECORDERS = [
 ]
 
 
+def chaos_drill_smoke(summary, rnd) -> None:
+    """Tier-2 smoke: the full chaos drill (tools/chaos_drill.py) at a
+    small size — kill+resume bit-identity, checkpoint-slot corruption
+    fallback, transient AOT/sink I/O retries, injected NaN.  A
+    recovery-path regression fails the recording round immediately
+    instead of surfacing in the next preemption."""
+    env = dict(os.environ)
+    env.setdefault("QUEST_CHAOS_QUBITS", "10")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "chaos_drill.py"),
+             rnd],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=1800)
+        ok, out, err = r.returncode == 0, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        ok, out, err = False, "", f"TIMEOUT after {e.timeout}s"
+    secs = time.time() - t0
+    summary.append(("chaos_drill", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'chaos_drill':22s} {secs:7.1f}s")
+    if not ok:
+        print(out[-1500:])
+        print(err[-1500:])
+
+
 def bench_gate_smoke(summary) -> None:
     """Tier-2 smoke: a small, fast bench run gated against the newest
     recorded BENCH_*.json (``bench.py --gate``, tools/ledger_diff.py
@@ -99,6 +125,7 @@ def main():
             print(out[-1500:])
             print(err[-1500:])
     bench_gate_smoke(summary)
+    chaos_drill_smoke(summary, rnd)
     n_fail = sum(1 for _, ok, _ in summary if not ok)
     print(f"{len(summary)} recorders, {n_fail} failed")
     sys.exit(1 if n_fail else 0)
