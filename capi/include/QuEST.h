@@ -116,10 +116,44 @@ int stopTimelineCapture(QuESTEnv env, char *path);
  * `qureg` (falling back to the older slot if the newest fails its
  * integrity check) and returns the recorded position — the count of
  * flushed gate runs already applied — so the driver can skip
- * re-submitting them; exits with an error (like every QuEST
- * validation failure) when no restorable snapshot exists. */
+ * re-submitting them.  Resume failures are RECOVERABLE: instead of
+ * exiting like a validation failure, resumeRun returns the NEGATED
+ * QuESTErrorCode (e.g. -QUEST_ERROR_TOPOLOGY when the snapshot was
+ * written under a different device count), so a driver can branch on
+ * the failure class and fall back; getLastErrorCode/-String report
+ * the same.  resumeRunEx adds the degraded-mesh flag: a nonzero
+ * allowTopologyChange accepts a snapshot written under a different
+ * device count (the cross-topology restore reshapes exactly). */
 void setCheckpointEvery(QuESTEnv env, const char *directory, int every);
 long long int resumeRun(Qureg qureg, const char *directory);
+long long int resumeRunEx(Qureg qureg, const char *directory,
+                          int allowTopologyChange);
+/* quest_tpu extension: stable error-class codes (the Python-side
+ * QuESTError taxonomy).  Codes are ABI — never renumbered.  A C driver
+ * branches on these instead of parsing message strings. */
+enum QuESTErrorCode {
+    QUEST_SUCCESS = 0,
+    QUEST_ERROR = 1,            /* unclassified QuESTError            */
+    QUEST_ERROR_VALIDATION = 2, /* invalid input / refused operation  */
+    QUEST_ERROR_TIMEOUT = 3,    /* collective watchdog deadline breach */
+    QUEST_ERROR_CORRUPTION = 4, /* integrity check failed (checksum,
+                                 * sidecar, poisoned state)           */
+    QUEST_ERROR_TOPOLOGY = 5    /* snapshot from a different mesh and
+                                 * no allowTopologyChange             */
+};
+/* Code/message of the most recent recoverable failure (0 / "" when the
+ * last recoverable call succeeded). */
+int getLastErrorCode(QuESTEnv env);
+void getLastErrorString(QuESTEnv env, char *str, int maxLen);
+/* quest_tpu extension: the collective watchdog (quest_tpu.resilience).
+ * Arms per-item deadlines on observed runs: budget = minSeconds +
+ * bytes-per-device / (gbps GB/s) * slack, from the same exchange-byte
+ * accounting the run ledger records.  A non-positive parameter CLEARS
+ * any prior override back to the env/default value
+ * (QUEST_WATCHDOG_GBPS/_SLACK/_MIN_S).  A breach
+ * dumps the flight recorder and surfaces as QUEST_ERROR_TIMEOUT. */
+void setCollectiveWatchdog(QuESTEnv env, int enabled, double gbps,
+                           double slack, double minSeconds);
 void seedQuESTDefault(void);
 void seedQuEST(unsigned long int *seedArray, int numSeeds);
 

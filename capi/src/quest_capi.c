@@ -401,8 +401,48 @@ long long int resumeRun(Qureg qureg, const char *directory) {
     long long pos = as_longlong(bcall("resumeRun", "(ls)", qh(qureg),
                                       directory ? directory : ""),
                                 "resumeRun");
-    mirror(qureg); /* restore mutates the device state */
+    if (pos >= 0)
+        mirror(qureg); /* restore mutates the device state */
+    return pos;        /* < 0: negated QuESTErrorCode, state untouched */
+}
+
+long long int resumeRunEx(Qureg qureg, const char *directory,
+                          int allowTopologyChange) {
+    long long pos = as_longlong(bcall("resumeRunEx", "(lsi)", qh(qureg),
+                                      directory ? directory : "",
+                                      allowTopologyChange),
+                                "resumeRunEx");
+    if (pos >= 0)
+        mirror(qureg);
     return pos;
+}
+
+int getLastErrorCode(QuESTEnv env) {
+    (void)env;
+    return (int)as_longlong(bcall("getLastErrorCode", "()"),
+                            "getLastErrorCode");
+}
+
+void getLastErrorString(QuESTEnv env, char *str, int maxLen) {
+    (void)env;
+    if (!str || maxLen <= 0)
+        return;
+    PyObject *r = bcall("getLastErrorString", "()");
+    PyGILState_STATE g = PyGILState_Ensure();
+    const char *s = PyUnicode_AsUTF8(r);
+    if (!s)
+        fatal("getLastErrorString");
+    strncpy(str, s, (size_t)maxLen - 1);
+    str[maxLen - 1] = '\0';
+    Py_DECREF(r);
+    PyGILState_Release(g);
+}
+
+void setCollectiveWatchdog(QuESTEnv env, int enabled, double gbps,
+                           double slack, double minSeconds) {
+    (void)env;
+    BVOID("setCollectiveWatchdog", "(iddd)", enabled, gbps, slack,
+          minSeconds);
 }
 
 void seedQuESTDefault(void) { BVOID("seedQuESTDefault", "()"); }

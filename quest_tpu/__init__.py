@@ -56,7 +56,13 @@ from .register import (
     get_density_matrix,
     compare_states,
 )
-from .validation import QuESTError
+from .validation import (
+    QuESTError,
+    QuESTValidationError,
+    QuESTTimeoutError,
+    QuESTCorruptionError,
+    QuESTTopologyError,
+)
 from .ops.gates import (
     hadamard,
     pauli_x,
@@ -120,6 +126,9 @@ from .resilience import (
     resume_run,
     resume_state,
     set_checkpoint_policy,
+    set_watchdog,
+    mesh_health,
+    clear_mesh_health,
 )
 from . import reporting
 from .reporting import (

@@ -215,17 +215,78 @@ def setCheckpointEvery(directory: str, every: int) -> int:
     return 0
 
 
+#: Last QuESTError class the resume/watchdog entry points caught, as
+#: its stable taxonomy code + message (the C driver branches on the
+#: code via getLastErrorCode instead of parsing strings).
+_last_error = {"code": 0, "message": ""}
+
+
+def _record_error(e: Exception) -> int:
+    _last_error["code"] = int(getattr(e, "code", 1))
+    _last_error["message"] = str(e)
+    return _last_error["code"]
+
+
+def getLastErrorCode() -> int:
+    """Stable taxonomy code of the most recent recoverable failure
+    (0 = none; see the QuESTErrorCode enum in capi/include/QuEST.h and
+    the taxonomy table in docs/ROBUSTNESS.md)."""
+    return _last_error["code"]
+
+
+def getLastErrorString() -> str:
+    """Message of the most recent recoverable failure ('' when none)."""
+    return _last_error["message"]
+
+
 def resumeRun(h: int, directory: str) -> int:
     """Restore the last-good snapshot under ``directory`` into the
     register (two-slot fallback on integrity failure) and return the
     recorded position — flushed gate runs already applied — so the
-    driver can skip re-submitting them."""
+    driver can skip re-submitting them.
+
+    RECOVERABLE: a resume failure returns the NEGATED taxonomy code
+    (e.g. -5 QUEST_ERROR_TOPOLOGY when the snapshot was written under
+    a different device count) instead of exiting the process — resume
+    is exactly where a driver must be able to branch on the failure
+    class (also via getLastErrorCode) and fall back."""
+    return resumeRunEx(h, directory, 0)
+
+
+def resumeRunEx(h: int, directory: str, allow_topology_change: int) -> int:
+    """``resumeRun`` with the degraded-mesh flag: a nonzero
+    ``allow_topology_change`` accepts a snapshot written under a
+    different device count (the cross-topology ``stateio`` restore is
+    exact for flush snapshots — the flag makes the operator acknowledge
+    the surviving mesh is not the one that wrote the checkpoint)."""
+    from . import resilience
+    from .validation import QuESTError
+
+    try:
+        # only flush-kind snapshots reach here (resume_state refuses
+        # mid-run circuit snapshots), and only those carry flush_index
+        pos = resilience.resume_state(
+            _q(h), directory,
+            allow_topology_change=bool(allow_topology_change))
+    except QuESTError as e:
+        return -_record_error(e)
+    _last_error["code"] = 0
+    _last_error["message"] = ""
+    return int(pos.get("flush_index", 0))
+
+
+def setCollectiveWatchdog(enabled: int, gbps: float, slack: float,
+                          min_seconds: float) -> int:
+    """Arm/disarm the collective watchdog from C (quest_tpu.resilience
+    ``set_watchdog``); a non-positive parameter CLEARS any prior
+    override back to the env/default value (QUEST_WATCHDOG_GBPS /
+    _SLACK / _MIN_S) — set_watchdog gives non-positive exactly that
+    meaning, so the values pass through raw."""
     from . import resilience
 
-    # only flush-kind snapshots reach here (resume_state refuses
-    # mid-run circuit snapshots), and only those carry flush_index
-    pos = resilience.resume_state(_q(h), directory)
-    return int(pos.get("flush_index", 0))
+    resilience.set_watchdog(bool(enabled), gbps=gbps, slack=slack,
+                            min_s=min_seconds)
+    return 0
 
 
 def seedQuESTDefault() -> int:

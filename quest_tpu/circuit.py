@@ -442,7 +442,10 @@ class Circuit:
                         re, im,
                         {"kind": "xla-segment", "index": i, "ops": 1,
                          "op": kind, "targets": _op_targets(op),
-                         "last_in_run": i in last_in_run},
+                         "last_in_run": i in last_in_run,
+                         # per-gate dispatch in recorded order: every
+                         # boundary is op-aligned, layout canonical
+                         "ops_done": i + 1},
                         hook=item_hook)
                 else:
                     re, im = run_kernel((re, im), scalars, kind=kind,
@@ -482,7 +485,7 @@ class Circuit:
         # schedule_stats — so run-ledger attribution never re-schedules
         mesh_stats = {"passes": 0, "relayouts": 0, "exchange_elems": 0}
 
-        def run_fn(run_ops):
+        def run_fn(run_ops, op_base):
             if mesh is not None and mesh.devices.size > 1:
                 nvec = self.num_qubits * (2 if self.is_density else 1)
                 if (1 << nvec) // mesh.devices.size < 2:
@@ -508,7 +511,10 @@ class Circuit:
                                      "targets": _op_targets(
                                          (kind, statics, scalars)),
                                      "last_in_run":
-                                         i + 1 == len(run_ops)},
+                                         i + 1 == len(run_ops),
+                                     "ndev": int(mesh.devices.size),
+                                     # per-gate, in order: op-aligned
+                                     "ops_done": op_base + i + 1},
                                     hook=item_hook)
                             else:
                                 re, im = run_kernel((re, im), scalars,
@@ -524,7 +530,8 @@ class Circuit:
                                        interpret=interpret,
                                        per_item=per_item,
                                        donate=not per_item,
-                                       item_hook=item_hook)
+                                       item_hook=item_hook,
+                                       op_base=op_base)
                 for k in mesh_stats:
                     mesh_stats[k] += mfn.plan_stats[k]
                 return mfn
@@ -550,7 +557,14 @@ class Circuit:
                             {"kind": "pallas-pass", "index": i,
                              "ops": len(seg_ops),
                              "high_bits": sorted(high),
-                             "last_in_run": i + 1 == len(segs)},
+                             "last_in_run": i + 1 == len(segs),
+                             # in-run segment scheduling reorders ops,
+                             # so only the run's final boundary is
+                             # op-aligned (layout is always canonical
+                             # on the single-device path)
+                             "ops_done": (op_base + len(run_ops)
+                                          if i + 1 == len(segs)
+                                          else None)},
                             hook=item_hook)
                     else:
                         re, im = apply_fused_segment(re, im, seg_ops,
@@ -560,7 +574,16 @@ class Circuit:
 
             return fn
 
-        run_fns = [run_fn(r) if r else None for r in gate_runs]
+        # global op index of each gate run's first op (runs interleave
+        # with one measure/collapse op each in the recorded stream) —
+        # the base for per-item ops_done annotations
+        bases = []
+        acc = 0
+        for r in gate_runs:
+            bases.append(acc)
+            acc += len(r) + 1
+        run_fns = [run_fn(r, bases[i]) if r else None
+                   for i, r in enumerate(gate_runs)]
         if mesh is not None and mesh.devices.size > 1:
             self._compiled[("sched_stats", mesh, tuple(self.ops))] = \
                 mesh_stats
@@ -734,16 +757,16 @@ class Circuit:
         import operator
 
         if self.num_measurements == 0:
-            raise _v.QuESTError("Circuit.sample requires at least one "
+            raise _v.QuESTValidationError("Circuit.sample requires at least one "
                                 "recorded measure()")
         try:
             shots = operator.index(shots)
         except TypeError:
-            raise _v.QuESTError("Circuit.sample: shots must be an integer")
+            raise _v.QuESTValidationError("Circuit.sample: shots must be an integer")
         if shots < 1:
-            raise _v.QuESTError("Circuit.sample: shots must be >= 1")
+            raise _v.QuESTValidationError("Circuit.sample: shots must be >= 1")
         if mode not in ("auto", "vmap", "sequential"):
-            raise _v.QuESTError(
+            raise _v.QuESTValidationError(
                 "Circuit.sample: mode must be 'auto', 'vmap' or "
                 "'sequential'")
         if key is None:
@@ -857,7 +880,8 @@ class Circuit:
         cursor = _RunCursor(
             skip=int(resume["item_index"]) if resume else 0,
             stored_outcomes=resume.get("outcomes", ()) if resume else (),
-            key=key)
+            key=key,
+            preseed=resume.get("preseed", ()) if resume else ())
         probe.configure(ckpt=ckpt, cursor=cursor)
         if resume:
             # the restored slot is the run's current last-good snapshot
@@ -908,11 +932,11 @@ class Circuit:
         # exists to prevent (env-only knobs stay lenient: a globally
         # exported QUEST_CKPT_DIR with no cadence means "off")
         if checkpoint_dir is not None and not ck_every:
-            raise _v.QuESTError(
+            raise _v.QuESTValidationError(
                 "Circuit.run: checkpoint_dir given without a cadence — "
                 "pass checkpoint_every=k (or set QUEST_CKPT_EVERY)")
         if checkpoint_every and not ck_dir:
-            raise _v.QuESTError(
+            raise _v.QuESTValidationError(
                 "Circuit.run: checkpoint_every given without a "
                 "directory — pass checkpoint_dir (or set "
                 "QUEST_CKPT_DIR)")
@@ -920,8 +944,14 @@ class Circuit:
         if ck_dir and ck_every:
             ckpt = {"directory": ck_dir, "every": int(ck_every),
                     "fingerprint": resilience.plan_fingerprint(
+                        self, qureg, pallas),
+                    "parts": resilience.plan_fingerprint_parts(
                         self, qureg, pallas)}
         with metrics.run_ledger("circuit_run"):
+            # per-run resilience baseline: the record's `resilience`
+            # annotation reports THIS run's retry/fault numbers, not
+            # process-lifetime totals
+            resilience.begin_run()
             metrics.annotate_run("num_qubits", self.num_qubits)
             metrics.annotate_run("is_density", self.is_density)
             metrics.annotate_run(
@@ -929,38 +959,44 @@ class Circuit:
                 1 if qureg.mesh is None else int(qureg.mesh.devices.size))
             observed = (metrics.timeline_active()
                         or metrics.health_every() > 0
-                        or ckpt is not None or _resume is not None)
+                        or ckpt is not None or _resume is not None
+                        or resilience.watchdog_enabled())
             if observed:
                 metrics.annotate_run("observed", True)
-            draws = self._has_nonunitary and self.num_measurements > 0
-            if draws and key is None:
-                if _resume is not None and _resume.get("key") is not None:
-                    # continue with the interrupted run's exact key so
-                    # the remaining measurements draw identically
-                    key = resilience.decode_prng_key(_resume["key"])
-                else:
-                    from .env import default_measure_key
+            try:
+                draws = self._has_nonunitary and self.num_measurements > 0
+                if draws and key is None:
+                    if _resume is not None \
+                            and _resume.get("key") is not None:
+                        # continue with the interrupted run's exact key
+                        # so the remaining measurements draw identically
+                        key = resilience.decode_prng_key(_resume["key"])
+                    else:
+                        from .env import default_measure_key
 
-                    key = default_measure_key()
-            with metrics.span("compile"):
-                if observed:
-                    fn = self._observed_fn(qureg, pallas, ckpt=ckpt,
-                                           resume=_resume, key=key)
-                else:
-                    fn = self.compile(mesh=qureg.mesh, donate=False,
-                                      pallas=pallas)
-            self._record_run_stats(qureg, pallas)
-            with metrics.span("execute"):
-                if self._has_nonunitary:
-                    re, im, outcomes = fn(qureg.re, qureg.im, key)
+                        key = default_measure_key()
+                with metrics.span("compile"):
+                    if observed:
+                        fn = self._observed_fn(qureg, pallas, ckpt=ckpt,
+                                               resume=_resume, key=key)
+                    else:
+                        fn = self.compile(mesh=qureg.mesh, donate=False,
+                                          pallas=pallas)
+                self._record_run_stats(qureg, pallas)
+                with metrics.span("execute"):
+                    if self._has_nonunitary:
+                        re, im, outcomes = fn(qureg.re, qureg.im, key)
+                        qureg._set(re, im)
+                        # collapse-only circuits consume no randomness
+                        # and yield no outcomes: keep the
+                        # mutating-facade contract (return qureg)
+                        return outcomes if draws else qureg
+                    re, im = fn(qureg.re, qureg.im)
                     qureg._set(re, im)
-                    # collapse-only circuits consume no randomness and
-                    # yield no outcomes: keep the mutating-facade
-                    # contract (return qureg)
-                    return outcomes if draws else qureg
-                re, im = fn(qureg.re, qureg.im)
-                qureg._set(re, im)
-                return qureg
+                    return qureg
+            finally:
+                metrics.annotate_run("resilience",
+                                     resilience.run_counters())
 
     def _record_run_stats(self, qureg, pallas) -> None:
         """Attribute one application's recorded schedule costs to the
@@ -998,15 +1034,24 @@ class _RunCursor:
     the checkpoint and must pass through untouched, with skipped
     measurements replaying their recorded outcomes from ``stored``.
     ``outcomes`` is the run's LIVE outcomes list (the checkpoint hook
-    snapshots it into the sidecar); ``key`` the run's PRNG key."""
+    snapshots it into the sidecar); ``key`` the run's PRNG key.
+
+    ``preseed``: outcomes drawn BEFORE this run even starts — the
+    degraded-mesh resume path runs the remaining ops as their own
+    (tail) circuit, so the already-recorded outcomes pre-populate the
+    live list: the returned outcomes vector is complete and the next
+    measure's ``fold_in`` index (= len(outcomes)) continues where the
+    interrupted run stopped."""
 
     __slots__ = ("executed", "skip", "stored", "outcomes", "key")
 
-    def __init__(self, skip: int = 0, stored_outcomes=(), key=None):
+    def __init__(self, skip: int = 0, stored_outcomes=(), key=None,
+                 preseed=()):
         self.executed = 0
         self.skip = int(skip)
         self.stored = [int(x) for x in stored_outcomes]
-        self.outcomes: list = []
+        self.outcomes: list = [jnp.asarray(int(x), jnp.int32)
+                               for x in preseed]
         self.key = key
 
     def take(self) -> bool:
@@ -1054,6 +1099,8 @@ class _HealthProbe:
         self._ops_since = 0
         self._ref = None          # norm/trace at the last healthy probe
         self._last_healthy = None
+        self._ops_done = None     # op-aligned prefix at the last item
+        self._layout = None       # qubit layout after the last item
 
     def configure(self, ckpt: dict | None = None,
                   cursor: "_RunCursor | None" = None) -> None:
@@ -1079,12 +1126,21 @@ class _HealthProbe:
             "format_version": 1,
             "kind": "circuit_run",
             "fingerprint": ck["fingerprint"],
+            "fingerprint_parts": ck.get("parts"),
             "item_index": cur.executed if cur is not None else self._count,
             "every": ck["every"],
             "key": resilience.encode_prng_key(
                 None if cur is None else cur.key),
             "outcomes": [int(x) for x in
                          (cur.outcomes if cur is not None else [])],
+            # degraded-mesh resume bookkeeping: the op-aligned prefix
+            # length at this boundary (None when the cut is mid
+            # segment batch — not degradable) and the qubit layout the
+            # snapshot's amplitudes are stored in (identity when
+            # absent); same-topology resumes ignore both
+            "ops_applied": self._ops_done,
+            "layout": (list(self._layout) if self._layout is not None
+                       else None),
         }
         path = resilience.snapshot(
             re, im, num_qubits=self._c.num_qubits,
@@ -1100,6 +1156,9 @@ class _HealthProbe:
         if not k and ck is None:
             return
         self._count += 1
+        if "ops_done" in meta:
+            self._ops_done = meta.get("ops_done")
+            self._layout = meta.get("layout")
         self._ops_since += int(meta.get("ops", 1))
         probe_due = bool(k) and self._count % k == 0
         ckpt_due = ck is not None and self._count % ck["every"] == 0
@@ -1131,6 +1190,8 @@ class _HealthProbe:
                      "last_healthy": self._last_healthy}
         path = metrics.flight_dump(f"health probe tripped: {reason}",
                                    offending=offending)
+        from . import resilience
+
         msg = (
             f"QUEST_HEALTH_EVERY probe tripped after plan item "
             f"{meta.get('index')} ({meta.get('kind')}): {reason}"
@@ -1142,4 +1203,4 @@ class _HealthProbe:
                     if self._last_snapshot else
                     f"; no checkpoint written yet under "
                     f"{ck['directory']}")
-        raise _v.QuESTError(msg)
+        raise _v.QuESTCorruptionError(msg + resilience.health_suffix())

@@ -105,6 +105,14 @@ def _now() -> float:
     return time.perf_counter()
 
 
+def clock() -> float:
+    """Monotonic wall-clock reading on the ledger's timebase — the
+    sanctioned clock for subsystems outside this module that must
+    measure durations under the instrumentation lint (the resilience
+    collective watchdog walls plan items with it)."""
+    return _now()
+
+
 def counter_inc(name: str, value=1) -> None:
     """Add ``value`` to process counter ``name`` and to this thread's
     active run record (all nesting levels), if any."""

@@ -15,6 +15,7 @@ import numpy as np
 from ..register import Qureg
 from ..validation import (
     QuESTError,
+    QuESTValidationError,
     validate_matching_dims,
     validate_target,
     validate_outcome,
@@ -94,7 +95,7 @@ def calc_inner_product(bra: Qureg, ket: Qureg) -> complex:
     """<bra|ket> (reference: calcInnerProduct, QuEST.c:623-635; kernel
     QuEST_cpu.c:994-1036 + allreduce QuEST_cpu_distributed.c:41-57)."""
     if bra.is_density or ket.is_density:
-        raise QuESTError("calcInnerProduct requires state-vectors")
+        raise QuESTValidationError("calcInnerProduct requires state-vectors")
     validate_matching_dims(bra, ket, "calcInnerProduct")
     r, i = run_kernel(
         (bra.re, bra.im, ket.re, ket.im), (), kind="sv_inner_product",
@@ -119,7 +120,7 @@ def calc_fidelity(qureg: Qureg, pure_state: Qureg) -> float:
     QuEST.c:637-645; statevec form QuEST_common.c:321-327; density form
     QuEST_cpu_distributed.c:407-420)."""
     if pure_state.is_density:
-        raise QuESTError("second argument of calcFidelity must be a state-vector")
+        raise QuESTValidationError("second argument of calcFidelity must be a state-vector")
     validate_matching_dims(qureg, pure_state, "calcFidelity")
     if not qureg.is_density:
         ip = calc_inner_product(qureg, pure_state)

@@ -341,6 +341,42 @@ def apply_relayout(re, im, perm, dev, axis: str, ndev: int,
     return z[0], z[1]
 
 
+def apply_layout_perm(re, im, perm, mesh):
+    """Apply the bit permutation ``new[i] = old[j]`` (bit ``b`` of
+    ``j`` = bit ``perm[b]`` of ``i``) to a concrete (re, im) pair on
+    ``mesh`` — pure data movement, no arithmetic, so the result is
+    exact.
+
+    This is the degraded-mesh resume's canonicalisation step
+    (``resilience._resume_degraded``): a mid-plan snapshot holds the
+    OLD mesh's relabelled qubit layout, and applying ``perm = inv``
+    (``scheduler.plan_layouts``) under the NEW mesh restores canonical
+    order so the remaining ops can be re-planned there.  Single-device
+    registers permute in-chunk (one transpose); meshes route through
+    :func:`apply_relayout` under shard_map."""
+    n = len(perm)
+    if all(p == b for b, p in enumerate(perm)):
+        return re, im
+    if mesh is None or mesh.devices.size == 1:
+        z = jnp.stack([re, im])
+        z = _permute_local_bits(z, list(perm), n)
+        return z[0], z[1]
+    (axis,) = mesh.axis_names
+    ndev = math.prod(mesh.devices.shape)
+    lane_bits = _ilog2(re.shape[1])
+    chunk_bits = n - _ilog2(ndev)
+
+    def body(r, i_):
+        dev = lax.axis_index(axis)
+        return apply_relayout(r, i_, tuple(perm), dev, axis, ndev,
+                              chunk_bits, lane_bits)
+
+    fn = shard_map_compat(body, mesh=mesh,
+                          in_specs=(P(axis), P(axis)),
+                          out_specs=(P(axis), P(axis)))
+    return jax.jit(fn)(re, im)
+
+
 def item_timeline_meta(item, num_vec_bits: int, dev_bits: int,
                        backend: str = "pallas") -> dict:
     """Static timeline/flight-recorder tags for one plan item: kind
@@ -373,7 +409,7 @@ def observe_item(f, re, im, meta: dict, hook=None):
     health ``hook`` on the produced state.  Only reached when the
     caller verified the arrays are concrete (never under a trace).
 
-    Two resilience integrations (quest_tpu.resilience):
+    Three resilience integrations (quest_tpu.resilience):
 
     * **Resume cursor** — a ``hook`` carrying a ``cursor`` has every
       item pass through ``cursor.take()`` in deterministic plan order;
@@ -385,35 +421,71 @@ def observe_item(f, re, im, meta: dict, hook=None):
       output amplitude [0, 0] is poisoned AFTER it executes, upstream
       of the health hook that should catch it), and ``mesh_exchange``
       additionally fires on items that move data over the interconnect
-      (comm class half/full/relayout)."""
+      (comm class half/full/relayout).  Both support the straggler
+      kinds ``delay:<ms>`` (sleeps under the watchdog wall) and
+      ``stall`` (blocks until the armed watchdog deadline).
+    * **Collective watchdog** — when armed
+      (``resilience.watchdog_enabled``), the item is walled with a
+      deadline priced from its exchange bytes (the SAME
+      ``plan_exchange_elems`` figure the ledger records); completion is
+      forced with ``block_until_ready`` so the elapsed time is honest
+      device time, an in-flight timer dumps the flight ring if the
+      item runs past its budget (a hung collective leaves a diagnosis
+      on disk), and a breach raises a typed ``QuESTTimeoutError``."""
     from .. import resilience
 
     cur = getattr(hook, "cursor", None) if hook is not None else None
     if cur is not None and not cur.take():
         return re, im
-    poison = None
-    if resilience.fault_active():
-        if meta.get("comm_class") in ("half", "full", "relayout"):
-            resilience.fault_point("mesh_exchange")
-        poison = resilience.fault_point("run_item")
     itemsize = jnp.dtype(re.dtype).itemsize
     args = dict(meta)
     kind = args.pop("kind")
     elems = args.pop("exchange_elems", 0)
+    ndev = args.pop("ndev", 1)
+    args.pop("ops_done", None)   # resume bookkeeping, not a trace tag
+    args.pop("layout", None)
+    exchange_bytes = elems * itemsize
     if elems or meta.get("comm_class") is not None:
-        args["exchange_bytes"] = elems * itemsize
-    metrics.flight_record(kind, shape=list(re.shape),
-                          dtype=str(re.dtype), **args)
-    if metrics.timeline_active():
-        with metrics.timeline_span(kind, args=args):
+        args["exchange_bytes"] = exchange_bytes
+    wd_meta = dict(args, kind=kind, ndev=ndev)
+    wall = resilience.watchdog_begin(wd_meta, exchange_bytes, ndev)
+    # everything after the wall is armed runs under the cancel guard: a
+    # raising fault seam must not leak a live timer that would later
+    # fire and overwrite the real failure's flight dump
+    try:
+        poison = None
+        stalled = False
+        if resilience.fault_active():
+            fired = []
+            if meta.get("comm_class") in ("half", "full", "relayout"):
+                fired.append(resilience.fault_point("mesh_exchange"))
+            fired.append(resilience.fault_point("run_item"))
+            poison = "nan" if "nan" in fired else None
+            stalled = "stall" in fired
+        metrics.flight_record(kind, shape=list(re.shape),
+                              dtype=str(re.dtype), **args)
+        if stalled:
+            # a simulated hung collective: blocks until the armed
+            # deadline, then raises the breach (never returns)
+            resilience.watchdog_stall(wall, wd_meta)
+        if metrics.timeline_active():
+            with metrics.timeline_span(kind, args=args):
+                re, im = f(re, im)
+                jax.block_until_ready((re, im))
+        elif wall is not None:
             re, im = f(re, im)
             jax.block_until_ready((re, im))
-    else:
-        re, im = f(re, im)
+        else:
+            re, im = f(re, im)
+    except BaseException:
+        if wall is not None:
+            wall.cancel()
+        raise
+    resilience.watchdog_end(wall)
     if poison == "nan":
         re = re.at[(0,) * re.ndim].set(float("nan"))
     if hook is not None:
-        hook(re, im, dict(meta, exchange_bytes=elems * itemsize))
+        hook(re, im, dict(meta, exchange_bytes=exchange_bytes))
     return re, im
 
 
@@ -528,7 +600,7 @@ def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int):
 def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
                      interpret: bool = False, backend: str = "pallas",
                      per_item: bool = False, donate: bool = True,
-                     item_hook=None):
+                     item_hook=None, op_base: int = 0):
     """A pure (re, im) -> (re, im) function running the recorded ops as
     fused segments inside shard_map over ``mesh``, with relayout
     half-exchanges for sharded-qubit gates.  Input and output arrays are
@@ -559,16 +631,21 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
     Chrome-trace event (kind / targets / comm class / exchange bytes,
     from the same ``plan_exchange_elems`` accounting the ledger uses),
     plus a flight-recorder entry; ``item_hook(re, im, meta)`` — the
-    health-probe seam — runs after every item."""
+    health-probe seam — runs after every item.
+
+    ``op_base``: the index of ``ops[0]`` within the whole circuit's op
+    stream — per-item metas then carry GLOBAL ``ops_done`` annotations
+    (op-aligned boundaries only) plus the post-item qubit ``layout``,
+    which checkpoint sidecars record for degraded-mesh resume."""
     return _mesh_plan_fn(ops, num_vec_bits, mesh, interpret, backend,
                          per_item=per_item, donate=donate,
-                         item_hook=item_hook)
+                         item_hook=item_hook, op_base=op_base)
 
 
 def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
                   backend: str, per_item: bool, donate: bool = True,
-                  item_hook=None):
-    from ..scheduler import schedule_mesh
+                  item_hook=None, op_base: int = 0):
+    from ..scheduler import plan_layouts, schedule_mesh
     from ..ops.segment_xla import apply_segment_xla
 
     (axis,) = mesh.axis_names
@@ -577,7 +654,8 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
     lanes = state_shape(1 << num_vec_bits, ndev)[1]
     lane_bits = _ilog2(lanes)
     chunk_bits = num_vec_bits - dev_bits
-    plan = schedule_mesh(list(ops), num_vec_bits, dev_bits, lane_bits)
+    plan, aligned = schedule_mesh(list(ops), num_vec_bits, dev_bits,
+                                  lane_bits, with_meta=True)
 
     # Ledger accounting for one application of the plan, computed once
     # here; the returned fn records per EXECUTION (skipped under an
@@ -652,8 +730,13 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
                             donate_argnums=(0, 1) if donate else ())
                 unique[key] = f
             item_fns.append(f)
+        layouts = plan_layouts(plan, num_vec_bits)
         metas = [dict(item_timeline_meta(item, num_vec_bits, dev_bits,
-                                         backend), index=i)
+                                         backend),
+                      index=i, ndev=ndev,
+                      ops_done=(None if aligned[i] is None
+                                else op_base + aligned[i]),
+                      layout=list(layouts[i]))
                  for i, item in enumerate(plan)]
         if metas:
             # the plan's final item restores the canonical layout and

@@ -31,6 +31,8 @@ from .env import QuESTEnv
 from .ops.lattice import amp_sharding, lru_get, state_shape
 from .validation import (
     QuESTError,
+    QuESTCorruptionError,
+    QuESTValidationError,
     validate_create_num_qubits,
     validate_state_index,
     validate_num_amps,
@@ -233,7 +235,7 @@ class Qureg:
             bound = 64 * max(n_ops, 1) * _prec.real_eps(self.real_dtype)
             drift = abs(norm - before)
             if drift > bound * max(before, 1.0):
-                raise QuESTError(
+                raise QuESTCorruptionError(
                     f"norm drift {drift:.3e} after {n_ops} {tag} ops "
                     f"exceeds debug bound {bound:.3e} (norm {before!r} -> "
                     f"{norm!r}) — kernel regression?")
@@ -275,11 +277,12 @@ class Qureg:
                               "num_vec_qubits": self.num_vec_qubits}}
         path = metrics.flight_dump(f"health probe tripped: {reason}",
                                    offending=offending)
-        raise QuESTError(
+        raise QuESTCorruptionError(
             f"QUEST_HEALTH_EVERY probe tripped after a flushed run of "
             f"{n_ops} gate ops: {reason}"
             + (f"; flight recorder dumped to {path}" if path else
-               " (flight-recorder dump failed; see metrics.sink_errors)"))
+               " (flight-recorder dump failed; see metrics.sink_errors)")
+            + resilience.health_suffix())
 
     def _run_gates(self, jax, run, run_kernel_donated) -> None:
         n_run = len(run)
@@ -954,7 +957,7 @@ def _alloc(num_qubits: int, is_density: bool, env: QuESTEnv, dtype) -> Qureg:
     # limit too: numAmpsPerChunk = 2^n / numRanks >= 1, QuEST_cpu.c:1204).
     min_bits = num_qubits if is_density else 0
     if ndev > 1 and (1 << nvec) // ndev < (1 << min_bits):
-        raise QuESTError(
+        raise QuESTValidationError(
             f"cannot shard {num_qubits}-qubit "
             f"{'density matrix' if is_density else 'state-vector'} over "
             f"{ndev} devices: chunks would be smaller than "
@@ -1197,7 +1200,7 @@ def init_state_of_single_qubit(qureg: Qureg, qubit: int, outcome: int) -> None:
     (reference: initStateOfSingleQubit, QuEST_debug.h:25-31,
     QuEST_cpu.c:1427-1467)."""
     if qureg.is_density:
-        raise QuESTError("initStateOfSingleQubit requires a state-vector")
+        raise QuESTValidationError("initStateOfSingleQubit requires a state-vector")
     validate_target(qureg, qubit)
     validate_outcome(outcome)
     norm = 1.0 / np.sqrt(qureg.num_amps / 2.0)
@@ -1217,7 +1220,7 @@ def init_pure_state(qureg: Qureg, pure: Qureg) -> None:
     rho[r, c] = psi_r * conj(psi_c); the two agree exactly on real
     states (covered by the reference-parity test suite)."""
     if pure.is_density:
-        raise QuESTError("second argument of initPureState must be a state-vector")
+        raise QuESTValidationError("second argument of initPureState must be a state-vector")
     validate_matching_dims(qureg, pure)
     if not qureg.is_density:
         # Fresh buffers, not shared references: a later flush donates the
@@ -1243,7 +1246,7 @@ def init_state_from_amps(qureg: Qureg, reals, imags) -> None:
     reals = np.asarray(reals, dtype=qureg.real_dtype).reshape(-1)
     imags = np.asarray(imags, dtype=qureg.real_dtype).reshape(-1)
     if reals.shape != (qureg.num_amps,) or imags.shape != (qureg.num_amps,):
-        raise QuESTError(
+        raise QuESTValidationError(
             f"initStateFromAmps needs {qureg.num_amps} reals and imags"
         )
     shape = qureg.state_shape
@@ -1282,7 +1285,7 @@ def set_amps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
     """Overwrite a contiguous window of amplitudes (reference: setAmps,
     QuEST.c:143-152, windowed per-chunk in QuEST_cpu.c:1160-1200)."""
     if qureg.is_density:
-        raise QuESTError("setAmps requires a state-vector")
+        raise QuESTValidationError("setAmps requires a state-vector")
     validate_num_amps(qureg, start_ind, num_amps)
     if num_amps == 0:
         return
@@ -1321,7 +1324,7 @@ def clone_qureg(target: Qureg, copy: Qureg) -> None:
     Copies the buffers (as the reference does): sharing them would let a
     later donated flush on one register invalidate the other."""
     if target.is_density != copy.is_density:
-        raise QuESTError("cloneQureg requires registers of the same kind")
+        raise QuESTValidationError("cloneQureg requires registers of the same kind")
     validate_matching_dims(target, copy)
     target._set(copy.re + 0, copy.im + 0)
 
@@ -1572,14 +1575,14 @@ def get_real_amp(qureg: Qureg, index: int) -> float:
     statevec_getRealAmp QuEST_cpu_distributed.c:202-210 — the cross-device
     fetch is a JAX gather here.)"""
     if qureg.is_density:
-        raise QuESTError("getRealAmp requires a state-vector")
+        raise QuESTValidationError("getRealAmp requires a state-vector")
     validate_state_index(qureg, index)
     return float(_amp_at(qureg, index)[0])
 
 
 def get_imag_amp(qureg: Qureg, index: int) -> float:
     if qureg.is_density:
-        raise QuESTError("getImagAmp requires a state-vector")
+        raise QuESTValidationError("getImagAmp requires a state-vector")
     validate_state_index(qureg, index)
     return float(_amp_at(qureg, index)[1])
 
@@ -1587,7 +1590,7 @@ def get_imag_amp(qureg: Qureg, index: int) -> float:
 def get_amp(qureg: Qureg, index: int) -> complex:
     """(reference: getAmp, QuEST.c:521-527.)"""
     if qureg.is_density:
-        raise QuESTError("getAmp requires a state-vector")
+        raise QuESTValidationError("getAmp requires a state-vector")
     validate_state_index(qureg, index)
     re, im = _amp_at(qureg, index)
     return complex(float(re), float(im))
@@ -1603,7 +1606,7 @@ def get_density_amp(qureg: Qureg, row: int, col: int) -> complex:
     """rho[row, col], flat index row + col * 2^N (reference: getDensityAmp,
     QuEST.c:529-539)."""
     if not qureg.is_density:
-        raise QuESTError("getDensityAmp requires a density matrix")
+        raise QuESTValidationError("getDensityAmp requires a density matrix")
     validate_state_index(qureg, row)
     validate_state_index(qureg, col)
     ind = row + col * (1 << qureg.num_qubits)
@@ -1624,7 +1627,7 @@ def get_density_matrix(qureg: Qureg) -> np.ndarray:
     """Full density matrix as a host (2^N, 2^N) complex array, indexed
     [row, col]."""
     if not qureg.is_density:
-        raise QuESTError("getDensityMatrix requires a density matrix")
+        raise QuESTValidationError("getDensityMatrix requires a density matrix")
     dim = 1 << qureg.num_qubits
     # flat index = col * dim + row -> reshape gives [col, row]; transpose.
     return get_state_vector(qureg).reshape(dim, dim).T

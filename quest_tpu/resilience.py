@@ -48,6 +48,33 @@ Three subsystems:
   bit-identical to the uninterrupted run, which ``tools/chaos_drill.py``
   asserts under a whole fault matrix.
 
+Pod-scale additions (ISSUE-7) extend this to the DISTRIBUTED failure
+modes — slow chips, hung collectives, lost slices:
+
+* **Collective watchdog** — armed via ``QUEST_WATCHDOG=1`` /
+  :func:`set_watchdog`, every observed plan item gets a deadline
+  priced from the same exchange-byte accounting the ledger records
+  (:func:`watchdog_budget_s`); an in-flight timer dumps the flight
+  ring while a hung item still runs, and a breach raises a typed
+  :class:`QuESTTimeoutError` naming the item, comm class, and
+  expected-vs-elapsed budget.  The ``delay:<ms>`` / ``stall``
+  straggler fault kinds make breaches drillable with zero randomness.
+
+* **Mesh-health registry** — comm-item breaches strike the
+  participating devices; ``strikes`` breaches (circuit breaker) mark a
+  device DEGRADED in :func:`mesh_health`, the run ledger, and every
+  subsequent probe message (:func:`health_suffix`).
+
+* **Degraded-mesh resume** — ``resume_run(...,
+  allow_topology_change=True)`` resumes a checkpoint written by a
+  LARGER mesh onto the surviving one: the fingerprint splits into
+  circuit/topology/backend components
+  (:func:`plan_fingerprint_parts` — mismatches name what differs), the
+  snapshot restores through the exact cross-topology stateio path, the
+  recorded mid-plan layout is canonicalised with one exact relayout,
+  and the remaining ops re-plan for the new mesh — bit-identical to a
+  clean smaller-mesh run of the tail (docs/ROBUSTNESS.md).
+
 NOTE mid-run snapshots are RESUME POSITIONS, not canonical states: on a
 mesh, a plan item boundary may hold the register in a relabelled qubit
 layout that only the remaining plan items restore.  Resume them with
@@ -65,7 +92,9 @@ import threading
 import time
 
 from . import metrics
-from .validation import QuESTError
+from .validation import (QuESTError, QuESTCorruptionError,
+                         QuESTTimeoutError, QuESTTopologyError,
+                         QuESTValidationError)
 
 #: Every fault seam wired into the codebase.  The instrumentation lint
 #: (tests/test_metrics.py) asserts the call sites reference EXACTLY
@@ -82,8 +111,16 @@ SEAMS = frozenset({
     "stream_dispatch",  # register._run_gates_inner: donated gate dispatch
 })
 
-#: Fault kinds a plan entry may script.
-KINDS = ("io", "runtime", "nan")
+#: Fault kinds a plan entry may script.  ``delay:<ms>`` (a deterministic
+#: straggler: the seam sleeps that many milliseconds before the item
+#: runs) and ``stall`` (a simulated hung collective: the seam blocks
+#: until the armed watchdog's deadline fires) are valid only on the
+#: :data:`STRAGGLER_SEAMS`.
+KINDS = ("io", "runtime", "nan", "stall")
+
+#: The seams that model slow/hung devices (``delay:<ms>`` / ``stall``):
+#: the ones walled by the collective watchdog.
+STRAGGLER_SEAMS = ("mesh_exchange", "run_item")
 
 #: Per-seam bounded retry budget (attempts AFTER the first).  Sinks are
 #: best-effort (they already degrade), so one retry; checkpoint I/O is
@@ -137,10 +174,23 @@ _dir_owners: dict[str, str] = {}
 # ---------------------------------------------------------------------------
 
 
+def _delay_ms(kind: str) -> int | None:
+    """The millisecond count of a ``delay:<ms>`` fault kind, else None."""
+    if not isinstance(kind, str) or not kind.startswith("delay:"):
+        return None
+    try:
+        ms = int(kind.split(":", 1)[1])
+    except ValueError:
+        return None
+    return ms if ms >= 0 else None
+
+
 def _parse_plan(spec) -> list[tuple[str, int, str]]:
     """Normalise a fault plan: a ``"seam:hit:kind[,...]"`` string (the
-    ``QUEST_FAULT_PLAN`` format; ``;`` also separates entries) or an
-    iterable of ``(seam, hit, kind)`` triples / dicts."""
+    ``QUEST_FAULT_PLAN`` format; ``;`` also separates entries; the
+    ``delay`` kind carries its milliseconds as a fourth field,
+    ``seam:hit:delay:250``) or an iterable of ``(seam, hit, kind)``
+    triples / dicts."""
     entries = []
     if isinstance(spec, str):
         parts = [p for chunk in spec.split(";") for p in chunk.split(",")]
@@ -149,9 +199,12 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
             if not part:
                 continue
             bits = part.split(":")
+            if len(bits) == 4 and bits[2] == "delay":
+                bits = [bits[0], bits[1], f"delay:{bits[3]}"]
             if len(bits) != 3:
-                raise QuESTError(
-                    f"bad fault-plan entry {part!r}: want seam:hit:kind")
+                raise QuESTValidationError(
+                    f"bad fault-plan entry {part!r}: want seam:hit:kind "
+                    "(or seam:hit:delay:<ms>)")
             entries.append((bits[0], bits[1], bits[2]))
     else:
         for e in spec:
@@ -162,18 +215,26 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
     plan = []
     for seam, hit, kind in entries:
         if seam not in SEAMS:
-            raise QuESTError(
+            raise QuESTValidationError(
                 f"unknown fault seam {seam!r}; seams: {sorted(SEAMS)}")
-        if kind not in KINDS:
-            raise QuESTError(
-                f"unknown fault kind {kind!r}; kinds: {list(KINDS)}")
+        if kind not in KINDS and _delay_ms(kind) is None:
+            raise QuESTValidationError(
+                f"unknown fault kind {kind!r}; kinds: {list(KINDS)} or "
+                "delay:<ms>")
+        if (kind == "stall" or _delay_ms(kind) is not None) \
+                and seam not in STRAGGLER_SEAMS:
+            raise QuESTValidationError(
+                f"fault kind {kind!r} models a straggler device and is "
+                f"valid only on the {sorted(STRAGGLER_SEAMS)} seams, "
+                f"not {seam!r}")
         try:
             hit = int(hit)
         except (TypeError, ValueError):
-            raise QuESTError(f"fault hit index must be an integer, got "
-                             f"{hit!r}")
+            raise QuESTValidationError(
+                f"fault hit index must be an integer, got {hit!r}")
         if hit < 0:
-            raise QuESTError(f"fault hit index must be >= 0, got {hit}")
+            raise QuESTValidationError(
+                f"fault hit index must be >= 0, got {hit}")
         plan.append((seam, hit, kind))
     return plan
 
@@ -231,11 +292,15 @@ def fault_point(name: str) -> str | None:
     Counts this invocation of seam ``name``; when the active fault plan
     scripts a fault at exactly this hit index, it fires:
     ``io`` raises :class:`OSError`, ``runtime`` raises
-    :class:`RuntimeError` (both naming the seam and hit), and ``nan``
+    :class:`RuntimeError` (both naming the seam and hit), ``nan``
     RETURNS ``"nan"`` — the caller poisons the state it owns (only the
     ``run_item`` seam supports injection; other seams treat it as
-    ``runtime``).  With no plan installed this is a single dict lookup
-    and returns None."""
+    ``runtime``); ``delay:<ms>`` sleeps that long here — a
+    deterministic straggler the collective watchdog then catches — and
+    returns ``"delay"``; ``stall`` RETURNS ``"stall"`` and the caller
+    (``mesh_exec.observe_item``) blocks on the armed watchdog deadline,
+    modelling a hung collective.  With no plan installed this is a
+    single dict lookup and returns None."""
     if _plan is None and not os.environ.get("QUEST_FAULT_PLAN"):
         return None
     plan = _current_plan()
@@ -253,6 +318,12 @@ def fault_point(name: str) -> str | None:
     metrics.trace(f"fault injected at seam {name!r} hit {idx} ({fired})")
     if fired == "nan" and name == "run_item":
         return "nan"
+    ms = _delay_ms(fired)
+    if ms is not None:
+        time.sleep(ms / 1000.0)
+        return "delay"
+    if fired == "stall":
+        return "stall"
     if fired == "io":
         raise OSError(f"scripted fault at seam {name!r} (hit {idx})")
     raise RuntimeError(f"scripted fault at seam {name!r} (hit {idx})")
@@ -279,7 +350,7 @@ def with_retries(fn, *, seam: str, retries: int | None = None,
     a file read/write is safe, re-running a donated-buffer gate dispatch
     is not (see the module docstring — that path requeues instead)."""
     if seam not in SEAMS:
-        raise QuESTError(f"unknown retry seam {seam!r}")
+        raise QuESTValidationError(f"unknown retry seam {seam!r}")
     n = RETRY_POLICY.get(seam, 2) if retries is None else int(retries)
     base = RETRY_BASE_DELAY if base_delay is None else float(base_delay)
     last = None
@@ -294,6 +365,316 @@ def with_retries(fn, *, seam: str, retries: int | None = None,
             last = e
     metrics.counter_inc("resilience.gave_up")
     raise last
+
+
+# ---------------------------------------------------------------------------
+# Collective watchdog + mesh-health registry
+# ---------------------------------------------------------------------------
+#
+# A hung collective on a pod otherwise blocks forever with no diagnosis.
+# The watchdog walls every OBSERVED plan item (mesh_exec.observe_item)
+# with a deadline priced from the SAME plan_exchange_elems accounting
+# the run ledger records: budget = min_s + (bytes-per-device / link
+# GB/s) x slack.  Two layers: an in-flight timer thread dumps the
+# flight-recorder ring to disk the moment an item runs past its budget
+# (so a genuinely hung process still leaves a diagnosis), and the
+# post-completion check raises a typed QuESTTimeoutError naming the
+# item, its comm class, and the expected-vs-elapsed budget.  Each comm
+# breach also strikes the participating devices in the mesh-health
+# registry; k strikes (circuit breaker) mark a device DEGRADED — in the
+# run ledger (``degraded_devices`` annotation), the health-probe
+# messages, and :func:`mesh_health`.
+
+#: Watchdog defaults; env-overridable (QUEST_WATCHDOG_GBPS / _SLACK /
+#: _MIN_S / _STRIKES), programmatic config (set_watchdog) wins.
+#: 45 GB/s is a conservative per-device ICI figure; slack 8x absorbs
+#: congestion and launch skew; min_s floors compute-only items.
+WATCHDOG_GBPS_DEFAULT = 45.0
+WATCHDOG_SLACK_DEFAULT = 8.0
+WATCHDOG_MIN_S_DEFAULT = 30.0
+WATCHDOG_STRIKES_DEFAULT = 3
+
+_watchdog = {"on": False, "gbps": None, "slack": None, "min_s": None,
+             "strikes": None}
+
+#: Per-device suspect counters and the degraded set, keyed by device
+#: index on the executing mesh.
+_mesh_health = {"strikes": {}, "degraded": []}
+
+
+def set_watchdog(enabled: bool = True, *, gbps: float | None = None,
+                 slack: float | None = None, min_s: float | None = None,
+                 strikes: int | None = None) -> None:
+    """Programmatically arm (or disarm) the collective watchdog and
+    override its budget parameters.  ``None`` keeps the current
+    override; a NON-POSITIVE value CLEARS the override back to the
+    env/default (the C API's ``setCollectiveWatchdog`` contract — a
+    driver has no other way to drop a prior override).  The env knob
+    ``QUEST_WATCHDOG=1`` arms it for unmodified drivers."""
+    _watchdog["on"] = bool(enabled)
+
+    def _norm(v, cast):
+        if v is None:
+            return "keep"
+        v = cast(v)
+        return v if v > 0 else None
+
+    for key, v, cast in (("gbps", gbps, float), ("slack", slack, float),
+                         ("min_s", min_s, float),
+                         ("strikes", strikes, int)):
+        nv = _norm(v, cast)
+        if nv != "keep":
+            _watchdog[key] = nv
+
+
+def watchdog_enabled() -> bool:
+    """True when the collective watchdog is armed (programmatic
+    :func:`set_watchdog` or ``QUEST_WATCHDOG=1``).  An armed watchdog
+    routes ``Circuit.run`` onto the observed per-item path — deadlines
+    need per-item walls, which the whole-program jit cannot provide."""
+    return _watchdog["on"] or os.environ.get("QUEST_WATCHDOG") == "1"
+
+
+def _wd_param(key: str, env: str, default: float) -> float:
+    v = _watchdog[key]
+    if v is not None:
+        return v
+    try:
+        return float(os.environ[env])
+    except (KeyError, ValueError):
+        return default
+
+
+def watchdog_strikes() -> int:
+    """Strikes before the circuit breaker marks a device degraded."""
+    v = _watchdog["strikes"]
+    if v is not None:
+        return v
+    try:
+        return max(1, int(os.environ["QUEST_WATCHDOG_STRIKES"]))
+    except (KeyError, ValueError):
+        return WATCHDOG_STRIKES_DEFAULT
+
+
+def watchdog_budget_s(exchange_bytes: int, ndev: int) -> float:
+    """Deadline for one observed plan item, in seconds.
+
+    ``exchange_bytes`` is the item's interconnect volume summed over
+    every device and both (re, im) arrays — the EXACT
+    ``plan_exchange_elems`` figure the ledger records, so the watchdog
+    and the ledger can never disagree about an item's cost.  Per-device
+    wire time prices against the configured link bandwidth with a slack
+    factor; the floor covers compute-only items (exchange_bytes 0)."""
+    gbps = _wd_param("gbps", "QUEST_WATCHDOG_GBPS", WATCHDOG_GBPS_DEFAULT)
+    slack = _wd_param("slack", "QUEST_WATCHDOG_SLACK",
+                      WATCHDOG_SLACK_DEFAULT)
+    min_s = _wd_param("min_s", "QUEST_WATCHDOG_MIN_S",
+                      WATCHDOG_MIN_S_DEFAULT)
+    per_dev = exchange_bytes / max(int(ndev), 1)
+    return min_s + (per_dev / (gbps * 1e9)) * slack
+
+
+class _WatchdogWall:
+    """One armed per-item deadline (see :func:`watchdog_begin`)."""
+
+    __slots__ = ("meta", "budget", "t0", "expired", "_timer")
+
+    def __init__(self, meta: dict, budget: float):
+        self.meta = dict(meta)
+        self.budget = budget
+        self.expired = threading.Event()
+        self.t0 = metrics.clock()
+        self._timer = threading.Timer(budget, self._on_expiry)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _on_expiry(self) -> None:
+        # The item is STILL RUNNING past its budget: a possible hang.
+        # Dump the flight ring now, from this timer thread — if the
+        # collective never completes, the diagnosis is already on disk.
+        self.expired.set()
+        metrics.counter_inc("resilience.watchdog_overdue")
+        metrics.flight_dump(
+            "collective watchdog: plan item still running past its "
+            f"budget ({self.budget:.3f}s)",
+            offending={"item": self.meta, "budget_s": self.budget})
+
+    def cancel(self) -> None:
+        self._timer.cancel()
+
+
+def watchdog_begin(meta: dict, exchange_bytes: int,
+                   ndev: int) -> "_WatchdogWall | None":
+    """Arm the per-item deadline for one observed plan item; returns
+    None when the watchdog is disarmed (the common case — zero cost)."""
+    if not watchdog_enabled():
+        return None
+    return _WatchdogWall(meta, watchdog_budget_s(exchange_bytes, ndev))
+
+
+def watchdog_end(wall: "_WatchdogWall | None") -> None:
+    """Close an armed wall after the item completed: cancel the
+    in-flight timer and raise :class:`QuESTTimeoutError` (via
+    :func:`_watchdog_breach`) when the honest elapsed device time
+    exceeded the budget."""
+    if wall is None:
+        return
+    wall.cancel()
+    elapsed = metrics.clock() - wall.t0
+    if elapsed > wall.budget:
+        _watchdog_breach(wall.meta, elapsed, wall.budget)
+
+
+def watchdog_stall(wall: "_WatchdogWall | None", meta: dict) -> None:
+    """A scripted ``stall`` fault fired: block until the armed deadline
+    (deterministic — the wait ends exactly when the watchdog timer
+    fires) and raise the breach.  Without an armed watchdog a stall
+    would hang forever, so it is refused instead."""
+    if wall is None:
+        raise QuESTValidationError(
+            "scripted 'stall' fault fired with no armed collective "
+            "watchdog — arm it (QUEST_WATCHDOG=1 / resilience."
+            "set_watchdog) so the hang is detected, or script "
+            "'delay:<ms>' instead")
+    wall.expired.wait()
+    wall.cancel()
+    _watchdog_breach(wall.meta, metrics.clock() - wall.t0, wall.budget,
+                     stalled=True)
+
+
+def _watchdog_breach(meta: dict, elapsed: float, budget: float,
+                     stalled: bool = False) -> None:
+    """One deadline breach: flight dump, per-device strikes, typed
+    error naming the item, comm class, and expected-vs-elapsed."""
+    metrics.counter_inc("resilience.watchdog_breaches")
+    cc = meta.get("comm_class")
+    ndev = int(meta.get("ndev", 1) or 1)
+    newly = []
+    if cc in ("half", "full", "relayout") and ndev > 1:
+        # every device participates in a half/relayout exchange (and a
+        # full exchange cannot name the slow half from host-side wall
+        # time), so the strike lands on all participants; the breaker
+        # threshold keeps one bad round from degrading a healthy mesh
+        newly = suspect_devices(range(ndev),
+                                reason=f"watchdog breach on item "
+                                       f"{meta.get('index')}")
+    path = metrics.flight_dump(
+        "collective watchdog tripped: "
+        + ("item stalled past" if stalled else "item exceeded")
+        + f" its {budget:.3f}s budget",
+        offending={"item": dict(meta), "budget_s": budget,
+                   "elapsed_s": round(elapsed, 6)})
+    msg = (
+        f"collective watchdog tripped on plan item {meta.get('index')} "
+        f"({meta.get('kind')}"
+        + (f", comm class {cc}" if cc else "")
+        + (", STALLED in flight" if stalled else "")
+        + f"): elapsed {elapsed:.3f}s exceeds the expected budget "
+        f"{budget:.3f}s (exchange_bytes="
+        f"{meta.get('exchange_bytes', 0)}, {ndev} device(s); budget = "
+        "min_s + bytes/device / link_GBps x slack — see "
+        "QUEST_WATCHDOG_* in docs/ROBUSTNESS.md)"
+        + (f"; flight recorder dumped to {path}" if path else
+           " (flight-recorder dump failed; see metrics.sink_errors)")
+        + (f"; devices newly degraded: {newly}" if newly else "")
+        + health_suffix())
+    raise QuESTTimeoutError(msg)
+
+
+def suspect_devices(devices, reason: str = "") -> list[int]:
+    """Strike each device in ``devices`` in the mesh-health registry;
+    devices reaching the circuit-breaker threshold
+    (:func:`watchdog_strikes`) are marked DEGRADED — returned, counted
+    (``resilience.devices_degraded``), annotated onto the active run
+    ledger record, and surfaced by :func:`health_suffix`."""
+    k = watchdog_strikes()
+    newly = []
+    with _lock:
+        for d in devices:
+            d = int(d)
+            n = _mesh_health["strikes"].get(d, 0) + 1
+            _mesh_health["strikes"][d] = n
+            if n >= k and d not in _mesh_health["degraded"]:
+                _mesh_health["degraded"].append(d)
+                newly.append(d)
+        degraded = sorted(_mesh_health["degraded"])
+    if newly:
+        metrics.counter_inc("resilience.devices_degraded", len(newly))
+        metrics.trace(f"mesh health: device(s) {newly} marked degraded "
+                      f"after {k} strike(s)" +
+                      (f" ({reason})" if reason else ""))
+    if degraded:
+        metrics.annotate_run("degraded_devices", degraded)
+    return newly
+
+
+def mesh_health() -> dict:
+    """Snapshot of the mesh-health registry: per-device suspect-strike
+    counters, the degraded set, and the breaker threshold."""
+    with _lock:
+        return {"strikes": dict(_mesh_health["strikes"]),
+                "degraded": sorted(_mesh_health["degraded"]),
+                "strikes_to_degrade": watchdog_strikes()}
+
+
+def clear_mesh_health() -> None:
+    """Zero the strike counters and the degraded set (a repaired mesh,
+    or a test hook)."""
+    with _lock:
+        _mesh_health["strikes"].clear()
+        del _mesh_health["degraded"][:]
+
+
+def health_suffix() -> str:
+    """Degraded-device summary appended to health-probe and watchdog
+    messages ('' while the mesh is healthy) — the probe-facing face of
+    the mesh-health registry."""
+    with _lock:
+        degraded = sorted(_mesh_health["degraded"])
+    if not degraded:
+        return ""
+    return (f"; mesh health: device(s) {degraded} are marked DEGRADED "
+            f"({watchdog_strikes()}-strike circuit breaker) — consider "
+            "a degraded-mesh resume onto the surviving devices "
+            "(resilience.resume_run(..., allow_topology_change=True))")
+
+
+# ---------------------------------------------------------------------------
+# Per-run resilience accounting
+# ---------------------------------------------------------------------------
+
+#: Counters whose per-run deltas Circuit.run reports on its ledger
+#: record (process counters stay monotonic, per the metrics contract).
+_RUN_COUNTER_KEYS = ("resilience.retries", "resilience.gave_up",
+                     "resilience.faults_injected",
+                     "resilience.watchdog_breaches")
+_run_base: dict = {}
+
+
+def begin_run() -> None:
+    """Anchor per-run resilience accounting (called at ``Circuit.run``
+    ledger-scope entry): snapshot the resilience counters and the
+    per-seam fault-hit totals, so :func:`run_counters` — and the
+    ``resilience`` annotation on the run's ledger record — reports
+    THIS run's numbers instead of process-lifetime totals."""
+    c = metrics.counters()
+    with _lock:
+        _run_base.clear()
+        _run_base.update({k: c.get(k, 0) for k in _RUN_COUNTER_KEYS})
+        _run_base["fault_hits"] = sum(_hits.values())
+
+
+def run_counters() -> dict:
+    """Per-run resilience numbers since the last :func:`begin_run`:
+    ``{"retries", "gave_up", "faults_injected", "watchdog_breaches",
+    "fault_hits"}`` deltas."""
+    c = metrics.counters()
+    with _lock:
+        out = {k.split(".")[-1]: c.get(k, 0) - _run_base.get(k, 0)
+               for k in _RUN_COUNTER_KEYS}
+        out["fault_hits"] = sum(_hits.values()) \
+            - _run_base.get("fault_hits", 0)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -464,7 +845,7 @@ def load_snapshot(qureg, directory: str) -> dict:
                           f"restored {slot}")
         pos["slot"] = path
         return pos
-    raise QuESTError(
+    raise QuESTCorruptionError(
         f"no restorable checkpoint under {directory}: " + "; ".join(errors))
 
 
@@ -484,13 +865,13 @@ def _read_position(path: str, required: bool = False) -> dict:
             return json.load(f)
     except FileNotFoundError:
         if required:
-            raise QuESTError(
+            raise QuESTCorruptionError(
                 f"snapshot at {path} is missing its run_position "
                 f"sidecar ({p}) — treating the slot as corrupt")
         return {}
     except (OSError, ValueError) as e:
         if required:
-            raise QuESTError(
+            raise QuESTCorruptionError(
                 f"run_position sidecar at {p} is unreadable "
                 f"({type(e).__name__}: {e}) — treating the slot as "
                 "corrupt")
@@ -557,7 +938,58 @@ def plan_fingerprint(circuit, qureg, pallas: str = "auto") -> str:
     return hashlib.sha256(tag.encode()).hexdigest()[:16]
 
 
-def resume_state(qureg, directory: str) -> dict:
+def plan_fingerprint_parts(circuit, qureg, pallas: str = "auto") -> dict:
+    """The :func:`plan_fingerprint` identity split into its three
+    components, recorded in every run-position sidecar so a mismatch
+    can NAME what differs — and so a degraded-mesh resume
+    (``allow_topology_change=True``) can verify that ONLY the
+    topology/backend changed while the circuit identity survived:
+
+    * ``circuit``  — hash of (ops, num_qubits, is_density, dtype):
+      the work itself; never resumable across a change;
+    * ``topology`` — the device count (raw, so errors can say
+      ``8 -> 4 devices``);
+    * ``backend``  — the pallas flag (fused segments vs per-gate
+      kernels — a different item decomposition)."""
+    import hashlib
+
+    ndev = 1 if qureg.mesh is None else int(qureg.mesh.devices.size)
+    use_pallas = pallas is True or pallas == "auto"
+    circ_tag = repr((tuple(circuit.ops), circuit.num_qubits,
+                     circuit.is_density, str(qureg.real_dtype)))
+    return {
+        "circuit": hashlib.sha256(circ_tag.encode()).hexdigest()[:16],
+        "topology": ndev,
+        "backend": bool(use_pallas),
+    }
+
+
+def _peek_saved_devices(directory: str) -> int | None:
+    """The ``num_devices`` the snapshot under ``directory`` was saved
+    with (first readable ``qureg.json`` among latest-first slots, else
+    the flat directory), or None when nothing is readable — the
+    topology peek :func:`resume_state` decides its refusal from BEFORE
+    any restore touches the register."""
+    from . import stateio
+
+    latest = _read_pointer(directory)
+    order = ([latest] if latest else []) + \
+        [s for s in SLOTS if s != latest] + [""]
+    for slot in order:
+        p = os.path.join(directory, slot, stateio._META) if slot \
+            else os.path.join(directory, stateio._META)
+        try:
+            with open(p) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "num_devices" in meta:
+            return int(meta["num_devices"])
+    return None
+
+
+def resume_state(qureg, directory: str,
+                 allow_topology_change: bool = False) -> dict:
     """Restore the last-good snapshot into ``qureg`` and return its
     position sidecar — the eager/C-driver resume path (the C API's
     ``resumeRun`` returns the position index so an unmodified driver
@@ -569,23 +1001,63 @@ def resume_state(qureg, directory: str) -> dict:
     final state would silently yield permuted amplitudes.  They resume
     through :func:`resume_run`, which replays the remaining items (the
     inverse refusal — ``resume_run`` on a flush snapshot — is guarded
-    the same way).  The refusal is decided from the position sidecars
-    BEFORE any restore, so a refused call leaves ``qureg`` untouched."""
+    the same way).
+
+    A snapshot written under a DIFFERENT device count is refused with a
+    :class:`QuESTTopologyError` unless ``allow_topology_change=True``
+    (C API: ``resumeRunEx(qureg, dir, 1)``): flush snapshots are
+    canonical-layout, so the cross-topology restore itself is exact —
+    the flag only makes the operator acknowledge that the surviving
+    mesh is not the one that wrote the checkpoint.  All refusals are
+    decided from the sidecars BEFORE any restore, so a refused call
+    leaves ``qureg`` untouched."""
     directory = os.path.abspath(directory)
     for slot in (os.path.join(directory, s) for s in SLOTS):
         peek = _read_position(slot)
         if peek.get("kind") == "circuit_run":
-            raise QuESTError(
+            raise QuESTValidationError(
                 f"checkpoint at {slot} is a mid-run Circuit.run "
                 f"snapshot (item {peek.get('item_index')}): not a "
                 "canonical final state — resume it with "
                 "resilience.resume_run(circuit, qureg, directory)")
+    if not allow_topology_change:
+        saved = _peek_saved_devices(directory)
+        ndev = 1 if qureg.mesh is None else int(qureg.mesh.devices.size)
+        if saved is not None and saved != ndev:
+            raise QuESTTopologyError(
+                f"checkpoint at {directory} was written under {saved} "
+                f"device(s); this register runs on {ndev} — pass "
+                "allow_topology_change=True (C API: resumeRunEx(..., "
+                "1)) to restore onto the surviving topology")
     pos = load_snapshot(qureg, directory)
     metrics.counter_inc("resilience.resumes")
     return pos
 
 
-def resume_run(circuit, qureg, directory: str, pallas: str = "auto"):
+def _describe_fingerprint_diff(got_parts: dict, want_parts: dict) -> list:
+    """Human-readable names of the fingerprint components that differ
+    between a sidecar and the resuming (circuit, register, backend) —
+    so an operator can tell 'wrong circuit' from 'smaller mesh' at a
+    glance.  Returns (component_key, description) pairs."""
+    diffs = []
+    if got_parts.get("circuit") != want_parts["circuit"]:
+        diffs.append(("circuit",
+                      "circuit plan (different ops, qubit count, "
+                      "density flag or dtype)"))
+    if got_parts.get("topology") != want_parts["topology"]:
+        diffs.append(("topology",
+                      f"topology ({got_parts.get('topology')} -> "
+                      f"{want_parts['topology']} devices)"))
+    if got_parts.get("backend") != want_parts["backend"]:
+        diffs.append(("backend",
+                      f"pallas/backend flag "
+                      f"({got_parts.get('backend')} -> "
+                      f"{want_parts['backend']})"))
+    return diffs
+
+
+def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
+               allow_topology_change: bool = False):
     """Resume an interrupted ``Circuit.run``: restore the last-good
     snapshot under ``directory`` into ``qureg``, validate the plan
     fingerprint, and replay ONLY the remaining plan items (skipped
@@ -594,25 +1066,129 @@ def resume_run(circuit, qureg, directory: str, pallas: str = "auto"):
     RNG key) — so the resumed amplitudes are bit-identical to the
     uninterrupted run, which ``tools/chaos_drill.py`` asserts.
     Checkpointing continues into the same directory at the recorded
-    cadence.  Returns what ``Circuit.run`` returns."""
+    cadence.  Returns what ``Circuit.run`` returns.
+
+    A fingerprint mismatch names the differing component (circuit plan
+    vs topology vs pallas/backend flag).  When ONLY the topology and/or
+    backend differ — the checkpoint was written by a larger mesh that
+    lost devices — ``allow_topology_change=True`` performs a
+    **degraded-mesh resume** instead of refusing: the snapshot is
+    restored into the surviving register's sharding (the cross-topology
+    ``stateio`` path), the recorded mid-plan qubit layout is
+    canonicalised with one exact relayout, and the remaining OPS are
+    re-planned for the new mesh (``scheduler.schedule_mesh``), with
+    recorded measurement outcomes replayed and the remaining draws
+    taken from the stored RNG key.  The resumed amplitudes are
+    bit-identical to restoring the same snapshot into a fresh
+    smaller-mesh register and running the remaining ops there
+    uninterrupted (pinned in ``tests/test_degraded_resume.py`` — note
+    cross-mesh plans legitimately differ in last-ulp rounding, so
+    bit-identity to the ORIGINAL mesh's full run is not a meaningful
+    target).  Only op-aligned checkpoint boundaries support a degraded
+    resume (the sidecar's ``ops_applied``); a mid-segment-batch cut is
+    refused because the scheduler's in-batch reordering leaves no
+    op-aligned prefix there."""
     pos = load_snapshot(qureg, directory)
     if "item_index" not in pos:
-        raise QuESTError(
+        raise QuESTValidationError(
             f"checkpoint at {pos.get('slot', directory)} carries no "
             "mid-run position (an eager-path or plain save_checkpoint "
             "snapshot); restore it with resilience.resume_state")
     want = plan_fingerprint(circuit, qureg, pallas)
     got = pos.get("fingerprint")
-    if got != want:
-        raise QuESTError(
-            f"checkpoint at {pos['slot']} was written by a different run "
-            f"plan (fingerprint {got} != {want}): resume_run needs the "
-            "same circuit ops, register geometry, dtype and device mesh")
+    if got == want:
+        metrics.counter_inc("resilience.resumes")
+        every = int(pos.get("every") or 0)
+        return circuit.run(qureg, pallas=pallas,
+                           checkpoint_dir=directory if every else None,
+                           checkpoint_every=every, _resume=pos)
+    want_parts = plan_fingerprint_parts(circuit, qureg, pallas)
+    got_parts = pos.get("fingerprint_parts")
+    base = (f"checkpoint at {pos['slot']} was written by a different "
+            f"run plan (fingerprint {got} != {want})")
+    if not got_parts:
+        raise QuESTTopologyError(
+            base + ": resume_run needs the same circuit ops, register "
+            "geometry, dtype and device mesh (sidecar carries no "
+            "fingerprint_parts — written by an older version, so the "
+            "differing component cannot be named)")
+    diffs = _describe_fingerprint_diff(got_parts, want_parts)
+    named = "; ".join(d for _, d in diffs) or "components unknown"
+    if any(k == "circuit" for k, _ in diffs) or not diffs:
+        raise QuESTValidationError(
+            base + f" — differs in: {named}.  A different circuit can "
+            "never be resumed from this snapshot")
+    if not allow_topology_change:
+        raise QuESTTopologyError(
+            base + f" — differs in: {named}.  The circuit identity "
+            "matches, so this snapshot CAN resume onto the surviving "
+            "mesh: pass allow_topology_change=True (degraded-mesh "
+            "resume; C API resumeRunEx)")
+    return _resume_degraded(circuit, qureg, pos, pallas, named)
+
+
+def _resume_degraded(circuit, qureg, pos: dict, pallas, named: str):
+    """Degraded-mesh resume onto ``qureg``'s (smaller/different) mesh;
+    the snapshot state is ALREADY restored into ``qureg``'s sharding
+    (``load_snapshot`` in :func:`resume_run`).  See the contract in
+    :func:`resume_run`'s docstring."""
+    ops_applied = pos.get("ops_applied")
+    if ops_applied is None:
+        raise QuESTTopologyError(
+            f"checkpoint at {pos['slot']} was cut mid segment batch: "
+            "the scheduler's in-batch op reordering leaves no "
+            "op-aligned prefix there, so only op-aligned boundaries "
+            "(the sidecar's ops_applied) support a degraded-mesh "
+            "resume — resume on the original topology, or resume from "
+            "an op-aligned checkpoint")
     metrics.counter_inc("resilience.resumes")
-    every = int(pos.get("every") or 0)
-    return circuit.run(qureg, pallas=pallas,
-                       checkpoint_dir=directory if every else None,
-                       checkpoint_every=every, _resume=pos)
+    metrics.counter_inc("resilience.degraded_resumes")
+    metrics.trace(f"degraded-mesh resume from {pos['slot']} ({named}): "
+                  f"{ops_applied}/{len(circuit.ops)} ops already "
+                  "applied; canonicalising layout and re-planning the "
+                  "tail for the surviving mesh")
+    layout = pos.get("layout")
+    if layout and any(p != b for b, p in enumerate(layout)):
+        # the snapshot holds the OLD plan's mid-run relabelled layout;
+        # one exact relayout (pure data movement, no arithmetic)
+        # restores the canonical qubit order under the NEW mesh
+        from .parallel.mesh_exec import apply_layout_perm
+
+        re, im = apply_layout_perm(qureg.re, qureg.im, tuple(layout),
+                                   qureg.mesh)
+        qureg._set(re, im)
+    from .circuit import Circuit  # deferred: import cycle
+
+    ops_applied = int(ops_applied)
+    tail = Circuit(circuit.num_qubits, circuit.is_density,
+                   ops=list(circuit.ops)[ops_applied:])
+    preseed = [int(x) for x in pos.get("outcomes", ())]
+    # NOTE the degraded tail does not continue checkpointing: its
+    # sidecars would carry the TAIL's fingerprint and positions, which
+    # the original circuit could no longer resume — re-arm
+    # checkpointing explicitly for very long tails.
+    if tail.num_measurements and preseed:
+        # remaining draws must fold in at index len(preseed): the
+        # preseeded cursor needs the observed path (the ONLY reason to
+        # observe here — an observed tail is per-item-compiled, which
+        # rounds identically to itself but not to the clean whole-plan
+        # program)
+        resume = {"item_index": 0, "outcomes": [], "key": pos.get("key"),
+                  "preseed": preseed, "slot": pos.get("slot")}
+        return tail.run(qureg, pallas=pallas, _resume=resume)
+    if tail.num_measurements:
+        # no prior draws: a plain clean run with the stored key is
+        # exactly the uninterrupted smaller-mesh run of the tail
+        return tail.run(qureg, pallas=pallas,
+                        key=decode_prng_key(pos.get("key")))
+    out = tail.run(qureg, pallas=pallas)
+    if preseed:
+        # every recorded draw happened before the cut: the outcomes
+        # vector is exactly the replayed prefix
+        import jax.numpy as jnp
+
+        return jnp.asarray(preseed, jnp.int32)
+    return out
 
 
 def maybe_eager_checkpoint(qureg) -> None:
@@ -649,7 +1225,7 @@ def maybe_eager_checkpoint(qureg) -> None:
         num_qubits=qureg.num_qubits, mesh=qureg.mesh, before=None,
         n_ops=1)
     if reason is not None:
-        raise QuESTError(
+        raise QuESTCorruptionError(
             f"checkpoint health check failed at flush {n}: {reason} — "
             "snapshot NOT written (the previous checkpoint, if any, is "
             "the last good state)")
@@ -661,14 +1237,19 @@ def maybe_eager_checkpoint(qureg) -> None:
 
 
 def reset() -> None:
-    """Clear fault plans, hit counters, checkpoint policy and the
-    eager flush counter (test hook)."""
+    """Clear fault plans, hit counters, checkpoint policy, the eager
+    flush counter, the watchdog config, and the mesh-health registry
+    (test hook)."""
     global _plan, _env_plan
     with _lock:
         _plan = None
         _env_plan = None
         _hits.clear()
+        _run_base.clear()
     _policy["directory"] = None
     _policy["every"] = 0
     _eager_flush_counts.clear()
     _dir_owners.clear()
+    _watchdog.update(on=False, gbps=None, slack=None, min_s=None,
+                     strikes=None)
+    clear_mesh_health()

@@ -361,7 +361,8 @@ def schedule_segments_best(ops, num_vec_bits: int, lane_bits: int = 7,
 def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
                   row_budget: int | None = None,
                   max_high: int | None = None,
-                  fuse_relayouts: bool = True):
+                  fuse_relayouts: bool = True,
+                  with_meta: bool = False):
     """Mesh scheduling with qubit relabeling.
 
     Returns a plan: a list of
@@ -391,6 +392,17 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     The plan ends with relayouts restoring the canonical (identity)
     layout, so the produced state is bit-compatible with every other
     kernel and with amplitude access.
+
+    ``with_meta=True`` additionally returns a parallel ``aligned`` list:
+    ``aligned[i]`` is the count of ORIGINAL ops fully covered by plan
+    items ``0..i`` when that boundary is op-aligned, else None.  The
+    boundaries between seg items of one flush batch are NOT aligned —
+    ``_schedule_chunk``'s commute-sliding reorders ops within a batch,
+    so no op prefix corresponds to a mid-batch cut — while every
+    relayout boundary and every batch-final seg boundary is.  The
+    resilience subsystem records this (plus :func:`plan_layouts`) in
+    checkpoint sidecars so a degraded-mesh resume can re-plan the
+    remaining ops for a different mesh (docs/ROBUSTNESS.md).
     """
     ops = normalize_diag(ops)
     chunk_bits = num_vec_bits - dev_bits
@@ -427,18 +439,24 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
         return out
 
     plan = []
+    aligned = []      # ops-prefix length at each item's end (None mid-batch)
     pending = []
+    n_appended = [0]  # original ops consumed into pending/plan so far
 
     def flush():
         if pending:
-            for seg in _schedule_chunk(pending, chunk_bits, lane_bits,
-                                       row_budget, max_high):
+            segs = list(_schedule_chunk(pending, chunk_bits, lane_bits,
+                                        row_budget, max_high))
+            for j, seg in enumerate(segs):
                 plan.append(("seg",) + seg)
+                aligned.append(n_appended[0] if j + 1 == len(segs)
+                               else None)
             pending.clear()
 
     def do_swap(a: int, b: int):
         flush()
         plan.append(("swap", a, b))
+        aligned.append(n_appended[0])
         qa, qb = inv[a], inv[b]
         inv[a], inv[b] = qb, qa
         pos[qa], pos[qb] = b, a
@@ -486,6 +504,7 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
 
     for i, op in enumerate(ops):
         kind, statics, scalars = op
+        n_appended[0] = i
         if kind == "apply_2x2":
             localise(statics[0], i)
             t, cm = statics
@@ -502,6 +521,7 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
         else:
             (sm,) = statics
             pending.append((kind, (tr_mask(sm),), scalars))
+        n_appended[0] = i + 1
     flush()
 
     # restore canonical layout, cycle by cycle.  Anchoring each cycle on a
@@ -524,7 +544,7 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
             do_swap(anchor, inv[anchor])
     n_swaps = sum(1 for it in plan if it[0] == "swap")
     if fuse_relayouts:
-        plan = _fuse_swap_runs(plan, num_vec_bits)
+        plan, aligned = _fuse_swap_runs(plan, num_vec_bits, aux=aligned)
     metrics.counter_inc("sched.mesh_plans")
     metrics.counter_inc("sched.gates_in", len(ops))
     metrics.counter_inc("sched.segments",
@@ -533,6 +553,8 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     n_fused = sum(1 for it in plan if it[0] == "relayout")
     if n_fused:
         metrics.counter_inc("sched.fused_relayouts", n_fused)
+    if with_meta:
+        return plan, aligned
     return plan
 
 
@@ -550,34 +572,86 @@ def compose_swap_perm(run, num_vec_bits: int, perm=None):
     return tuple(perm)
 
 
-def _fuse_swap_runs(plan, num_vec_bits: int):
+def plan_layouts(plan, num_vec_bits: int):
+    """The qubit layout after each plan item: a list (parallel to
+    ``plan``) of ``inv`` tuples with ``inv[b]`` = the logical qubit
+    stored at physical index bit ``b`` once items ``0..i`` have
+    executed.  Derived purely from the items' permutation semantics:
+    seg items never move bits; a swap transposes; a relayout
+    ``new[i] = old[j]`` (bit b of j = bit perm[b] of i) moves the
+    content of physical bit c to physical bit perm[c], composing
+    ``inv_new[perm[c]] = inv_old[c]`` — a plain transposition is its
+    own inverse, so only multi-bit relayouts expose the direction.
+    Reproduces the scheduler's internal ``inv`` tracking exactly —
+    pinned in tests/test_degraded_resume.py.
+
+    Applying a relayout with ``perm = inv`` to the mid-plan state
+    restores the canonical (identity) layout: that is how a
+    degraded-mesh resume canonicalises a snapshot cut mid-plan before
+    re-planning the remaining ops for a different mesh."""
+    inv = list(range(num_vec_bits))
+    out = []
+    for item in plan:
+        if item[0] == "swap":
+            a, b = item[1], item[2]
+            inv[a], inv[b] = inv[b], inv[a]
+        elif item[0] == "relayout":
+            perm = item[1]
+            nxt = list(inv)
+            for c, p in enumerate(perm):
+                nxt[p] = inv[c]
+            inv = nxt
+        out.append(tuple(inv))
+    return out
+
+
+def _fuse_swap_runs(plan, num_vec_bits: int, aux=None):
     """Coalesce each maximal run of adjacent ("swap", a, b) items (no
     intervening "seg") into a single ("relayout", perm) item carrying
     the composed bit permutation.  Single swaps stay "swap" (the
     executor's pairwise path moves the same half chunk, with the re/im
     payload stacked either way); runs whose composed permutation is the
-    identity vanish."""
+    identity vanish.
+
+    ``aux``: an optional per-item metadata list parallel to ``plan``
+    (the ``schedule_mesh`` op-alignment annotations); it is fused with
+    the same grouping — a coalesced run keeps its LAST entry (the swaps
+    of one run are adjacent, so the values agree anyway) — and
+    ``(plan, aux)`` is returned instead of ``plan``."""
     out, run = [], []
+    out_aux, run_aux = [], []
+    track = aux is not None
+    if track:
+        assert len(aux) == len(plan)
 
     def emit():
         if not run:
             return
         if len(run) == 1:
             out.append(run[0])
+            if track:
+                out_aux.append(run_aux[0])
         else:
             perm = compose_swap_perm(run, num_vec_bits)
             if any(p != b for b, p in enumerate(perm)):
                 out.append(("relayout", perm))
+                if track:
+                    out_aux.append(run_aux[-1])
         run.clear()
+        del run_aux[:]
 
-    for item in plan:
+    for i, item in enumerate(plan):
         if item[0] == "swap":
             run.append(item)
+            if track:
+                run_aux.append(aux[i])
         else:
             emit()
             out.append(item)
+            if track:
+                out_aux.append(aux[i])
     emit()
-    return out
+    return (out, out_aux) if track else out
 
 
 class _Group:

@@ -28,7 +28,8 @@ import numpy as np
 import jax
 
 from .register import Qureg
-from .validation import QuESTError
+from .validation import (QuESTError, QuESTCorruptionError,
+                         QuESTValidationError)
 from .ops.lattice import amp_sharding, state_shape
 
 #: Metadata sidecar name inside a checkpoint directory.
@@ -219,33 +220,33 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
         with open(meta_path) as f:
             meta = json.load(f)
     except FileNotFoundError:
-        raise QuESTError(f"no checkpoint at {directory}")
+        raise QuESTValidationError(f"no checkpoint at {directory}")
     except (OSError, ValueError) as e:
-        raise QuESTError(
+        raise QuESTCorruptionError(
             f"checkpoint metadata at {meta_path} is unreadable "
             f"({type(e).__name__}: {e})")
     for field in ("num_qubits", "is_density", "dtype"):
         if field not in meta:
             # a raw KeyError would escape the slot-fallback loop in
             # resilience.load_snapshot (which catches QuESTError only)
-            raise QuESTError(
+            raise QuESTCorruptionError(
                 f"checkpoint metadata at {meta_path} is missing "
                 f"{field!r} — damaged sidecar")
     if meta["num_qubits"] != qureg.num_qubits or meta["is_density"] != qureg.is_density:
-        raise QuESTError(
+        raise QuESTValidationError(
             f"checkpoint holds a {meta['num_qubits']}-qubit "
             f"{'density matrix' if meta['is_density'] else 'state-vector'}; "
             f"register is a {qureg.num_qubits}-qubit "
             f"{'density matrix' if qureg.is_density else 'state-vector'}"
         )
     if meta["dtype"] != str(np.dtype(qureg.real_dtype)):
-        raise QuESTError(
+        raise QuESTValidationError(
             f"checkpoint precision is {meta['dtype']}; register is "
             f"{np.dtype(qureg.real_dtype)} — restoring would silently cast"
         )
     arrays_dir = os.path.join(directory, _ARRAYS)
     if not os.path.isdir(arrays_dir):
-        raise QuESTError(
+        raise QuESTCorruptionError(
             f"checkpoint at {directory} is missing its arrays directory "
             f"({arrays_dir})")
     sh = amp_sharding(qureg.mesh)
@@ -283,7 +284,7 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
         # types; all of them mean "this checkpoint is unusable" — wrap,
         # name the path, and let the caller (resilience.load_snapshot)
         # fall back to the other slot
-        raise QuESTError(
+        raise QuESTCorruptionError(
             f"failed to restore checkpoint arrays from {arrays_dir}: "
             f"{type(e).__name__}: {e}") from e
     checksums = meta.get("checksums") or {}
@@ -294,7 +295,7 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
                 continue
             got = _array_checksum(out[name])
             if got != want:
-                raise QuESTError(
+                raise QuESTCorruptionError(
                     f"checkpoint array {name!r} under {arrays_dir} failed "
                     f"its integrity check (checksum {got} != recorded "
                     f"{want}) — the shard data is corrupt")

@@ -18,12 +18,58 @@ from . import precision
 
 
 class QuESTError(ValueError):
-    """Raised for invalid API input (reference error codes:
-    QuEST_validation.c:19-80)."""
+    """Base of the QuEST-TPU error taxonomy (reference error codes:
+    QuEST_validation.c:19-80).
+
+    Every subclass carries a stable integer ``code`` exposed through
+    the C ABI (``getLastErrorCode`` / the negative return of
+    ``resumeRun``/``resumeRunEx``), so an unmodified C driver can
+    branch on the failure CLASS instead of parsing message strings.
+    The codes are part of the ABI — never renumber them (see the
+    ``QuESTErrorCode`` enum in capi/include/QuEST.h and the taxonomy
+    table in docs/ROBUSTNESS.md)."""
+
+    #: Stable C-ABI error code (QUEST_ERROR in capi/include/QuEST.h).
+    code = 1
+
+
+class QuESTValidationError(QuESTError):
+    """Invalid API input or refused operation: bad arguments, a resume
+    against the wrong circuit, a half-configured checkpoint policy.
+    The request was wrong; state and files are fine."""
+
+    code = 2
+
+
+class QuESTTimeoutError(QuESTError):
+    """The collective watchdog tripped: an observed plan item exceeded
+    its priced deadline (a hung or straggling exchange), or a scripted
+    ``stall`` fault was detected in flight.  Carries the item, its comm
+    class, and the expected-vs-elapsed budget in the message; the
+    flight-recorder ring is dumped before this is raised."""
+
+    code = 3
+
+
+class QuESTCorruptionError(QuESTError):
+    """Data failed an integrity check: a checkpoint checksum mismatch,
+    a missing/garbled sidecar, or a numerically poisoned state caught
+    by a health probe (NaN/Inf, norm/trace/hermiticity drift)."""
+
+    code = 4
+
+
+class QuESTTopologyError(QuESTError):
+    """A restore/resume was refused because the device topology (or
+    backend decomposition) differs from the one that wrote the
+    snapshot and the caller did not opt into a degraded-mesh resume
+    (``allow_topology_change=True`` / C API ``resumeRunEx``)."""
+
+    code = 5
 
 
 def _fail(msg: str, func: str | None = None):
-    raise QuESTError(msg if func is None else f"{func}: {msg}")
+    raise QuESTValidationError(msg if func is None else f"{func}: {msg}")
 
 
 def validate_create_num_qubits(num_qubits: int) -> None:

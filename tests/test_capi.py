@@ -141,6 +141,13 @@ def lib():
     L.setCheckpointEvery.argtypes = [QuESTEnv, ct.c_char_p, ct.c_int]
     L.resumeRun.restype = ct.c_longlong
     L.resumeRun.argtypes = [Qureg, ct.c_char_p]
+    L.resumeRunEx.restype = ct.c_longlong
+    L.resumeRunEx.argtypes = [Qureg, ct.c_char_p, ct.c_int]
+    L.getLastErrorCode.restype = ct.c_int
+    L.getLastErrorCode.argtypes = [QuESTEnv]
+    L.getLastErrorString.argtypes = [QuESTEnv, ct.c_char_p, ct.c_int]
+    L.setCollectiveWatchdog.argtypes = [QuESTEnv, ct.c_int, ct.c_double,
+                                        ct.c_double, ct.c_double]
     return L
 
 
@@ -351,6 +358,36 @@ def test_checkpoint_resume_c_api(lib, cenv, tmp_path):
     assert metrics.counters().get("resilience.resumes", 0) >= 1
     lib.destroyQureg(q, cenv)
     lib.destroyQureg(q2, cenv)
+
+
+def test_error_taxonomy_c_api(lib, cenv, tmp_path):
+    """resumeRun/resumeRunEx return the NEGATED taxonomy code instead
+    of exiting, and getLastErrorCode/-String report the failure class —
+    the C driver branches on codes, never on message strings."""
+    q = lib.createQureg(4, cenv)
+    # no checkpoint there: a validation-class refusal, not an exit
+    missing = str(tmp_path / "nothing-here").encode()
+    rc = lib.resumeRun(q, missing)
+    assert rc == -2  # -QUEST_ERROR_VALIDATION
+    assert lib.getLastErrorCode(cenv) == 2
+    buf = ct.create_string_buffer(512)
+    lib.getLastErrorString(cenv, buf, 512)
+    assert b"no checkpoint" in buf.value
+    # a real flush snapshot under the SAME topology resumes fine and
+    # clears the error state
+    d = str(tmp_path / "ok").encode()
+    lib.setCheckpointEvery(cenv, d, 1)
+    try:
+        q2 = lib.createQureg(4, cenv)
+        lib.hadamard(q2, 0)
+        lib.getProbAmp(q2, 0)  # flush -> snapshot
+    finally:
+        lib.setCheckpointEvery(cenv, b"", 0)
+    q3 = lib.createQureg(4, cenv)
+    assert lib.resumeRunEx(q3, d, 1) >= 1
+    assert lib.getLastErrorCode(cenv) == 0
+    for h in (q, q2, q3):
+        lib.destroyQureg(h, cenv)
 
 
 def test_precision_code(lib):

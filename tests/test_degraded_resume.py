@@ -1,0 +1,330 @@
+"""Degraded-mesh resume (ISSUE-7): checkpoint on a large mesh, resume
+on the surviving smaller one.
+
+The property test cuts a mixed QFT/random plan at EVERY item boundary
+(checkpoint_every=1 + a scripted kill at each successive item) and
+asserts:
+
+* same-mesh resume is bit-identical to the uninterrupted run at every
+  boundary (the PR-4 contract, re-pinned under the new sidecar fields);
+* at every op-aligned boundary, a degraded resume onto a smaller mesh
+  (8 -> 4 devices, and 4 -> 1) is BIT-IDENTICAL to restoring the same
+  snapshot into a fresh smaller-mesh register, canonicalising the
+  recorded layout on the host (exact numpy bit-permute), and running
+  the remaining ops there uninterrupted — i.e. the resume adds zero
+  numerical divergence beyond the smaller mesh's own arithmetic.
+  (Bit-identity to the ORIGINAL mesh's full run is not a meaningful
+  target: plans on different meshes legitimately differ in last-ulp
+  rounding — cross-checked here against the numpy oracle instead.)
+
+Every degraded resume is additionally checked against the full-circuit
+reference to 1e-10, so the exact-equality pin cannot be satisfied by a
+self-consistently wrong implementation.
+
+Skips where the environment lacks the 8 virtual devices the conftest
+normally forces (the same capability guard the multihost tests use).
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+import quest_tpu as qt
+from quest_tpu import models, resilience
+from quest_tpu.circuit import Circuit
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs the conftest's 8 virtual devices")
+
+N = 6
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+def _mixed_circuit(n=N, seed=7):
+    """QFT prefix + seeded random tail: relayout-heavy AND
+    reorder-prone, so both aligned and unaligned boundaries occur."""
+    rng = random.Random(seed)
+    c = models.qft(n)
+    for _ in range(2 * n):
+        k = rng.randrange(5)
+        t = rng.randrange(n)
+        if k == 0:
+            c.hadamard(t)
+        elif k == 1:
+            c.rotate_y(t, rng.random())
+        elif k == 2:
+            c.phase_shift(t, rng.random())
+        elif k == 3:
+            cq = rng.randrange(n)
+            if cq != t:
+                c.cnot(cq, t)
+        else:
+            c.t_gate(t)
+    return c
+
+
+def _state(circ, env, pallas="auto"):
+    q = qt.create_qureg(circ.num_qubits, env)
+    circ.run(q, pallas=pallas)
+    return qt.get_state_vector(q)
+
+
+def _canonicalise_np(raw, perm):
+    """Host-side exact relayout: new[i] = raw[j], bit b of j =
+    bit perm[b] of i — the same semantics as mesh_exec.apply_relayout,
+    applied with numpy so the reference path shares no device code with
+    the implementation under test."""
+    n_amps = raw.shape[0]
+    ar = np.arange(n_amps)
+    idx = np.zeros(n_amps, dtype=np.int64)
+    for b, p in enumerate(perm):
+        idx |= ((ar >> p) & 1) << b
+    return raw[idx]
+
+
+def _killed_checkpoint(circ, env, directory, kill_at):
+    """Run `circ` with checkpoint_every=1 and a scripted kill at item
+    `kill_at`; returns True when the kill fired (False: the plan has
+    fewer items — enumeration is done)."""
+    q = qt.create_qureg(circ.num_qubits, env)
+    resilience.set_fault_plan([("run_item", kill_at, "runtime")])
+    try:
+        circ.run(q, pallas="auto", checkpoint_dir=directory,
+                 checkpoint_every=1)
+        return False
+    except RuntimeError:
+        return True
+    finally:
+        resilience.clear_fault_plan()
+
+
+def _sidecar(directory):
+    with open(os.path.join(directory, "latest")) as f:
+        latest = f.read().strip()
+    return resilience._read_position(os.path.join(directory, latest),
+                                     required=True)
+
+
+def _degraded_reference(circ, pos, dst_env, directory):
+    """The contract's right-hand side, built from PUBLIC pieces only:
+    restore the snapshot into a fresh register on the target mesh,
+    canonicalise the recorded layout on the host, and run the
+    remaining ops there uninterrupted."""
+    n = circ.num_qubits
+    probe = qt.create_qureg(n, dst_env)
+    resilience.load_snapshot(probe, directory)
+    raw = qt.get_state_vector(probe)
+    perm = pos.get("layout") or list(range(n))
+    canon = _canonicalise_np(raw, perm)
+    fresh = qt.create_qureg(n, dst_env)
+    qt.init_state_from_amps(fresh, canon.real.copy(), canon.imag.copy())
+    tail = Circuit(n, circ.is_density,
+                   ops=list(circ.ops)[int(pos["ops_applied"]):])
+    tail.run(fresh, pallas="auto")
+    return qt.get_state_vector(fresh)
+
+
+def test_every_boundary_resumes_bit_identical(tmp_path):
+    """Kill at every item boundary; same-mesh resume is bit-identical
+    everywhere, degraded resume (8 -> 4) is bit-identical to the clean
+    smaller-mesh tail run at every op-aligned boundary."""
+    env8 = qt.create_env(num_devices=8)
+    env4 = qt.create_env(num_devices=4)
+    circ = _mixed_circuit()
+    ref8 = _state(circ, env8)
+    oracle = _state(circ, env4)  # 4-dev full run, the 1e-10 cross-check
+    aligned_seen = unaligned_seen = 0
+    degraded_checked = 0
+    kill_at = 1
+    while True:
+        d = str(tmp_path / f"b{kill_at}")
+        if not _killed_checkpoint(circ, env8, d, kill_at):
+            break
+        pos = _sidecar(d)
+        assert pos["item_index"] == kill_at  # every boundary visited
+
+        # degraded checks FIRST: the same-mesh resume below continues
+        # checkpointing into `d`, rotating this boundary's snapshot out
+        if pos["ops_applied"] is None:
+            unaligned_seen += 1
+            # a mid-batch cut must be REFUSED for degraded resume, with
+            # the reason named — never a silently wrong replay
+            with pytest.raises(qt.QuESTTopologyError,
+                               match="mid segment batch"):
+                resilience.resume_run(circ, qt.create_qureg(N, env4), d,
+                                      pallas="auto",
+                                      allow_topology_change=True)
+        else:
+            aligned_seen += 1
+            q4 = qt.create_qureg(N, env4)
+            resilience.resume_run(circ, q4, d, pallas="auto",
+                                  allow_topology_change=True)
+            got = qt.get_state_vector(q4)
+            ref = _degraded_reference(circ, pos, env4, d)
+            assert np.array_equal(got, ref), \
+                f"degraded resume diverged at boundary {kill_at}"
+            assert np.abs(got - oracle).max() < 1e-10
+            degraded_checked += 1
+
+        # same-mesh resume: bit-identical at EVERY boundary
+        q8 = qt.create_qureg(N, env8)
+        resilience.resume_run(circ, q8, d, pallas="auto")
+        assert np.array_equal(qt.get_state_vector(q8), ref8), \
+            f"same-mesh resume diverged at boundary {kill_at}"
+        kill_at += 1
+    # the enumeration must have actually exercised the plan: several
+    # boundaries and >= 1 degraded resume (unaligned boundaries only
+    # occur when a flush batch splits into several segments — this
+    # tiny plan may have none; the refusal path is pinned separately
+    # in test_unaligned_boundary_refused)
+    assert kill_at > 4, "plan too short to exercise boundaries"
+    assert aligned_seen >= 1 and degraded_checked >= 1
+    assert unaligned_seen >= 0
+
+
+def test_unaligned_boundary_refused(tmp_path):
+    """A checkpoint whose sidecar carries no op-aligned prefix
+    (ops_applied null — a mid-segment-batch cut) is REFUSED for
+    degraded resume with the reason named, never silently replayed."""
+    import json
+
+    env8 = qt.create_env(num_devices=8)
+    env4 = qt.create_env(num_devices=4)
+    circ = _mixed_circuit()
+    d = str(tmp_path / "un")
+    assert _killed_checkpoint(circ, env8, d, 2)
+    with open(os.path.join(d, "latest")) as f:
+        latest = f.read().strip()
+    sidecar = os.path.join(d, latest, "run_position.json")
+    with open(sidecar) as f:
+        pos = json.load(f)
+    pos["ops_applied"] = None
+    with open(sidecar, "w") as f:
+        json.dump(pos, f)
+    with pytest.raises(qt.QuESTTopologyError, match="mid segment batch"):
+        resilience.resume_run(circ, qt.create_qureg(N, env4), d,
+                              pallas="auto", allow_topology_change=True)
+
+
+def test_degraded_resume_4_to_1(tmp_path):
+    """4-device checkpoint resumes onto a single device (mesh -> local
+    executor) with the same exact-tail contract."""
+    env4 = qt.create_env(num_devices=4)
+    env1 = qt.create_env(num_devices=1)
+    circ = _mixed_circuit(seed=11)
+    oracle = _state(circ, env1)
+    d = str(tmp_path / "ck41")
+    checked = 0
+    for kill_at in (3, 6, 9):
+        dd = f"{d}-{kill_at}"
+        if not _killed_checkpoint(circ, env4, dd, kill_at):
+            break
+        pos = _sidecar(dd)
+        if pos["ops_applied"] is None:
+            continue
+        q1 = qt.create_qureg(N, env1)
+        resilience.resume_run(circ, q1, dd, pallas="auto",
+                              allow_topology_change=True)
+        got = qt.get_state_vector(q1)
+        ref = _degraded_reference(circ, pos, env1, dd)
+        assert np.array_equal(got, ref)
+        assert np.abs(got - oracle).max() < 1e-10
+        checked += 1
+    assert checked >= 1
+    assert resilience.mesh_health()["degraded"] == []  # no strikes here
+
+
+def test_degraded_resume_replays_measurement_outcomes(tmp_path):
+    """A measurement-bearing run killed on 8 devices resumes onto 4:
+    the outcomes vector is the replayed prefix + live suffix drawn
+    from the SAME stored key (fold-in indices continue where the
+    interrupted run stopped), and the final state passes the norm and
+    oracle checks."""
+    env8 = qt.create_env(num_devices=8)
+    env4 = qt.create_env(num_devices=4)
+    n = N
+    circ = Circuit(n)
+    for t in range(n):
+        circ.hadamard(t)
+    circ.measure(0)
+    for t in range(n):
+        circ.rotate_y(t, 0.31)
+    circ.measure(1).measure(2)
+    key = jax.random.PRNGKey(23)
+    outs8 = np.asarray(circ.run(qt.create_qureg(n, env8), pallas="auto",
+                                key=key))
+
+    d = str(tmp_path / "ckm")
+    q = qt.create_qureg(n, env8)
+    resilience.set_fault_plan([("run_item", 6, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas="auto", key=key, checkpoint_dir=d,
+                 checkpoint_every=2)
+    resilience.clear_fault_plan()
+    pos = _sidecar(d)
+    if pos["ops_applied"] is None:
+        pytest.skip("kill landed on an unaligned boundary for this plan")
+    q4 = qt.create_qureg(n, env4)
+    outs = np.asarray(resilience.resume_run(circ, q4, d, pallas="auto",
+                                            allow_topology_change=True))
+    assert outs.shape == outs8.shape
+    # the replayed prefix is exactly the interrupted run's draws
+    k = len(pos.get("outcomes", ()))
+    assert np.array_equal(outs[:k], np.asarray(pos["outcomes"]))
+    # the resumed state is a valid post-measurement state
+    assert qt.calc_total_prob(q4) == pytest.approx(1.0, abs=1e-10)
+    got = qt.get_state_vector(q4)
+    # cross-check against the public-pieces reference (same key): the
+    # tail draws fold in at index len(prefix), which the preseeded
+    # cursor reproduces — equality means the continuation is seamless
+    fresh = qt.create_qureg(n, env4)
+    resilience.load_snapshot(fresh, d)
+    raw = qt.get_state_vector(fresh)
+    canon = _canonicalise_np(raw, pos.get("layout") or list(range(n)))
+    ref_q = qt.create_qureg(n, env4)
+    qt.init_state_from_amps(ref_q, canon.real.copy(), canon.imag.copy())
+    tail = Circuit(n, False, ops=list(circ.ops)[int(pos["ops_applied"]):])
+    from quest_tpu.circuit import _RunCursor  # the preseed seam itself
+    resume = {"item_index": 0, "outcomes": [], "key": pos["key"],
+              "preseed": pos.get("outcomes", ())}
+    ref_outs = np.asarray(tail.run(ref_q, pallas="auto", _resume=resume))
+    assert np.array_equal(outs, ref_outs)
+    assert np.array_equal(got, qt.get_state_vector(ref_q))
+
+
+def test_plan_layouts_matches_scheduler(tmp_path):
+    """scheduler.plan_layouts reproduces the scheduler's own layout
+    tracking: composing every item of a mesh plan must end at the
+    identity (the canonical-restore epilogue contract), and the
+    aligned-ops annotation is monotonically non-decreasing with the
+    final item covering every op."""
+    from quest_tpu.ops.lattice import _ilog2, state_shape
+    from quest_tpu.scheduler import plan_layouts, schedule_mesh
+
+    circ = _mixed_circuit()
+    n = N
+    lanes = state_shape(1 << n, 8)[1]
+    plan, aligned = schedule_mesh(list(circ.ops), n, 3, _ilog2(lanes),
+                                  with_meta=True)
+    assert len(plan) == len(aligned)
+    layouts = plan_layouts(plan, n)
+    assert layouts[-1] == tuple(range(n)), \
+        "plan must end in canonical layout"
+    seen = [a for a in aligned if a is not None]
+    assert seen == sorted(seen)
+    assert seen[-1] == len(circ.ops)
+    # every relayout/swap boundary is op-aligned by construction
+    for item, a in zip(plan, aligned):
+        if item[0] in ("swap", "relayout"):
+            assert a is not None

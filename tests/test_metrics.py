@@ -364,3 +364,52 @@ def test_fault_seams_only_through_resilience():
         "the recoverable-I/O modules must not silently swallow "
         "failures (retry through a seam or raise a QuESTError naming "
         "the path):\n" + "\n".join(swallowers))
+
+
+# ---------------------------------------------------------------------------
+# Error-taxonomy discipline lint (quest_tpu.validation)
+# ---------------------------------------------------------------------------
+
+#: Any raise of the BASE class, however qualified (QuESTError,
+#: _v.QuESTError, validation.QuESTError, qt.QuESTError).  Subclass
+#: raises (QuESTValidationError, QuESTTimeoutError, ...) do not match.
+_RAISE_BASE = regex.compile(r"\braise\s+(?:[\w.]+\.)?QuESTError\s*\(")
+
+
+def test_error_taxonomy_discipline():
+    """Every raise site must use a taxonomy subclass — the C ABI
+    exposes the failure CLASS as a stable code (getLastErrorCode), so
+    a bare ``raise QuESTError`` would collapse a classifiable failure
+    into the unclassified bucket.  Bare raises are allowed only in
+    quest_tpu/validation.py (the taxonomy's home), and the subclass
+    codes themselves are pinned here as ABI."""
+    from quest_tpu import validation as v
+
+    offenders = []
+    for tree in ("quest_tpu", "tools"):
+        pkg = os.path.join(REPO, tree)
+        for root, _dirs, files in os.walk(pkg):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                rel = f"{tree}/{os.path.relpath(path, pkg)}"
+                if rel == "quest_tpu/validation.py":
+                    continue
+                with open(path) as f:
+                    for lineno, line in enumerate(f, 1):
+                        if _RAISE_BASE.search(line):
+                            offenders.append(
+                                f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raise a QuESTError taxonomy subclass (QuESTValidationError / "
+        "QuESTTimeoutError / QuESTCorruptionError / QuESTTopologyError"
+        "), not the bare base class — the C driver branches on the "
+        "class code:\n" + "\n".join(offenders))
+    # the codes are ABI (capi/include/QuEST.h QuESTErrorCode): pinned
+    assert (v.QuESTError.code, v.QuESTValidationError.code,
+            v.QuESTTimeoutError.code, v.QuESTCorruptionError.code,
+            v.QuESTTopologyError.code) == (1, 2, 3, 4, 5)
+    for sub in (v.QuESTValidationError, v.QuESTTimeoutError,
+                v.QuESTCorruptionError, v.QuESTTopologyError):
+        assert issubclass(sub, v.QuESTError)

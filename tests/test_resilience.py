@@ -624,6 +624,212 @@ def test_snapshot_owner_conflict_skips(env1, tmp_path, capfd):
     assert pos.get("kind") == "flush"
 
 
+# ---------------------------------------------------------------------------
+# (j) collective watchdog + straggler injection + mesh health
+# ---------------------------------------------------------------------------
+
+
+def _warm_observed(circ, env, pallas):
+    """Compile the observed per-item programs once (watchdog armed with
+    a generous floor), so watchdog tests time EXECUTION, not the first
+    run's jit compiles."""
+    resilience.set_watchdog(True, min_s=120.0)
+    q = qt.create_qureg(circ.num_qubits, env)
+    circ.run(q, pallas=pallas)
+
+
+def test_straggler_kinds_restricted_to_straggler_seams():
+    with pytest.raises(qt.QuESTValidationError, match="straggler"):
+        resilience.set_fault_plan([("aot_load", 0, "stall")])
+    with pytest.raises(qt.QuESTValidationError, match="straggler"):
+        resilience.set_fault_plan("ckpt_save:0:delay:50")
+    with pytest.raises(qt.QuESTValidationError, match="unknown fault"):
+        resilience.set_fault_plan([("run_item", 0, "delay:abc")])
+    # both spellings of a valid delay parse
+    resilience.set_fault_plan("mesh_exchange:1:delay:250")
+    resilience.set_fault_plan([("run_item", 0, "delay:250")])
+
+
+def test_watchdog_budget_formula(monkeypatch):
+    resilience.set_watchdog(True, gbps=10.0, slack=2.0, min_s=1.0)
+    # 10 GB moved per device at 10 GB/s with 2x slack = 2 s + 1 s floor
+    assert resilience.watchdog_budget_s(8 * 10_000_000_000, 8) \
+        == pytest.approx(3.0)
+    # compute-only items get the floor
+    assert resilience.watchdog_budget_s(0, 8) == pytest.approx(1.0)
+    # env knobs serve when no programmatic override is set
+    resilience.reset()
+    monkeypatch.setenv("QUEST_WATCHDOG_GBPS", "5")
+    monkeypatch.setenv("QUEST_WATCHDOG_SLACK", "1")
+    monkeypatch.setenv("QUEST_WATCHDOG_MIN_S", "0")
+    assert resilience.watchdog_budget_s(4 * 5_000_000_000, 4) \
+        == pytest.approx(1.0)
+    monkeypatch.setenv("QUEST_WATCHDOG_STRIKES", "7")
+    assert resilience.watchdog_strikes() == 7
+    # a NON-POSITIVE value clears a prior override back to env/default
+    # (the C setCollectiveWatchdog contract); None keeps it
+    resilience.set_watchdog(True, gbps=100.0, min_s=9.0)
+    resilience.set_watchdog(True, gbps=-1.0, min_s=None)
+    assert resilience.watchdog_budget_s(4 * 5_000_000_000, 4) \
+        == pytest.approx(9.0 + 1.0)  # gbps back to env(5), min_s kept
+
+
+def test_watchdog_catches_injected_straggler(env8, tmp_path, monkeypatch):
+    """An injected `delay` straggler on the mesh_exchange seam
+    deterministically trips the watchdog: typed QuESTTimeoutError
+    naming the plan item, its comm class, and the expected-vs-elapsed
+    budget, plus a flight-recorder dump (ISSUE-7 acceptance)."""
+    monkeypatch.setenv("QUEST_FLIGHT_FILE", str(tmp_path / "f.json"))
+    circ = models.qft(8)
+    _warm_observed(circ, env8, "auto")
+    resilience.set_watchdog(True, min_s=0.30, slack=2.0, strikes=99)
+    resilience.set_fault_plan([("mesh_exchange", 0, "delay:1200")])
+    q = qt.create_qureg(8, env8)
+    with pytest.raises(qt.QuESTTimeoutError) as ei:
+        circ.run(q, pallas="auto")
+    msg = str(ei.value)
+    assert "collective watchdog tripped on plan item" in msg
+    assert "comm class" in msg
+    assert "exceeds the expected budget" in msg
+    assert "flight recorder dumped to" in msg
+    assert os.path.exists(str(tmp_path / "f.json"))
+    assert metrics.counters().get("resilience.watchdog_breaches", 0) >= 1
+    # observed runs never donate: the register survives the breach
+    assert qt.calc_total_prob(q) == pytest.approx(1.0, abs=1e-10)
+
+
+def test_watchdog_stall_detected_in_flight(env8, tmp_path, monkeypatch):
+    """A `stall` fault (simulated hung collective) is detected BY the
+    in-flight watchdog timer — the run unblocks at the deadline with a
+    typed timeout instead of hanging forever."""
+    monkeypatch.setenv("QUEST_FLIGHT_FILE", str(tmp_path / "f.json"))
+    circ = models.qft(8)
+    _warm_observed(circ, env8, "auto")
+    resilience.set_watchdog(True, min_s=0.30, slack=2.0, strikes=99)
+    resilience.set_fault_plan([("run_item", 1, "stall")])
+    q = qt.create_qureg(8, env8)
+    with pytest.raises(qt.QuESTTimeoutError) as ei:
+        circ.run(q, pallas="auto")
+    assert "STALLED in flight" in str(ei.value)
+    assert metrics.counters().get("resilience.watchdog_overdue", 0) >= 1
+
+
+def test_stall_without_watchdog_refused(env8, monkeypatch):
+    """A stall with no armed watchdog would hang forever: refused with
+    a validation error pointing at the watchdog knobs."""
+    circ = models.qft(8)
+    resilience.set_fault_plan([("run_item", 0, "stall")])
+    monkeypatch.setenv("QUEST_TIMELINE", "1")  # observe, watchdog off
+    q = qt.create_qureg(8, env8)
+    with pytest.raises(qt.QuESTValidationError, match="watchdog"):
+        circ.run(q, pallas="auto")
+
+
+def test_circuit_breaker_marks_device_degraded(env8, tmp_path,
+                                               monkeypatch):
+    """k watchdog strikes trip the circuit breaker: devices are marked
+    degraded in the mesh-health registry, the run-ledger record, and
+    subsequent health/watchdog messages."""
+    monkeypatch.setenv("QUEST_FLIGHT_FILE", str(tmp_path / "f.json"))
+    circ = models.qft(8)
+    _warm_observed(circ, env8, "auto")
+    resilience.set_watchdog(True, min_s=0.30, slack=2.0, strikes=2)
+    for hit in range(2):
+        resilience.set_fault_plan([("mesh_exchange", 0, "delay:1200")])
+        q = qt.create_qureg(8, env8)
+        with pytest.raises(qt.QuESTTimeoutError) as ei:
+            circ.run(q, pallas="auto")
+        resilience.clear_fault_plan()
+    health = resilience.mesh_health()
+    assert health["degraded"], "2 strikes must degrade the participants"
+    assert health["strikes_to_degrade"] == 2
+    assert all(health["strikes"][d] >= 2 for d in health["degraded"])
+    assert "degraded" in str(ei.value)
+    assert metrics.counters().get("resilience.devices_degraded", 0) >= 1
+    # the breach's run-ledger record carries the degraded set
+    rec = metrics.get_run_ledger()
+    assert rec["meta"].get("degraded_devices") == health["degraded"]
+    # and the health-probe suffix names them for any later probe
+    assert "DEGRADED" in resilience.health_suffix()
+
+
+def test_run_ledger_reports_per_run_resilience_numbers(env1, monkeypatch):
+    """Per-run resilience counters reset at Circuit.run ledger-scope
+    entry: each record reports ITS run's numbers, not process-lifetime
+    totals."""
+    circ = models.ghz(4)
+    resilience.set_fault_plan([("run_item", 0, "nan")])
+    monkeypatch.setenv("QUEST_TIMELINE", "1")  # observe so run_item fires
+    q = qt.create_qureg(4, env1)
+    circ.run(q, pallas=False)
+    monkeypatch.delenv("QUEST_TIMELINE")
+    resilience.clear_fault_plan()
+    rec = metrics.get_run_ledger()
+    assert rec["meta"]["resilience"]["faults_injected"] == 1
+    assert rec["meta"]["resilience"]["fault_hits"] >= 1
+    # a second, clean run reports zeros even though process counters
+    # are nonzero
+    q2 = qt.create_qureg(4, env1)
+    circ.run(q2, pallas=False)
+    rec2 = metrics.get_run_ledger()
+    assert rec2["meta"]["resilience"]["faults_injected"] == 0
+    assert rec2["meta"]["resilience"]["fault_hits"] == 0
+    assert metrics.counters().get("resilience.faults_injected", 0) >= 1
+
+
+def test_fingerprint_mismatch_names_component(env1, env8, tmp_path):
+    """ISSUE-7 satellite: a fingerprint mismatch names WHICH component
+    differs — circuit plan vs topology vs pallas/backend flag — so an
+    operator can tell 'wrong circuit' from 'smaller mesh' at a
+    glance."""
+    n = 6
+    circ = models.qft(n)
+    d = str(tmp_path / "cmp")
+    q = qt.create_qureg(n, env8)
+    resilience.set_fault_plan([("run_item", 3, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas="auto", checkpoint_dir=d, checkpoint_every=1)
+    resilience.clear_fault_plan()
+    # wrong circuit, same topology: validation error naming the circuit
+    with pytest.raises(qt.QuESTValidationError,
+                       match="circuit plan"):
+        resilience.resume_run(models.ghz(n), qt.create_qureg(n, env8), d,
+                              pallas="auto")
+    # same circuit, smaller mesh: topology error naming the counts and
+    # pointing at the degraded-resume flag
+    with pytest.raises(qt.QuESTTopologyError,
+                       match=r"topology \(8 -> 1 devices\)") as ei:
+        resilience.resume_run(circ, qt.create_qureg(n, env1), d,
+                              pallas="auto")
+    assert "allow_topology_change" in str(ei.value)
+    # same circuit + topology, different backend decomposition
+    with pytest.raises(qt.QuESTTopologyError, match="backend"):
+        resilience.resume_run(circ, qt.create_qureg(n, env8), d,
+                              pallas=False)
+
+
+def test_resume_state_topology_flag(env1, env8, tmp_path):
+    """resume_state refuses a cross-topology flush snapshot without the
+    flag (QuESTTopologyError, register untouched) and restores exactly
+    with it — the C API's resumeRunEx contract."""
+    d = str(tmp_path / "xt")
+    qt.set_checkpoint_policy(d, 1)
+    try:
+        q = qt.create_qureg(5, env8)
+        qt.hadamard(q, 0)
+        qt.hadamard(q, 4)
+        ref = qt.get_state_vector(q)  # flush -> snapshot (8 devices)
+    finally:
+        qt.set_checkpoint_policy(None, 0)
+    q1 = qt.create_qureg(5, env1)
+    with pytest.raises(qt.QuESTTopologyError, match="8 device"):
+        resilience.resume_state(q1, d)
+    assert qt.get_state_vector(q1)[0] == pytest.approx(1.0)  # untouched
+    pos = resilience.resume_state(q1, d, allow_topology_change=True)
+    assert pos.get("flush_index", 0) >= 1
+    assert np.array_equal(qt.get_state_vector(q1), ref)
+
+
 def test_snapshot_rotation_alternates_slots(env1, tmp_path):
     """Consecutive snapshots rotate between slot-0 and slot-1 and the
     pointer always names the newest complete one."""

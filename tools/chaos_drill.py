@@ -23,6 +23,19 @@ each scenario's recovery contract:
 * ``injected_nan``    — a scripted NaN is injected into the state at a
   plan item; the health probe must trip AT that item, name it and the
   last-good checkpoint, and leave the register unbricked.
+* ``straggler_watchdog`` — a scripted ``delay:<ms>`` straggler on the
+  mesh_exchange seam; the collective watchdog must trip with a typed
+  ``QuESTTimeoutError`` naming the plan item, its comm class and the
+  expected-vs-elapsed budget, and dump the flight-recorder ring.
+* ``degraded_resume``  — a run checkpointed on the full virtual mesh is
+  killed and resumed onto HALF the devices
+  (``resume_run(..., allow_topology_change=True)``): amplitudes must be
+  bit-identical to restoring the same snapshot into a fresh
+  smaller-mesh register and running the remaining ops there
+  uninterrupted, and within 1e-10 of the full-circuit oracle.
+* ``breaker_trip``     — repeated watchdog breaches must trip the
+  k-strike circuit breaker: devices marked degraded in the mesh-health
+  registry and named by subsequent failure messages.
 
 Every scenario must end in either a clean recovery (with the
 resilience counters recorded) or a ``QuESTError`` naming the seam —
@@ -276,10 +289,182 @@ def drill_injected_nan(circ, env, pallas):
            named_last_good=named_ckpt, register_unbricked=unbricked)
 
 
+def _warm_observed(circ, env, pallas):
+    """Compile the observed per-item programs once under a generous
+    watchdog floor, so the straggler drills time execution rather than
+    the first run's jit compiles."""
+    resilience.set_watchdog(True, min_s=300.0)
+    q = qt.create_qureg(N_QUBITS, env)
+    circ.run(q, pallas=pallas)
+    resilience.set_watchdog(False)
+
+
+#: Straggler drill budget: floor (s) and injected delay (ms).  The
+#: delay must dominate the floor with margin on a loaded CPU host.
+WD_MIN_S = 0.5
+WD_DELAY_MS = 2000
+
+
+def drill_straggler_watchdog(circ, env, ndev, pallas):
+    # seam: mesh_exchange on a mesh (the acceptance scenario); on a
+    # 1-device host no plan item has communication, so the run_item
+    # seam models the straggler instead
+    seam = "mesh_exchange" if ndev > 1 else "run_item"
+    before = metrics.counters()
+    _warm_observed(circ, env, pallas)
+    resilience.set_watchdog(True, min_s=WD_MIN_S, slack=4.0, strikes=99)
+    resilience.set_fault_plan([(seam, 0, f"delay:{WD_DELAY_MS}")])
+    q = qt.create_qureg(N_QUBITS, env)
+    caught = named = budgeted = dumped = False
+    try:
+        circ.run(q, pallas=pallas)
+    except qt.QuESTTimeoutError as e:
+        msg = str(e)
+        caught = True
+        named = "collective watchdog tripped on plan item" in msg \
+            and (ndev == 1 or "comm class" in msg)
+        budgeted = "exceeds the expected budget" in msg
+        dumped = "flight recorder dumped to" in msg
+    finally:
+        resilience.clear_fault_plan()
+        resilience.set_watchdog(False)
+    delta = counters_delta(before, ("resilience.watchdog_breaches",
+                                    "resilience.faults_injected"))
+    unbricked = abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+    ok = caught and named and budgeted and dumped and unbricked \
+        and delta["resilience.watchdog_breaches"] >= 1
+    record("straggler_watchdog", ok, caught=caught, named_item=named,
+           named_budget=budgeted, flight_dumped=dumped,
+           register_unbricked=unbricked, seam=seam,
+           budget_s=round(resilience.watchdog_budget_s(0, ndev), 3),
+           **delta)
+
+
+def drill_degraded_resume(circ, env, ndev, pallas):
+    if ndev < 2:
+        record("degraded_resume", True, skipped="needs a multi-device "
+               "mesh (no smaller surviving topology on 1 device)")
+        return
+    env_half = qt.create_env(num_devices=ndev // 2)
+    oracle = reference_state(circ, env_half, pallas)
+    d = tempfile.mkdtemp(prefix="chaos-degraded-")
+    before = metrics.counters()
+    q = qt.create_qureg(N_QUBITS, env)
+    resilience.set_fault_plan([("run_item", KILL_AT, "runtime")])
+    try:
+        circ.run(q, pallas=pallas, checkpoint_dir=d,
+                 checkpoint_every=CKPT_EVERY)
+    except RuntimeError:
+        pass
+    finally:
+        resilience.clear_fault_plan()
+    with open(os.path.join(d, "latest")) as f:
+        latest = f.read().strip()
+    pos = resilience._read_position(os.path.join(d, latest),
+                                    required=True)
+    if pos.get("ops_applied") is None:
+        record("degraded_resume", False,
+               detail=f"checkpoint at item {pos.get('item_index')} not "
+                      "op-aligned — adjust QUEST_CHAOS_KILL_AT")
+        shutil.rmtree(d, ignore_errors=True)
+        return
+    # refused without the flag, with the differing component named
+    refused = False
+    try:
+        resilience.resume_run(circ, qt.create_qureg(N_QUBITS, env_half),
+                              d, pallas=pallas)
+    except qt.QuESTTopologyError as e:
+        refused = "topology" in str(e)
+    # degraded resume onto half the devices
+    q_half = qt.create_qureg(N_QUBITS, env_half)
+    resilience.resume_run(circ, q_half, d, pallas=pallas,
+                          allow_topology_change=True)
+    got = qt.get_state_vector(q_half)
+    # reference: restore the snapshot into a fresh half-mesh register,
+    # canonicalise the recorded layout on the host (exact), run the
+    # remaining ops there uninterrupted
+    probe = qt.create_qureg(N_QUBITS, env_half)
+    resilience.load_snapshot(probe, d)
+    raw = qt.get_state_vector(probe)
+    perm = pos.get("layout") or list(range(N_QUBITS))
+    idx = np.zeros(1 << N_QUBITS, dtype=np.int64)
+    ar = np.arange(1 << N_QUBITS)
+    for b, p in enumerate(perm):
+        idx |= ((ar >> p) & 1) << b
+    canon = raw[idx]
+    fresh = qt.create_qureg(N_QUBITS, env_half)
+    qt.init_state_from_amps(fresh, canon.real.copy(), canon.imag.copy())
+    from quest_tpu.circuit import Circuit
+
+    tail = Circuit(N_QUBITS, False,
+                   ops=list(circ.ops)[int(pos["ops_applied"]):])
+    tail.run(fresh, pallas=pallas)
+    ref = qt.get_state_vector(fresh)
+    delta = counters_delta(before, ("resilience.degraded_resumes",
+                                    "resilience.resumes"))
+    bit_identical = bool(np.array_equal(got, ref))
+    oracle_ok = bool(np.abs(got - oracle).max() < 1e-10)
+    ok = refused and bit_identical and oracle_ok \
+        and delta["resilience.degraded_resumes"] >= 1
+    record("degraded_resume", ok, refused_without_flag=refused,
+           bit_identical_to_clean_tail=bit_identical,
+           oracle_within_1e10=oracle_ok,
+           from_devices=ndev, to_devices=ndev // 2,
+           ops_applied=pos["ops_applied"], **delta)
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def drill_breaker_trip(circ, env, ndev, pallas):
+    if ndev < 2:
+        record("breaker_trip", True, skipped="per-device strikes need "
+               "a multi-device mesh")
+        return
+    resilience.clear_mesh_health()
+    before = metrics.counters()
+    strikes = 2
+    _warm_observed(circ, env, pallas)
+    resilience.set_watchdog(True, min_s=WD_MIN_S, slack=4.0,
+                            strikes=strikes)
+    last_msg = ""
+    try:
+        for _ in range(strikes):
+            resilience.set_fault_plan(
+                [("mesh_exchange", 0, f"delay:{WD_DELAY_MS}")])
+            q = qt.create_qureg(N_QUBITS, env)
+            try:
+                circ.run(q, pallas=pallas)
+            except qt.QuESTTimeoutError as e:
+                last_msg = str(e)
+            resilience.clear_fault_plan()
+    finally:
+        resilience.clear_fault_plan()
+        resilience.set_watchdog(False)
+    health = resilience.mesh_health()
+    delta = counters_delta(before, ("resilience.watchdog_breaches",
+                                    "resilience.devices_degraded"))
+    tripped = bool(health["degraded"])
+    named = "degraded" in last_msg
+    suffixed = "DEGRADED" in resilience.health_suffix()
+    ok = tripped and named and suffixed \
+        and delta["resilience.watchdog_breaches"] >= strikes \
+        and delta["resilience.devices_degraded"] >= 1
+    record("breaker_trip", ok, devices_degraded=health["degraded"],
+           strikes_to_degrade=health["strikes_to_degrade"],
+           named_in_error=named, named_in_health_suffix=suffixed,
+           **delta)
+    resilience.clear_mesh_health()
+
+
 def main():
     rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 6
     sw = stopwatch()
     resilience.reset()
+    # watchdog breaches and tripped probes dump the flight ring; keep
+    # the drill's dumps out of the repo working directory
+    os.environ.setdefault(
+        "QUEST_FLIGHT_FILE",
+        os.path.join(tempfile.gettempdir(),
+                     f"chaos-flight-{os.getpid()}.json"))
     env, ndev = make_env()
     # a mesh plan has relayout exchanges between segments; a 1-device
     # fused plan can collapse to one item, so the single-device drill
@@ -294,15 +479,29 @@ def main():
     drill_transient_aot()
     drill_sink_failure(circ, env, pallas)
     drill_injected_nan(circ, env, pallas)
+    drill_straggler_watchdog(circ, env, ndev, pallas)
+    drill_degraded_resume(circ, env, ndev, pallas)
+    drill_breaker_trip(circ, env, ndev, pallas)
 
     n_fail = sum(1 for r in results if not r["ok"])
     doc = {
         "artifact": "chaos-drill",
+        # config tag for ledger_diff's config-bound rules: wall-time
+        # comparisons only apply between drills of the same scenario
+        # matrix and size (a GROWN matrix is not a perf regression)
+        "metric": f"chaos-q{N_QUBITS}-s{len(results)}",
         "round": rnd,
         "qubits": N_QUBITS,
         "num_devices": ndev,
         "kill_at_item": KILL_AT,
         "checkpoint_every": CKPT_EVERY,
+        "watchdog": {
+            "min_s": WD_MIN_S,
+            "injected_delay_ms": WD_DELAY_MS,
+            "slack": 4.0,
+            "gbps_default": resilience.WATCHDOG_GBPS_DEFAULT,
+            "breaker_strikes": 2,
+        },
         "scenarios": results,
         "failures": n_fail,
         "seconds": round(sw.seconds, 2),

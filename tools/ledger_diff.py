@@ -39,6 +39,16 @@ import sys
 #: (key, signed limit fraction, config_bound) — config_bound rules only
 #: apply when both records describe the same workload config.
 DEFAULT_RULES = [
+    # recovery-path health: a chaos drill artifact (CHAOS_r*.json) with
+    # ANY failed scenario, or fewer scenarios than the baseline, is a
+    # regression of the fault matrix itself (keys absent on non-chaos
+    # records, so these skip everywhere else)
+    ("failures", +0.0, False),
+    # rate-style: FEWER breaches than baseline = the drill's watchdog
+    # scenarios stopped firing (a shrunken fault matrix).  NOTE the
+    # limit must be strictly negative — -0.0 compares >= 0 and would
+    # invert the rule into increase-is-bad
+    ("counters.resilience.watchdog_breaches", -0.001, False),
     # structural / communication metrics: tight, config-independent
     ("mesh_exchange_bytes_qft30", +0.01, False),
     ("counters.exec.exchange_bytes", +0.01, False),
