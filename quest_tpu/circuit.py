@@ -10,7 +10,10 @@ matrices are burned into the program (SURVEY §7.3 'gate-at-a-time dispatch
 overhead' — this is the key idiomatic departure from the reference).
 
 Ops are stored as (kind, statics, scalars) kernel invocations, so a
-Circuit runs identically on one device or sharded over a mesh.
+Circuit runs identically on one device or sharded over a mesh.  All
+compiled functions take and return the single interleaved (rows, 2L)
+amplitude array (quest_tpu.ops.lattice) — one HBM sweep per fused
+pass, one donated buffer per run.
 """
 
 from __future__ import annotations
@@ -22,34 +25,34 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .ops.lattice import run_kernel, state_shape
+from .ops.lattice import amps_shape, run_kernel, state_shape
 from .ops import gates as _g
 from . import metrics
 from . import precision as _prec
 from . import validation as _v
 
 
-def _observing(re, item_hook) -> bool:
+def _observing(amps, item_hook) -> bool:
     """True when per-item observation applies right now: timeline
     capture or a health hook is on AND the state is concrete (never
     under a jit trace, where walls and probes would be meaningless)."""
-    return (not isinstance(re, jax.core.Tracer)
+    return (not isinstance(amps, jax.core.Tracer)
             and (metrics.timeline_active() or item_hook is not None))
 
 
-def measure_state_weight(re, im, is_density: bool, num_qubits: int,
+def measure_state_weight(amps, is_density: bool, num_qubits: int,
                          mesh) -> float:
     """Norm (state-vector) / trace (density matrix) of a state — the
     conserved quantity the health probes track."""
     if is_density:
-        return float(run_kernel((re, im), (), kind="dm_total_prob",
+        return float(run_kernel((amps,), (), kind="dm_total_prob",
                                 statics=(num_qubits,), mesh=mesh,
                                 out_kind="scalar"))
-    return float(run_kernel((re, im), (), kind="sv_total_prob",
+    return float(run_kernel((amps,), (), kind="sv_total_prob",
                             statics=(), mesh=mesh, out_kind="scalar"))
 
 
-def check_state_health(re, im, *, is_density: bool, num_qubits: int,
+def check_state_health(amps, *, is_density: bool, num_qubits: int,
                        mesh, before: float | None, n_ops: int,
                        structural: bool = True):
     """The ONE health check both probe seams share (``QUEST_HEALTH_EVERY``
@@ -70,16 +73,16 @@ def check_state_health(re, im, *, is_density: bool, num_qubits: int,
     next drift anchor)."""
     import math as _math
 
-    eps = _prec.real_eps(re.dtype)
+    eps = _prec.real_eps(amps.dtype)
     # generous per-op roundoff allowance: only genuine kernel bugs /
-    # injected garbage should trip
+    # injected garbage should trip.  NaN/Inf scans the ONE interleaved
+    # array — a single reduction where the split layout needed two.
     bound = 64 * max(n_ops, 1) * eps
-    if not (bool(jnp.isfinite(re).all())
-            and bool(jnp.isfinite(im).all())):
+    if not bool(jnp.isfinite(amps).all()):
         return "non-finite amplitudes (NaN/Inf)", None
     if not structural:
         return None, None
-    after = measure_state_weight(re, im, is_density, num_qubits, mesh)
+    after = measure_state_weight(amps, is_density, num_qubits, mesh)
     if before is not None:
         drift = abs(after - before)
         lim = bound * max(abs(before), 1.0)
@@ -88,15 +91,14 @@ def check_state_health(re, im, *, is_density: bool, num_qubits: int,
             return (f"{what} drift {drift:.3e} exceeds bound {lim:.3e} "
                     f"({before!r} -> {after!r})"), after
     if is_density:
-        # max |rho - rho^H|: the flat (rows, lanes) storage reshapes to
-        # the (dim, dim) matrix (flat index = col * dim + row, see
-        # register.get_density_amp); the check is symmetric in the
-        # index convention
-        dim = 1 << num_qubits
-        mr = re.reshape(dim, dim)
-        mi = im.reshape(dim, dim)
-        hd = float(jnp.maximum(jnp.abs(mr - mr.T).max(),
-                               jnp.abs(mi + mi.T).max()))
+        # max |rho - rho^H| over the global state (lattice.dm_herm_drift
+        # — computed on the sharded global array, never replicated
+        # per device; flat index = col * dim + row, see
+        # register.get_density_amp; the check is symmetric in the
+        # index convention)
+        from .ops.lattice import dm_herm_drift
+
+        hd = dm_herm_drift(amps, num_qubits)
         if not _math.isfinite(hd) or hd > bound:
             return (f"hermiticity drift {hd:.3e} exceeds bound "
                     f"{bound:.3e}"), after
@@ -301,15 +303,15 @@ class Circuit:
         """Recorded ``measure`` ops (= length of the outcomes vector)."""
         return sum(1 for kind, _, _ in self.ops if kind == "measure")
 
-    def _measure_step(self, re, im, key, meas_ix, target, mesh):
+    def _measure_step(self, amps, key, meas_ix, target, mesh):
         """One on-device measurement: reduce P(0), sample, collapse."""
-        eps = _prec.real_eps(re.dtype)
+        eps = _prec.real_eps(amps.dtype)
         if self.is_density:
-            p0 = run_kernel((re, im), (), kind="dm_prob_zero",
+            p0 = run_kernel((amps,), (), kind="dm_prob_zero",
                             statics=(self.num_qubits, target), mesh=mesh,
                             out_kind="scalar")
         else:
-            p0 = run_kernel((re, im), (), kind="sv_prob_zero",
+            p0 = run_kernel((amps,), (), kind="sv_prob_zero",
                             statics=(target,), mesh=mesh,
                             out_kind="scalar")
         u = jax.random.uniform(jax.random.fold_in(key, meas_ix),
@@ -319,10 +321,10 @@ class Circuit:
         outcome = jnp.where(p0 < eps, 1,
                             jnp.where(1 - p0 < eps, 0,
                                       (u > p0).astype(jnp.int32)))
-        re, im = self._collapse_step(re, im, target, outcome, p0, mesh)
-        return re, im, outcome
+        amps = self._collapse_step(amps, target, outcome, p0, mesh)
+        return amps, outcome
 
-    def _collapse_step(self, re, im, target, outcome, p0, mesh):
+    def _collapse_step(self, amps, target, outcome, p0, mesh):
         prob = jnp.where(outcome == 0, p0, 1 - p0)
         # Degenerate projection (prob ~ 0, possible only via a recorded
         # collapse onto an impossible outcome): compiled code cannot
@@ -330,20 +332,20 @@ class Circuit:
         # clamp the renorm divisor — the kept block is (near-)zero, so
         # the result is a (near-)zero state, detectable via
         # calc_total_prob, rather than a silent NaN/Inf poisoning.
-        eps = _prec.real_eps(re.dtype)
+        eps = _prec.real_eps(amps.dtype)
         prob = jnp.maximum(prob, eps)
         if self.is_density:
-            re, im = run_kernel((re, im), (outcome, 1.0 / prob),
-                                kind="dm_collapse",
-                                statics=(self.num_qubits, target),
-                                mesh=mesh)
+            amps = run_kernel((amps,), (outcome, 1.0 / prob),
+                              kind="dm_collapse",
+                              statics=(self.num_qubits, target),
+                              mesh=mesh)
         else:
-            re, im = run_kernel((re, im), (outcome, 1.0 / jnp.sqrt(prob)),
-                                kind="sv_collapse", statics=(target,),
-                                mesh=mesh)
-        return re, im
+            amps = run_kernel((amps,), (outcome, 1.0 / jnp.sqrt(prob)),
+                              kind="sv_collapse", statics=(target,),
+                              mesh=mesh)
+        return amps
 
-    def _nonunitary_observed(self, re, im, key, outcomes, op, mesh, cur):
+    def _nonunitary_observed(self, amps, key, outcomes, op, mesh, cur):
         """One measure/collapse step under an observed run's resume
         cursor (quest_tpu.resilience): a step the cursor SKIPS was
         already applied before the checkpoint being resumed, so the
@@ -354,34 +356,34 @@ class Circuit:
         if cur is not None and not cur.take():
             if op[0] == "measure":
                 outcomes.append(jnp.asarray(cur.stored.pop(0), jnp.int32))
-            return re, im
-        re, im, out, _ = self._nonunitary_step(re, im, key,
-                                               len(outcomes), op, mesh)
+            return amps
+        amps, out, _ = self._nonunitary_step(amps, key, len(outcomes),
+                                             op, mesh)
         if out is not None:
             outcomes.append(out)
-        return re, im
+        return amps
 
-    def _nonunitary_step(self, re, im, key, meas_ix, op, mesh):
+    def _nonunitary_step(self, amps, key, meas_ix, op, mesh):
         """Dispatch one recorded measure/collapse op; returns
-        (re, im, outcome-or-None, consumed_randomness)."""
+        (amps, outcome-or-None, consumed_randomness)."""
         kind, statics, _ = op
         if kind == "measure":
-            re, im, out = self._measure_step(re, im, key, meas_ix,
-                                             statics[0], mesh)
-            return re, im, out, True
+            amps, out = self._measure_step(amps, key, meas_ix,
+                                           statics[0], mesh)
+            return amps, out, True
         target, outcome = statics
         if self.is_density:
-            p0 = run_kernel((re, im), (), kind="dm_prob_zero",
+            p0 = run_kernel((amps,), (), kind="dm_prob_zero",
                             statics=(self.num_qubits, target), mesh=mesh,
                             out_kind="scalar")
         else:
-            p0 = run_kernel((re, im), (), kind="sv_prob_zero",
+            p0 = run_kernel((amps,), (), kind="sv_prob_zero",
                             statics=(target,), mesh=mesh,
                             out_kind="scalar")
-        re, im = self._collapse_step(re, im, target,
-                                     jnp.asarray(outcome, jnp.int32), p0,
-                                     mesh)
-        return re, im, None, False
+        amps = self._collapse_step(amps, target,
+                                   jnp.asarray(outcome, jnp.int32), p0,
+                                   mesh)
+        return amps, None, False
 
     # -- compilation -----------------------------------------------------
     @property
@@ -402,10 +404,11 @@ class Circuit:
         kernel path; jit-compatible, correct for single-device or
         mesh-sharded arrays.
 
-        Signature is ``(re, im) -> (re, im)``; when the circuit records
-        ``measure`` or ``collapse`` ops it is ``(re, im, key) ->
-        (re, im, outcomes)`` with ``key`` a jax PRNG key and ``outcomes``
-        an int32 vector of the recorded measurements in record order.
+        Signature is ``amps -> amps`` over the interleaved (rows, 2L)
+        state; when the circuit records ``measure`` or ``collapse`` ops
+        it is ``(amps, key) -> (amps, outcomes)`` with ``key`` a jax
+        PRNG key and ``outcomes`` an int32 vector of the recorded
+        measurements in record order.
 
         When timeline capture is active (or ``item_hook`` — the health
         probe seam — is given) and the arrays are concrete, each gate
@@ -421,39 +424,44 @@ class Circuit:
                        if op[0] not in _nu
                        and (i + 1 == len(ops) or ops[i + 1][0] in _nu)}
 
-        def fn(re, im, key=None):
+        def fn(amps, key=None):
             cur = None
             if item_hook is not None \
-                    and not isinstance(re, jax.core.Tracer):
+                    and not isinstance(amps, jax.core.Tracer):
                 cur = getattr(item_hook, "cursor", None)
             outcomes = cur.outcomes if cur is not None else []
             for i, op in enumerate(ops):
                 kind, statics, scalars = op
                 if kind in ("measure", "collapse"):
-                    re, im = self._nonunitary_observed(
-                        re, im, key, outcomes, op, mesh, cur)
-                elif _observing(re, item_hook):
+                    amps = self._nonunitary_observed(
+                        amps, key, outcomes, op, mesh, cur)
+                elif _observing(amps, item_hook):
                     from .parallel.mesh_exec import observe_item
 
-                    re, im = observe_item(
-                        lambda r, j, _op=op: run_kernel(
-                            (r, j), _op[2], kind=_op[0], statics=_op[1],
+                    amps = observe_item(
+                        lambda a, _op=op: run_kernel(
+                            (a,), _op[2], kind=_op[0], statics=_op[1],
                             mesh=mesh),
-                        re, im,
+                        amps,
                         {"kind": "xla-segment", "index": i, "ops": 1,
                          "op": kind, "targets": _op_targets(op),
                          "last_in_run": i in last_in_run,
+                         # per-gate dispatch: one full sweep over the
+                         # interleaved state per gate kernel
+                         "stream_elems":
+                             1 << (self.num_qubits
+                                   * (2 if self.is_density else 1) + 2),
                          # per-gate dispatch in recorded order: every
                          # boundary is op-aligned, layout canonical
                          "ops_done": i + 1},
                         hook=item_hook)
                 else:
-                    re, im = run_kernel((re, im), scalars, kind=kind,
-                                        statics=statics, mesh=mesh)
+                    amps = run_kernel((amps,), scalars, kind=kind,
+                                      statics=statics, mesh=mesh)
             if has_nu:
-                return re, im, jnp.stack(outcomes) if outcomes \
-                    else jnp.zeros((0,), jnp.int32)
-            return re, im
+                return amps, (jnp.stack(outcomes) if outcomes
+                              else jnp.zeros((0,), jnp.int32))
+            return amps
 
         return fn
 
@@ -474,7 +482,7 @@ class Circuit:
         ``per_item``/``item_hook``: the observability surface (see
         :meth:`run`).  ``per_item`` routes a mesh plan through per-item
         jitted programs (non-donating here, so a tripped probe never
-        bricks the caller's register); ``item_hook(re, im, meta)`` runs
+        bricks the caller's register); ``item_hook(amps, meta)`` runs
         after every executed item when the state is concrete, and active
         timeline capture walls each item with ``block_until_ready``.
         Measure/collapse steps between gate runs are not separate
@@ -493,19 +501,19 @@ class Circuit:
                     # the per-gate XLA path (trivially cheap at this size)
                     mesh_stats["passes"] += len(run_ops)
 
-                    def fn(re, im):
+                    def fn(amps):
                         for i, (kind, statics, scalars) in \
                                 enumerate(run_ops):
-                            if _observing(re, item_hook):
+                            if _observing(amps, item_hook):
                                 from .parallel.mesh_exec import \
                                     observe_item
 
-                                re, im = observe_item(
-                                    lambda r, j, _o=(kind, statics,
-                                                     scalars):
-                                    run_kernel((r, j), _o[2], kind=_o[0],
+                                amps = observe_item(
+                                    lambda a, _o=(kind, statics,
+                                                  scalars):
+                                    run_kernel((a,), _o[2], kind=_o[0],
                                                statics=_o[1], mesh=mesh),
-                                    re, im,
+                                    amps,
                                     {"kind": "xla-segment", "index": i,
                                      "ops": 1, "op": kind,
                                      "targets": _op_targets(
@@ -513,15 +521,16 @@ class Circuit:
                                      "last_in_run":
                                          i + 1 == len(run_ops),
                                      "ndev": int(mesh.devices.size),
+                                     "stream_elems": 1 << (nvec + 2),
                                      # per-gate, in order: op-aligned
                                      "ops_done": op_base + i + 1},
                                     hook=item_hook)
                             else:
-                                re, im = run_kernel((re, im), scalars,
-                                                    kind=kind,
-                                                    statics=statics,
-                                                    mesh=mesh)
-                        return re, im
+                                amps = run_kernel((amps,), scalars,
+                                                  kind=kind,
+                                                  statics=statics,
+                                                  mesh=mesh)
+                        return amps
 
                     return fn
                 from .parallel.mesh_exec import as_mesh_fused_fn
@@ -539,25 +548,29 @@ class Circuit:
             from .ops.pallas_kernels import apply_fused_segment
             from .scheduler import schedule_segments_best
 
-            def fn(re, im):
-                lanes = re.shape[1]
+            def fn(amps):
+                lanes = amps.shape[1] // 2
                 lane_bits = lanes.bit_length() - 1
-                nbits = (re.shape[0] * lanes).bit_length() - 1
+                nbits = (amps.shape[0] * lanes).bit_length() - 1
                 segs = schedule_segments_best(run_ops, nbits,
                                               lane_bits=lane_bits)
                 for i, (seg_ops, high) in enumerate(segs):
-                    if _observing(re, item_hook):
+                    if _observing(amps, item_hook):
                         from .parallel.mesh_exec import observe_item
 
-                        re, im = observe_item(
-                            lambda r, j, _s=seg_ops, _h=high:
-                            apply_fused_segment(r, j, _s, _h,
+                        amps = observe_item(
+                            lambda a, _s=seg_ops, _h=high:
+                            apply_fused_segment(a, _s, _h,
                                                 interpret=interpret),
-                            re, im,
+                            amps,
                             {"kind": "pallas-pass", "index": i,
                              "ops": len(seg_ops),
                              "high_bits": sorted(high),
                              "last_in_run": i + 1 == len(segs),
+                             # one-sweep traffic: read + write of the
+                             # interleaved state (the roofline
+                             # attribution figure)
+                             "stream_elems": 1 << (nbits + 2),
                              # in-run segment scheduling reorders ops,
                              # so only the run's final boundary is
                              # op-aligned (layout is always canonical
@@ -567,10 +580,9 @@ class Circuit:
                                           else None)},
                             hook=item_hook)
                     else:
-                        re, im = apply_fused_segment(re, im, seg_ops,
-                                                     high,
-                                                     interpret=interpret)
-                return re, im
+                        amps = apply_fused_segment(amps, seg_ops, high,
+                                                   interpret=interpret)
+                return amps
 
             return fn
 
@@ -588,22 +600,22 @@ class Circuit:
             self._compiled[("sched_stats", mesh, tuple(self.ops))] = \
                 mesh_stats
         if not nu_ops:
-            return run_fns[0] or (lambda re, im: (re, im))
+            return run_fns[0] or (lambda amps: amps)
 
-        def fn(re, im, key=None):
+        def fn(amps, key=None):
             cur = None
             if item_hook is not None \
-                    and not isinstance(re, jax.core.Tracer):
+                    and not isinstance(amps, jax.core.Tracer):
                 cur = getattr(item_hook, "cursor", None)
             outcomes = cur.outcomes if cur is not None else []
             for i, op in enumerate(nu_ops + [None]):
                 if run_fns[i] is not None:
-                    re, im = run_fns[i](re, im)
+                    amps = run_fns[i](amps)
                 if op is not None:
-                    re, im = self._nonunitary_observed(
-                        re, im, key, outcomes, op, mesh, cur)
-            return re, im, (jnp.stack(outcomes) if outcomes
-                            else jnp.zeros((0,), jnp.int32))
+                    amps = self._nonunitary_observed(
+                        amps, key, outcomes, op, mesh, cur)
+            return amps, (jnp.stack(outcomes) if outcomes
+                          else jnp.zeros((0,), jnp.int32))
 
         return fn
 
@@ -647,7 +659,7 @@ class Circuit:
                     raw = self.as_fused_fn(interpret=interpret, mesh=mesh)
                 else:
                     raw = self.as_fn(mesh)
-            fn = jax.jit(raw, donate_argnums=(0, 1) if donate else ())
+            fn = jax.jit(raw, donate_argnums=(0,) if donate else ())
             self._compiled[key] = fn
         else:
             metrics.counter_inc("circuit.compile_cache_hits")
@@ -775,7 +787,7 @@ class Circuit:
             key = default_measure_key()
         dtype = jnp.dtype(dtype or _prec.default_real_dtype())
         nvec = self.num_qubits * (2 if self.is_density else 1)
-        shape = state_shape(1 << nvec)
+        shape = amps_shape(1 << nvec)
         if mode == "auto":
             pair_bytes = 2 * (1 << nvec) * dtype.itemsize
             mode = ("vmap" if shots * pair_bytes <= self.SAMPLE_VMAP_BYTES
@@ -797,11 +809,11 @@ class Circuit:
                 fn = self.as_fn(mesh=None)
 
                 def one(k):
-                    # flat index 0 is |0...0> for state-vectors and
-                    # |0><0| for density matrices alike
-                    re0 = jnp.zeros(shape, dtype).at[0, 0].set(1)
-                    im0 = jnp.zeros(shape, dtype)
-                    return fn(re0, im0, k)[2]
+                    # storage element (0, 0) is the real part of flat
+                    # index 0 — |0...0> for state-vectors and |0><0|
+                    # for density matrices alike
+                    amps0 = jnp.zeros(shape, dtype).at[0, 0].set(1)
+                    return fn(amps0, k)[1]
 
                 vmapped = jax.jit(jax.vmap(one))
 
@@ -817,19 +829,17 @@ class Circuit:
                 n_m = self.num_measurements
 
                 def body(shot, carry):
-                    re, im, outs, k = carry
+                    amps, outs, k = carry
                     k, sub = jax.random.split(k)
-                    re = jnp.zeros_like(re).at[0, 0].set(1)
-                    im = jnp.zeros_like(im)
-                    re, im, out = fn(re, im, sub)
-                    return re, im, outs.at[shot].set(out), k
+                    amps = jnp.zeros_like(amps).at[0, 0].set(1)
+                    amps, out = fn(amps, sub)
+                    return amps, outs.at[shot].set(out), k
 
                 def seq(k):
-                    re0 = jnp.zeros(shape, dtype)
-                    im0 = jnp.zeros(shape, dtype)
+                    amps0 = jnp.zeros(shape, dtype)
                     outs0 = jnp.zeros((shots, n_m), jnp.int32)
-                    _, _, outs, _ = lax.fori_loop(
-                        0, shots, body, (re0, im0, outs0, k))
+                    _, outs, _ = lax.fori_loop(
+                        0, shots, body, (amps0, outs0, k))
                     return outs
 
                 jitted = jax.jit(seq)
@@ -887,7 +897,7 @@ class Circuit:
             # the restored slot is the run's current last-good snapshot
             probe._last_snapshot = resume.get("slot")
         if metrics.health_every() or ckpt is not None:
-            probe.baseline(qureg.re, qureg.im)
+            probe.baseline(qureg.amps)
         return fn
 
     def run(self, qureg, pallas: str = "auto", key=None, *,
@@ -985,14 +995,13 @@ class Circuit:
                 self._record_run_stats(qureg, pallas)
                 with metrics.span("execute"):
                     if self._has_nonunitary:
-                        re, im, outcomes = fn(qureg.re, qureg.im, key)
-                        qureg._set(re, im)
+                        amps, outcomes = fn(qureg.amps, key)
+                        qureg._set_state(amps)
                         # collapse-only circuits consume no randomness
                         # and yield no outcomes: keep the
                         # mutating-facade contract (return qureg)
                         return outcomes if draws else qureg
-                    re, im = fn(qureg.re, qureg.im)
-                    qureg._set(re, im)
+                    qureg._set_state(fn(qureg.amps))
                     return qureg
             finally:
                 metrics.annotate_run("resilience",
@@ -1010,11 +1019,14 @@ class Circuit:
             st = {"passes": len(self.ops), "relayouts": 0,
                   "exchange_elems": 0}
         metrics.counter_inc("exec.passes", st["passes"])
-        # one in-place pass streams the state once: read + write of
-        # both (re, im) arrays, summed over every device's chunk
+        # ONE-SWEEP accounting: an in-place pass streams the single
+        # interleaved array once — read + write of its 2^(nvec+1)
+        # storage elements, summed over every device's chunk (equal to
+        # the split layout's "both arrays" total, so historical ledger
+        # pins keep holding)
         nvec = self.num_qubits * (2 if self.is_density else 1)
         metrics.counter_inc("exec.stream_bytes",
-                            st["passes"] * 2 * 2 * (1 << nvec) * itemsize)
+                            st["passes"] * (1 << (nvec + 2)) * itemsize)
         if st["relayouts"]:
             metrics.counter_inc("exec.relayouts", st["relayouts"])
             metrics.counter_inc("exec.exchange_bytes",
@@ -1111,13 +1123,13 @@ class _HealthProbe:
         self.cursor = cursor
         self._last_snapshot = None
 
-    def baseline(self, re, im) -> None:
+    def baseline(self, amps) -> None:
         """Anchor the drift reference on the register's pre-run state
         (a run may start from any state, not just norm 1)."""
-        self._ref = measure_state_weight(re, im, self._c.is_density,
+        self._ref = measure_state_weight(amps, self._c.is_density,
                                          self._c.num_qubits, self._mesh)
 
-    def _snapshot(self, re, im) -> None:
+    def _snapshot(self, amps) -> None:
         from . import resilience
 
         ck = self._ckpt
@@ -1143,14 +1155,14 @@ class _HealthProbe:
                        else None),
         }
         path = resilience.snapshot(
-            re, im, num_qubits=self._c.num_qubits,
+            amps, num_qubits=self._c.num_qubits,
             is_density=self._c.is_density, mesh=self._mesh,
             directory=ck["directory"], position=pos,
             owner=f"circuit:{ck['fingerprint']}")
         if path is not None:  # None: directory owned by another writer
             self._last_snapshot = path
 
-    def __call__(self, re, im, meta: dict) -> None:
+    def __call__(self, amps, meta: dict) -> None:
         k = metrics.health_every()
         ck = self._ckpt
         if not k and ck is None:
@@ -1172,7 +1184,7 @@ class _HealthProbe:
         structural = (not self._c.is_density) \
             or bool(meta.get("last_in_run"))
         reason, val = check_state_health(
-            re, im, is_density=self._c.is_density,
+            amps, is_density=self._c.is_density,
             num_qubits=self._c.num_qubits, mesh=self._mesh,
             before=self._ref, n_ops=self._ops_since,
             structural=structural)
@@ -1183,7 +1195,7 @@ class _HealthProbe:
             self._last_healthy = {"index": meta.get("index"),
                                   "kind": meta.get("kind")}
             if ckpt_due:
-                self._snapshot(re, im)
+                self._snapshot(amps)
             return
         offending = {"item": dict(meta),
                      "window_items": k or ck["every"],

@@ -509,6 +509,27 @@ def flight_entries() -> list[dict]:
         return json.loads(json.dumps(_flight))
 
 
+def flight_dir() -> str:
+    """Directory flight dumps land in: ``$QUEST_FLIGHT_DIR`` (created
+    on demand), else a per-user ``quest-tpu`` run directory under the
+    system temp dir — NEVER the process working directory, which on a
+    dev checkout is the repo root (a stray ``quest-flight-*.json``
+    next to the sources is how this knob earned its existence)."""
+    import tempfile
+
+    d = os.environ.get("QUEST_FLIGHT_DIR")
+    if not d:
+        d = os.path.join(tempfile.gettempdir(),
+                         f"quest-tpu-{os.getuid()}")
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        # unwritable target: fall back to the temp root; the sink-write
+        # degradation below still guards the actual dump
+        d = tempfile.gettempdir()
+    return d
+
+
 def flight_dump(reason: str, offending: dict | None = None,
                 path: str | None = None) -> str | None:
     """Dump the flight ring (tripped health probe, or on demand).
@@ -516,10 +537,11 @@ def flight_dump(reason: str, offending: dict | None = None,
     ``offending`` names the item the tripping probe just walled; the
     dump also carries the ring (the last N executed items leading up to
     it) and a process-counter snapshot.  Written to ``path``, else
-    ``$QUEST_FLIGHT_FILE``, else ``quest-flight-<pid>.json`` in the
-    working directory; returns the path (None if the sink failed)."""
+    ``$QUEST_FLIGHT_FILE``, else ``quest-flight-<pid>.json`` under
+    :func:`flight_dir` (``$QUEST_FLIGHT_DIR`` or a temp run dir);
+    returns the path (None if the sink failed)."""
     path = path or os.environ.get("QUEST_FLIGHT_FILE") \
-        or f"quest-flight-{os.getpid()}.json"
+        or os.path.join(flight_dir(), f"quest-flight-{os.getpid()}.json")
     doc = {
         "schema": "quest-tpu-flight/1",
         "reason": reason,

@@ -33,7 +33,7 @@ def _prob_table(qureg: Qureg) -> np.ndarray:
     per-qubit readout loop (e.g. the reference driver's 30
     calcProbOfOutcome calls, tutorial_example.c:515-521) then costs one
     round trip instead of one per qubit."""
-    re, im = qureg.re, qureg.im  # property reads flush pending gates
+    amps = qureg.amps  # property read flushes pending gates
     tab = qureg._readout.get("p0")
     if tab is None:
         from ..register import _trace
@@ -42,20 +42,20 @@ def _prob_table(qureg: Qureg) -> np.ndarray:
         if qureg.mesh is None:
             from ..register import readout_warm_get
 
-            warm = readout_warm_get("p0", re.shape, re.dtype,
+            warm = readout_warm_get("p0", amps.shape, amps.dtype,
                                     qureg.num_vec_qubits,
                                     density=qureg.is_density)
         if warm is not None:
-            vec = warm((re, im), ())
+            vec = warm((amps,), ())
         elif qureg.is_density:
             vec = run_kernel(
-                (re, im), (), kind="dm_prob_zero_all",
+                (amps,), (), kind="dm_prob_zero_all",
                 statics=(qureg.num_qubits,), mesh=qureg.mesh,
                 out_kind="scalar",
             )
         else:
             vec = run_kernel(
-                (re, im), (), kind="sv_prob_zero_all",
+                (amps,), (), kind="sv_prob_zero_all",
                 statics=(qureg.num_vec_qubits,), mesh=qureg.mesh,
                 out_kind="scalar",
             )
@@ -98,7 +98,7 @@ def calc_inner_product(bra: Qureg, ket: Qureg) -> complex:
         raise QuESTValidationError("calcInnerProduct requires state-vectors")
     validate_matching_dims(bra, ket, "calcInnerProduct")
     r, i = run_kernel(
-        (bra.re, bra.im, ket.re, ket.im), (), kind="sv_inner_product",
+        (bra.amps, ket.amps), (), kind="sv_inner_product",
         mesh=bra.mesh, out_kind="scalar",
     )
     return complex(float(r), float(i))
@@ -109,7 +109,7 @@ def calc_purity(qureg: Qureg) -> float:
     QuEST_cpu.c:854-881, allreduce QuEST_cpu_distributed.c:1264-1272)."""
     validate_density_qureg(qureg, "calcPurity")
     return float(
-        run_kernel((qureg.re, qureg.im), (), kind="dm_purity",
+        run_kernel((qureg.amps,), (), kind="dm_purity",
                    mesh=qureg.mesh, out_kind="scalar")
     )
 
@@ -126,7 +126,7 @@ def calc_fidelity(qureg: Qureg, pure_state: Qureg) -> float:
         ip = calc_inner_product(qureg, pure_state)
         return ip.real * ip.real + ip.imag * ip.imag
     r, _ = run_kernel(
-        (qureg.re, qureg.im, pure_state.re, pure_state.im), (),
+        (qureg.amps, pure_state.amps), (),
         kind="dm_fidelity", statics=(qureg.num_qubits,),
         mesh=qureg.mesh, out_kind="scalar",
     )

@@ -2,16 +2,24 @@
 
 Design
 ======
-A register of ``n`` "vector qubits" holds ``2**n`` amplitudes as a pair of
-real arrays; global amplitude index bit ``q`` *is* qubit ``q`` (density
-matrices reuse this with 2N vector qubits — row bits low, column bits
-high; reference: QuEST/src/QuEST.c:8-10, :534).
+A register of ``n`` "vector qubits" holds ``2**n`` amplitudes in ONE
+interleaved real array; global amplitude index bit ``q`` *is* qubit ``q``
+(density matrices reuse this with 2N vector qubits — row bits low,
+column bits high; reference: QuEST/src/QuEST.c:8-10, :534).
 
-TPU-native layout: the amplitudes are stored **2-D, shape (S, L)** with
-``L = min(128, chunk)`` lanes, so every array is tile-aligned
-((8, 128) f32 tiles) and no kernel ever materialises a padded small-minor
-shape.  The flat index of element (row, lane) is ``row * L + lane``, and
-index bits therefore split into three classes:
+TPU-native layout: the amplitudes are stored **2-D, shape (S, 2L)** with
+``L = min(128, chunk)`` logical lanes — row ``r`` carries the REAL parts
+of amplitudes ``[r*L, (r+1)*L)`` in storage lanes ``[0, L)`` and their
+IMAGINARY parts in storage lanes ``[L, 2L)`` (the *lane-stacked
+interleaved* layout).  The reference's split ``ComplexArray`` pair
+(QuEST/include/QuEST.h:41-45) exists only at the boundaries
+(``capi_bridge``, ``stateio``); internally one array means one HBM
+sweep per fused pass and one collective payload per exchange instead of
+two correlated ones.  Arrays stay tile-aligned ((8, 128) f32 tiles) and
+no kernel ever materialises a padded small-minor shape.
+
+The *logical* view of a register is still (S, L) with flat amplitude
+index ``row * L + lane``; amplitude-index bits split into three classes:
 
 * **lane bits**  (``b < log2(L)``)            — inside a vector register
 * **row bits**   (up to the local chunk size) — sublane/vector-memory rows
@@ -85,11 +93,57 @@ def _ilog2(x: int) -> int:
 
 
 def state_shape(num_amps: int, ndev: int = 1) -> tuple[int, int]:
-    """Stored (S, L) shape for a register of ``num_amps`` over ``ndev``
-    devices (sharded on the row axis)."""
+    """LOGICAL (S, L) shape for a register of ``num_amps`` over ``ndev``
+    devices (sharded on the row axis).  This is the per-component view —
+    the shape of the ``re`` / ``im`` halves, the checkpoint sidecar's
+    ``shape`` field, and the C-ABI contract; the stored array itself is
+    ``amps_shape`` (lanes doubled by the re|im interleave)."""
     chunk = num_amps // ndev
     lanes = min(LANES, chunk)
     return num_amps // lanes, lanes
+
+
+def amps_shape(num_amps: int, ndev: int = 1) -> tuple[int, int]:
+    """STORAGE (S, 2L) shape of the single interleaved amplitude array
+    (see module doc: re in storage lanes [0, L), im in [L, 2L))."""
+    rows, lanes = state_shape(num_amps, ndev)
+    return rows, 2 * lanes
+
+
+def split_amps(amps):
+    """(re, im) views of one interleaved array — in-program math only.
+
+    Sanctioned call sites: this module's kernel-dispatch seam,
+    ``ops/segment_xla.py`` (the XLA fallback executor), ``register.py``
+    (the host-readout boundary properties) and the split-format
+    boundaries ``stateio.py`` / ``capi_bridge.py``; everywhere else the
+    split layout must not reappear (tests/test_metrics.py lint)."""
+    lanes = amps.shape[-1] // 2
+    return amps[..., :lanes], amps[..., lanes:]
+
+
+def merge_amps(re, im):
+    """Inverse of :func:`split_amps` (same sanctioned call sites)."""
+    return jnp.concatenate([re, im], axis=-1)
+
+
+def dm_herm_drift(amps, num_qubits: int) -> float:
+    """max |rho - rho^H| of a GLOBAL density state — the health probes'
+    hermiticity drift (quest_tpu.circuit.check_state_health).
+
+    Operates on the global (possibly sharded) array outside shard_map —
+    XLA reshards the transpose comparison without replicating the full
+    matrix per device (an all-gather formulation would hold ~2 full
+    components on EVERY device, an opt-in probe OOMing the run it
+    guards).  The component views are this module's sanctioned
+    in-program split; flat index = col * dim + row, and the check is
+    symmetric in that convention."""
+    re, im = split_amps(amps)
+    dim = 1 << num_qubits
+    mr = re.reshape(dim, dim)
+    mi = im.reshape(dim, dim)
+    return float(jnp.maximum(jnp.abs(mr - mr.T).max(),
+                             jnp.abs(mi + mi.T).max()))
 
 
 @lru_cache(maxsize=None)
@@ -117,6 +171,13 @@ class Lattice:
     def for_array(cls, x, axis: str | None, ndev: int) -> "Lattice":
         s, l = x.shape
         return cls(s, l, axis, ndev)
+
+    @classmethod
+    def for_amps(cls, amps, axis: str | None, ndev: int) -> "Lattice":
+        """Lattice over the LOGICAL (S, L) view of one interleaved
+        (S, 2L) storage array (kernel bodies see split halves)."""
+        s, l2 = amps.shape
+        return cls(s, l2 // 2, axis, ndev)
 
     # -- device-index helpers -------------------------------------------
     def _dev_index(self):
@@ -235,17 +296,18 @@ def shard_map_compat(body, mesh, in_specs, out_specs):
 
 def _dispatch(body, arrays, scalars, mesh: Mesh | None, out_kind: str):
     """Run ``body(lat, arrays, scalars)`` locally, or as ONE shard_map
-    region over ``mesh``.  ``out_kind`` is ``"arrays"`` (amp arrays back,
-    sharded like the inputs) or ``"scalar"`` (replicated reduction
-    result)."""
+    region over ``mesh``.  ``arrays`` are interleaved (S, 2L) amplitude
+    arrays; the lattice is built over their logical (S, L) view.
+    ``out_kind`` is ``"arrays"`` (amp arrays back, sharded like the
+    inputs) or ``"scalar"`` (replicated reduction result)."""
     if mesh is None or math.prod(mesh.devices.shape) == 1:
-        return body(Lattice.for_array(arrays[0], None, 1), arrays, scalars)
+        return body(Lattice.for_amps(arrays[0], None, 1), arrays, scalars)
 
     (axis,) = mesh.axis_names
     ndev = math.prod(mesh.devices.shape)
 
     def shbody(arrays, scalars):
-        return body(Lattice.for_array(arrays[0], axis, ndev), arrays,
+        return body(Lattice.for_amps(arrays[0], axis, ndev), arrays,
                     scalars)
 
     out_specs = {"arrays": P(axis), "scalar": P()}[out_kind]
@@ -259,17 +321,28 @@ def _dispatch(body, arrays, scalars, mesh: Mesh | None, out_kind: str):
 
 def _run_kernel_impl(arrays, scalars, *, kind: str, statics: tuple = (),
                      mesh: Mesh | None = None, out_kind: str = "arrays"):
-    """Run kernel body ``kind`` over ``arrays`` (tuple of (S, L) arrays).
+    """Run kernel body ``kind`` over ``arrays`` — a tuple of interleaved
+    (S, 2L) amplitude arrays, one per register.
 
     ``arrays`` are global views; with a mesh they must be sharded over the
     mesh's single axis on their leading (row) dimension.  ``scalars`` is a
     pytree of traced scalars (gate matrix elements, probabilities, ...)
     replicated everywhere.
-    """
+
+    This is the ONE sanctioned in-program split seam: kernel bodies stay
+    written against (re, im) half views (free slices of the interleaved
+    operand that XLA fuses into the kernel computation), and an
+    ``"arrays"`` result merges back into a single interleaved array
+    before it leaves the program — no split layout ever materialises as
+    storage."""
     kbody = KERNELS[kind]
 
     def body(lat, arrays, scalars):
-        return kbody(lat, arrays, scalars, *statics)
+        pairs = tuple(p for a in arrays for p in split_amps(a))
+        out = kbody(lat, pairs, scalars, *statics)
+        if out_kind == "arrays":
+            return merge_amps(*out)
+        return out
 
     return _dispatch(body, arrays, scalars, mesh, out_kind)
 
@@ -337,9 +410,13 @@ def run_kernel_chain(arrays, scalars_list, *, steps, mesh: Mesh | None):
     def build():
         def impl(arrays, scalars_list):
             def body(lat, arrays, scalars_list):
+                pairs = tuple(p for a in arrays
+                              for p in split_amps(a))
                 for (kind, statics), scalars in zip(steps, scalars_list):
-                    arrays = KERNELS[kind](lat, arrays, scalars, *statics)
-                return arrays
+                    pairs = KERNELS[kind](lat, pairs, scalars, *statics)
+                # one split at entry, one merge at exit: the whole chain
+                # stays a single sweep over the interleaved state
+                return merge_amps(*pairs)
 
             return _dispatch(body, arrays, scalars_list, mesh, "arrays")
 

@@ -118,8 +118,7 @@ def add_density_matrix(combine: Qureg, prob: float, other: Qureg) -> None:
     validate_density_qureg(other, "addDensityMatrix")
     validate_prob(prob, "addDensityMatrix")
     validate_matching_dims(combine, other, "addDensityMatrix")
-    re, im = run_kernel(
-        (combine.re, combine.im, other.re, other.im), (prob,),
+    combine._set_state(run_kernel(
+        (combine.amps, other.amps), (prob,),
         kind="dm_add_mix", mesh=combine.mesh,
-    )
-    combine._set(re, im)
+    ))

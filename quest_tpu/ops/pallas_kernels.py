@@ -16,11 +16,17 @@ reached according to the target qubit's bit class:
   the single-chip analogue of the reference's pair-rank exchange
   (QuEST_cpu_distributed.c:307-316, :451-479).
 
-Output aliases input (``input_output_aliases``), so a 30-qubit f32
-register (8 GiB) runs inside 16 GiB HBM with no ping-pong buffer.  The
-reference streams the whole state once per gate (QuEST_cpu.c:1570-2664);
-here a scheduled segment streams it once, period (SURVEY §7.3's
-"gate-at-a-time dispatch" hard part).  Control qubits are evaluated on
+The state is ONE interleaved (rows, 2L) array (quest_tpu.ops.lattice):
+a segment is a single pipelined sweep over a single HBM region — one
+BlockSpec, one aliased output, blocks double-buffered against compute
+(``dimension_semantics`` declares every grid axis to the pipeliner) —
+instead of the two correlated (re, im) sweeps the reference's split
+``ComplexArray`` layout forced.  Output aliases input
+(``input_output_aliases``), so a 30-qubit f32 register (8 GiB) runs
+inside 16 GiB HBM with no ping-pong buffer.  The reference streams the
+whole state once per gate (QuEST_cpu.c:1570-2664); here a scheduled
+segment streams it once, period (SURVEY §7.3's "gate-at-a-time
+dispatch" hard part).  Control qubits are evaluated on
 global indices (lane iota + grid-coordinate bit fields), matching the
 reference's global-index control tests (QuEST_cpu.c:1841, :2310).  CPU
 tests run the same kernels in interpreter mode.
@@ -101,6 +107,14 @@ def _combine_2x2(r, i, pr, pi, bit, m):
 MAX_HIGH_BITS = 10
 
 
+def _compiler_params(**kw):
+    """Mosaic compiler params across pallas spellings (newer toolchains
+    export ``CompilerParams``; jax 0.4.x names it ``TPUCompilerParams``)."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 def _os_env_gap() -> int:
     """MXU/VPU interleave spacing (QUEST_MM_GAP; swept 2-10 on v5e
     round 4, 6 best)."""
@@ -142,8 +156,11 @@ def plan_fused_shapes(rows: int, lanes: int, high_row_bits: tuple[int, ...],
                       row_budget: int = _ROW_BUDGET):
     """Compute (view_dims, block_shape, grid, index_map, c_blk) for a fused
     segment exposing ``high_row_bits`` (ascending row-bit positions) as
-    dedicated size-2 axes.  All reshapes split leading dims only, so the
-    HBM view is a bitcast of the stored (rows, lanes) array.
+    dedicated size-2 axes.  ``lanes`` is the LOGICAL lane count (L); the
+    stored interleaved array is (rows, 2L), so the trailing view/block
+    dim is ``2 * lanes`` — each delivered block carries the re AND im
+    halves of its amplitudes in one DMA.  All reshapes split leading
+    dims only, so the HBM view is a bitcast of the stored array.
     """
     k = len(high_row_bits)
     assert k <= MAX_HIGH_BITS
@@ -171,8 +188,8 @@ def plan_fused_shapes(rows: int, lanes: int, high_row_bits: tuple[int, ...],
     dims.append(1 << prev)
     block_shape.append(c_blk)
     grid_axes.append((len(dims) - 1, (1 << prev) // c_blk))
-    dims.append(lanes)
-    block_shape.append(lanes)
+    dims.append(2 * lanes)          # interleaved storage: re|im stacked
+    block_shape.append(2 * lanes)
 
     grid = tuple(n for _, n in grid_axes)
     gd = [d for d, _ in grid_axes]
@@ -186,7 +203,8 @@ def plan_fused_shapes(rows: int, lanes: int, high_row_bits: tuple[int, ...],
     return tuple(dims), tuple(block_shape), grid, index_map, c_blk
 
 
-def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
+def apply_fused_segment(amps, seg_ops: tuple,
+                        high_bits: tuple[int, ...] = (),
                         *, row_budget: int | None = None,
                         interpret: bool = False, dev_flags=None,
                         compute_dtype=None):
@@ -194,6 +212,16 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     targets are lane bits, low row bits (< log2(c_blk)), or one of up to
     ``MAX_HIGH_BITS`` arbitrary ``high_bits`` qubits (phases/controls:
     any bits).
+
+    ``amps`` is the interleaved (rows, 2L) storage array (see
+    quest_tpu.ops.lattice): the pass is ONE pipelined sweep over ONE
+    HBM region — a single BlockSpec whose blocks carry both halves of
+    their amplitudes, double-buffered against compute by the Pallas
+    pipeline (the next grid step's block DMAs while the current one
+    computes; every grid axis is declared in ``dimension_semantics``).
+    The pre-interleave layout streamed two correlated (re, im) sweeps —
+    two block streams at distant HBM addresses per grid step — which is
+    what held BENCH_r05 at roofline_frac ~0.19.
 
     This is the superset of ``apply_segment``: the reference needs one
     full state-vector sweep per gate and a rank-pair exchange per high
@@ -204,7 +232,7 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
 
     ``dev_flags``: optional (1, n_flags) 0/1 array of per-device
     selection flags (traced; one entry per interned device-bit mask from
-    the scheduler).  Under a mesh, ``re``/``im`` are one device's chunk
+    the scheduler).  Under a mesh, ``amps`` is one device's chunk
     and an op whose control/phase mask touches device bits applies only
     when its flag is 1 — the comm-free SPMD form of the reference's
     global-index control tests (QuEST_cpu.c:1841, :2310).
@@ -218,22 +246,24 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     ~2^-8 relative per pass; see tools/probe31.py for the measured
     accuracy statement.
     """
-    rows, lanes = re.shape
-    # Run-ledger accounting: one fused segment = one in-place streamed
-    # pass over the state — read + write of both (re, im) arrays.  These
-    # fire at BUILD/TRACE time (once per compiled program, not per
-    # execution); executed-pass attribution is the caller's
-    # (Circuit.run / mesh_exec record per execution from the schedule).
+    rows, lanes2 = amps.shape
+    lanes = lanes2 // 2
+    # Run-ledger accounting: one fused segment = ONE in-place streamed
+    # sweep over the interleaved state — read + write of the single
+    # (rows, 2L) array.  These fire at BUILD/TRACE time (once per
+    # compiled program, not per execution); executed-pass attribution is
+    # the caller's (Circuit.run / mesh_exec record per execution from
+    # the schedule).
     metrics.counter_inc("pallas.segment_builds")
     metrics.counter_inc("pallas.build_stream_bytes",
-                        2 * 2 * rows * lanes * jnp.dtype(re.dtype).itemsize)
+                        2 * rows * lanes2 * jnp.dtype(amps.dtype).itemsize)
     # flight-recorder breadcrumb: segment builds often immediately
     # precede the failure a dump is read for (fresh kernel, fresh shape)
     metrics.flight_record("pallas-build", ops=len(seg_ops),
-                          shape=[rows, lanes], dtype=str(re.dtype),
+                          shape=[rows, lanes2], dtype=str(amps.dtype),
                           high_bits=sorted(high_bits))
     cdtype = (jnp.dtype(compute_dtype) if compute_dtype is not None
-              else re.dtype)
+              else amps.dtype)
     lane_bits = _ilog2(lanes)
     if row_budget is None:
         row_budget = default_row_budget(len(high_bits))
@@ -506,7 +536,8 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     planned = tuple(planned)
     n_flags = 0 if dev_flags is None else dev_flags.shape[-1]
 
-    vshape = (2,) * k + (c_blk, lanes)
+    vshape = (2,) * k + (c_blk, lanes)       # one component's view
+    svshape = (2,) * k + (c_blk, 2 * lanes)  # the stored block's view
     ndim = len(vshape)
 
     def make_fields(gids):
@@ -525,17 +556,21 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
             fields.append(("mid", b + 1, upper, gids[k - 1 - i]))
         return fields
 
-    def kern(re_ref, im_ref, *refs):
+    def kern(amps_ref, *refs):
         mat_refs = refs[:len(mat_inputs)]
         refs = refs[len(mat_inputs):]
         if n_flags:
-            flags_ref, (ro_ref, io_ref) = refs[0], refs[1:]
+            flags_ref, (out_ref,) = refs[0], refs[1:]
             flags = flags_ref[:]
         else:
-            (ro_ref, io_ref), flags = refs, None
+            (out_ref,), flags = refs, None
         mats = [mr[:] for mr in mat_refs]
-        r = re_ref[:].reshape(vshape).astype(cdtype)
-        i = im_ref[:].reshape(vshape).astype(cdtype)
+        # ONE block load carries both halves: the component split is a
+        # static lane slice at the tile-aligned offset L, in VMEM — the
+        # HBM stream itself stays a single interleaved sweep.
+        x = amps_ref[:].reshape(svshape)
+        r = x[..., :lanes].astype(cdtype)
+        i = x[..., lanes:].astype(cdtype)
         gids = [pl.program_id(a) for a in range(len(grid))]
         fields = make_fields(gids)
 
@@ -543,8 +578,9 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
         for op in planned:
             r, i = _apply_fused_op(r, i, op, bf, high_axis, lane_bits,
                                    c_blk, cdtype, mats, flags)
-        ro_ref[:] = r.reshape(block_shape).astype(re.dtype)
-        io_ref[:] = i.reshape(block_shape).astype(im.dtype)
+        out = jnp.concatenate([r.astype(amps.dtype),
+                               i.astype(amps.dtype)], axis=-1)
+        out_ref[:] = out.reshape(block_shape)
 
     spec = pl.BlockSpec(block_shape, index_map)
     mat_specs = [pl.BlockSpec(m.shape, lambda *g: (0, 0))
@@ -564,19 +600,28 @@ def apply_fused_segment(re, im, seg_ops: tuple, high_bits: tuple[int, ...] = (),
     vmem = int(_os.environ.get("QUEST_VMEM_MB", "0") or "0")
     if not interpret and (vmem > 0 or k >= 8):
         ck["vmem_limit_bytes"] = (vmem if vmem > 0 else 110) << 20
+    if not interpret:
+        # Explicit grid semantics so the pipeliner double-buffers every
+        # axis: each step's state block prefetches while the previous
+        # one computes.  Blocks are disjoint (index_map is a bijection),
+        # so "parallel" is also legal — QUEST_DIM_SEMANTICS=parallel
+        # opts into megacore splitting on multi-core chips; the default
+        # stays the sequential-safe spelling.
+        sem = _os.environ.get("QUEST_DIM_SEMANTICS", "arbitrary")
+        ck["dimension_semantics"] = (sem,) * len(grid)
     if ck:
-        cparams["compiler_params"] = pltpu.CompilerParams(**ck)
-    out_r, out_i = pl.pallas_call(
+        cparams["compiler_params"] = _compiler_params(**ck)
+    (out,) = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[spec, spec] + mat_specs + flag_specs,
-        out_specs=[spec, spec],
-        out_shape=[jax.ShapeDtypeStruct(dims, re.dtype)] * 2,
-        input_output_aliases={0: 0, 1: 1},
+        in_specs=[spec] + mat_specs + flag_specs,
+        out_specs=[spec],
+        out_shape=[jax.ShapeDtypeStruct(dims, amps.dtype)],
+        input_output_aliases={0: 0},
         interpret=interpret,
         **cparams,
-    )(re.reshape(dims), im.reshape(dims), *mat_inputs, *flag_inputs)
-    return out_r.reshape(re.shape), out_i.reshape(im.shape)
+    )(amps.reshape(dims), *mat_inputs, *flag_inputs)
+    return out.reshape(amps.shape)
 
 
 class _FusedBits:

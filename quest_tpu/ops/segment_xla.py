@@ -22,7 +22,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from .lattice import Lattice, _ilog2
+from .lattice import Lattice, _ilog2, merge_amps, split_amps
 from .pallas_kernels import _X_MAT
 
 
@@ -147,13 +147,18 @@ def _chan(r, i, lat, tag, bits, sc, dtype):
     raise ValueError(tag)
 
 
-def apply_segment_xla(re, im, seg_ops: tuple, high_bits: tuple = (),
+def apply_segment_xla(amps, seg_ops: tuple, high_bits: tuple = (),
                       dev_flags=None):
     """Pure-XLA equivalent of ``apply_fused_segment`` on one chunk.
 
-    ``high_bits`` only determines the 2x2pair axis->bit mapping; the
-    chunk is processed whole, so exposure is irrelevant here.
+    ``amps`` is the interleaved (rows, 2L) chunk; the (re, im) halves
+    are in-program lane slices XLA fuses into the segment computation
+    (a sanctioned split seam — see lattice.split_amps), merged back
+    before the result leaves the program.  ``high_bits`` only
+    determines the 2x2pair axis->bit mapping; the chunk is processed
+    whole, so exposure is irrelevant here.
     """
+    re, im = split_amps(amps)
     lat = Lattice.for_array(re, None, 1)
     lanes = re.shape[1]
     lane_bits = _ilog2(lanes)
@@ -274,4 +279,4 @@ def apply_segment_xla(re, im, seg_ops: tuple, high_bits: tuple = (),
             re, im = _chan(re, im, lat, tag, bits, sc, dtype)
         else:
             raise ValueError(kind)
-    return re, im
+    return merge_amps(re, im)

@@ -29,10 +29,11 @@ def make_amp_mesh(devices=None, num_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devices), (AMP_AXIS,))
 
 
-def shard_state(re, im, mesh: Mesh):
-    """Move flat amplitude arrays onto the mesh's amplitude sharding."""
-    sh = amp_sharding(mesh)
-    return jax.device_put(re, sh), jax.device_put(im, sh)
+def shard_state(amps, mesh: Mesh):
+    """Move the interleaved amplitude array onto the mesh's amplitude
+    sharding (row-sharded; the lane-stacked re|im interleave rides
+    along untouched)."""
+    return jax.device_put(amps, amp_sharding(mesh))
 
 
 def to_host(x) -> np.ndarray:

@@ -2,15 +2,26 @@
 with half-chunk and fused multi-bit relayout exchanges.
 
 Executes a ``quest_tpu.scheduler.schedule_mesh`` plan over a 1-D device
-mesh.  Each device owns one contiguous chunk of the (rows, lanes)
-amplitude array; fused segments run the single-device Pallas kernel on
-the chunk (device-bit controls/phases resolved into a tiny per-device
-flag operand), and relayout items change the qubit layout: a single
-("swap", a, b) exchanges HALF of each chunk with the partner device
-(re+im stacked into one collective payload), and a fused
-("relayout", perm) executes a whole swap chain's composed bit
-permutation as ONE sub-block exchange (``apply_relayout``) moving
-chunk*(2^k-1)/2^k per device where the k-swap chain moved k*chunk/2.
+mesh.  Each device owns one contiguous chunk of the interleaved
+(rows, 2L) amplitude array (quest_tpu.ops.lattice); fused segments run
+the single-device Pallas kernel on the chunk (device-bit controls/phases
+resolved into a tiny per-device flag operand), and relayout items change
+the qubit layout: a single ("swap", a, b) exchanges HALF of each chunk
+with the partner device, and a fused ("relayout", perm) executes a whole
+swap chain's composed bit permutation as ONE sub-block exchange
+(``apply_relayout``) moving chunk*(2^k-1)/2^k per device where the
+k-swap chain moved k*chunk/2.
+
+Index lifting: a storage index of the interleaved chunk is the local
+amplitude index with ONE extra inert bit — the re/im component selector
+at position ``lane_bits`` (storage flat index = row * 2L + comp * L +
+lane).  Every bit-permutation primitive therefore works on the single
+array by lifting amplitude-bit positions across that fixed point
+(``_lift_bit`` / ``_lift_perm``), and every collective payload — half
+swaps, coset sub-blocks, whole-chunk exchanges — natively carries both
+components in one ppermute.  Nothing is stacked: the pre-interleave
+executor built a stacked two-component payload per exchange, which
+this layout makes structurally impossible to need.
 
 Contrast with the reference's distributed driver
 (QuEST_cpu_distributed.c:816-1214): there, every gate on a high qubit
@@ -38,11 +49,29 @@ from ..ops.lattice import Lattice, shard_map_compat, state_shape, _ilog2
 from ..ops.pallas_kernels import apply_fused_segment
 
 
+def _lift_bit(b: int, lane_bits: int) -> int:
+    """Amplitude-index bit -> storage-index bit of the interleaved
+    array (the re/im component bit is inert at position ``lane_bits``)."""
+    return b if b < lane_bits else b + 1
+
+
+def _lift_perm(perm, lane_bits: int) -> list[int]:
+    """Lift an amplitude-bit permutation over ``n`` bits to the
+    (n+1)-bit storage permutation with the component bit a fixed
+    point."""
+    n = len(perm)
+    out = list(range(n + 1))
+    for b, p in enumerate(perm):
+        out[_lift_bit(b, lane_bits)] = _lift_bit(p, lane_bits)
+    return out
+
+
 def _isolate_bit(x, bit: int, lane_bits: int):
-    """View ``x`` (rows, lanes) with local index bit ``bit`` as a
-    dedicated size-2 axis; returns (view, axis).  Leading-dim reshapes
-    for row bits; minor-dim reshape for lane bits (planner prefers row
-    bits, so the lane case only occurs on tiny chunks)."""
+    """View ``x`` (rows, lanes) with index bit ``bit`` (in the array's
+    OWN flat row*lanes+lane index space) as a dedicated size-2 axis;
+    returns (view, axis).  Leading-dim reshapes for row bits; minor-dim
+    reshape for lane bits.  Callers pass STORAGE bit positions for
+    interleaved arrays."""
     rows, lanes = x.shape
     if bit >= lane_bits:
         j = bit - lane_bits
@@ -54,27 +83,32 @@ def _isolate_bit(x, bit: int, lane_bits: int):
     return v, 2
 
 
-def bitswap_chunk(x, a: int, b: int, dev, axis: str, ndev: int,
-                  chunk_bits: int, lane_bits: int):
-    """Return the chunk after globally swapping index bits ``a``/``b``.
+def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
+                 chunk_bits: int, lane_bits: int):
+    """Return the interleaved chunk after globally swapping amplitude
+    index bits ``a``/``b``: new[i] = old[i with bits a, b swapped].
 
-    new[i] = old[i with bits a, b swapped].  Three regimes:
+    Three regimes, all with ONE payload per collective (the chunk
+    already interleaves re and im, where the split layout needed two
+    exchanges or a stacked copy):
 
-    * both local: comm-free in-chunk permutation (elements whose two bit
-      values differ fetch their XOR partner);
-    * one device bit: HALF-chunk ppermute with the partner device at the
-      bit's stride — the amortised half-exchange;
-    * both device bits: whole-chunk ppermute, but only for devices whose
-      two coordinate bits differ.
+    * both local: comm-free in-chunk permutation over the storage
+      lattice (amp bits lifted across the inert component bit);
+    * one device bit: HALF-chunk ppermute with the partner device at
+      the bit's stride — the amortised half-exchange;
+    * both device bits: whole-chunk ppermute, but only for devices
+      whose two coordinate bits differ.
     """
     if a > b:
         a, b = b, a
     if b < chunk_bits:
-        # local <-> local
-        lat = Lattice.for_array(x, axis, ndev)
-        mask = (1 << a) | (1 << b)
-        eq = lat.bit(a) == lat.bit(b)
-        return jnp.where(eq, x, lat.xor_shift(x, mask))
+        # local <-> local: the storage array IS a lattice with one
+        # extra lane bit; lifted masks leave the component bit alone
+        lat = Lattice.for_array(amps, axis, ndev)
+        sa, sb = _lift_bit(a, lane_bits), _lift_bit(b, lane_bits)
+        mask = (1 << sa) | (1 << sb)
+        eq = lat.bit(sa) == lat.bit(sb)
+        return jnp.where(eq, amps, lat.xor_shift(amps, mask))
     if a >= chunk_bits:
         # device <-> device: conditional full-chunk exchange
         o1, o2 = a - chunk_bits, b - chunk_bits
@@ -84,66 +118,19 @@ def bitswap_chunk(x, a: int, b: int, dev, axis: str, ndev: int,
             if ((p >> o1) & 1) != ((p >> o2) & 1) else (p, p)
             for p in range(ndev)
         ]
-        return lax.ppermute(x, axis, pairs)
-    # device <-> local: half-chunk exchange
+        return lax.ppermute(amps, axis, pairs)
+    # device <-> local: half-chunk exchange, re+im in one payload
     off = b - chunk_bits
     stride = 1 << off
     w = (dev >> off) & 1
-    v, ax2 = _isolate_bit(x, a, lane_bits)
+    v, ax2 = _isolate_bit(amps, _lift_bit(a, lane_bits), lane_bits + 1)
     h0 = lax.index_in_dim(v, 0, ax2, keepdims=False)
     h1 = lax.index_in_dim(v, 1, ax2, keepdims=False)
     send = jnp.where(w == 0, h1, h0)
     recv = lax.ppermute(send, axis, [(p, p ^ stride) for p in range(ndev)])
     new0 = jnp.where(w == 0, h0, recv)
     new1 = jnp.where(w == 0, recv, h1)
-    return jnp.stack([new0, new1], axis=ax2).reshape(x.shape)
-
-
-def bitswap_pair(re, im, a: int, b: int, dev, axis: str, ndev: int,
-                 chunk_bits: int, lane_bits: int):
-    """``bitswap_chunk`` over the (re, im) pair with both arrays stacked
-    into ONE collective payload: a device<->local half-swap costs a
-    single ppermute instead of two, and a device<->device swap likewise
-    (the reference exchanges re and im in separate MPI messages too,
-    exchangeStateVectors, QuEST_cpu_distributed.c:451-479).
-    local<->local swaps are comm-free and run per array unchanged."""
-    if a > b:
-        a, b = b, a
-    if b < chunk_bits:
-        return (bitswap_chunk(re, a, b, dev, axis, ndev, chunk_bits,
-                              lane_bits),
-                bitswap_chunk(im, a, b, dev, axis, ndev, chunk_bits,
-                              lane_bits))
-    if a >= chunk_bits:
-        o1, o2 = a - chunk_bits, b - chunk_bits
-        stride = (1 << o1) | (1 << o2)
-        pairs = [
-            (p, p ^ stride)
-            if ((p >> o1) & 1) != ((p >> o2) & 1) else (p, p)
-            for p in range(ndev)
-        ]
-        z = lax.ppermute(jnp.stack([re, im]), axis, pairs)
-        return z[0], z[1]
-    off = b - chunk_bits
-    stride = 1 << off
-    w = (dev >> off) & 1
-    vr, ax2 = _isolate_bit(re, a, lane_bits)
-    vi, _ = _isolate_bit(im, a, lane_bits)
-    r0 = lax.index_in_dim(vr, 0, ax2, keepdims=False)
-    r1 = lax.index_in_dim(vr, 1, ax2, keepdims=False)
-    i0 = lax.index_in_dim(vi, 0, ax2, keepdims=False)
-    i1 = lax.index_in_dim(vi, 1, ax2, keepdims=False)
-    send = jnp.stack([jnp.where(w == 0, r1, r0),
-                      jnp.where(w == 0, i1, i0)])
-    recv = lax.ppermute(send, axis,
-                        [(p, p ^ stride) for p in range(ndev)])
-    re = jnp.stack([jnp.where(w == 0, r0, recv[0]),
-                    jnp.where(w == 0, recv[0], r1)],
-                   axis=ax2).reshape(re.shape)
-    im = jnp.stack([jnp.where(w == 0, i0, recv[1]),
-                    jnp.where(w == 0, recv[1], i1)],
-                   axis=ax2).reshape(im.shape)
-    return re, im
+    return jnp.stack([new0, new1], axis=ax2).reshape(amps.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -170,7 +157,8 @@ def relayout_decompose(perm, chunk_bits: int):
     from local bits (``B``); ``R = perm . E`` is then block-diagonal —
     ``R[c] < chunk_bits`` for every local slot c (a comm-free in-chunk
     permutation) and ``R[b] >= chunk_bits`` for every device slot b (a
-    pure device relabel).  Returns (A, B, R)."""
+    pure device relabel).  Returns (A, B, R).  Works at either the
+    amplitude-bit or the lifted storage-bit level."""
     n = len(perm)
     A = [c for c in range(chunk_bits) if perm[c] >= chunk_bits]
     B = [b for b in range(chunk_bits, n) if perm[b] < chunk_bits]
@@ -185,6 +173,8 @@ def _relayout_dev_maps(perm, num_vec_bits: int, dev_bits: int):
     """Per-round destination maps of a fused relayout, shared verbatim
     by the executor (``apply_relayout``) and the ledger/cost accounting
     (``relayout_comm_elems``) so the two can never desynchronise.
+    Amplitude-bit level: the storage lift adds only a local fixed
+    point, so device routing is identical either way.
 
     Returns (q, dst_rounds) with ``dst_rounds[w][e]`` the device that
     round ``w``'s sub-block of device ``e`` is sent to; rounds where
@@ -221,22 +211,29 @@ def _relayout_dev_maps(perm, num_vec_bits: int, dev_bits: int):
 
 
 def relayout_comm_elems(perm, num_vec_bits: int, dev_bits: int) -> int:
-    """Amplitude elements ONE fused relayout moves over the
-    interconnect, both (re, im) arrays, summed over every device —
-    mirroring ``apply_relayout``'s round structure exactly (sub-blocks
-    whose destination is their own device move nothing)."""
-    chunk = 1 << (num_vec_bits - dev_bits)
+    """STORAGE elements (interleaved array entries — re and im entries
+    alike) ONE fused relayout moves over the interconnect, summed over
+    every device — mirroring ``apply_relayout``'s round structure
+    exactly (sub-blocks whose destination is their own device move
+    nothing).  One device's interleaved chunk is 2^(chunk_bits+1)
+    storage elements; a q-bit exchange moves chunk/2^q-sized sub-blocks
+    that each already carry both components — the one-sweep accounting
+    (same totals the split layout reached by doubling a per-component
+    count)."""
+    s_chunk = 1 << (num_vec_bits - dev_bits + 1)  # interleaved chunk
     q, dst_rounds = _relayout_dev_maps(perm, num_vec_bits, dev_bits)
-    block = (chunk >> q) * 2  # one sub-block, re + im stacked
+    block = s_chunk >> q  # one sub-block of the interleaved chunk
     return sum(block
                for dsts in dst_rounds.values()
                for e, d in enumerate(dsts) if d != e)
 
 
 def _permute_local_bits(z, lperm, chunk_bits: int):
-    """In-chunk bit permutation over the trailing (rows, lanes) local
+    """In-chunk bit permutation over the trailing (rows, lanes) flat
     index: ``new[l] = old[l']`` with bit c of l' = bit lperm[c] of l.
-    Comm-free: lowers to one transpose/copy of the chunk."""
+    Comm-free: lowers to one transpose/copy of the chunk.  Callers pass
+    STORAGE-lifted permutations for interleaved arrays (the component
+    bit a fixed point)."""
     if all(p == c for c, p in enumerate(lperm)):
         return z
     cb = chunk_bits
@@ -253,43 +250,45 @@ def _permute_local_bits(z, lperm, chunk_bits: int):
 
 
 def _split_blocks(z, A, chunk_bits: int):
-    """(2, rows, lanes) -> (2^q, 2, 2^(cb-q)): leading axis indexes the
-    value of the local bits ``A`` (bit i of the block index = local
-    index bit A[i]); the remaining local bits flatten in descending
-    significance.  Pure reshape/transpose (static)."""
-    cb = chunk_bits
+    """One device's chunk, viewed by its flat-index bits ->
+    (2^q, 2^(cb-q)): the leading axis indexes the value of bits ``A``
+    (bit i of the block index = chunk index bit A[i]); the remaining
+    bits flatten in descending significance.  Pure reshape/transpose
+    (static).  For interleaved chunks ``A`` holds storage-lifted bit
+    positions and every sub-block natively spans both components."""
     q = len(A)
-    t = z.reshape((2,) + (2,) * cb)
-    sel = [1 + (cb - 1 - A[i]) for i in range(q - 1, -1, -1)]
-    rest = [k for k in range(1, cb + 1) if k not in set(sel)]
-    return t.transpose(sel + [0] + rest).reshape(
-        (1 << q, 2, 1 << (cb - q)))
+    t = z.reshape((2,) * chunk_bits)
+    sel = [chunk_bits - 1 - A[i] for i in range(q - 1, -1, -1)]
+    rest = [ax for ax in range(chunk_bits) if ax not in set(sel)]
+    return t.transpose(sel + rest).reshape(1 << q, 1 << (chunk_bits - q))
 
 
 def _merge_blocks(nb, A, chunk_bits: int, shape):
-    """Inverse of ``_split_blocks``: (2^q, 2, 2^(cb-q)) -> ``shape``."""
-    cb = chunk_bits
+    """Inverse of ``_split_blocks``: (2^q, 2^(cb-q)) -> ``shape``."""
     q = len(A)
-    sel = [1 + (cb - 1 - A[i]) for i in range(q - 1, -1, -1)]
-    rest = [k for k in range(1, cb + 1) if k not in set(sel)]
-    order = sel + [0] + rest
-    invord = [order.index(k) for k in range(cb + 1)]
-    t = nb.reshape((2,) * q + (2,) + (2,) * (cb - q))
+    sel = [chunk_bits - 1 - A[i] for i in range(q - 1, -1, -1)]
+    rest = [ax for ax in range(chunk_bits) if ax not in set(sel)]
+    order = sel + rest
+    invord = [order.index(ax) for ax in range(chunk_bits)]
+    t = nb.reshape((2,) * chunk_bits)
     return t.transpose(invord).reshape(shape)
 
 
-def apply_relayout(re, im, perm, dev, axis: str, ndev: int,
+def apply_relayout(amps, perm, dev, axis: str, ndev: int,
                    chunk_bits: int, lane_bits: int):
-    """Execute a fused multi-bit relayout over the sharded (re, im)
-    pair: ``new[i] = old[j]`` with bit b of j = bit ``perm[b]`` of i.
+    """Execute a fused multi-bit relayout over the sharded interleaved
+    array: ``new[i] = old[j]`` with bit b of j = bit ``perm[b]`` of i
+    (amplitude-index bits).
 
-    Statically decomposes ``perm = R . E`` (``relayout_decompose``) and
-    runs E — the q-bit device<->local exchange — as 2^q - 1 XOR-coset
-    ppermutes, each moving one chunk/2^q sub-block per device with
-    re+im stacked into a single payload, so every sub-block crosses the
-    interconnect exactly once.  R's device<->device residual folds into
-    the same rounds' destination maps (no extra whole-chunk hop) and
-    its local<->local part is one comm-free in-chunk transpose.
+    Statically lifts ``perm`` to the storage index (component bit a
+    fixed point), decomposes ``perm = R . E`` (``relayout_decompose``)
+    and runs E — the q-bit device<->local exchange — as 2^q - 1
+    XOR-coset ppermutes, each moving one chunk/2^q sub-block of the
+    interleaved chunk per device, so every sub-block crosses the
+    interconnect exactly once and already carries both components.
+    R's device<->device residual folds into the same rounds'
+    destination maps (no extra whole-chunk hop) and its local<->local
+    part is one comm-free in-chunk transpose.
 
     Sub-block bookkeeping (all index math static; only the device index
     is traced): in round w device e sends its sub-block with selector
@@ -298,22 +297,24 @@ def apply_relayout(re, im, perm, dev, axis: str, ndev: int,
     block u of its new chunk is round ``u ^ d'_D`` (d' = the device
     relabel's source for d)."""
     n = len(perm)
-    cb = chunk_bits
-    A, B, R = relayout_decompose(perm, cb)
+    cb_s = chunk_bits + 1                      # storage chunk bits
+    perm_s = _lift_perm(perm, lane_bits)
+    A, B, R = relayout_decompose(perm_s, cb_s)
     q = len(A)
-    lperm = R[:cb]
-    _q, dst_rounds = _relayout_dev_maps(perm, n, n - cb)
+    lperm = R[:cb_s]
+    # device routing is lift-invariant: share the amp-level maps with
+    # the accounting (relayout_comm_elems) verbatim
+    _q, dst_rounds = _relayout_dev_maps(perm, n, n - chunk_bits)
 
-    z = jnp.stack([re, im])
     if q == 0:
+        z = amps
         dsts = dst_rounds.get(0)
         if dsts is not None:  # pure device relabel (+ local permute)
             z = lax.ppermute(z, axis, list(enumerate(dsts)))
-        z = _permute_local_bits(z, lperm, cb)
-        return z[0], z[1]
+        return _permute_local_bits(z, lperm, cb_s)
 
-    D = [b - cb for b in B]
-    blocks = _split_blocks(z, A, cb)
+    D = [b - cb_s for b in B]
+    blocks = _split_blocks(amps, A, cb_s)
     # e_D: this device's bits at the participating device slots; d'_D:
     # the same selector of the device-relabel source d' = src_R(dev)
     # (equal to e_D when R has no device<->device component)
@@ -321,7 +322,7 @@ def apply_relayout(re, im, perm, dev, axis: str, ndev: int,
     dD = jnp.zeros((), jnp.int32)
     for i in range(q):
         eD = eD | (((dev >> D[i]) & 1) << i)
-        dD = dD | (((dev >> (R[cb + D[i]] - cb)) & 1) << i)
+        dD = dD | (((dev >> (R[cb_s + D[i]] - cb_s)) & 1) << i)
     recv = []
     for w in range(1 << q):
         sent = lax.dynamic_index_in_dim(blocks, eD ^ w, axis=0,
@@ -336,16 +337,15 @@ def apply_relayout(re, im, perm, dev, axis: str, ndev: int,
         lax.dynamic_index_in_dim(rb, u ^ dD, axis=0, keepdims=False)
         for u in range(1 << q)
     ])
-    z = _merge_blocks(nb, A, cb, z.shape)
-    z = _permute_local_bits(z, lperm, cb)
-    return z[0], z[1]
+    z = _merge_blocks(nb, A, cb_s, amps.shape)
+    return _permute_local_bits(z, lperm, cb_s)
 
 
-def apply_layout_perm(re, im, perm, mesh):
-    """Apply the bit permutation ``new[i] = old[j]`` (bit ``b`` of
-    ``j`` = bit ``perm[b]`` of ``i``) to a concrete (re, im) pair on
-    ``mesh`` — pure data movement, no arithmetic, so the result is
-    exact.
+def apply_layout_perm(amps, perm, mesh):
+    """Apply the amplitude-bit permutation ``new[i] = old[j]`` (bit
+    ``b`` of ``j`` = bit ``perm[b]`` of ``i``) to a concrete interleaved
+    array on ``mesh`` — pure data movement, no arithmetic, so the
+    result is exact.
 
     This is the degraded-mesh resume's canonicalisation step
     (``resilience._resume_degraded``): a mid-plan snapshot holds the
@@ -356,41 +356,45 @@ def apply_layout_perm(re, im, perm, mesh):
     :func:`apply_relayout` under shard_map."""
     n = len(perm)
     if all(p == b for b, p in enumerate(perm)):
-        return re, im
+        return amps
+    lane_bits = _ilog2(amps.shape[1] // 2)
     if mesh is None or mesh.devices.size == 1:
-        z = jnp.stack([re, im])
-        z = _permute_local_bits(z, list(perm), n)
-        return z[0], z[1]
+        return _permute_local_bits(amps, _lift_perm(perm, lane_bits),
+                                   n + 1)
     (axis,) = mesh.axis_names
     ndev = math.prod(mesh.devices.shape)
-    lane_bits = _ilog2(re.shape[1])
     chunk_bits = n - _ilog2(ndev)
 
-    def body(r, i_):
+    def body(a):
         dev = lax.axis_index(axis)
-        return apply_relayout(r, i_, tuple(perm), dev, axis, ndev,
+        return apply_relayout(a, tuple(perm), dev, axis, ndev,
                               chunk_bits, lane_bits)
 
     fn = shard_map_compat(body, mesh=mesh,
-                          in_specs=(P(axis), P(axis)),
-                          out_specs=(P(axis), P(axis)))
-    return jax.jit(fn)(re, im)
+                          in_specs=(P(axis),),
+                          out_specs=P(axis))
+    return jax.jit(fn)(amps)
 
 
 def item_timeline_meta(item, num_vec_bits: int, dev_bits: int,
                        backend: str = "pallas") -> dict:
     """Static timeline/flight-recorder tags for one plan item: kind
     (``pallas-pass`` / ``xla-segment`` / ``bitswap`` / ``relayout``),
-    target bits, comm class, and the exchange-element attribution —
+    target bits, comm class, and the exchange/stream attribution —
     computed by the SAME accounting the run ledger records
-    (``plan_exchange_elems``), so a timeline's relayout bytes and the
-    ledger's ``exec.exchange_bytes`` can never disagree."""
+    (``plan_exchange_elems`` for relayouts; the one-sweep
+    ``stream_elems`` for segments), so a timeline's bytes and the
+    ledger's ``exec.exchange_bytes`` / ``exec.stream_bytes`` can never
+    disagree."""
     chunk_bits = num_vec_bits - dev_bits
     if item[0] == "seg":
         _, seg_ops, high, _dev_masks = item
         return {"kind": "pallas-pass" if backend == "pallas"
                 else "xla-segment",
-                "ops": len(seg_ops), "high_bits": sorted(high)}
+                "ops": len(seg_ops), "high_bits": sorted(high),
+                # one in-place sweep: read + write of the interleaved
+                # state (2^(nvec+1) storage elements), all devices
+                "stream_elems": 1 << (num_vec_bits + 2)}
     cls = _swap_comm_class(item, chunk_bits)
     _, elems = plan_exchange_elems([item], num_vec_bits, dev_bits)
     if item[0] == "relayout":
@@ -402,12 +406,12 @@ def item_timeline_meta(item, num_vec_bits: int, dev_bits: int,
             "exchange_elems": elems}
 
 
-def observe_item(f, re, im, meta: dict, hook=None):
+def observe_item(f, amps, meta: dict, hook=None):
     """Execute one plan item under observation: wall it for the
     timeline (``block_until_ready`` makes the duration honest device
     time), append a flight-recorder entry, and invoke the caller's
     health ``hook`` on the produced state.  Only reached when the
-    caller verified the arrays are concrete (never under a trace).
+    caller verified the array is concrete (never under a trace).
 
     Three resilience integrations (quest_tpu.resilience):
 
@@ -436,17 +440,22 @@ def observe_item(f, re, im, meta: dict, hook=None):
 
     cur = getattr(hook, "cursor", None) if hook is not None else None
     if cur is not None and not cur.take():
-        return re, im
-    itemsize = jnp.dtype(re.dtype).itemsize
+        return amps
+    itemsize = jnp.dtype(amps.dtype).itemsize
     args = dict(meta)
     kind = args.pop("kind")
     elems = args.pop("exchange_elems", 0)
+    stream_elems = args.pop("stream_elems", 0)
     ndev = args.pop("ndev", 1)
     args.pop("ops_done", None)   # resume bookkeeping, not a trace tag
     args.pop("layout", None)
     exchange_bytes = elems * itemsize
     if elems or meta.get("comm_class") is not None:
         args["exchange_bytes"] = exchange_bytes
+    if stream_elems:
+        # per-item achieved-GB/s attribution (tools/roofline_attr.py):
+        # the same one-sweep figure the ledger's exec.stream_bytes uses
+        args["stream_bytes"] = stream_elems * itemsize
     wd_meta = dict(args, kind=kind, ndev=ndev)
     wall = resilience.watchdog_begin(wd_meta, exchange_bytes, ndev)
     # everything after the wall is armed runs under the cancel guard: a
@@ -462,31 +471,32 @@ def observe_item(f, re, im, meta: dict, hook=None):
             fired.append(resilience.fault_point("run_item"))
             poison = "nan" if "nan" in fired else None
             stalled = "stall" in fired
-        metrics.flight_record(kind, shape=list(re.shape),
-                              dtype=str(re.dtype), **args)
+        metrics.flight_record(kind, shape=list(amps.shape),
+                              dtype=str(amps.dtype), **args)
         if stalled:
             # a simulated hung collective: blocks until the armed
             # deadline, then raises the breach (never returns)
             resilience.watchdog_stall(wall, wd_meta)
         if metrics.timeline_active():
             with metrics.timeline_span(kind, args=args):
-                re, im = f(re, im)
-                jax.block_until_ready((re, im))
+                amps = f(amps)
+                jax.block_until_ready(amps)
         elif wall is not None:
-            re, im = f(re, im)
-            jax.block_until_ready((re, im))
+            amps = f(amps)
+            jax.block_until_ready(amps)
         else:
-            re, im = f(re, im)
+            amps = f(amps)
     except BaseException:
         if wall is not None:
             wall.cancel()
         raise
     resilience.watchdog_end(wall)
     if poison == "nan":
-        re = re.at[(0,) * re.ndim].set(float("nan"))
+        # storage element (0, 0) is the real part of amplitude 0
+        amps = amps.at[(0,) * amps.ndim].set(float("nan"))
     if hook is not None:
-        hook(re, im, dict(meta, exchange_bytes=exchange_bytes))
-    return re, im
+        hook(amps, dict(meta, exchange_bytes=exchange_bytes))
+    return amps
 
 
 def _item_key(obj):
@@ -559,21 +569,25 @@ def plan_comm_stats(plan, num_vec_bits: int, dev_bits: int):
 
 
 def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int):
-    """Amplitude-array ELEMENTS a plan's relayouts actually move over
-    the interconnect, summed over every device and BOTH (re, im) arrays
-    (multiply by the dtype itemsize for bytes — the run ledger's
-    ``exec.exchange_bytes``).
+    """STORAGE elements (entries of the interleaved amplitude array) a
+    plan's relayouts actually move over the interconnect, summed over
+    every device (multiply by the dtype itemsize for bytes — the run
+    ledger's ``exec.exchange_bytes``).  Re-derived from the one-array
+    layout: an interleaved chunk is 2^(chunk_bits+1) elements, and
+    every payload carries both components natively — the totals equal
+    the split layout's "both arrays" accounting, so historical pins
+    keep holding.
 
-    Per ``bitswap_pair``: a device<->local swap is a HALF-chunk
-    ppermute on every device (each sends chunk/2 elements per array); a
-    device<->device swap moves the WHOLE chunk, but only for the half of
-    the devices whose two coordinate bits differ; local<->local swaps
-    are comm-free.  A fused ("relayout", perm) item is costed exactly by
+    Per ``bitswap_amps``: a device<->local swap is a HALF-chunk
+    ppermute on every device; a device<->device swap moves the WHOLE
+    chunk, but only for the half of the devices whose two coordinate
+    bits differ; local<->local swaps are comm-free.  A fused
+    ("relayout", perm) item is costed exactly by
     ``relayout_comm_elems`` — one sub-block crossing per participating
-    coset, chunk * (2^q - 1) / 2^q per device for a q-bit device<->local
-    exchange.  Returns (relayouts_with_comm, elems)."""
+    coset, chunk * (2^q - 1) / 2^q per device for a q-bit
+    device<->local exchange.  Returns (relayouts_with_comm, elems)."""
     ndev = 1 << dev_bits
-    chunk = (1 << num_vec_bits) // ndev
+    s_chunk = (1 << (num_vec_bits + 1)) // ndev  # interleaved chunk
     chunk_bits = num_vec_bits - dev_bits
     relayouts = 0
     elems = 0
@@ -589,11 +603,9 @@ def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int):
             continue
         relayouts += 1
         if cls == "full":
-            elems += (ndev // 2) * chunk * 2       # full chunk, half the
-            #                                        devices, re + im
+            elems += (ndev // 2) * s_chunk   # full chunk, half the devs
         else:
-            elems += ndev * (chunk // 2) * 2       # half chunk, every
-            #                                        device, re + im
+            elems += ndev * (s_chunk // 2)   # half chunk, every device
     return relayouts, elems
 
 
@@ -601,10 +613,11 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
                      interpret: bool = False, backend: str = "pallas",
                      per_item: bool = False, donate: bool = True,
                      item_hook=None, op_base: int = 0):
-    """A pure (re, im) -> (re, im) function running the recorded ops as
+    """A pure ``amps -> amps`` function running the recorded ops as
     fused segments inside shard_map over ``mesh``, with relayout
-    half-exchanges for sharded-qubit gates.  Input and output arrays are
-    in the canonical (identity) qubit layout.
+    half-exchanges for sharded-qubit gates.  Input and output arrays
+    are interleaved (rows, 2L) storage in the canonical (identity)
+    qubit layout.
 
     ``backend``: "pallas" (the TPU kernels; ``interpret`` selects
     interpreter mode) or "xla" (``apply_segment_xla`` — the same plan,
@@ -618,19 +631,18 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
     minutes, while per-item programs compile in seconds each (and
     repeated structures hit jit's cache); dispatch overhead is noise
     at these state sizes.  NOTE: the per-item programs DONATE their
-    inputs (one live (re, im) pair instead of two per step), so the
-    arrays passed to a ``per_item`` function — the caller's included —
-    are consumed; rebind to the returned pair and never reuse the
-    originals.  ``donate=False`` keeps them alive (the observed
-    Circuit.run path, which must not brick the register on a tripped
-    health probe).
+    input (one live state instead of two per step), so the array passed
+    to a ``per_item`` function — the caller's included — is consumed;
+    rebind to the returned array and never reuse the original.
+    ``donate=False`` keeps it alive (the observed Circuit.run path,
+    which must not brick the register on a tripped health probe).
 
     ``per_item`` is also the OBSERVABILITY granularity: when timeline
     capture (``metrics.timeline_active``) is on at execution time, each
     item is walled with ``block_until_ready`` and recorded as a
     Chrome-trace event (kind / targets / comm class / exchange bytes,
     from the same ``plan_exchange_elems`` accounting the ledger uses),
-    plus a flight-recorder entry; ``item_hook(re, im, meta)`` — the
+    plus a flight-recorder entry; ``item_hook(amps, meta)`` — the
     health-probe seam — runs after every item.
 
     ``op_base``: the index of ``ops[0]`` within the whole circuit's op
@@ -667,16 +679,16 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
     plan_stats = {"passes": n_passes, "relayouts": n_relayouts,
                   "exchange_elems": exch_elems}
 
-    def _record_execution(re):
-        if isinstance(re, jax.core.Tracer):
+    def _record_execution(amps):
+        if isinstance(amps, jax.core.Tracer):
             return
         metrics.counter_inc("mesh.executions")
         metrics.counter_inc("mesh.passes", n_passes)
         metrics.counter_inc("mesh.relayouts", n_relayouts)
         metrics.counter_inc("mesh.exchange_bytes",
-                            exch_elems * re.dtype.itemsize)
+                            exch_elems * amps.dtype.itemsize)
 
-    def item_body(item, re, im):
+    def item_body(item, amps):
         dev = lax.axis_index(axis)
         if item[0] == "seg":
             _, seg_ops, high, dev_masks = item
@@ -684,18 +696,18 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
             if dev_masks:
                 flags = jnp.stack(
                     [(dev & dm) == dm for dm in dev_masks]
-                ).astype(re.dtype).reshape(1, -1)
+                ).astype(amps.dtype).reshape(1, -1)
             if backend == "xla":
-                return apply_segment_xla(re, im, seg_ops, high,
+                return apply_segment_xla(amps, seg_ops, high,
                                          dev_flags=flags)
-            return apply_fused_segment(re, im, seg_ops, high,
+            return apply_fused_segment(amps, seg_ops, high,
                                        interpret=interpret,
                                        dev_flags=flags)
         if item[0] == "relayout":
-            return apply_relayout(re, im, item[1], dev, axis, ndev,
+            return apply_relayout(amps, item[1], dev, axis, ndev,
                                   chunk_bits, lane_bits)
         _, a, b = item
-        return bitswap_pair(re, im, a, b, dev, axis, ndev,
+        return bitswap_amps(amps, a, b, dev, axis, ndev,
                             chunk_bits, lane_bits)
 
     def shmap(body):
@@ -704,8 +716,8 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
         # output here is trivially per-shard (specs are all P(axis)).
         return shard_map_compat(
             body, mesh=mesh,
-            in_specs=(P(axis), P(axis)),
-            out_specs=(P(axis), P(axis)),
+            in_specs=(P(axis),),
+            out_specs=P(axis),
         )
 
     if per_item:
@@ -718,8 +730,8 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
         # items carry numpy matrices (lanemm/rowmm/dtab), which are
         # unhashable — the memo key replaces every ndarray leaf with
         # (shape, dtype, bytes).  Inputs are donated: every item updates
-        # the state in place, so the per-item path holds ONE (re, im)
-        # pair in device memory instead of two per step.
+        # the state in place, so the per-item path holds ONE interleaved
+        # state in device memory instead of two per step.
         unique: dict = {}
         item_fns = []
         for item in plan:
@@ -727,7 +739,7 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
             f = unique.get(key)
             if f is None:
                 f = jax.jit(shmap(functools.partial(item_body, item)),
-                            donate_argnums=(0, 1) if donate else ())
+                            donate_argnums=(0,) if donate else ())
                 unique[key] = f
             item_fns.append(f)
         layouts = plan_layouts(plan, num_vec_bits)
@@ -745,30 +757,30 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
             # NaN checks are layout-invariant and probe anywhere)
             metas[-1]["last_in_run"] = True
 
-        def fn(re, im):
-            _record_execution(re)
-            observe = (not isinstance(re, jax.core.Tracer)
+        def fn(amps):
+            _record_execution(amps)
+            observe = (not isinstance(amps, jax.core.Tracer)
                        and (metrics.timeline_active()
                             or item_hook is not None))
             for i, f in enumerate(item_fns):
                 if observe:
-                    re, im = observe_item(f, re, im, metas[i],
-                                           hook=item_hook)
+                    amps = observe_item(f, amps, metas[i],
+                                        hook=item_hook)
                 else:
-                    re, im = f(re, im)
-            return re, im
+                    amps = f(amps)
+            return amps
 
         fn.plan_stats = plan_stats
         return fn
 
-    def body(re, im):
+    def body(amps):
         for item in plan:
-            re, im = item_body(item, re, im)
-        return re, im
+            amps = item_body(item, amps)
+        return amps
 
-    def fn(re, im):
-        _record_execution(re)
-        return shmap(body)(re, im)
+    def fn(amps):
+        _record_execution(amps)
+        return shmap(body)(amps)
 
     fn.plan_stats = plan_stats
     return fn

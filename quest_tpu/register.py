@@ -1,11 +1,16 @@
 """Quantum registers: creation, initial states, and amplitude access.
 
-A :class:`Qureg` owns a pair of flat real/imag device arrays (the
-reference's ``ComplexArray`` split layout, QuEST/include/QuEST.h:41-45,
-91-112), sharded over the environment's amplitude mesh when one exists
+A :class:`Qureg` owns ONE interleaved (rows, 2L) real device array
+(quest_tpu.ops.lattice: re in storage lanes [0, L), im in [L, 2L)),
+sharded over the environment's amplitude mesh when one exists
 (reference chunking: statevec_createQureg, QuEST/src/CPU/QuEST_cpu.c:
-1202-1232).  A density matrix over N qubits is stored as a 2N-qubit vector
-(reference: createDensityQureg, QuEST/src/QuEST.c:42-54).
+1202-1232).  The reference's split ``ComplexArray`` layout
+(QuEST/include/QuEST.h:41-45, 91-112) survives only as the read-side
+``re``/``im`` boundary views here (and in the stateio / C-ABI format
+edges) — internally a register is one array, so every fused pass is one
+HBM sweep and every exchange one payload.  A density matrix over N
+qubits is stored as a 2N-qubit vector (reference: createDensityQureg,
+QuEST/src/QuEST.c:42-54).
 
 The public API mutates registers in place — matching the reference C API's
 semantics so that user programs, the golden test harness, and the C ABI
@@ -28,7 +33,8 @@ from . import precision
 from . import qasm
 from . import resilience
 from .env import QuESTEnv
-from .ops.lattice import amp_sharding, lru_get, state_shape
+from .ops.lattice import (amp_sharding, amps_shape, lru_get, merge_amps,
+                          split_amps, state_shape)
 from .validation import (
     QuESTError,
     QuESTCorruptionError,
@@ -43,15 +49,16 @@ from .validation import (
 
 
 class _LazyZero:
-    """Placeholder for an unmaterialised |0...0> device buffer pair.
+    """Placeholder for an unmaterialised |0...0> device buffer.
 
-    Carries just enough surface (shape, dtype) for the deferred-stream
-    bookkeeping that must not force an allocation.  Used only for
-    registers created while a speculative stream execution is in flight
-    (see ``aot_speculative_preload``): if the recorded gate stream then
-    matches the speculated one, the register ADOPTS the speculation's
-    result buffers and the zero state is never allocated at all — which
-    is what lets a 30-qubit adoption fit HBM (two 8 GiB pairs do not).
+    Carries just enough surface (the interleaved storage shape, dtype)
+    for the deferred-stream bookkeeping that must not force an
+    allocation.  Used only for registers created while a speculative
+    stream execution is in flight (see ``aot_speculative_preload``): if
+    the recorded gate stream then matches the speculated one, the
+    register ADOPTS the speculation's result buffer and the zero state
+    is never allocated at all — which is what lets a 30-qubit adoption
+    fit HBM (two 8 GiB states do not).
     """
 
     __slots__ = ("shape", "dtype")
@@ -77,12 +84,11 @@ class Qureg:
     dispatches one C call per gate, QuEST/src/QuEST.c).
     """
 
-    __slots__ = ("_re", "_im", "num_qubits", "is_density", "mesh", "qasm",
+    __slots__ = ("_amps", "num_qubits", "is_density", "mesh", "qasm",
                  "_pending", "_readout", "_struct_history", "_res_uid")
 
-    def __init__(self, re, im, num_qubits: int, is_density: bool, mesh):
-        self._re = re
-        self._im = im
+    def __init__(self, amps, num_qubits: int, is_density: bool, mesh):
+        self._amps = amps
         self.num_qubits = num_qubits
         self.is_density = is_density
         self.mesh = mesh
@@ -103,30 +109,35 @@ class Qureg:
 
     # -- deferred gate stream -------------------------------------------
     @property
-    def re(self):
+    def amps(self):
+        """The interleaved (rows, 2L) state array — THE storage.  Reads
+        flush any deferred gate stream and materialise a lazy zero."""
         if self._pending:
             self._flush()
         self._materialize()
-        return self._re
+        return self._amps
 
-    @re.setter
-    def re(self, value):
-        self._re = value
+    @amps.setter
+    def amps(self, value):
+        self._amps = value
         self._pending.clear()
         self._readout.clear()
 
     @property
-    def im(self):
-        if self._pending:
-            self._flush()
-        self._materialize()
-        return self._im
+    def re(self):
+        """Read-only split view of the real parts — the host-readout /
+        C-ABI boundary (the reference's ``ComplexArray.real``).  The
+        split layout exists ONLY through these views and the
+        stateio/capi boundaries; internal code works on ``amps``.
+        None after ``destroy_qureg`` released the buffer."""
+        amps = self.amps
+        return None if amps is None else split_amps(amps)[0]
 
-    @im.setter
-    def im(self, value):
-        self._im = value
-        self._pending.clear()
-        self._readout.clear()
+    @property
+    def im(self):
+        """Read-only split view of the imaginary parts (see ``re``)."""
+        amps = self.amps
+        return None if amps is None else split_amps(amps)[1]
 
     def _defer(self, op) -> None:
         """Queue a (kind, statics, scalars) kernel op."""
@@ -135,16 +146,17 @@ class Qureg:
             self._readout.clear()
 
     def _materialize(self) -> None:
-        """Replace a lazy |0...0> placeholder with real device buffers.
+        """Replace a lazy |0...0> placeholder with a real device buffer.
 
-        Any still-held speculative stream result is dropped FIRST so the
-        two full-size states never coexist in HBM (an 8 GiB pair each at
-        30 qubits on a 15.75 GiB chip)."""
-        if isinstance(self._re, _LazyZero):
+        Any still-held speculative stream result is dropped FIRST so
+        two full-size states never coexist in HBM (8 GiB each at
+        30 qubits f32 on a 15.75 GiB chip)."""
+        if isinstance(self._amps, _LazyZero):
             _spec_exec_drop()
-            build = _init_builder("classical", self._re.shape,
-                                  self._re.dtype, self.mesh)
-            self._re, self._im = build(0)
+            rows, lanes2 = self._amps.shape
+            build = _init_builder("classical", (rows, lanes2 // 2),
+                                  self._amps.dtype, self.mesh)
+            self._amps = build(0)
 
     def _flush(self) -> None:
         # One deferred-stream flush = one "circuit run" of the eager /
@@ -196,8 +208,8 @@ class Qureg:
                 steps = tuple((kind, statics) for kind, statics, _ in sub)
                 scalars_list = tuple(sc for _, _, sc in sub)
                 try:
-                    self._re, self._im = run_kernel_chain(
-                        (self._re, self._im), scalars_list, steps=steps,
+                    self._amps = run_kernel_chain(
+                        (self._amps,), scalars_list, steps=steps,
                         mesh=self.mesh)
                 except Exception:
                     self._pending = chain + self._pending
@@ -221,12 +233,12 @@ class Qureg:
         from . import precision as _prec
 
         if self.is_density:
-            norm = float(run_kernel((self._re, self._im), (),
+            norm = float(run_kernel((self._amps,), (),
                                     kind="dm_total_prob",
                                     statics=(self.num_qubits,),
                                     mesh=self.mesh, out_kind="scalar"))
         else:
-            norm = float(run_kernel((self._re, self._im), (),
+            norm = float(run_kernel((self._amps,), (),
                                     kind="sv_total_prob", statics=(),
                                     mesh=self.mesh, out_kind="scalar"))
         if before is not None:
@@ -245,11 +257,11 @@ class Qureg:
         """Norm (state-vector) / trace (density) of the current state;
         a still-lazy |0...0> is exactly 1 without forcing allocation
         (materialising here would forfeit speculative adoption)."""
-        if isinstance(self._re, _LazyZero):
+        if isinstance(self._amps, _LazyZero):
             return 1.0
         from .circuit import measure_state_weight  # deferred: cycle
 
-        return measure_state_weight(self._re, self._im, self.is_density,
+        return measure_state_weight(self._amps, self.is_density,
                                     self.num_qubits, self.mesh)
 
     def _health_probe(self, before: float | None, n_ops: int) -> None:
@@ -268,7 +280,7 @@ class Qureg:
         # flush boundaries are always structural: gate runs carry
         # complete density pairs and end in the canonical layout
         reason, _after = check_state_health(
-            self._re, self._im, is_density=self.is_density,
+            self._amps, is_density=self.is_density,
             num_qubits=self.num_qubits, mesh=self.mesh,
             before=before, n_ops=n_ops)
         if reason is None:
@@ -314,21 +326,21 @@ class Qureg:
         # path, whose compile cache is angle-independent.
         use_fused = (jax.default_backend() == "tpu"
                      and self.num_amps >= (1 << 13)
-                     and self._re.dtype == jnp.float32
+                     and self._amps.dtype == jnp.float32
                      and not _is_sweep(self, run))
         if use_fused:
             ops = tuple(run)
-            if isinstance(self._re, _LazyZero):
+            if isinstance(self._amps, _LazyZero):
                 # Speculative stream execution: if the preload thread ran
                 # THIS exact stream on |0...0> while the process was
                 # starting, adopt its result — the gates already executed
                 # on the chip, overlapped with interpreter boot.
                 adopted = _spec_exec_take(ops, self.num_vec_qubits,
-                                          self._re.dtype)
+                                          self._amps.dtype)
                 if adopted is not None:
                     metrics.counter_inc("spec.adopted")
                     _trace("speculative stream result ADOPTED")
-                    (self._re, self._im), readout = adopted
+                    self._amps, readout = adopted
                     # install the pre-warmed readout caches ONLY when
                     # nothing else is queued: a pending collapse/channel
                     # would mutate the state right after, and the chain
@@ -341,17 +353,17 @@ class Qureg:
                     return
                 self._materialize()
             try:
-                # One fused program per unique stream, buffers donated —
+                # One fused program per unique stream, buffer donated —
                 # the state is updated strictly in place (a 30q f32
-                # register needs one 8 GiB buffer pair, not two).
+                # register needs one 8 GiB interleaved buffer, not two).
                 fn = _stream_fn(ops, self.num_vec_qubits, self.mesh,
-                                self._re.dtype)
+                                self._amps.dtype)
                 _trace("stream dispatch")
                 resilience.fault_point("stream_dispatch")
                 metrics.counter_inc("exec.gates", len(ops))
                 metrics.flight_record(
-                    "stream", ops=len(ops), shape=list(self._re.shape),
-                    dtype=str(self._re.dtype), donated=True)
+                    "stream", ops=len(ops), shape=list(self._amps.shape),
+                    dtype=str(self._amps.dtype), donated=True)
                 with metrics.span("execute"):
                     if metrics.timeline_active():
                         # walled capture: the one deliberate sync of
@@ -359,10 +371,10 @@ class Qureg:
                         # time for the whole fused stream as one item
                         with metrics.timeline_span(
                                 "stream", args={"ops": len(ops)}):
-                            self._re, self._im = fn(self._re, self._im)
-                            jax.block_until_ready((self._re, self._im))
+                            self._amps = fn(self._amps)
+                            jax.block_until_ready(self._amps)
                     else:
-                        self._re, self._im = fn(self._re, self._im)
+                        self._amps = fn(self._amps)
                 _trace("stream dispatched (async)")
             except Exception:
                 # Requeue so the gates aren't silently dropped: a retry
@@ -386,8 +398,8 @@ class Qureg:
             metrics.counter_inc("exec.gates", len(run))
             metrics.counter_inc("exec.passes", len(run))
             metrics.flight_record(
-                "xla-stream", ops=len(run), shape=list(self._re.shape),
-                dtype=str(self._re.dtype), donated=True)
+                "xla-stream", ops=len(run), shape=list(self._amps.shape),
+                dtype=str(self._amps.dtype), donated=True)
             with metrics.span("execute"):
                 import contextlib as _ctx
 
@@ -400,8 +412,8 @@ class Qureg:
                         kind, statics, scalars = run[0]
                         try:
                             resilience.fault_point("stream_dispatch")
-                            self._re, self._im = run_kernel_donated(
-                                (self._re, self._im), scalars, kind=kind,
+                            self._amps = run_kernel_donated(
+                                (self._amps,), scalars, kind=kind,
                                 statics=statics, mesh=self.mesh)
                         except Exception:
                             # requeue the unapplied tail — same no-retry
@@ -410,7 +422,7 @@ class Qureg:
                             raise
                         del run[0]
                     if metrics.timeline_active():
-                        jax.block_until_ready((self._re, self._im))
+                        jax.block_until_ready(self._amps)
 
     # -- shape bookkeeping ----------------------------------------------
     @property
@@ -425,23 +437,29 @@ class Qureg:
 
     @property
     def real_dtype(self):
-        # _re directly: dtype is invariant under pending gates, and this
-        # is read on gate-validation paths that must not force a flush.
-        return self._re.dtype
+        # _amps directly: dtype is invariant under pending gates, and
+        # this is read on gate-validation paths that must not flush.
+        return self._amps.dtype
 
     @property
     def state_shape(self) -> tuple[int, int]:
-        """Stored 2-D (rows, lanes) shape — tile-aligned for TPU; flat
-        index = row * lanes + lane (see quest_tpu.ops.lattice)."""
-        return self._re.shape
+        """LOGICAL 2-D (rows, lanes) shape of one component — the
+        split-layout contract the boundaries keep; flat amplitude index
+        = row * lanes + lane (see quest_tpu.ops.lattice)."""
+        rows, lanes2 = self._amps.shape
+        return rows, lanes2 // 2
 
-    def _set(self, re, im) -> None:
+    @property
+    def storage_shape(self) -> tuple[int, int]:
+        """Stored interleaved (rows, 2L) shape — tile-aligned for TPU."""
+        return self._amps.shape
+
+    def _set_state(self, amps) -> None:
         """Install a new functional state (in-place mutation facade).
 
         Discards any still-deferred gates: callers either read the state
         first (which flushes) or are replacing it wholesale (inits)."""
-        self._re = re
-        self._im = im
+        self._amps = amps
         self._pending.clear()
         self._readout.clear()
 
@@ -449,7 +467,7 @@ class Qureg:
         kind = "density-matrix" if self.is_density else "state-vector"
         return (
             f"Qureg({kind}, {self.num_qubits} qubits, {self.num_amps} amps, "
-            f"{self._re.dtype.name}, "
+            f"{self._amps.dtype.name}, "
             f"mesh={None if self.mesh is None else self.mesh.shape})"
         )
 
@@ -797,28 +815,26 @@ def aot_speculative_preload() -> None:
             return
         try:
             ops, nvec, dtype_str = meta[0], meta[1], meta[2]
-            from .ops.lattice import run_kernel, state_shape
+            from .ops.lattice import run_kernel
 
-            shape = state_shape(1 << nvec)
             dtype = jnp.dtype(dtype_str)
-            re = jnp.zeros(shape, dtype).at[0, 0].set(1)
-            im = jnp.zeros(shape, dtype)
-            rr, ii = fn(re, im)
+            amps = jnp.zeros(amps_shape(1 << nvec),
+                             dtype).at[0, 0].set(1)
+            aa = fn(amps)
             if mode == "warm":
                 # QUEST_AOT_SPECULATE=warm: execute the blob purely to
                 # warm the per-process executable staging (~1.4-3 s on
                 # the tunnelled host even after Mosaic init), then DROP
                 # the result — nothing is ever adopted, every output is
-                # computed inside main().  The dummy pair is freed
+                # computed inside main().  The dummy state is freed
                 # before the driver's own register can allocate.  A
                 # host element read is the only true sync under the
                 # tunnel (block_until_ready returns early).
-                _ = float(rr[0, 0])
-                rr.delete()
-                ii.delete()
+                _ = float(aa[0, 0])
+                aa.delete()
                 _trace("aot warm-exec done (results dropped)")
                 return
-            exec_holder["result"] = (rr, ii)
+            exec_holder["result"] = aa
             # Pre-warm the end-of-run readouts on the speculative state:
             # the per-qubit probability table and the amplitude prefix
             # (the standard driver epilogue — tutorial_example.c:515-533)
@@ -826,15 +842,15 @@ def aot_speculative_preload() -> None:
             # (~1.2 s + ~0.1 s measured); computed HERE they ride the
             # same overlap as the stream itself.  State-vector semantics
             # only — adoption installs them just for non-density regs.
-            vec = run_kernel((rr, ii), (), kind="sv_prob_zero_all",
+            vec = run_kernel((aa,), (), kind="sv_prob_zero_all",
                              statics=(nvec,), mesh=None,
                              out_kind="scalar")
             p0 = np.asarray(jax.device_get(vec), dtype=np.float64)
-            rows = min(_PREFIX_ROWS, rr.shape[0])
-            pre = jax.device_get(_prefix_fetch(rows, None)(rr, ii))
+            rows = min(_PREFIX_ROWS, aa.shape[0])
+            pre = jax.device_get(_prefix_fetch(rows, None)(aa))
             exec_holder["sv_readout"] = {
                 "p0": p0,
-                "amp_prefix": (np.asarray(pre[0]), np.asarray(pre[1])),
+                "amp_prefix": np.asarray(pre),
             }
         except Exception:
             exec_holder.pop("result", None)
@@ -890,11 +906,9 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
     if not path:
         return None
     try:
-        from .ops.lattice import state_shape
-
-        shape = state_shape(1 << num_vec_qubits)
-        aval = jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
-        compiled = jit_fn.lower(aval, aval).compile()
+        aval = jax.ShapeDtypeStruct(amps_shape(1 << num_vec_qubits),
+                                    jnp.dtype(dtype))
+        compiled = jit_fn.lower(aval).compile()
     except Exception:
         return None  # explicit AOT compile unsupported: plain jit serves
     metrics.counter_inc("aot.saves")
@@ -968,23 +982,22 @@ def _alloc(num_qubits: int, is_density: bool, env: QuESTEnv, dtype) -> Qureg:
         # a speculative stream execution for exactly this register
         # config is in flight: defer the zero-state allocation so the
         # first flush can adopt the speculated result outright
-        re = _LazyZero(shape, dtype)
-        im = _LazyZero(shape, dtype)
+        amps = _LazyZero(amps_shape(1 << nvec, ndev), dtype)
     else:
         # allocating a non-matching register: release any speculative
-        # result FIRST — a held full-size pair plus this allocation
+        # result FIRST — a held full-size state plus this allocation
         # could exceed HBM (e.g. a 29q density register after a 30q
         # speculated run)
         _spec_exec_drop()
         _warm_exec_join()
         build = _init_builder("classical", shape, dtype, env.mesh)
-        re, im = build(0)
-    q = Qureg(re, im, num_qubits, is_density, env.mesh)
+        amps = build(0)
+    q = Qureg(amps, num_qubits, is_density, env.mesh)
     qasm.setup(q)
     if (env.mesh is None and (1 << nvec) >= (1 << 13)
             and jax.default_backend() == "tpu"):
         pallas_runtime_warmup()  # no-op if bridge init already fired it
-        _readout_prewarm(shape, dtype, nvec,
+        _readout_prewarm(amps_shape(1 << nvec, ndev), dtype, nvec,
                          num_qubits if is_density else None)
     return q
 
@@ -1003,8 +1016,7 @@ def create_density_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
 
 def destroy_qureg(qureg: Qureg, env: QuESTEnv | None = None) -> None:
     """Release device buffers (reference: destroyQureg)."""
-    qureg.re = None
-    qureg.im = None
+    qureg.amps = None
 
 
 def get_num_qubits(qureg: Qureg) -> int:
@@ -1023,38 +1035,46 @@ def get_num_amps(qureg: Qureg) -> int:
 def _init_body(kind: str, shape: tuple[int, int], dtype):
     """Initial-state builder body factory for ``kind``.
 
-    Returns ``make(zeros)`` where ``zeros`` supplies the base (re, im)
-    zero arrays: fresh ``jnp.zeros`` at creation, or ``old * 0`` for
-    in-place re-initialisation (the dataflow through the old buffers is
-    what lets XLA recycle the donated allocation — a donated-but-unused
-    argument is NOT recycled on the TPU runtime, measured: re-init of a
-    30q f32 register OOMs without it).
+    ``shape`` is the LOGICAL (rows, lanes) per-component shape; the
+    built array is the interleaved (rows, 2*lanes) storage.  Returns
+    ``make(zeros)`` where ``zeros`` supplies the base zero array: fresh
+    ``jnp.zeros`` at creation, or ``old * 0`` for in-place
+    re-initialisation (the dataflow through the old buffer is what lets
+    XLA recycle the donated allocation — a donated-but-unused argument
+    is NOT recycled on the TPU runtime, measured: re-init of a 30q f32
+    register OOMs without it).
 
-    All builders produce the (S, L) state from sharded iotas over the
-    zero base, so no full-size host array is ever materialised — each
-    device fills only its own chunk.  Bit values of the flat index
-    (= row * L + lane) are derived from row/lane iotas separately, so no
-    64-bit global iota is needed at any register size.
+    All builders produce the state from sharded iotas over the zero
+    base, so no full-size host array is ever materialised — each device
+    fills only its own chunk.  Bit values of the flat amplitude index
+    (= row * L + (storage lane & (L-1)); storage lane bit log2(L) is
+    the re/im component selector) are derived from row/lane iotas
+    separately, so no 64-bit global iota is needed at any register
+    size.
     """
     rows, lanes = shape
+    sshape = (rows, 2 * lanes)
     lane_bits = (lanes - 1).bit_length()
 
     if kind == "classical":
         # reference: statevec_initClassicalState (QuEST_cpu.c:1352) /
-        # densmatr_initClassicalState (:1038): one unit amplitude.
+        # densmatr_initClassicalState (:1038): one unit amplitude (its
+        # real part — storage lane ind % L of row ind // L).
         def make(zeros):
             def build(ind):
-                re, im = zeros()
-                return re.at[ind // lanes, ind % lanes].set(1), im
+                return zeros().at[ind // lanes, ind % lanes].set(1)
             return build
 
     elif kind == "plus":
         # reference: statevec_initPlusState (QuEST_cpu.c:1320) /
-        # densmatr_initPlusState (:1077): uniform fill.
+        # densmatr_initPlusState (:1077): uniform REAL fill — the re
+        # half of every row.
         def make(zeros):
             def build(norm):
-                re, im = zeros()
-                return re + jnp.asarray(norm, dtype), im
+                lane_i = jax.lax.broadcasted_iota(jnp.int32, sshape, 1)
+                return zeros() + jnp.where(
+                    lane_i < lanes, jnp.asarray(norm, dtype),
+                    jnp.asarray(0, dtype))
             return build
 
     elif kind == "debug":
@@ -1062,28 +1082,31 @@ def _init_body(kind: str, shape: tuple[int, int], dtype):
         # amp[k] = (2k)/10 + i(2k+1)/10.
         def make(zeros):
             def build():
-                re, im = zeros()
-                k = (jax.lax.broadcasted_iota(dtype, shape, 0) * lanes
-                     + jax.lax.broadcasted_iota(dtype, shape, 1))
-                return re + 0.2 * k, im + 0.2 * k + 0.1
+                lane_i = jax.lax.broadcasted_iota(jnp.int32, sshape, 1)
+                amp_lane = (lane_i & (lanes - 1)).astype(dtype)
+                is_im = (lane_i >= lanes).astype(dtype)
+                k = (jax.lax.broadcasted_iota(dtype, sshape, 0) * lanes
+                     + amp_lane)
+                return zeros() + 0.2 * k + 0.1 * is_im
             return build
 
     elif kind == "single_qubit":
         # reference: statevec_initStateOfSingleQubit (QuEST_cpu.c:1427):
-        # uniform over basis states whose `qubit` bit equals `outcome`.
+        # uniform over basis states whose `qubit` bit equals `outcome`
+        # (real amplitudes: the re half only).
         def make(zeros):
             def build(qubit, outcome, norm):
-                re, im = zeros()
-                lane_i = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-                row_i = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+                lane_i = jax.lax.broadcasted_iota(jnp.int32, sshape, 1)
+                row_i = jax.lax.broadcasted_iota(jnp.int32, sshape, 0)
+                amp_lane = lane_i & (lanes - 1)
                 bit = jnp.where(
                     qubit < lane_bits,
-                    (lane_i >> qubit) & 1,
+                    (amp_lane >> qubit) & 1,
                     (row_i >> jnp.maximum(qubit - lane_bits, 0)) & 1,
                 )
-                re = re + jnp.where(bit == outcome,
-                                    jnp.asarray(norm, dtype), 0)
-                return re, im
+                sel = jnp.logical_and(bit == outcome, lane_i < lanes)
+                return zeros() + jnp.where(sel,
+                                           jnp.asarray(norm, dtype), 0)
             return build
 
     else:  # pragma: no cover
@@ -1100,9 +1123,9 @@ def _init_builder(kind: str, shape: tuple[int, int], dtype, mesh):
     make = _init_body(kind, shape, dtype)
 
     def zeros():
-        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+        return jnp.zeros((shape[0], 2 * shape[1]), dtype)
 
-    kw = {} if sh is None else {"out_shardings": (sh, sh)}
+    kw = {} if sh is None else {"out_shardings": sh}
     return jax.jit(make(zeros), **kw)
 
 
@@ -1118,23 +1141,22 @@ def _reinit_builder(kind: str, shape: tuple[int, int], dtype, mesh):
     sh = amp_sharding(mesh)
     make = _init_body(kind, shape, dtype)
 
-    def rebuild(old_re, old_im, *args):
+    def rebuild(old, *args):
         # where(isfinite) rather than plain `old * 0`: NaN/Inf amplitudes
         # (f32 overflow, collapse at prob 0) would otherwise poison the
-        # fresh state, while the dataflow through the donated buffers is
+        # fresh state, while the dataflow through the donated buffer is
         # what lets XLA recycle the allocation in place.
         def zeros():
-            return (jnp.where(jnp.isfinite(old_re), old_re, 0) * 0,
-                    jnp.where(jnp.isfinite(old_im), old_im, 0) * 0)
+            return jnp.where(jnp.isfinite(old), old, 0) * 0
         return make(zeros)(*args)
 
-    kw = {} if sh is None else {"out_shardings": (sh, sh)}
-    return jax.jit(rebuild, donate_argnums=(0, 1), **kw)
+    kw = {} if sh is None else {"out_shardings": sh}
+    return jax.jit(rebuild, donate_argnums=(0,), **kw)
 
 
 def _reinit(qureg: "Qureg", kind: str, *args) -> None:
     """Overwrite ``qureg``'s state in place with builder ``kind``."""
-    if isinstance(qureg._re, _LazyZero):
+    if isinstance(qureg._amps, _LazyZero):
         if kind == "classical" and args == (0,):
             # initZeroState on a still-lazy |0...0>: stays lazy (the
             # C driver's createQureg + initZeroState prologue must not
@@ -1145,16 +1167,16 @@ def _reinit(qureg: "Qureg", kind: str, *args) -> None:
         qureg._materialize()
     build = _reinit_builder(kind, qureg.state_shape, qureg.real_dtype,
                             qureg.mesh)
-    old_re, old_im = qureg._re, qureg._im
-    qureg._re = qureg._im = None  # drop our refs so donation can recycle
+    old = qureg._amps
+    qureg._amps = None  # drop our ref so donation can recycle
     qureg._pending.clear()
     try:
-        qureg._set(*build(old_re, old_im, *args))
+        qureg._set_state(build(old, *args))
     except Exception:
-        # Restore the old refs so a failed (re)compile doesn't brick the
-        # register; if execution consumed the donated buffers, later use
+        # Restore the old ref so a failed (re)compile doesn't brick the
+        # register; if execution consumed the donated buffer, later use
         # raises jax's deleted-buffer error rather than AttributeError.
-        qureg._re, qureg._im = old_re, old_im
+        qureg._amps = old
         raise
 
 
@@ -1223,21 +1245,20 @@ def init_pure_state(qureg: Qureg, pure: Qureg) -> None:
         raise QuESTValidationError("second argument of initPureState must be a state-vector")
     validate_matching_dims(qureg, pure)
     if not qureg.is_density:
-        # Fresh buffers, not shared references: a later flush donates the
-        # target's arrays in place, which must never invalidate ``pure``
-        # (the reference copies amplitudes here too, QuEST_cpu.c:1107).
-        qureg._set(pure.re + 0, pure.im + 0)
+        # A fresh buffer, not a shared reference: a later flush donates
+        # the target's array in place, which must never invalidate
+        # ``pure`` (the reference copies amplitudes too, QuEST_cpu.c:1107).
+        qureg._set_state(pure.amps + 0)
         return
     from .ops.lattice import run_kernel  # deferred to avoid import cycle
 
-    re, im = run_kernel(
-        (qureg.re, qureg.im, pure.re, pure.im),
+    qureg._set_state(run_kernel(
+        (qureg.amps, pure.amps),
         (),
         kind="dm_init_pure",
         statics=(qureg.num_qubits,),
         mesh=qureg.mesh,
-    )
-    qureg._set(re, im)
+    ))
 
 
 def init_state_from_amps(qureg: Qureg, reals, imags) -> None:
@@ -1250,23 +1271,30 @@ def init_state_from_amps(qureg: Qureg, reals, imags) -> None:
             f"initStateFromAmps needs {qureg.num_amps} reals and imags"
         )
     shape = qureg.state_shape
-    reals, imags = reals.reshape(shape), imags.reshape(shape)
+    # host-boundary interleave: lane-stack the split input into the
+    # (rows, 2L) storage layout before it ever touches a device
+    amps = np.concatenate([reals.reshape(shape), imags.reshape(shape)],
+                          axis=1)
     sh = amp_sharding(qureg.mesh)
     if sh is None:
-        qureg._set(jnp.asarray(reals), jnp.asarray(imags))
+        qureg._set_state(jnp.asarray(amps))
     else:
-        qureg._set(jax.device_put(reals, sh), jax.device_put(imags, sh))
+        qureg._set_state(jax.device_put(amps, sh))
 
 
 @lru_cache(maxsize=64)
 def _row_window_update(shape: tuple[int, int], dtype, mesh):
-    """Jitted donated row-window overwrite: the state buffers update in
-    place and only the patch (window rows x lanes) is ever allocated —
-    the flat-reshape formulation this replaces materialised multiple
-    full-size copies (12+ GiB transient at 30 qubits)."""
+    """Jitted donated row-window overwrite: the state buffer updates in
+    place and only the patch (window rows x lanes per component) is
+    ever allocated — the flat-reshape formulation this replaces
+    materialised multiple full-size copies (12+ GiB transient at 30
+    qubits).  ``shape`` is the logical (rows, lanes) view: the re patch
+    lands at storage column 0, the im patch at column L of the same
+    rows."""
     sh = amp_sharding(mesh)
+    lanes = shape[1]
 
-    def upd(re, im, pre, pim, r0):
+    def upd(amps, pre, pim, r0):
         # s32 index: under x64 a Python-int row index arrives as s64 and
         # the SPMD partitioner's shard-offset comparison then mixes
         # s64/s32 operands, which the HLO verifier rejects on the
@@ -1274,11 +1302,12 @@ def _row_window_update(shape: tuple[int, int], dtype, mesh):
         # types"); the row count always fits s32.
         r0 = jnp.asarray(r0, jnp.int32)
         c0 = jnp.zeros((), jnp.int32)
-        return (jax.lax.dynamic_update_slice(re, pre, (r0, c0)),
-                jax.lax.dynamic_update_slice(im, pim, (r0, c0)))
+        cL = jnp.asarray(lanes, jnp.int32)
+        amps = jax.lax.dynamic_update_slice(amps, pre, (r0, c0))
+        return jax.lax.dynamic_update_slice(amps, pim, (r0, cL))
 
-    kw = {} if sh is None else {"out_shardings": (sh, sh)}
-    return jax.jit(upd, donate_argnums=(0, 1), **kw)
+    kw = {} if sh is None else {"out_shardings": sh}
+    return jax.jit(upd, donate_argnums=(0,), **kw)
 
 
 def set_amps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
@@ -1308,25 +1337,25 @@ def set_amps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
     pre.reshape(-1)[off:off + num_amps] = reals
     pim.reshape(-1)[off:off + num_amps] = imags
     upd = _row_window_update(qureg.state_shape, dtype, qureg.mesh)
-    old_re, old_im = qureg.re, qureg.im  # property read flushes first
-    qureg._re = qureg._im = None
+    old = qureg.amps  # property read flushes first
+    qureg._amps = None
     try:
-        qureg._set(*upd(old_re, old_im, jnp.asarray(pre), jnp.asarray(pim),
-                        r0))
+        qureg._set_state(upd(old, jnp.asarray(pre), jnp.asarray(pim),
+                             r0))
     except Exception:
-        qureg._re, qureg._im = old_re, old_im
+        qureg._amps = old
         raise
 
 
 def clone_qureg(target: Qureg, copy: Qureg) -> None:
     """target := copy (reference: cloneQureg, QuEST.c:73-81).
 
-    Copies the buffers (as the reference does): sharing them would let a
+    Copies the buffer (as the reference does): sharing it would let a
     later donated flush on one register invalidate the other."""
     if target.is_density != copy.is_density:
         raise QuESTValidationError("cloneQureg requires registers of the same kind")
     validate_matching_dims(target, copy)
-    target._set(copy.re + 0, copy.im + 0)
+    target._set_state(copy.amps + 0)
 
 
 # ---------------------------------------------------------------------------
@@ -1480,17 +1509,17 @@ def _readout_prewarm(shape, dtype, nvec: int,
             aval = jax.ShapeDtypeStruct(shape, dtype)
             if num_qubits is None:
                 holder["p0"] = run_kernel.lower(
-                    (aval, aval), (), kind="sv_prob_zero_all",
+                    (aval,), (), kind="sv_prob_zero_all",
                     statics=(nvec,), mesh=None,
                     out_kind="scalar").compile()
             else:
                 holder["p0"] = run_kernel.lower(
-                    (aval, aval), (), kind="dm_prob_zero_all",
+                    (aval,), (), kind="dm_prob_zero_all",
                     statics=(num_qubits,), mesh=None,
                     out_kind="scalar").compile()
             rows = min(_PREFIX_ROWS, shape[0])
             holder["prefix"] = _prefix_fetch(rows, None).lower(
-                aval, aval).compile()
+                aval).compile()
             metrics.counter_inc("readout.prewarm_builds")
             _trace("readout prewarm done")
         except Exception:
@@ -1529,15 +1558,15 @@ def _prefix_fetch(rows: int, mesh):
     slice keeps the row sharding, and fetching it would span
     non-addressable devices)."""
     def build():
-        def f(re, im):
-            return re[:rows], im[:rows]
+        def f(amps):
+            return amps[:rows]
 
         if mesh is None:
             return jax.jit(f)
         from jax.sharding import NamedSharding, PartitionSpec
 
         rep = NamedSharding(mesh, PartitionSpec())
-        return jax.jit(f, out_shardings=(rep, rep))
+        return jax.jit(f, out_shardings=rep)
 
     return lru_get(_PREFIX_FETCH_CACHE, (rows, mesh),
                    _PREFIX_FETCH_CACHE_MAX, build)
@@ -1545,29 +1574,31 @@ def _prefix_fetch(rows: int, mesh):
 
 def _amp_at(qureg: Qureg, index: int):
     """One element by (row, lane) — never materialises a flat copy (a
-    reshape(-1) of a 30-qubit array would allocate 4 GiB on-device)."""
+    reshape(-1) of a 30-qubit array would allocate 4 GiB on-device).
+    The interleaved prefix rows carry re AND im, so one fetch still
+    serves both parts of every cached amplitude."""
     lanes = qureg.state_shape[1]
     row, lane = index // lanes, index % lanes
     if row < _PREFIX_ROWS:
         pre = qureg._readout.get("amp_prefix")
         if pre is None:
-            re, im = qureg.re, qureg.im  # property read flushes pending
-            rows = min(_PREFIX_ROWS, re.shape[0])
+            amps = qureg.amps  # property read flushes pending
+            rows = min(_PREFIX_ROWS, amps.shape[0])
             fn = None
             if qureg.mesh is None:
-                fn = readout_warm_get("prefix", re.shape, re.dtype,
+                fn = readout_warm_get("prefix", amps.shape, amps.dtype,
                                       qureg.num_vec_qubits,
                                       density=qureg.is_density)
             if fn is None:
                 fn = _prefix_fetch(rows, qureg.mesh)
-            # one dispatch, one synchronising fetch for both arrays
+            # one dispatch, one synchronising fetch for the whole window
             metrics.counter_inc("readout.prefix_fetches")
             with metrics.span("readout"):
-                pre = jax.device_get(fn(re, im))
-            pre = (np.asarray(pre[0]), np.asarray(pre[1]))
+                pre = np.asarray(jax.device_get(fn(amps)))
             qureg._readout["amp_prefix"] = pre
-        return pre[0][row, lane], pre[1][row, lane]
-    return qureg.re[row, lane], qureg.im[row, lane]
+        return pre[row, lane], pre[row, lanes + lane]
+    amps = qureg.amps
+    return amps[row, lane], amps[row, lanes + lane]
 
 
 def get_real_amp(qureg: Qureg, index: int) -> float:

@@ -725,7 +725,7 @@ def _write_json_atomic(path: str, doc: dict) -> None:
     os.replace(tmp, path)
 
 
-def snapshot(re, im, *, num_qubits: int, is_density: bool, mesh,
+def snapshot(amps, *, num_qubits: int, is_density: bool, mesh,
              directory: str, position: dict,
              owner: str | None = None) -> str | None:
     """Write one mid-run snapshot into the two-slot rotation under
@@ -767,9 +767,9 @@ def snapshot(re, im, *, num_qubits: int, is_density: bool, mesh,
         shutil.rmtree(tmp)
     os.makedirs(tmp)
     meta = stateio.checkpoint_meta(
-        num_qubits=num_qubits, is_density=is_density, dtype=re.dtype,
+        num_qubits=num_qubits, is_density=is_density, dtype=amps.dtype,
         num_devices=1 if mesh is None else int(mesh.devices.size))
-    stateio._write_snapshot(re, im, meta, tmp)
+    stateio._write_snapshot(amps, meta, tmp)
     with_retries(
         lambda: _write_json_atomic(os.path.join(tmp, stateio._POSITION),
                                    position),
@@ -1154,9 +1154,8 @@ def _resume_degraded(circuit, qureg, pos: dict, pallas, named: str):
         # restores the canonical qubit order under the NEW mesh
         from .parallel.mesh_exec import apply_layout_perm
 
-        re, im = apply_layout_perm(qureg.re, qureg.im, tuple(layout),
-                                   qureg.mesh)
-        qureg._set(re, im)
+        qureg._set_state(apply_layout_perm(qureg.amps, tuple(layout),
+                                           qureg.mesh))
     from .circuit import Circuit  # deferred: import cycle
 
     ops_applied = int(ops_applied)
@@ -1221,7 +1220,7 @@ def maybe_eager_checkpoint(qureg) -> None:
     from .circuit import check_state_health  # deferred: import cycle
 
     reason, _ = check_state_health(
-        qureg._re, qureg._im, is_density=qureg.is_density,
+        qureg._amps, is_density=qureg.is_density,
         num_qubits=qureg.num_qubits, mesh=qureg.mesh, before=None,
         n_ops=1)
     if reason is not None:
@@ -1229,7 +1228,7 @@ def maybe_eager_checkpoint(qureg) -> None:
             f"checkpoint health check failed at flush {n}: {reason} — "
             "snapshot NOT written (the previous checkpoint, if any, is "
             "the last good state)")
-    snapshot(qureg._re, qureg._im, num_qubits=qureg.num_qubits,
+    snapshot(qureg._amps, num_qubits=qureg.num_qubits,
              is_density=qureg.is_density, mesh=qureg.mesh,
              directory=directory, owner=f"register:{uid}",
              position={"format_version": 1, "kind": "flush",

@@ -30,7 +30,7 @@ import jax
 from .register import Qureg
 from .validation import (QuESTError, QuESTCorruptionError,
                          QuESTValidationError)
-from .ops.lattice import amp_sharding, state_shape
+from .ops.lattice import amp_sharding, merge_amps, split_amps, state_shape
 
 #: Metadata sidecar name inside a checkpoint directory.
 _META = "qureg.json"
@@ -147,16 +147,25 @@ def _array_checksum(arr) -> str:
     return f"{crc:08x}"
 
 
-def _write_snapshot(re, im, meta: dict, directory: str) -> None:
+def _write_snapshot(amps, meta: dict, directory: str) -> None:
     """Write one checkpoint (orbax arrays + checksummed ``qureg.json``)
-    into ``directory``.  The orbax save and the metadata write run
-    under the ``ckpt_save`` retry seam (``resilience.with_retries``);
-    the metadata lands via write-temp-then-rename so a crash never
-    leaves a truncated sidecar next to complete arrays."""
+    into ``directory``.
+
+    THIS is the split-format boundary: the v2 on-disk layout stores
+    separate ``re``/``im`` arrays (and their per-array checksums), so
+    checkpoints written before the interleaved-storage change restore
+    bit-identically and new checkpoints stay readable by format-v2
+    tooling — the interleave exists only in memory.  The lane-axis
+    slices preserve the row sharding, so no full-state host gather
+    happens here.  The orbax save and the metadata write run under the
+    ``ckpt_save`` retry seam (``resilience.with_retries``); the
+    metadata lands via write-temp-then-rename so a crash never leaves
+    a truncated sidecar next to complete arrays."""
     import orbax.checkpoint as ocp
 
     from . import resilience
 
+    re, im = split_amps(amps)
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
 
@@ -183,7 +192,7 @@ def save_checkpoint(qureg: Qureg, directory: str) -> None:
     sidecar (format_version 2; see :func:`restore_checkpoint` for the
     integrity and topology guarantees)."""
     _write_snapshot(
-        qureg.re, qureg.im,
+        qureg.amps,
         checkpoint_meta(
             num_qubits=qureg.num_qubits, is_density=qureg.is_density,
             dtype=qureg.real_dtype,
@@ -252,7 +261,7 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
     sh = amp_sharding(qureg.mesh)
     if sh is None:
         sh = jax.sharding.SingleDeviceSharding(
-            list(qureg.re.devices())[0])
+            list(qureg.amps.devices())[0])
     # The stored 2-D (rows, lanes) shape depends on the SAVING device
     # count for tiny registers (state_shape caps lanes at the chunk).
     # Flat index = row * lanes + lane is shape-invariant, so a
@@ -302,6 +311,9 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
     if not same_shape:
         import jax.numpy as jnp
 
-        out = {k: jax.device_put(jnp.reshape(v, qureg.state_shape), sh)
+        out = {k: jnp.reshape(v, qureg.state_shape)
                for k, v in out.items()}
-    qureg._set(out["re"], out["im"])
+    # split -> interleaved at the boundary: lane-stack the two restored
+    # component arrays back into the one storage array (row sharding
+    # preserved; device_put pins the register's own sharding)
+    qureg._set_state(jax.device_put(merge_amps(out["re"], out["im"]), sh))

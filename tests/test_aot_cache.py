@@ -27,7 +27,7 @@ except AttributeError:
 import numpy as np
 import jax.numpy as jnp
 from quest_tpu import models, register
-from quest_tpu.ops.lattice import state_shape
+from quest_tpu.ops.lattice import amps_shape
 
 n = 10
 circ = models.random_circuit(n, depth=2, seed=4)
@@ -41,13 +41,10 @@ assert any(f.startswith("stream-") for f in os.listdir({cache!r}))
 loaded = register._aot_load(ops, n)
 assert loaded is not None
 
-shape = state_shape(1 << n)
-re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
-im = jnp.zeros(shape, jnp.float32)
-r1, i1 = jit_fn(re, im)
-r2, i2 = loaded(re, im)
-np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
-np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+amps = jnp.zeros(amps_shape(1 << n), jnp.float32).at[0, 0].set(1.0)
+a1 = jit_fn(amps)
+a2 = loaded(amps)
+np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
 
 # key changes with the stream: a different circuit misses
 other = tuple(models.random_circuit(n, depth=2, seed=5).ops)

@@ -248,22 +248,22 @@ def test_single_target_reduction_kernels(env):
     psi = random_statevector(N, 81)
     q = qt.create_qureg(N, env)
     load_statevector(q, psi)
-    total = float(run_kernel((q.re, q.im), (), kind="sv_total_prob",
+    total = float(run_kernel((q.amps,), (), kind="sv_total_prob",
                              mesh=q.mesh, out_kind="scalar"))
     assert abs(total - qt.calc_total_prob(q)) < TOL
     for t in range(N):
-        p0 = float(run_kernel((q.re, q.im), (), kind="sv_prob_zero",
+        p0 = float(run_kernel((q.amps,), (), kind="sv_prob_zero",
                               statics=(t,), mesh=q.mesh, out_kind="scalar"))
         assert abs(p0 - qt.calc_prob_of_outcome(q, t, 0)) < TOL
 
     rho = random_density_matrix(ND, 82)
     d = qt.create_density_qureg(ND, env)
     load_density_matrix(d, rho)
-    total = float(run_kernel((d.re, d.im), (), kind="dm_total_prob",
+    total = float(run_kernel((d.amps,), (), kind="dm_total_prob",
                              statics=(ND,), mesh=d.mesh, out_kind="scalar"))
     assert abs(total - qt.calc_total_prob(d)) < TOL
     for t in range(ND):
-        p0 = float(run_kernel((d.re, d.im), (), kind="dm_prob_zero",
+        p0 = float(run_kernel((d.amps,), (), kind="dm_prob_zero",
                               statics=(ND, t), mesh=d.mesh,
                               out_kind="scalar"))
         assert abs(p0 - qt.calc_prob_of_outcome(d, t, 0)) < TOL

@@ -45,15 +45,14 @@ def test_measurement_statistics(env1):
     state matches the outcome deterministically."""
     circ = Circuit(1).hadamard(0).measure(0)
     fn = jax.jit(circ.as_fn(mesh=None))
-    shape = qt.create_qureg(1, env1).re.shape
+    shape = qt.create_qureg(1, env1).storage_shape
 
     import jax.numpy as jnp
 
     ones = 0
     shots = 200
-    re0 = jnp.zeros(shape, jnp.float64).at[0, 0].set(1.0)
-    im0 = jnp.zeros(shape, jnp.float64)
-    outs = jax.vmap(lambda k: fn(re0, im0, k)[2][0])(
+    amps0 = jnp.zeros(shape, jnp.float64).at[0, 0].set(1.0)
+    outs = jax.vmap(lambda k: fn(amps0, k)[1][0])(
         jax.random.split(jax.random.PRNGKey(7), shots))
     outs = np.asarray(outs)
     ones = int(outs.sum())

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 import quest_tpu as qt
-from quest_tpu.ops.lattice import run_kernel, state_shape
+from quest_tpu.ops.lattice import merge_amps, run_kernel, state_shape
 from quest_tpu.ops.pallas_kernels import apply_fused_segment
 from quest_tpu.scheduler import schedule_segments
 
@@ -54,23 +54,22 @@ def test_fused_channels_match_xla(n):
     shape = state_shape(1 << nvec)
     rho = random_density_matrix(n, seed=n)
     flat = rho.T.reshape(-1)
-    re = jnp.asarray(flat.real.reshape(shape))
-    im = jnp.asarray(flat.imag.reshape(shape))
+    amps = merge_amps(jnp.asarray(flat.real.reshape(shape)),
+                      jnp.asarray(flat.imag.reshape(shape)))
 
     ops = _chan_ops(n)
-    r2, i2 = re, im
+    a2 = amps
     for kind, statics, scalars in ops:
-        r2, i2 = run_kernel((r2, i2), scalars, kind=kind, statics=statics,
-                            mesh=None)
+        a2 = run_kernel((a2,), scalars, kind=kind, statics=statics,
+                        mesh=None)
 
-    r1, i1 = re, im
+    a1 = amps
     segs = schedule_segments(list(ops), nvec, lane_bits=min(7, nvec))
     assert any(op[0] == "chan" for seg_ops, _ in segs for op in seg_ops)
     for seg_ops, high in segs:
-        r1, i1 = apply_fused_segment(r1, i1, seg_ops, high, interpret=True)
+        a1 = apply_fused_segment(a1, seg_ops, high, interpret=True)
 
-    np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), atol=1e-12)
-    np.testing.assert_allclose(np.asarray(i1), np.asarray(i2), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2), atol=1e-12)
 
 
 def test_channels_fuse_into_gate_stream(env1):

@@ -1,0 +1,144 @@
+"""Interleaved (lane-stacked) amplitude storage: layout round-trips,
+boundary bit-identity, and the pre-change checkpoint fixture.
+
+The internal representation is ONE (rows, 2L) array (re in storage
+lanes [0, L), im in [L, 2L) — quest_tpu.ops.lattice); the split
+``ComplexArray`` layout survives only at the boundaries (``stateio``'s
+v2 on-disk format, the C ABI, the read-side ``Qureg.re``/``im``
+views).  These tests pin that every conversion across that boundary is
+EXACT — pure data movement, no arithmetic — in both f32 and f64, and
+that a checkpoint written by the pre-interleave code (a committed
+fixture) restores bit-identically.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.ops.lattice import (amps_shape, merge_amps, split_amps,
+                                   state_shape)
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_split_merge_roundtrip_exact(dtype, seed):
+    """Property: split(merge(re, im)) == (re, im) and
+    merge(split(amps)) == amps, bit-for-bit, at every power-of-two
+    geometry the storage uses (lanes capped at 128, sub-128 tiny
+    states included)."""
+    rng = np.random.default_rng(seed)
+    for nbits in (3, 7, 10, 14):
+        rows, lanes = state_shape(1 << nbits)
+        assert amps_shape(1 << nbits) == (rows, 2 * lanes)
+        re = rng.standard_normal((rows, lanes)).astype(dtype)
+        im = rng.standard_normal((rows, lanes)).astype(dtype)
+        amps = merge_amps(jnp.asarray(re), jnp.asarray(im))
+        assert amps.shape == (rows, 2 * lanes) and amps.dtype == dtype
+        r2, i2 = split_amps(amps)
+        np.testing.assert_array_equal(np.asarray(r2), re)
+        np.testing.assert_array_equal(np.asarray(i2), im)
+        back = np.asarray(merge_amps(r2, i2))
+        np.testing.assert_array_equal(back, np.asarray(amps))
+
+
+@pytest.mark.parametrize("dtype_name", ["float32", "float64"])
+def test_register_boundary_views_exact(env1, dtype_name):
+    """Host amplitudes loaded through the split boundary
+    (init_state_from_amps) read back bit-identically through every
+    split-view surface: .re/.im, per-amp getters, get_state_vector."""
+    dtype = np.dtype(dtype_name)
+    n = 5
+    rng = np.random.default_rng(99)
+    re = rng.standard_normal(1 << n).astype(dtype)
+    im = rng.standard_normal(1 << n).astype(dtype)
+    q = qt.create_qureg(n, env1, dtype=dtype)
+    qt.init_state_from_amps(q, re.copy(), im.copy())
+    np.testing.assert_array_equal(
+        np.asarray(q.re).reshape(-1), re)
+    np.testing.assert_array_equal(
+        np.asarray(q.im).reshape(-1), im)
+    sv = qt.get_state_vector(q)
+    np.testing.assert_array_equal(sv.real.astype(dtype), re)
+    np.testing.assert_array_equal(sv.imag.astype(dtype), im)
+    for k in (0, 1, (1 << n) - 1):
+        assert qt.get_real_amp(q, k) == float(re[k])
+        assert qt.get_imag_amp(q, k) == float(im[k])
+
+
+def test_checkpoint_roundtrip_bit_identical(env1, tmp_path):
+    """stateio v2 write -> restore through the split disk boundary is
+    bit-identical on the f64 path (conversion is pure data movement)."""
+    from quest_tpu import stateio
+
+    n = 6
+    rng = np.random.default_rng(7)
+    re = rng.standard_normal(1 << n)
+    im = rng.standard_normal(1 << n)
+    q = qt.create_qureg(n, env1)
+    qt.init_state_from_amps(q, re.copy(), im.copy())
+    d = str(tmp_path / "ck")
+    stateio.save_checkpoint(q, d)
+    q2 = qt.create_qureg(n, env1)
+    stateio.restore_checkpoint(q2, d)
+    np.testing.assert_array_equal(np.asarray(q2.amps),
+                                  np.asarray(q.amps))
+
+
+def test_prechange_checkpoint_restores_bit_identical(env1):
+    """A checkpoint WRITTEN BY THE PRE-INTERLEAVE CODE (committed
+    fixture, split (re, im) arrays + v2 checksums on disk) restores
+    bit-identically into the interleaved register — the disk format is
+    the compatibility contract the refactor must keep."""
+    d = os.path.join(DATA, "prechange_ckpt_v2")
+    want_re = np.load(os.path.join(DATA, "prechange_ckpt_v2_re.npy"))
+    want_im = np.load(os.path.join(DATA, "prechange_ckpt_v2_im.npy"))
+    from quest_tpu import stateio
+
+    q = qt.create_qureg(4, env1)
+    stateio.restore_checkpoint(q, d)
+    np.testing.assert_array_equal(
+        np.asarray(q.re).reshape(-1), want_re)
+    np.testing.assert_array_equal(
+        np.asarray(q.im).reshape(-1), want_im)
+    # and a fresh save of the restored state reproduces the fixture's
+    # per-array checksums (same disk bytes, same CRCs)
+    import json
+
+    with open(os.path.join(d, "qureg.json")) as f:
+        fixture_meta = json.load(f)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        stateio.save_checkpoint(q, td)
+        with open(os.path.join(td, "qureg.json")) as f:
+            new_meta = json.load(f)
+    assert new_meta["checksums"] == fixture_meta["checksums"]
+    assert new_meta["shape"] == fixture_meta["shape"]
+
+
+def test_report_state_csv_boundary(env1, tmp_path):
+    """The reference-format CSV boundary still writes split columns
+    readable by init_state_from_single_file (round trip through BOTH
+    split boundaries)."""
+    from quest_tpu import stateio
+
+    n = 4
+    rng = np.random.default_rng(3)
+    re = rng.standard_normal(1 << n)
+    im = rng.standard_normal(1 << n)
+    v = np.sqrt((re * re + im * im).sum())
+    re, im = re / v, im / v
+    q = qt.create_qureg(n, env1)
+    qt.init_state_from_amps(q, re.copy(), im.copy())
+    path = stateio.report_state(q, str(tmp_path))
+    q2 = qt.create_qureg(n, env1)
+    assert stateio.init_state_from_single_file(q2, path)
+    sv = qt.get_state_vector(q2)
+    # CSV is %.12f text: exact to the printed precision
+    np.testing.assert_allclose(sv.real, re, atol=1e-11)
+    np.testing.assert_allclose(sv.imag, im, atol=1e-11)

@@ -199,7 +199,7 @@ def test_conditional_lane_group_under_mesh(env8, env1):
 
 
 def test_plan_xla_backend_equivalence_20q(env8, env1):
-    """The PLAN ITSELF — fused segments plus real bitswap_chunk
+    """The PLAN ITSELF — fused segments plus real bitswap_amps
     relayouts — executed via the XLA segment backend at 20 qubits must
     match the per-gate path amplitude-for-amplitude (VERDICT r3 item 2:
     plan execution must not depend on interpret-mode Pallas).  The
@@ -218,8 +218,7 @@ def test_plan_xla_backend_equivalence_20q(env8, env1):
     q = qt.create_qureg(n, env8, dtype=jnp.float32)
     qt.init_zero_state(q)
     fn = as_mesh_fused_fn(list(circ.ops), n, q.mesh, backend="xla")
-    re, im = jax.jit(fn)(q.re, q.im)
-    q._set(re, im)
+    q._set_state(jax.jit(fn)(q.amps))
 
     ref = qt.create_qureg(n, env1, dtype=jnp.float32)
     qt.init_zero_state(ref)
@@ -249,8 +248,7 @@ def test_plan_per_item_equivalence(env8, env1):
     qt.init_zero_state(q)
     fn = as_mesh_fused_fn(list(circ.ops), n, q.mesh, backend="xla",
                           per_item=True)
-    re, im = fn(q.re, q.im)
-    q._set(re, im)
+    q._set_state(fn(q.amps))
 
     ref = qt.create_qureg(n, env1, dtype=jnp.float32)
     qt.init_zero_state(ref)
@@ -292,16 +290,15 @@ def test_plan_xla_backend_density_channels(env8, env1):
     q = qt.create_density_qureg(n, env8, dtype=jnp.float32)
     qt.init_zero_state(q)
     fn = as_mesh_fused_fn(ops, 2 * n, q.mesh, backend="xla")
-    re, im = jax.jit(fn)(q.re, q.im)
-    q._set(re, im)
+    q._set_state(jax.jit(fn)(q.amps))
 
     ref = qt.create_density_qureg(n, env1, dtype=jnp.float32)
     qt.init_zero_state(ref)
-    r2, i2 = ref.re, ref.im
+    a2 = ref.amps
     for kind, statics, scalars in ops:
-        r2, i2 = run_kernel((r2, i2), scalars, kind=kind,
-                            statics=statics, mesh=None)
-    ref._set(r2, i2)
+        a2 = run_kernel((a2,), scalars, kind=kind,
+                        statics=statics, mesh=None)
+    ref._set_state(a2)
 
     from quest_tpu.parallel import to_host
 
@@ -337,16 +334,13 @@ def test_pallas_vs_xla_backend_equivalence_20q():
                               for dm in dev_masks]], jnp.float32)
     chunk_rows = (1 << (n - dev_bits)) // lanes
     rng = np.random.RandomState(3)
-    re = jnp.asarray(rng.randn(chunk_rows, lanes), jnp.float32)
-    im = jnp.asarray(rng.randn(chunk_rows, lanes), jnp.float32)
+    amps = jnp.asarray(rng.randn(chunk_rows, 2 * lanes), jnp.float32)
 
-    pr, pi = apply_fused_segment(re, im, seg_ops, tuple(high),
-                                 interpret=True, dev_flags=flags)
-    xr, xi = apply_segment_xla(re, im, seg_ops, tuple(high),
-                               dev_flags=flags)
+    pa = apply_fused_segment(amps, seg_ops, tuple(high),
+                             interpret=True, dev_flags=flags)
+    xa = apply_segment_xla(amps, seg_ops, tuple(high), dev_flags=flags)
     # both backends must PRESERVE f32 under x64 (np.abs comparison
     # would silently pass across a dtype promotion)
-    assert pr.dtype == xr.dtype == jnp.float32
-    err = max(float(np.abs(np.asarray(pr) - np.asarray(xr)).max()),
-              float(np.abs(np.asarray(pi) - np.asarray(xi)).max()))
+    assert pa.dtype == xa.dtype == jnp.float32
+    err = float(np.abs(np.asarray(pa) - np.asarray(xa)).max())
     assert err < 1e-5

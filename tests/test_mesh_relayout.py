@@ -1,5 +1,5 @@
 """Fused multi-bit relayouts: ``mesh_exec.apply_relayout`` vs the
-serial ``bitswap_pair`` composition and a numpy index oracle.
+serial ``bitswap_amps`` composition and a numpy index oracle.
 
 The fusion contract (ISSUE 2): executing a swap chain's composed bit
 permutation as ONE sub-block exchange must be bit-identical to
@@ -22,7 +22,7 @@ from quest_tpu import models
 from quest_tpu.ops.lattice import state_shape, _ilog2, shard_map_compat
 from quest_tpu.parallel.mesh_exec import (
     apply_relayout,
-    bitswap_pair,
+    bitswap_amps,
     plan_exchange_elems,
     relayout_comm_elems,
 )
@@ -43,37 +43,39 @@ def _np_apply(perm, flat):
 
 def _run_both(run, perm, ndev, n):
     """(fused_re, fused_im, serial_re, serial_im) flats for a random
-    state under the composed relayout vs the serial swap chain."""
+    state under the composed relayout vs the serial swap chain, both
+    executed over the single interleaved storage array."""
     dev_bits = _ilog2(ndev)
     cb = n - dev_bits
     shape = state_shape(1 << n, ndev)
-    lane_bits = _ilog2(shape[1])
+    lanes = shape[1]
+    lane_bits = _ilog2(lanes)
     rng = np.random.RandomState(hash((ndev, n, tuple(perm))) % (2**31))
     flat_re = rng.randn(1 << n)
     flat_im = rng.randn(1 << n)
     mesh = Mesh(np.array(jax.devices()[:ndev]), (AXIS,))
     sh = NamedSharding(mesh, P(AXIS))
-    re = jax.device_put(jnp.asarray(flat_re.reshape(shape)), sh)
-    im = jax.device_put(jnp.asarray(flat_im.reshape(shape)), sh)
+    host = np.concatenate([flat_re.reshape(shape),
+                           flat_im.reshape(shape)], axis=1)
+    amps = jax.device_put(jnp.asarray(host), sh)
 
-    def fused(re, im):
+    def fused(a):
         dev = lax.axis_index(AXIS)
-        return apply_relayout(re, im, perm, dev, AXIS, ndev, cb, lane_bits)
+        return apply_relayout(a, perm, dev, AXIS, ndev, cb, lane_bits)
 
-    def serial(re, im):
+    def serial(a):
         dev = lax.axis_index(AXIS)
-        for _, a, b in run:
-            re, im = bitswap_pair(re, im, a, b, dev, AXIS, ndev, cb,
-                                  lane_bits)
-        return re, im
+        for _, x, y in run:
+            a = bitswap_amps(a, x, y, dev, AXIS, ndev, cb, lane_bits)
+        return a
 
     out = []
     for body in (fused, serial):
         fn = shard_map_compat(body, mesh=mesh,
-                              in_specs=(P(AXIS), P(AXIS)),
-                              out_specs=(P(AXIS), P(AXIS)))
-        r, i = fn(re, im)
-        out += [np.asarray(r).reshape(-1), np.asarray(i).reshape(-1)]
+                              in_specs=(P(AXIS),),
+                              out_specs=P(AXIS))
+        o = np.asarray(fn(amps))
+        out += [o[:, :lanes].reshape(-1), o[:, lanes:].reshape(-1)]
     return out, _np_apply(perm, flat_re), _np_apply(perm, flat_im)
 
 
@@ -116,7 +118,8 @@ def test_apply_relayout_matches_serial(ndev):
 def test_relayout_comm_elems_closed_form():
     """The exact per-round accounting reduces to the closed forms: a
     fused pure k-bit device<->local relayout moves
-    ndev * chunk * (2^k - 1)/2^k elements per array (x2 stacked), and a
+    ndev * chunk * (2^k - 1)/2^k amplitude pairs (storage elements: x2,
+    since every interleaved sub-block carries re AND im), and a
     fused single swap moves exactly what the serial half-exchange
     moves."""
     n, dev_bits = 12, 3

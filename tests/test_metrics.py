@@ -289,6 +289,81 @@ def test_no_adhoc_instrumentation_outside_metrics():
 
 
 # ---------------------------------------------------------------------------
+# Interleaved-storage discipline lint (quest_tpu.ops.lattice)
+# ---------------------------------------------------------------------------
+
+#: Modules allowed to convert between the interleaved storage and the
+#: split (re, im) layout, with WHY:
+#:   ops/lattice.py     — defines the helpers + the in-program
+#:                        kernel-dispatch seam (views inside one jitted
+#:                        program, never persistent storage)
+#:   ops/segment_xla.py — the XLA fallback executor's in-program views
+#:   register.py        — the host-readout boundary (.re/.im views and
+#:                        host-side init/readout conversions)
+#:   stateio.py         — the checkpoint v2 split on-disk format
+#:   capi_bridge.py     — the C ABI's ComplexArray contract
+_SPLIT_BOUNDARY_MODULES = {
+    "ops/lattice.py", "ops/segment_xla.py", "register.py",
+    "stateio.py", "capi_bridge.py",
+}
+
+_SPLIT_CALL = regex.compile(r"\b(?:split_amps|merge_amps)\s*\(")
+#: The old collective-payload construction: stacking re/im into one
+#: array before a ppermute.  The interleaved layout makes this
+#: structurally unnecessary — its reappearance means a code path went
+#: back to split state.
+_SPLIT_STACK = regex.compile(
+    r"stack\(\s*\[\s*(?:re|_?im|r|i)\w*\s*,\s*(?:im|i)\w*\s*\]")
+
+
+def test_no_split_layout_outside_boundaries():
+    """No code path outside the declared boundary modules may construct
+    the split (re, im) layout: ``split_amps``/``merge_amps`` call sites
+    are restricted to ``_SPLIT_BOUNDARY_MODULES`` (import lines don't
+    count; definitions live in lattice), and the executor layers must
+    not re-stack components into collective payloads.  This is what
+    keeps the fused sweep ONE sweep — a silent re-split would halve
+    roofline_frac long before anyone reread the kernel."""
+    offenders = []
+    stackers = []
+    pkg = os.path.join(REPO, "quest_tpu")
+    for root, _dirs, files in os.walk(pkg):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, pkg)
+            with open(path) as f:
+                lines = f.readlines()
+            for lineno, line in enumerate(lines, 1):
+                stripped = line.strip()
+                if stripped.startswith(("#", "import ", "from ")):
+                    continue
+                if _SPLIT_CALL.search(line) \
+                        and rel not in _SPLIT_BOUNDARY_MODULES:
+                    offenders.append(f"{rel}:{lineno}: {stripped}")
+                if _SPLIT_STACK.search(line) and rel in (
+                        "parallel/mesh_exec.py",
+                        "ops/pallas_kernels.py", "circuit.py"):
+                    stackers.append(f"{rel}:{lineno}: {stripped}")
+    assert not offenders, (
+        "split-layout construction outside the boundary modules "
+        f"({sorted(_SPLIT_BOUNDARY_MODULES)}) — the interleaved "
+        "storage must stay one array everywhere else:\n"
+        + "\n".join(offenders))
+    assert not stackers, (
+        "re/im re-stacked into a collective payload in an executor "
+        "module — interleaved chunks already carry both components in "
+        "one array:\n" + "\n".join(stackers))
+    # the fused kernel keeps exactly ONE aliased state operand: a
+    # second state BlockSpec is the two-sweep layout coming back
+    src = open(os.path.join(pkg, "ops", "pallas_kernels.py")).read()
+    assert "input_output_aliases={0: 0}" in src
+    assert "input_output_aliases={0: 0, 1: 1}" not in src
+    assert "in_specs=[spec, spec]" not in src
+
+
+# ---------------------------------------------------------------------------
 # Fault-seam / retry discipline lint (quest_tpu.resilience)
 # ---------------------------------------------------------------------------
 

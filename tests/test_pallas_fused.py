@@ -291,13 +291,10 @@ def test_expmm_kept_diag_entry_bars_group(monkeypatch):
 
     n = 13
     rng = np.random.RandomState(3)
-    re0 = rng.randn(1 << (n - 7), 128).astype(np.float32)
-    im0 = rng.randn(1 << (n - 7), 128).astype(np.float32)
+    amps0 = rng.randn(1 << (n - 7), 256).astype(np.float32)
     hb = tuple(b for b in high)
-    r1, i1 = apply_segment_xla(jnp.array(re0), jnp.array(im0), seg, hb)
-    r2, i2 = apply_segment_xla(jnp.array(re0), jnp.array(im0), folded, hb)
-    a = np.asarray(r1) + 1j * np.asarray(i1)
-    b = np.asarray(r2) + 1j * np.asarray(i2)
+    a = np.asarray(apply_segment_xla(jnp.array(amps0), seg, hb))
+    b = np.asarray(apply_segment_xla(jnp.array(amps0), folded, hb))
     assert float(np.abs(a - b).max()) < 1e-5
 
 
@@ -335,8 +332,7 @@ def test_expmm_xla_backend_equivalence(env8, env1, monkeypatch):
     q = qt.create_qureg(n, env8, dtype=jnp.float32)
     qt.init_zero_state(q)
     fn = as_mesh_fused_fn(list(circ.ops), n, q.mesh, backend="xla")
-    re, im = jax.jit(fn)(q.re, q.im)
-    q._set(re, im)
+    q._set_state(jax.jit(fn)(q.amps))
 
     ref = qt.create_qureg(n, env1, dtype=jnp.float32)
     qt.init_zero_state(ref)
@@ -355,28 +351,26 @@ def test_bf16_storage_f32_compute(env1):
     import jax.numpy as jnp
     from quest_tpu.scheduler import schedule_segments
     from quest_tpu.ops.pallas_kernels import apply_fused_segment
-    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.ops.lattice import amps_shape
 
     n = 14
     circ = models.random_circuit(n, depth=3, seed=5)
     segs = schedule_segments(list(circ.ops), n, max_high=7,
                              row_budget=2048)
-    shape = state_shape(1 << n)
+    shape = amps_shape(1 << n)
 
-    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1)
-    im = jnp.zeros(shape, jnp.float32)
+    amps = jnp.zeros(shape, jnp.float32).at[0, 0].set(1)
     for ops, high in segs:
-        re, im = apply_fused_segment(re, im, ops, tuple(high),
-                                     row_budget=2048, interpret=True)
-    rb = jnp.zeros(shape, jnp.bfloat16).at[0, 0].set(1)
-    ib = jnp.zeros(shape, jnp.bfloat16)
+        amps = apply_fused_segment(amps, ops, tuple(high),
+                                   row_budget=2048, interpret=True)
+    ab = jnp.zeros(shape, jnp.bfloat16).at[0, 0].set(1)
     for ops, high in segs:
-        rb, ib = apply_fused_segment(rb, ib, ops, tuple(high),
-                                     row_budget=2048, interpret=True,
-                                     compute_dtype=jnp.float32)
-    assert rb.dtype == jnp.bfloat16
-    a = np.asarray(re)
-    b = np.asarray(rb.astype(jnp.float32))
+        ab = apply_fused_segment(ab, ops, tuple(high),
+                                 row_budget=2048, interpret=True,
+                                 compute_dtype=jnp.float32)
+    assert ab.dtype == jnp.bfloat16
+    a = np.asarray(amps)
+    b = np.asarray(ab.astype(jnp.float32))
     scale = float(np.abs(a).max())
     assert float(np.abs(a - b).max()) < 0.02 * scale
 

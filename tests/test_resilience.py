@@ -609,12 +609,12 @@ def test_snapshot_owner_conflict_skips(env1, tmp_path, capfd):
     d = str(tmp_path / "own")
     q = qt.create_qureg(4, env1)
     assert resilience.snapshot(
-        q.re, q.im, num_qubits=4, is_density=False, mesh=q.mesh,
+        q.amps, num_qubits=4, is_density=False, mesh=q.mesh,
         directory=d, owner="register:1",
         position={"kind": "flush", "flush_index": 1}) is not None
     before = metrics.counters().get("resilience.ckpt_dir_conflicts", 0)
     assert resilience.snapshot(
-        q.re, q.im, num_qubits=4, is_density=False, mesh=q.mesh,
+        q.amps, num_qubits=4, is_density=False, mesh=q.mesh,
         directory=d, owner="circuit:abcd",
         position={"kind": "circuit_run", "item_index": 2}) is None
     assert metrics.counters()["resilience.ckpt_dir_conflicts"] == before + 1
@@ -838,7 +838,7 @@ def test_snapshot_rotation_alternates_slots(env1, tmp_path):
     slots = []
     for i in range(3):
         path = resilience.snapshot(
-            q.re, q.im, num_qubits=4, is_density=False, mesh=q.mesh,
+            q.amps, num_qubits=4, is_density=False, mesh=q.mesh,
             directory=d, position={"item_index": i, "fingerprint": "x",
                                    "every": 1, "outcomes": [],
                                    "key": None})

@@ -29,7 +29,7 @@ def teardown_function(_fn):
 
 def test_spec_take_key_match_and_mismatch():
     ops = (("apply_2x2", (0, 0), ((1.0, 0.0),) * 4),)
-    res = (jnp.zeros((8, 128)), jnp.zeros((8, 128)))
+    res = jnp.zeros((8, 256))
     _fake_spec((ops, 10, jnp.dtype(jnp.float32)), res)
     out = reg._spec_exec_take(ops, 10, jnp.float32)
     assert out is not None and out[0] is res
@@ -43,17 +43,16 @@ def test_spec_take_key_match_and_mismatch():
 def test_lazy_zero_register_materialises_to_zero_state():
     env = qt.create_env(num_devices=1)
     n = 6
-    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.ops.lattice import amps_shape
 
-    shape = state_shape(1 << n)
+    shape = amps_shape(1 << n)
     _fake_spec(((("x",),), n, jnp.dtype(jnp.float32)),
-               (jnp.zeros(shape, jnp.float32), jnp.zeros(shape,
-                                                         jnp.float32)))
+               jnp.zeros(shape, jnp.float32))
     q = qt.create_qureg(n, env, dtype=jnp.float32)
-    assert isinstance(q._re, reg._LazyZero)
+    assert isinstance(q._amps, reg._LazyZero)
     # initZeroState on a lazy register keeps it lazy
     qt.init_zero_state(q)
-    assert isinstance(q._re, reg._LazyZero)
+    assert isinstance(q._amps, reg._LazyZero)
     # a state read materialises |0...0> and DROPS the speculation
     amps = qt.get_state_vector(q)
     assert reg._SPEC_EXEC is None
@@ -67,14 +66,13 @@ def test_lazy_zero_register_runs_gates_correctly():
     produce the same state as on an eagerly-allocated one."""
     env = qt.create_env(num_devices=1)
     n = 5
-    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.ops.lattice import amps_shape
 
-    shape = state_shape(1 << n)
+    shape = amps_shape(1 << n)
     _fake_spec(((("y",),), n, jnp.dtype(jnp.float32)),
-               (jnp.zeros(shape, jnp.float32), jnp.zeros(shape,
-                                                         jnp.float32)))
+               jnp.zeros(shape, jnp.float32))
     q = qt.create_qureg(n, env, dtype=jnp.float32)
-    assert isinstance(q._re, reg._LazyZero)
+    assert isinstance(q._amps, reg._LazyZero)
     qt.hadamard(q, 0)
     qt.controlled_not(q, 0, 3)
     a = qt.get_state_vector(q)
@@ -89,21 +87,20 @@ def test_lazy_zero_register_runs_gates_correctly():
 def test_other_inits_materialise_lazy_register():
     env = qt.create_env(num_devices=1)
     n = 5
-    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.ops.lattice import amps_shape
 
-    shape = state_shape(1 << n)
+    shape = amps_shape(1 << n)
     _fake_spec(((("z",),), n, jnp.dtype(jnp.float32)),
-               (jnp.zeros(shape, jnp.float32), jnp.zeros(shape,
-                                                         jnp.float32)))
+               jnp.zeros(shape, jnp.float32))
     q = qt.create_qureg(n, env, dtype=jnp.float32)
     qt.init_plus_state(q)          # not the zero special case
-    assert not isinstance(q._re, reg._LazyZero)
+    assert not isinstance(q._amps, reg._LazyZero)
     assert abs(qt.calc_total_prob(q) - 1.0) < 1e-6
 
 
 def test_spec_pending_requires_matching_config():
     n = 5
-    _fake_spec(((("w",),), n, jnp.dtype(jnp.float32)), (None, None))
+    _fake_spec(((("w",),), n, jnp.dtype(jnp.float32)), None)
     assert reg._spec_exec_pending(n, jnp.float32, None)
     assert not reg._spec_exec_pending(n + 1, jnp.float32, None)
     assert not reg._spec_exec_pending(n, jnp.float64, None)
@@ -115,12 +112,11 @@ def test_nonmatching_alloc_drops_speculation():
     the held result first — a full-size speculative pair plus a fresh
     full-size allocation must never coexist in HBM."""
     env = qt.create_env(num_devices=1)
-    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.ops.lattice import amps_shape
 
-    shape = state_shape(1 << 6)
+    shape = amps_shape(1 << 6)
     _fake_spec(((("v",),), 6, jnp.dtype(jnp.float32)),
-               (jnp.zeros(shape, jnp.float32),
-                jnp.zeros(shape, jnp.float32)))
+               jnp.zeros(shape, jnp.float32))
     qt.create_qureg(7, env, dtype=jnp.float32)   # different size
     assert reg._SPEC_EXEC is None
 

@@ -119,7 +119,10 @@ def test_device_time_sums_match_execute_span(env8):
 def test_timeline_exchange_bytes_match_ledger(env8):
     """Relayout/bitswap timeline items carry the exact exchange-byte
     attribution the ledger records — both read plan_exchange_elems, so
-    the totals must be EQUAL, not merely close."""
+    the totals must be EQUAL, not merely close.  Extended to the
+    interleaved one-sweep payload shape: segment items likewise carry
+    ``stream_bytes`` (one read+write of the single (rows, 2L) array),
+    and their sum must equal the ledger's ``exec.stream_bytes``."""
     n = 12
     circ = _mesh_circuit(n)
     q = qt.create_qureg(n, env8)
@@ -131,6 +134,15 @@ def test_timeline_exchange_bytes_match_ledger(env8):
     tl_bytes = sum(e["args"].get("exchange_bytes", 0) for e in ev)
     assert tl_bytes > 0
     assert tl_bytes == led["counters"]["exec.exchange_bytes"]
+    # one-sweep stream accounting: every segment item priced, totals
+    # equal — a re-split layout would double the per-item sweep count
+    # without doubling the bytes and break this pin
+    seg_ev = [e for e in ev if e["name"] in ("pallas-pass",
+                                             "xla-segment")]
+    assert seg_ev and all(e["args"].get("stream_bytes", 0) > 0
+                          for e in seg_ev)
+    tl_stream = sum(e["args"]["stream_bytes"] for e in seg_ev)
+    assert tl_stream == led["counters"]["exec.stream_bytes"]
     # correctness under observation: the per-item observed path must
     # produce the same state as the unobserved jitted program
     import numpy as np

@@ -1,0 +1,26 @@
+"""Shared compat shim for the historical round probes.
+
+The probe30*/probe31/probe40/probe50/microbench_pass scripts predate
+the interleaved (rows, 2L) amplitude storage and drive the fused
+executor with split (re, im) pairs.  ``fused_pair`` keeps their
+recorded methodology runnable against the one-array
+``apply_fused_segment`` — one extra concat per call, fine for a probe,
+never a product path.  Lives in ONE place so a future signature or
+layout change is applied once (the per-file copies this replaces
+diverged on the very first refactor).
+"""
+
+from __future__ import annotations
+
+
+def fused_pair(re, im, *args, **kwargs):
+    """``apply_fused_segment`` with the historical (re, im) pair
+    signature: merge -> one-sweep segment -> split."""
+    import jax.numpy as jnp
+
+    from quest_tpu.ops.pallas_kernels import apply_fused_segment
+
+    lanes = re.shape[1]
+    out = apply_fused_segment(jnp.concatenate([re, im], axis=1),
+                              *args, **kwargs)
+    return out[:, :lanes], out[:, lanes:]

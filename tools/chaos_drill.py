@@ -198,7 +198,7 @@ except AttributeError:
 import numpy as np
 import jax.numpy as jnp
 from quest_tpu import metrics, models, register
-from quest_tpu.ops.lattice import state_shape
+from quest_tpu.ops.lattice import amps_shape
 
 n = 8
 circ = models.qft(n)
@@ -208,12 +208,10 @@ compiled = register._aot_save(jit_fn, ops, n)
 assert compiled is not None, "aot save failed under transient fault"
 loaded = register._aot_load(ops, n)
 assert loaded is not None, "aot load failed under transient fault"
-shape = state_shape(1 << n)
-re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
-im = jnp.zeros(shape, jnp.float32)
-r1, i1 = jit_fn(re, im)
-r2, i2 = loaded(re, im)
-assert np.array_equal(np.asarray(r1), np.asarray(r2))
+amps = jnp.zeros(amps_shape(1 << n), jnp.float32).at[0, 0].set(1.0)
+a1 = jit_fn(amps)
+a2 = loaded(amps)
+assert np.array_equal(np.asarray(a1), np.asarray(a2))
 retries = metrics.counters().get("resilience.retries", 0)
 assert retries >= 2, f"expected >=2 retries, saw {{retries}}"
 print("AOT_DRILL_OK retries=%d" % retries)

@@ -62,6 +62,11 @@ DEFAULT_RULES = [
     ("seconds", +0.25, True),
     ("spans.execute.seconds", +0.25, True),
     ("hbm_gbps", -0.25, True),
+    # achieved-fraction-of-roofline: the interleaved one-sweep layout's
+    # headline metric.  A layout regression that re-splits the stream
+    # (two correlated sweeps again) roughly HALVES this, far past the
+    # noise allowance — bench.py --gate then fails
+    ("roofline_frac", -0.2, True),
 ]
 
 

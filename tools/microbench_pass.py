@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from tools._probe_compat import fused_pair as _fused_pair
+
 from quest_tpu.ops.lattice import state_shape
 from quest_tpu.scheduler import schedule_segments
 from quest_tpu import models
@@ -32,7 +34,7 @@ def timed(label, seg_ops, high=(), extra_fn=None):
     def body(re, im):
         if extra_fn is not None:
             return extra_fn(re, im)
-        return apply_fused_segment(re, im, seg_ops, high)
+        return _fused_pair(re, im, seg_ops, high)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def run(re, im):

@@ -78,15 +78,15 @@ q = qt.create_qureg(n, env)
 qt.init_zero_state(q)
 fn = jax.jit(as_mesh_fused_fn(list(circ.ops), n, q.mesh, backend="xla"))
 t0 = reporting.stopwatch()
-re, im = fn(q.re, q.im)
-jax.block_until_ready((re, im))
+amps = fn(q.amps)
+jax.block_until_ready(amps)
 compile_plus_run = t0.seconds
-q._set(re, im)
+q._set_state(amps)
 t0 = reporting.stopwatch()
-re, im = fn(q.re, q.im)
-jax.block_until_ready((re, im))
+amps = fn(q.amps)
+jax.block_until_ready(amps)
 warm = t0.seconds
-q._set(re, im)
+q._set_state(amps)
 total = qt.calc_total_prob(q)
 
 # Execute one PALLAS-backend segment of the same plan on this
@@ -107,18 +107,15 @@ if dev_masks:
                           for dm in dev_masks]], jnp.float32)
 chunk_rows = (1 << (n - dev_bits)) // lanes
 rng = np.random.default_rng(100 + pid)
-cre = jnp.asarray(rng.standard_normal((chunk_rows, lanes)), jnp.float32)
-cim = jnp.asarray(rng.standard_normal((chunk_rows, lanes)), jnp.float32)
+camps = jnp.asarray(rng.standard_normal((chunk_rows, 2 * lanes)),
+                    jnp.float32)
 t0 = reporting.stopwatch()
-pr, pi2 = apply_fused_segment(cre, cim, seg_ops, tuple(shigh),
-                              interpret=True, dev_flags=flags)
-jax.block_until_ready((pr, pi2))
+pa = apply_fused_segment(camps, seg_ops, tuple(shigh),
+                         interpret=True, dev_flags=flags)
+jax.block_until_ready(pa)
 pallas_seg_s = t0.seconds
-xr, xi = apply_segment_xla(cre, cim, seg_ops, tuple(shigh),
-                           dev_flags=flags)
-pallas_vs_xla_err = max(
-    float(np.abs(np.asarray(pr) - np.asarray(xr)).max()),
-    float(np.abs(np.asarray(pi2) - np.asarray(xi)).max()))
+xa = apply_segment_xla(camps, seg_ops, tuple(shigh), dev_flags=flags)
+pallas_vs_xla_err = float(np.abs(np.asarray(pa) - np.asarray(xa)).max())
 assert pallas_vs_xla_err < 1e-5, pallas_vs_xla_err
 
 chunk_bytes = 2 * (1 << (n - dev_bits)) * 4
@@ -150,39 +147,37 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from quest_tpu import models, reporting
 from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn
-from quest_tpu.ops.lattice import run_kernel, state_shape
+from quest_tpu.ops.lattice import amps_shape, run_kernel
 
 n = {n}
 circ = models.random_circuit(n, depth=2, seed=31)
-shape = state_shape(1 << n)
+shape = amps_shape(1 << n)
 
-def fetches(re, im):
+def fetches(amps):
     p0 = np.asarray(jax.device_get(run_kernel(
-        (re, im), (), kind="sv_prob_zero_all", statics=(n,),
+        (amps,), (), kind="sv_prob_zero_all", statics=(n,),
         mesh=None, out_kind="scalar")), dtype=np.float64)
-    pre_r = np.asarray(jax.device_get(re[:16]))
-    pre_i = np.asarray(jax.device_get(im[:16]))
-    return p0, pre_r, pre_i
+    pre = np.asarray(jax.device_get(amps[:16]))
+    lanes = pre.shape[1] // 2
+    return p0, pre[:, :lanes], pre[:, lanes:]
 
 t0 = reporting.stopwatch()
 if which == "mesh":
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("amp",))
     fn = as_mesh_fused_fn(list(circ.ops), n, mesh, backend="pallas")
-    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
-    im = jnp.zeros(shape, jnp.float32)
-    re, im = jax.jit(fn, donate_argnums=(0, 1))(re, im)
-    jax.block_until_ready((re, im))
+    amps = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    amps = jax.jit(fn, donate_argnums=(0,))(amps)
+    jax.block_until_ready(amps)
 else:
     # donated raw-array form (Circuit.run's mutating facade keeps both
-    # input and output pairs live — 16 GiB at 30q; see RANDOM34's
+    # input and output states live — 16 GiB at 30q; see RANDOM34's
     # driver for the same pattern)
     fn = circ.compile(mesh=None, donate=True)
-    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
-    im = jnp.zeros(shape, jnp.float32)
-    re, im = fn(re, im)
-    jax.block_until_ready((re, im))
+    amps = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
+    amps = fn(amps)
+    jax.block_until_ready(amps)
 secs = t0.seconds
-p0, pre_r, pre_i = fetches(re, im)
+p0, pre_r, pre_i = fetches(amps)
 print("STAGE " + json.dumps({{
     "which": which, "seconds": round(secs, 2),
     "p0": p0.tolist(),

@@ -18,6 +18,8 @@ import numpy as np
 from quest_tpu.ops.pallas_kernels import apply_fused_segment
 from quest_tpu.ops.lattice import state_shape
 from quest_tpu.scheduler import schedule_segments
+from tools._probe_compat import fused_pair as _fused_pair
+
 from quest_tpu import models
 
 N = int(os.environ.get("MB_QUBITS", "30"))
@@ -33,7 +35,7 @@ def timed(label, seg_ops, high=(), row_budget=1024):
     def run(re, im):
         return jax.lax.fori_loop(
             0, INNER,
-            lambda _, s: apply_fused_segment(*s, seg_ops, high,
+            lambda _, s: _fused_pair(*s, seg_ops, high,
                                              row_budget=row_budget),
             (re, im))
 

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 
 from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from tools._probe_compat import fused_pair as _fused_pair
+
 from quest_tpu.ops.lattice import state_shape
 from quest_tpu.scheduler import schedule_segments
 from quest_tpu import models
@@ -36,7 +38,7 @@ def timed(label, lane_min, row_min, max_high):
 
     def apply(re, im):
         for seg_ops, high in segs:
-            re, im = apply_fused_segment(re, im, seg_ops, high)
+            re, im = _fused_pair(re, im, seg_ops, high)
         return re, im
 
     @partial(jax.jit, donate_argnums=(0, 1))

@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 
 from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from tools._probe_compat import fused_pair as _fused_pair
+
 from quest_tpu.ops.lattice import state_shape
 from quest_tpu.scheduler import schedule_segments
 from quest_tpu import models
@@ -27,7 +29,7 @@ H = ((0.7071067811865476, 0.0), (0.7071067811865476, 0.0),
 def timed_segs(label, segs, n_gates, row_budget=1024):
     def apply(re, im):
         for seg_ops, high in segs:
-            re, im = apply_fused_segment(re, im, seg_ops, high,
+            re, im = _fused_pair(re, im, seg_ops, high,
                                          row_budget=row_budget)
         return re, im
 

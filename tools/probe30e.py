@@ -13,6 +13,8 @@ import numpy as np
 from jax import lax
 
 from quest_tpu.ops.pallas_kernels import apply_fused_segment
+from tools._probe_compat import fused_pair as _fused_pair
+
 from quest_tpu.ops.lattice import state_shape
 from quest_tpu.scheduler import schedule_segments
 from quest_tpu import models
@@ -70,7 +72,7 @@ def circ_fn(depth, mh, rb):
 
     def apply(re, im):
         for seg_ops, high in segs:
-            re, im = apply_fused_segment(re, im, seg_ops, high,
+            re, im = _fused_pair(re, im, seg_ops, high,
                                          row_budget=rb)
         return re, im
 

@@ -41,11 +41,14 @@ from quest_tpu import models, reporting
 from quest_tpu.circuit import Circuit
 from quest_tpu.scheduler import schedule_segments
 from quest_tpu.ops.pallas_kernels import apply_fused_segment
+
+from tools._probe_compat import fused_pair as _fused_pair
+
 from quest_tpu.ops.lattice import state_shape
 
 def run_plan(re, im, segs, cdtype, rb=None):
     for seg_ops, high in segs:
-        re, im = apply_fused_segment(re, im, seg_ops, tuple(high),
+        re, im = _fused_pair(re, im, seg_ops, tuple(high),
                                      row_budget=rb, compute_dtype=cdtype)
     return re, im
 

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 
 import quest_tpu.ops.pallas_kernels as pk
+from tools._probe_compat import fused_pair as _fused_pair
+
 import quest_tpu.scheduler as sched
 from quest_tpu.ops.lattice import state_shape
 from quest_tpu import models
@@ -35,7 +37,7 @@ shape = state_shape(1 << N)
 def timed(label, segs, row_budget=None):
     def apply(re, im):
         for seg_ops, high in segs:
-            re, im = pk.apply_fused_segment(re, im, seg_ops, high,
+            re, im = _fused_pair(re, im, seg_ops, high,
                                             row_budget=row_budget)
         return re, im
 

@@ -28,6 +28,7 @@ from functools import partial
 
 sys.path.insert(0, __file__.rsplit('/', 2)[0])
 from quest_tpu import reporting  # noqa: E402
+from tools._probe_compat import fused_pair as _fused_pair  # noqa: E402
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -101,22 +102,25 @@ def make_copy2d(k, row_budget=2048):
     dims, block_shape, grid, index_map, c_blk = plan_fused_shapes(
         ROWS, LANES, high_row, row_budget)
 
-    def kern(re_ref, im_ref, ro_ref, io_ref):
-        ro_ref[:] = re_ref[:]
-        io_ref[:] = im_ref[:]
+    def kern(a_ref, o_ref):
+        o_ref[:] = a_ref[:]
 
     spec = pl.BlockSpec(block_shape, index_map)
     cp = {"compiler_params": pltpu.CompilerParams(
         vmem_limit_bytes=110 << 20)} if k >= 8 else {}
 
     def fn(re, im):
-        r, i = pl.pallas_call(
+        # plan_fused_shapes now describes the interleaved (rows, 2L)
+        # storage: one operand, one aliased output
+        amps = jnp.concatenate([re, im], axis=1)
+        (out,) = pl.pallas_call(
             kern, grid=grid,
-            in_specs=[spec, spec], out_specs=[spec, spec],
-            out_shape=[jax.ShapeDtypeStruct(dims, re.dtype)] * 2,
-            input_output_aliases={0: 0, 1: 1}, **cp,
-        )(re.reshape(dims), im.reshape(dims))
-        return r.reshape(re.shape), i.reshape(im.shape)
+            in_specs=[spec], out_specs=[spec],
+            out_shape=[jax.ShapeDtypeStruct(dims, amps.dtype)],
+            input_output_aliases={0: 0}, **cp,
+        )(amps.reshape(dims))
+        out = out.reshape(ROWS, 2 * LANES)
+        return out[:, :LANES], out[:, LANES:]
     return fn
 
 
@@ -191,7 +195,6 @@ def make_seg(n_2x2, k=8, with_mm=0, row_budget=2048):
     """apply_fused_segment with n synthetic 2x2s round-robin over the k
     exposed (top) qubits + optionally with_mm composed real lane matmul
     groups — the real executor pass at bench structure."""
-    from quest_tpu.ops.pallas_kernels import apply_fused_segment
     import numpy as np
 
     high_bits = tuple(range(N - k, N))
@@ -207,7 +210,7 @@ def make_seg(n_2x2, k=8, with_mm=0, row_budget=2048):
         ops.append(("2x2", t, _h(), 0, -1))
 
     def fn(re, im):
-        return apply_fused_segment(re, im, tuple(ops), high_bits,
+        return _fused_pair(re, im, tuple(ops), high_bits,
                                    row_budget=row_budget)
     return fn
 
@@ -253,7 +256,7 @@ def make_seg_expmm(n_2x2, k=8, j=8, with_mm=0, complex_u=False):
     ops.append(("expmm", tuple(range(j)), U.real.copy(), U.imag.copy()))
 
     def fn(re, im):
-        return apply_fused_segment(re, im, tuple(ops), high_bits,
+        return _fused_pair(re, im, tuple(ops), high_bits,
                                    row_budget=2048)
     return fn
 
@@ -262,7 +265,7 @@ def make_seg_direct(seg_ops, high):
     from quest_tpu.ops.pallas_kernels import apply_fused_segment
 
     def fn(re, im):
-        return apply_fused_segment(re, im, seg_ops, tuple(high))
+        return _fused_pair(re, im, seg_ops, tuple(high))
     return fn
 
 
@@ -289,7 +292,7 @@ def bench_sched_variants():
 
         def fn(re, im, segs=segs, rb=rb):
             for seg_ops, high in segs:
-                re, im = apply_fused_segment(re, im, seg_ops,
+                re, im = _fused_pair(re, im, seg_ops,
                                              tuple(high),
                                              row_budget=rb)
             return re, im

@@ -56,7 +56,7 @@ def run_real_chip(max_qubits: int = 30):
     import jax.numpy as jnp
 
     from quest_tpu import models, reporting
-    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.ops.lattice import amps_shape, state_shape
 
     dev = jax.devices()[0]
     hbm = 16 << 30
@@ -69,29 +69,29 @@ def run_real_chip(max_qubits: int = 30):
         n -= 1
 
     circ = models.qft(n)
-    # compile() jits with donated buffers: one (re, im) pair in HBM.
+    # compile() jits with a donated buffer: one interleaved state in HBM.
     fn = circ.compile(mesh=None, donate=True)
 
     x = (0b1011 << (n - 8)) | 0b1101  # non-trivial input basis state
-    shape = state_shape(1 << n)
-    lanes = shape[1]
+    lanes = state_shape(1 << n)[1]
+    shape = amps_shape(1 << n)
 
     def fresh():
-        re = jnp.zeros(shape, jnp.float32).at[x // lanes, x % lanes].set(1.0)
-        return re, jnp.zeros(shape, jnp.float32)
+        return jnp.zeros(shape,
+                         jnp.float32).at[x // lanes, x % lanes].set(1.0)
 
-    re, im = fresh()
+    amps = fresh()
     sw = reporting.stopwatch()
-    re, im = fn(re, im)
-    _ = float(re[0, 0])  # host read = real sync under the axon tunnel
+    amps = fn(amps)
+    _ = float(amps[0, 0])  # host read = real sync under the axon tunnel
     compile_s = sw.seconds
 
-    # Warm timing: re-apply on the same donated buffers (same compiled
+    # Warm timing: re-apply on the same donated buffer (same compiled
     # program; input state is irrelevant to gate timing) so only ONE
-    # (re, im) pair ever lives in HBM.
+    # interleaved state ever lives in HBM.
     sw = reporting.stopwatch()
-    re, im = fn(re, im)
-    _ = float(re[0, 0])
+    amps = fn(amps)
+    _ = float(amps[0, 0])
     run_s = sw.seconds
 
     # Sustained on-chip throughput: amortise the ~90 ms tunnel dispatch
@@ -105,30 +105,29 @@ def run_real_chip(max_qubits: int = 30):
     apply2 = circ2.as_fused_fn() if jax.default_backend() == "tpu" \
         else circ2.as_fn(mesh=None)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def spin(re, im):
-        return jax.lax.fori_loop(0, inner, lambda _, s: apply2(*s),
-                                 (re, im))
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def spin(a):
+        return jax.lax.fori_loop(0, inner, lambda _, s: apply2(s), a)
 
-    del re, im
-    sre, sim = spin(*fresh())
-    _ = float(sre[0, 0])
+    del amps
+    sa = spin(fresh())
+    _ = float(sa[0, 0])
     best = None
     for _rep in range(2):
         sw = reporting.stopwatch()
-        sre, sim = spin(sre, sim)
-        _ = float(sre[0, 0])
+        sa = spin(sa)
+        _ = float(sa[0, 0])
         dt = sw.seconds / inner
         best = dt if best is None else min(best, dt)
     sustained = circ.num_gates / best
-    del sre, sim
+    del sa
 
     # Fresh pass for the analytic amplitude check.
-    re, im = fn(*fresh())
+    amps = fn(fresh())
 
     def get_amp(k):
-        return complex(float(re[k // lanes, k % lanes]),
-                       float(im[k // lanes, k % lanes]))
+        return complex(float(amps[k // lanes, k % lanes]),
+                       float(amps[k // lanes, lanes + k % lanes]))
 
     err = _analytic_check(get_amp, n, x, [0, 1, 5, (1 << n) - 1,
                                           (1 << (n - 1)) + 3])
@@ -149,7 +148,7 @@ def run_real_chip(max_qubits: int = 30):
 
 def run_virtual_mesh(n: int | None = None, ndev: int = 8):
     """Sharded QFT on a virtual CPU mesh EXECUTING the fused-mesh plan
-    itself — relabeling segments plus real ``bitswap_chunk`` relayout
+    itself — relabeling segments plus real ``bitswap_amps`` relayout
     exchanges — via the XLA segment backend (``as_mesh_fused_fn(...,
     backend="xla")``; the plan no longer needs interpret-mode Pallas,
     whose grid walk bounded earlier rounds' evidence to 16q).  Runs in a
@@ -186,7 +185,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from quest_tpu import metrics, models, reporting
 from quest_tpu.env import AMP_AXIS
-from quest_tpu.ops.lattice import state_shape
+from quest_tpu.ops.lattice import amps_shape, state_shape
 from quest_tpu.scheduler import schedule_mesh
 from quest_tpu.parallel.mesh_exec import as_mesh_fused_fn
 
@@ -196,22 +195,21 @@ mesh = Mesh(np.array(jax.devices()[:ndev]), (AMP_AXIS,))
 sh = NamedSharding(mesh, P(AMP_AXIS))
 circ = models.qft(n)
 # THE PLAN, EXECUTED: schedule_mesh segments with per-chunk XLA bodies
-# and the planned bitswap_chunk half-exchanges actually performed.
+# and the planned bitswap_amps half-exchanges actually performed.
 # per_item: one giant XLA:CPU program over the whole 26q plan takes
 # tens of minutes to compile; per-item programs compile in seconds.
 # per_item is ALSO the timeline granularity: under QUEST_TIMELINE=1
 # every item is walled and tagged (kind, targets, exchange bytes).
 fn = as_mesh_fused_fn(list(circ.ops), n, mesh, backend="xla",
                       per_item=True)
-shape = state_shape(1 << n, ndev)
-lanes = shape[1]
+lanes = state_shape(1 << n, ndev)[1]
+shape = amps_shape(1 << n, ndev)
 x = (0b1011 << (n - 8)) | 0b1101
-re = jax.device_put(jnp.zeros(shape, jnp.float32).at[x // lanes, x % lanes]
-                    .set(1.0), sh)
-im = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
+amps = jax.device_put(jnp.zeros(shape, jnp.float32)
+                      .at[x // lanes, x % lanes].set(1.0), sh)
 sw = reporting.stopwatch()
-re, im = fn(re, im)
-jax.block_until_ready((re, im))
+amps = fn(amps)
+jax.block_until_ready(amps)
 compile_plus_run = sw.seconds
 timeline = os.environ.get("QUEST_TIMELINE") == "1"
 if timeline:
@@ -219,12 +217,11 @@ if timeline:
     # per-item XLA compiles with execution, which would swamp the
     # device-time attribution the timeline is for
     metrics.start_timeline()
-re2 = jax.device_put(jnp.zeros(shape, jnp.float32)
-                     .at[x // lanes, x % lanes].set(1.0), sh)
-im2 = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
+amps2 = jax.device_put(jnp.zeros(shape, jnp.float32)
+                       .at[x // lanes, x % lanes].set(1.0), sh)
 sw = reporting.stopwatch()
-re, im = fn(re2, im2)
-jax.block_until_ready((re, im))
+amps = fn(amps2)
+jax.block_until_ready(amps)
 warm_run = sw.seconds
 timeline_summary = None
 if timeline:
@@ -251,8 +248,8 @@ err = 0.0
 for k in (0, 1, 5, (1 << n) - 1, (1 << (n - 1)) + 3):
     expect = norm * complex(math.cos(2 * math.pi * x * k / (1 << n)),
                             math.sin(2 * math.pi * x * k / (1 << n)))
-    got = complex(float(re[k // lanes, k % lanes]),
-                  float(im[k // lanes, k % lanes]))
+    got = complex(float(amps[k // lanes, k % lanes]),
+                  float(amps[k // lanes, lanes + k % lanes]))
     err = max(err, abs(got - expect))
 
 # relayout-plan comm accounting at THIS chunk size: per-swap volumes
@@ -265,8 +262,8 @@ plan = schedule_mesh(list(circ.ops), n, dev_bits, lane_bits)
 swaps = []
 for step in plan:
     if step[0] == "relayout":
-        # fused multi-bit relayout: exact sub-block accounting (both
-        # arrays ride one stacked payload); average bytes per device
+        # fused multi-bit relayout: exact sub-block accounting (each
+        # interleaved sub-block carries re+im); average bytes per device
         elems = relayout_comm_elems(step[1], n, dev_bits)
         swaps.append({{"perm": list(step[1]), "kind": "fused-relayout",
                        "bytes_per_device": elems * 4 // ndev}})
@@ -289,7 +286,7 @@ n_segs = sum(1 for s in plan if s[0] == "seg")
 print("RESULT " + json.dumps({{
     "qubits": n, "devices": ndev, "gates": circ.num_gates,
     "path": "fused-mesh PLAN EXECUTED: relabeling segments (XLA "
-            "backend) + planned bitswap_chunk relayouts performed "
+            "backend) + planned bitswap_amps relayouts performed "
             "under shard_map",
     "plan_executed": True,
     "plan_segments": n_segs,

@@ -32,7 +32,7 @@ def main():
     import jax.numpy as jnp
 
     from quest_tpu import models
-    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.ops.lattice import amps_shape
     from quest_tpu.scheduler import schedule_segments_best
 
     dev = jax.devices()[0]
@@ -48,17 +48,16 @@ def main():
     circ = models.random_circuit(n, depth=DEPTH, seed=77)
     n_passes = len(schedule_segments_best(list(circ.ops), n))
     fn = circ.compile(mesh=None, donate=True)
-    shape = state_shape(1 << n)
+    shape = amps_shape(1 << n)
 
-    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
-    im = jnp.zeros(shape, jnp.float32)
+    amps = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
     t0 = reporting.stopwatch()
-    re, im = fn(re, im)
-    _ = float(re[0, 0])
+    amps = fn(amps)
+    _ = float(amps[0, 0])
     compile_s = t0.seconds
     t0 = reporting.stopwatch()
-    re, im = fn(re, im)
-    _ = float(re[0, 0])
+    amps = fn(amps)
+    _ = float(amps[0, 0])
     run_s = t0.seconds
 
     # Pod estimate: per chip the pass traffic is chunk read+write; with

@@ -98,6 +98,30 @@ def bench_gate_smoke(summary) -> None:
         print(err[-1500:])
 
 
+def roofline_attr_smoke(summary) -> None:
+    """Tier-2 smoke: tools/roofline_attr.py --smoke — captures a small
+    observed run and pins the timeline's per-item one-sweep byte
+    accounting (stream_bytes) against the run ledger's
+    exec.stream_bytes, then renders the attribution table.  A layout
+    or accounting change that desynchronises "where does the roofline
+    gap live" from the ledger fails the recording round here."""
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "roofline_attr.py"), "--smoke"],
+            capture_output=True, text=True, cwd=REPO, timeout=900)
+        ok, out, err = r.returncode == 0, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        ok, out, err = False, "", f"TIMEOUT after {e.timeout}s"
+    secs = time.time() - t0
+    summary.append(("roofline_attr", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'roofline_attr':22s} {secs:7.1f}s")
+    if not ok:
+        print(out[-1500:])
+        print(err[-1500:])
+
+
 def main():
     rnd = sys.argv[1] if len(sys.argv) > 1 else "2"
     summary = []
@@ -125,6 +149,7 @@ def main():
             print(out[-1500:])
             print(err[-1500:])
     bench_gate_smoke(summary)
+    roofline_attr_smoke(summary)
     chaos_drill_smoke(summary, rnd)
     n_fail = sum(1 for _, ok, _ in summary if not ok)
     print(f"{len(summary)} recorders, {n_fail} failed")

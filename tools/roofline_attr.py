@@ -1,0 +1,172 @@
+"""Per-item achieved-GB/s attribution over a timeline capture.
+
+Answers "where does the remaining roofline gap live" from ONE artifact:
+each walled item of a ``QUEST_TIMELINE=1`` capture carries the SAME
+byte accounting the run ledger records (``stream_bytes`` for fused/XLA
+segment sweeps — the one-sweep read+write of the interleaved state —
+and ``exchange_bytes`` for relayout collectives, both priced by
+``mesh_exec.item_timeline_meta``), so bytes / walled-duration is the
+item's achieved bandwidth and its distance to the spec roofline is
+attributable per item, per kind, per plan position.
+
+Usage::
+
+    python tools/roofline_attr.py timeline.json [--bw GBPS] [-k N]
+    python tools/roofline_attr.py --smoke
+
+``--bw`` is the spec bandwidth the fractions are computed against
+(GB/s; default 819 — v5e).  ``--smoke`` is the tier-2 self-check
+``tools/record_all.py`` runs: it captures a small observed run, feeds
+the capture through the attribution, and FAILS unless every segment
+item carries ``stream_bytes`` and their sum equals the run ledger's
+``exec.stream_bytes`` — the timeline/ledger one-sweep equality pin,
+as a smoke.
+
+Exit status: 0 clean, 1 smoke-pin violation, 2 usage/unreadable file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import defaultdict
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def _item_bytes(e: dict) -> int:
+    args = e.get("args", {})
+    return int(args.get("stream_bytes", 0)) \
+        + int(args.get("exchange_bytes", 0))
+
+
+def attribute(events: list[dict], bw_gbps: float = 819.0,
+              top_k: int = 10) -> str:
+    """Per-kind and top-k per-item achieved-GB/s table."""
+    by_kind: dict = defaultdict(lambda: {"count": 0, "us": 0.0,
+                                         "bytes": 0})
+    for e in events:
+        k = by_kind[e.get("name", "?")]
+        k["count"] += 1
+        k["us"] += float(e.get("dur", 0.0))
+        k["bytes"] += _item_bytes(e)
+    total_us = sum(k["us"] for k in by_kind.values())
+    total_bytes = sum(k["bytes"] for k in by_kind.values())
+    lines = [f"{len(events)} items, {total_us / 1e6:.3f} s walled, "
+             f"{total_bytes / 1e9:.3f} GB priced, roofline "
+             f"{bw_gbps:g} GB/s"]
+    lines.append(f"{'kind':<14}{'count':>7}{'total ms':>12}{'GB':>9}"
+                 f"{'GB/s':>9}{'roofline':>10}")
+    for name, k in sorted(by_kind.items(), key=lambda kv: -kv[1]["us"]):
+        gbps = (k["bytes"] / (k["us"] / 1e6) / 1e9) if k["us"] else 0.0
+        lines.append(
+            f"{name:<14}{k['count']:>7}{k['us'] / 1e3:>12.2f}"
+            f"{k['bytes'] / 1e9:>9.2f}{gbps:>9.1f}"
+            f"{gbps / bw_gbps:>10.1%}")
+    priced = [e for e in events if _item_bytes(e) and e.get("dur")]
+    # slowest first: the items where the remaining gap lives
+    slowest = sorted(
+        priced, key=lambda e: _item_bytes(e) / float(e["dur"]))[:top_k]
+    lines.append(f"bottom {len(slowest)} items by achieved GB/s:")
+    for e in slowest:
+        args = e.get("args", {})
+        gbps = _item_bytes(e) / (float(e["dur"]) / 1e6) / 1e9
+        tags = ", ".join(f"{k}={args[k]}" for k in
+                         ("index", "ops", "targets", "high_bits",
+                          "comm_class") if k in args)
+        lines.append(f"  {gbps:>8.1f} GB/s ({gbps / bw_gbps:>6.1%})  "
+                     f"{float(e['dur']) / 1e3:>8.2f} ms  "
+                     f"{e.get('name', '?'):<12} {tags}")
+    return "\n".join(lines)
+
+
+def smoke() -> int:
+    """Self-contained tier-2 pin: capture a small observed run and
+    verify the timeline's one-sweep byte accounting against the run
+    ledger, then exercise the attribution table itself."""
+    import tempfile
+
+    sys.path.insert(0, REPO)
+    import quest_tpu as qt
+    from quest_tpu import metrics, models
+    from quest_tpu.circuit import Circuit  # noqa: F401 (import check)
+
+    env = qt.create_env(num_devices=1)
+    n = 10
+    circ = models.random_circuit(n, depth=2, seed=9)
+    q = qt.create_qureg(n, env)
+    metrics.start_timeline()
+    circ.run(q)
+    led = metrics.get_run_ledger() or {}
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "timeline.json")
+        metrics.stop_timeline(path)
+        events = load_events(path)
+        print(attribute(events, bw_gbps=819.0))
+    seg_kinds = ("pallas-pass", "xla-segment", "stream", "xla-stream")
+    segs = [e for e in events if e.get("name") in seg_kinds]
+    if not segs:
+        print("roofline-attr smoke: no segment items captured")
+        return 1
+    tl_stream = sum(int(e.get("args", {}).get("stream_bytes", 0))
+                    for e in segs)
+    ledger_stream = int((led.get("counters") or {})
+                        .get("exec.stream_bytes", 0))
+    if tl_stream != ledger_stream:
+        print(f"roofline-attr smoke: timeline stream_bytes {tl_stream} "
+              f"!= ledger exec.stream_bytes {ledger_stream} — the "
+              "one-sweep accounting diverged")
+        return 1
+    missing = [e for e in segs
+               if e.get("name") in ("pallas-pass", "xla-segment")
+               and not e.get("args", {}).get("stream_bytes")]
+    if missing:
+        print(f"roofline-attr smoke: {len(missing)} segment item(s) "
+              "carry no stream_bytes attribution")
+        return 1
+    print(f"roofline-attr smoke OK: {len(segs)} segment items, "
+          f"{tl_stream} bytes == ledger")
+    return 0
+
+
+def main(argv) -> int:
+    args = list(argv)
+    if "--smoke" in args:
+        return smoke()
+    bw = 819.0
+    top_k = 10
+    for flag, cast in (("--bw", float), ("-k", int)):
+        if flag in args:
+            i = args.index(flag)
+            try:
+                val = cast(args[i + 1])
+            except (IndexError, ValueError):
+                print(__doc__)
+                return 2
+            if flag == "--bw":
+                bw = val
+            else:
+                top_k = val
+            del args[i:i + 2]
+    if len(args) != 1:
+        print(__doc__)
+        return 2
+    try:
+        events = load_events(args[0])
+    except (OSError, ValueError, KeyError) as e:
+        print(f"roofline-attr: {args[0]}: {e}")
+        return 2
+    print(attribute(events, bw_gbps=bw, top_k=top_k))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -29,7 +29,7 @@ def measure(n: int):
     import jax.numpy as jnp
 
     from quest_tpu import models
-    from quest_tpu.ops.lattice import state_shape
+    from quest_tpu.ops.lattice import amps_shape
     from quest_tpu.scheduler import schedule_segments_best
 
     circ = models.random_circuit(n, depth=DEPTH, seed=123)
@@ -40,20 +40,18 @@ def measure(n: int):
     # Keep each timed call ~1s: more inner reps for small, fast states.
     inner = max(4, min(256, (1 << 30) // (1 << n) * 2))
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def run(re, im):
-        return jax.lax.fori_loop(0, inner, lambda _, s: apply(*s), (re, im))
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(a):
+        return jax.lax.fori_loop(0, inner, lambda _, s: apply(s), a)
 
-    shape = state_shape(1 << n)
-    re = jnp.zeros(shape, jnp.float32).at[0, 0].set(1.0)
-    im = jnp.zeros(shape, jnp.float32)
-    re, im = run(re, im)
-    _ = float(re[0, 0])
+    amps = jnp.zeros(amps_shape(1 << n), jnp.float32).at[0, 0].set(1.0)
+    amps = run(amps)
+    _ = float(amps[0, 0])
     times = []
     for _r in range(REPS):
         t0 = reporting.stopwatch()
-        re, im = run(re, im)
-        _ = float(re[0, 0])
+        amps = run(amps)
+        _ = float(amps[0, 0])
         times.append((t0.seconds) / inner)
     best = min(times)
     state_gb = 2 * (1 << n) * 4 / 1e9
