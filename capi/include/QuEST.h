@@ -154,6 +154,25 @@ void getLastErrorString(QuESTEnv env, char *str, int maxLen);
  * dumps the flight recorder and surfaces as QUEST_ERROR_TIMEOUT. */
 void setCollectiveWatchdog(QuESTEnv env, int enabled, double gbps,
                            double slack, double minSeconds);
+/* quest_tpu extension: the in-run integrity layer (silent-data-
+ * corruption defense, quest_tpu.resilience).  When enabled, runs
+ * execute on the observed per-item path with (1) CHECKSUMMED
+ * COLLECTIVES — every relayout/bitswap ppermute round carries a
+ * folded payload checksum verified on receipt; a mismatch surfaces
+ * as QUEST_ERROR_CORRUPTION naming the round and sender/receiver
+ * pair, striking both devices in the mesh-health registry — and
+ * (2) INVARIANT DRIFT BUDGETS — per-item norm/trace drift priced
+ * against an fp-model budget from gate count, precision and device
+ * count (QUEST_DRIFT_OP_FACTOR / QUEST_DRIFT_DEV_FACTOR), flagging
+ * suspected SDC long before anything goes NaN.  With heal nonzero
+ * (the default while armed) a detected corruption on a checkpointed
+ * run SELF-HEALS: bounded rollback to the last good slot
+ * (maxRollbacks; non-positive keeps the env/default,
+ * QUEST_INTEGRITY_ROLLBACKS, default 2).  Env knob for unmodified
+ * drivers: QUEST_INTEGRITY=1 (+ QUEST_INTEGRITY_HEAL=0 to opt out
+ * of healing). */
+void setIntegrityChecks(QuESTEnv env, int enabled, int heal,
+                        int maxRollbacks);
 void seedQuESTDefault(void);
 void seedQuEST(unsigned long int *seedArray, int numSeeds);
 

@@ -445,6 +445,12 @@ void setCollectiveWatchdog(QuESTEnv env, int enabled, double gbps,
           minSeconds);
 }
 
+void setIntegrityChecks(QuESTEnv env, int enabled, int heal,
+                        int maxRollbacks) {
+    (void)env;
+    BVOID("setIntegrityChecks", "(iii)", enabled, heal, maxRollbacks);
+}
+
 void seedQuESTDefault(void) { BVOID("seedQuESTDefault", "()"); }
 
 void seedQuEST(unsigned long int *seedArray, int numSeeds) {

@@ -127,6 +127,9 @@ from .resilience import (
     resume_state,
     set_checkpoint_policy,
     set_watchdog,
+    set_integrity,
+    heal_run,
+    verify_checkpoint,
     mesh_health,
     clear_mesh_health,
 )

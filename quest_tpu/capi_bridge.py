@@ -289,6 +289,21 @@ def setCollectiveWatchdog(enabled: int, gbps: float, slack: float,
     return 0
 
 
+def setIntegrityChecks(enabled: int, heal: int, max_rollbacks: int) -> int:
+    """Arm/disarm the in-run integrity layer from C (quest_tpu.
+    resilience ``set_integrity``): checksummed collectives + invariant
+    drift budgets, with self-healing rollback on checkpointed runs
+    when ``heal`` is nonzero.  A non-positive ``max_rollbacks`` CLEARS
+    any prior override back to the env/default
+    (QUEST_INTEGRITY_ROLLBACKS), the ``setCollectiveWatchdog``
+    contract."""
+    from . import resilience
+
+    resilience.set_integrity(bool(enabled), heal=bool(heal),
+                             rollbacks=max_rollbacks)
+    return 0
+
+
 def seedQuESTDefault() -> int:
     _qt.seed_quest_default()
     return 0

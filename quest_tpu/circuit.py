@@ -54,7 +54,8 @@ def measure_state_weight(amps, is_density: bool, num_qubits: int,
 
 def check_state_health(amps, *, is_density: bool, num_qubits: int,
                        mesh, before: float | None, n_ops: int,
-                       structural: bool = True):
+                       structural: bool = True,
+                       drift_bound: float | None = None):
     """The ONE health check both probe seams share (``QUEST_HEALTH_EVERY``
     — circuit._HealthProbe per plan item, register._health_probe per
     flushed run), so bounds, checks, and reason strings can never
@@ -67,6 +68,11 @@ def check_state_health(amps, *, is_density: bool, num_qubits: int,
     NaN/Inf scan — for boundaries where the density U (x) U* pair may
     be half-applied or the mesh layout non-canonical, where trace and
     hermiticity are legitimately "wrong".
+
+    ``drift_bound`` overrides the RELATIVE norm/trace drift allowance
+    (the integrity layer passes ``resilience.drift_budget`` — an
+    fp-model budget priced from gate count, dtype and device count);
+    the NaN scan and the hermiticity bound are unaffected.
 
     Returns ``(reason, after)``: ``reason`` is None when healthy;
     ``after`` is the measured norm/trace when computed (the caller's
@@ -85,11 +91,14 @@ def check_state_health(amps, *, is_density: bool, num_qubits: int,
     after = measure_state_weight(amps, is_density, num_qubits, mesh)
     if before is not None:
         drift = abs(after - before)
-        lim = bound * max(abs(before), 1.0)
+        rel = bound if drift_bound is None else drift_bound
+        lim = rel * max(abs(before), 1.0)
         if not _math.isfinite(after) or drift > lim:
             what = "trace" if is_density else "norm"
-            return (f"{what} drift {drift:.3e} exceeds bound {lim:.3e} "
-                    f"({before!r} -> {after!r})"), after
+            return (f"{what} drift {drift:.3e} exceeds "
+                    + ("bound" if drift_bound is None else
+                       "the fp-model drift budget")
+                    + f" {lim:.3e} ({before!r} -> {after!r})"), after
     if is_density:
         # max |rho - rho^H| over the global state (lattice.dm_herm_drift
         # — computed on the sharded global array, never replicated
@@ -871,8 +880,15 @@ class Circuit:
         the already-applied items and replays recorded measurement
         outcomes; ``key`` is the run's PRNG key, recorded into every
         snapshot so the resumed run draws identical outcomes."""
+        from . import resilience
+
         use_pallas = pallas is True or pallas == "auto"
-        memo_key = ("observed", qureg.mesh, use_pallas, tuple(self.ops))
+        # the integrity flag is part of the identity: an armed layer
+        # compiles comm items as CHECKED (amps, fault) programs, which
+        # a later unarmed run must not reuse (and vice versa)
+        integ = resilience.integrity_enabled()
+        memo_key = ("observed", qureg.mesh, use_pallas, integ,
+                    tuple(self.ops))
         ent = self._compiled.get(memo_key)
         if ent is None:
             probe = _HealthProbe(self, qureg.mesh)
@@ -896,7 +912,7 @@ class Circuit:
         if resume:
             # the restored slot is the run's current last-good snapshot
             probe._last_snapshot = resume.get("slot")
-        if metrics.health_every() or ckpt is not None:
+        if metrics.health_every() or ckpt is not None or integ:
             probe.baseline(qureg.amps)
         return fn
 
@@ -970,7 +986,8 @@ class Circuit:
             observed = (metrics.timeline_active()
                         or metrics.health_every() > 0
                         or ckpt is not None or _resume is not None
-                        or resilience.watchdog_enabled())
+                        or resilience.watchdog_enabled()
+                        or resilience.integrity_enabled())
             if observed:
                 metrics.annotate_run("observed", True)
             try:
@@ -993,16 +1010,33 @@ class Circuit:
                         fn = self.compile(mesh=qureg.mesh, donate=False,
                                           pallas=pallas)
                 self._record_run_stats(qureg, pallas)
-                with metrics.span("execute"):
-                    if self._has_nonunitary:
-                        amps, outcomes = fn(qureg.amps, key)
-                        qureg._set_state(amps)
-                        # collapse-only circuits consume no randomness
-                        # and yield no outcomes: keep the
-                        # mutating-facade contract (return qureg)
-                        return outcomes if draws else qureg
-                    qureg._set_state(fn(qureg.amps))
-                    return qureg
+                try:
+                    with metrics.span("execute"):
+                        if self._has_nonunitary:
+                            amps, outcomes = fn(qureg.amps, key)
+                            qureg._set_state(amps)
+                            # collapse-only circuits consume no
+                            # randomness and yield no outcomes: keep
+                            # the mutating-facade contract (return
+                            # qureg)
+                            return outcomes if draws else qureg
+                        qureg._set_state(fn(qureg.amps))
+                        return qureg
+                except _v.QuESTCorruptionError as e:
+                    # self-healing (the integrity layer): a DETECTED
+                    # corruption on a checkpointed, integrity-armed run
+                    # rolls back to the last good slot and replays
+                    # instead of dying — bounded, counted, and refused
+                    # when the mesh itself is degraded (see
+                    # resilience.self_heal; quarantine via heal_run).
+                    # A _resume run never re-heals here: its failures
+                    # belong to the healer's own bounded loop.
+                    if (ckpt is None or _resume is not None
+                            or not resilience.integrity_enabled()
+                            or not resilience.integrity_heal_enabled()):
+                        raise
+                    return resilience.self_heal(
+                        self, qureg, ckpt["directory"], pallas, e)
             finally:
                 metrics.annotate_run("resilience",
                                      resilience.run_counters())
@@ -1153,6 +1187,10 @@ class _HealthProbe:
             "ops_applied": self._ops_done,
             "layout": (list(self._layout) if self._layout is not None
                        else None),
+            # a resumed run inherits device quarantine instead of
+            # re-learning it strike by strike (restored by
+            # resilience.resume_run; None while the registry is empty)
+            "mesh_health": resilience.mesh_health_snapshot(),
         }
         path = resilience.snapshot(
             amps, num_qubits=self._c.num_qubits,
@@ -1163,16 +1201,21 @@ class _HealthProbe:
             self._last_snapshot = path
 
     def __call__(self, amps, meta: dict) -> None:
+        from . import resilience
+
         k = metrics.health_every()
         ck = self._ckpt
-        if not k and ck is None:
+        integ = resilience.integrity_enabled()
+        if not k and ck is None and not integ:
             return
         self._count += 1
         if "ops_done" in meta:
             self._ops_done = meta.get("ops_done")
             self._layout = meta.get("layout")
         self._ops_since += int(meta.get("ops", 1))
-        probe_due = bool(k) and self._count % k == 0
+        # the integrity layer probes EVERY item: the drift budget's
+        # whole point is per-item attribution of a suspected SDC
+        probe_due = (bool(k) and self._count % k == 0) or integ
         ckpt_due = ck is not None and self._count % ck["every"] == 0
         if not (probe_due or ckpt_due):
             return
@@ -1183,11 +1226,17 @@ class _HealthProbe:
         # probe at ANY item boundary.
         structural = (not self._c.is_density) \
             or bool(meta.get("last_in_run"))
+        budget = None
+        if integ and structural:
+            ndev = (1 if self._mesh is None
+                    else int(self._mesh.devices.size))
+            budget = resilience.drift_budget(self._ops_since,
+                                             amps.dtype, ndev)
         reason, val = check_state_health(
             amps, is_density=self._c.is_density,
             num_qubits=self._c.num_qubits, mesh=self._mesh,
             before=self._ref, n_ops=self._ops_since,
-            structural=structural)
+            structural=structural, drift_bound=budget)
         if reason is None:
             if structural:
                 self._ref = val if val is not None else self._ref
@@ -1197,15 +1246,25 @@ class _HealthProbe:
             if ckpt_due:
                 self._snapshot(amps)
             return
+        if integ and "drift budget" in reason:
+            # a budget breach is SUSPECTED silent data corruption:
+            # counted (resilience.sdc_detected), attributed to this
+            # item, and — on a checkpointed run — self-healed by
+            # Circuit.run's rollback handler
+            reason = resilience.sdc_suspected(reason, meta)
+        # integrity mode probes every item, so the corruption window is
+        # ONE item regardless of any coarser QUEST_HEALTH_EVERY cadence
         offending = {"item": dict(meta),
-                     "window_items": k or ck["every"],
+                     "window_items": (1 if integ
+                                      else k or ck["every"]),
                      "last_healthy": self._last_healthy}
         path = metrics.flight_dump(f"health probe tripped: {reason}",
                                    offending=offending)
-        from . import resilience
-
+        label = ("QUEST_HEALTH_EVERY probe" if k else
+                 "integrity probe" if integ else
+                 "checkpoint health probe")
         msg = (
-            f"QUEST_HEALTH_EVERY probe tripped after plan item "
+            f"{label} tripped after plan item "
             f"{meta.get('index')} ({meta.get('kind')}): {reason}"
             + (f"; flight recorder dumped to {path}" if path else
                " (flight-recorder dump failed; see metrics.sink_errors)"))

@@ -253,6 +253,16 @@ def run_ledger(label: str = "run"):
             _finalize(rec, wall)
 
 
+def run_depth() -> int:
+    """Nesting depth of this thread's active run-ledger scopes (0
+    outside any run).  Lets per-run baseline anchors
+    (``resilience.begin_run``) distinguish the OUTERMOST ``Circuit.run``
+    — whose record is the one actually emitted — from nested re-entries
+    like a self-healing rollback's resume."""
+    with _lock:
+        return len(_stack())
+
+
 #: Warning kinds already emitted once (a full disk must not spam one
 #: line per run; counters keep the exact counts).
 _SINK_WARNED: set = set()

@@ -83,8 +83,74 @@ def _isolate_bit(x, bit: int, lane_bits: int):
     return v, 2
 
 
+def _fold_token(x):
+    """Folded checksum of one collective payload: XOR-reduce over the
+    payload's raw bit pattern (bitcast to the same-width unsigned int),
+    returned as a shape-(1,) array so it can ride the SAME ppermute
+    route as the payload.  Exhaustive for the wire-corruption model —
+    a ppermute is normally bit-exact, so ANY flipped bit (and any
+    rescale, which rewrites mantissas) changes the fold.  One read of
+    a payload that is already streaming: cheap enough for an opt-in
+    integrity mode."""
+    ut = jnp.uint32 if jnp.dtype(x.dtype).itemsize == 4 else jnp.uint64
+    bits = lax.bitcast_convert_type(x, ut).reshape(-1)
+    return lax.reduce(bits, jnp.zeros((), ut), lax.bitwise_xor,
+                      (0,)).reshape(1)
+
+
+def _corrupt_payload(payload, fault, active):
+    """Deterministically corrupt one collective payload IN FLIGHT —
+    after the send-side token folded, before the ppermute — which is
+    exactly where a mercurial link/core would hit and exactly what the
+    receive-side verification must catch.
+
+    ``fault`` is the traced int32[2] SDC vector
+    (``resilience.sdc_params``): code 1 flips storage bit ``param`` of
+    the payload's first element, code 2 scales every element by
+    ``1 + param * 1e-6``; code 0 (and an inactive gate) is the
+    identity.  ``active`` (traced bool) confines the corruption to the
+    scripted sender device and round."""
+    ut = (jnp.uint32 if jnp.dtype(payload.dtype).itemsize == 4
+          else jnp.uint64)
+    flat = payload.reshape(-1)
+    b0 = lax.bitcast_convert_type(flat[0], ut)
+    # shift reduced modulo the element width: sdc_params allows bits
+    # 0..63 without knowing the run's dtype, and a shift past an f32
+    # element's 32 bits would silently be a NO-OP injection (XLA yields
+    # 0), reporting a fault as injected that never corrupted anything
+    nbits = jnp.asarray(8 * jnp.dtype(payload.dtype).itemsize, ut)
+    flip = lax.bitcast_convert_type(
+        b0 ^ (jnp.ones((), ut) << (fault[1].astype(ut) % nbits)),
+        payload.dtype)
+    bitflipped = flat.at[0].set(flip).reshape(payload.shape)
+    scaled = payload * (jnp.asarray(1.0, payload.dtype)
+                        + fault[1].astype(payload.dtype)
+                        * jnp.asarray(1e-6, payload.dtype))
+    out = jnp.where(fault[0] == 1, bitflipped,
+                    jnp.where(fault[0] == 2, scaled, payload))
+    return jnp.where(active, out, payload)
+
+
+def _checked_ppermute(payload, axis, pairs, dev, fault, armed):
+    """One verified collective round: fold the send-side token, apply
+    any scripted in-flight corruption (``armed`` = this round is the
+    scripted one; the drill corrupts sender device 0), route payload
+    and token through the SAME pairs, and flag a receive-side refold
+    mismatch.  Returns ``(received, flag)`` with ``flag`` shape (1,)
+    int32."""
+    tok = _fold_token(payload)
+    if armed:
+        payload = _corrupt_payload(payload, fault,
+                                   (fault[0] > 0) & (dev == 0))
+    recv = lax.ppermute(payload, axis, pairs)
+    tok_recv = lax.ppermute(tok, axis, pairs)
+    flag = (_fold_token(recv) != tok_recv).astype(jnp.int32)
+    return recv, flag
+
+
 def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
-                 chunk_bits: int, lane_bits: int):
+                 chunk_bits: int, lane_bits: int, check: bool = False,
+                 fault=None):
     """Return the interleaved chunk after globally swapping amplitude
     index bits ``a``/``b``: new[i] = old[i with bits a, b swapped].
 
@@ -98,6 +164,12 @@ def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
       the bit's stride — the amortised half-exchange;
     * both device bits: whole-chunk ppermute, but only for devices
       whose two coordinate bits differ.
+
+    ``check=True`` (the integrity layer, quest_tpu.resilience ISSUE-9)
+    verifies the exchange with a folded payload checksum riding the
+    same route (:func:`_checked_ppermute`) and returns
+    ``(amps, flags)`` with ``flags`` a per-device (1, 1) int32
+    mismatch indicator; ``fault`` is the traced SDC injection vector.
     """
     if a > b:
         a, b = b, a
@@ -108,7 +180,8 @@ def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
         sa, sb = _lift_bit(a, lane_bits), _lift_bit(b, lane_bits)
         mask = (1 << sa) | (1 << sb)
         eq = lat.bit(sa) == lat.bit(sb)
-        return jnp.where(eq, amps, lat.xor_shift(amps, mask))
+        out = jnp.where(eq, amps, lat.xor_shift(amps, mask))
+        return (out, jnp.zeros((1, 1), jnp.int32)) if check else out
     if a >= chunk_bits:
         # device <-> device: conditional full-chunk exchange
         o1, o2 = a - chunk_bits, b - chunk_bits
@@ -118,7 +191,11 @@ def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
             if ((p >> o1) & 1) != ((p >> o2) & 1) else (p, p)
             for p in range(ndev)
         ]
-        return lax.ppermute(amps, axis, pairs)
+        if not check:
+            return lax.ppermute(amps, axis, pairs)
+        recv, flag = _checked_ppermute(amps, axis, pairs, dev, fault,
+                                       armed=True)
+        return recv, flag.reshape(1, 1)
     # device <-> local: half-chunk exchange, re+im in one payload
     off = b - chunk_bits
     stride = 1 << off
@@ -127,10 +204,16 @@ def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
     h0 = lax.index_in_dim(v, 0, ax2, keepdims=False)
     h1 = lax.index_in_dim(v, 1, ax2, keepdims=False)
     send = jnp.where(w == 0, h1, h0)
-    recv = lax.ppermute(send, axis, [(p, p ^ stride) for p in range(ndev)])
+    pairs = [(p, p ^ stride) for p in range(ndev)]
+    if check:
+        recv, flag = _checked_ppermute(send, axis, pairs, dev, fault,
+                                       armed=True)
+    else:
+        recv = lax.ppermute(send, axis, pairs)
     new0 = jnp.where(w == 0, h0, recv)
     new1 = jnp.where(w == 0, recv, h1)
-    return jnp.stack([new0, new1], axis=ax2).reshape(amps.shape)
+    out = jnp.stack([new0, new1], axis=ax2).reshape(amps.shape)
+    return (out, flag.reshape(1, 1)) if check else out
 
 
 # ---------------------------------------------------------------------------
@@ -275,10 +358,20 @@ def _merge_blocks(nb, A, chunk_bits: int, shape):
 
 
 def apply_relayout(amps, perm, dev, axis: str, ndev: int,
-                   chunk_bits: int, lane_bits: int):
+                   chunk_bits: int, lane_bits: int, check: bool = False,
+                   fault=None):
     """Execute a fused multi-bit relayout over the sharded interleaved
     array: ``new[i] = old[j]`` with bit b of j = bit ``perm[b]`` of i
     (amplitude-index bits).
+
+    ``check=True`` verifies every ppermute round with a folded payload
+    checksum (:func:`_checked_ppermute` — the integrity layer) and
+    returns ``(amps, flags)``, ``flags`` a per-device (1, R) int32
+    array over the R communicating rounds in ascending-``w`` order —
+    the SAME order :func:`exchange_round_senders` reports its static
+    sender maps in, so a flagged (device, round) pair attributes to an
+    exact sender.  A scripted in-flight fault corrupts sender device
+    0's payload in the first communicating round.
 
     Statically lifts ``perm`` to the storage index (component bit a
     fixed point), decomposes ``perm = R . E`` (``relayout_decompose``)
@@ -309,9 +402,17 @@ def apply_relayout(amps, perm, dev, axis: str, ndev: int,
     if q == 0:
         z = amps
         dsts = dst_rounds.get(0)
+        flags = jnp.zeros((1, 1), jnp.int32)
         if dsts is not None:  # pure device relabel (+ local permute)
-            z = lax.ppermute(z, axis, list(enumerate(dsts)))
-        return _permute_local_bits(z, lperm, cb_s)
+            if check:
+                z, flag = _checked_ppermute(z, axis,
+                                            list(enumerate(dsts)), dev,
+                                            fault, armed=True)
+                flags = flag.reshape(1, 1)
+            else:
+                z = lax.ppermute(z, axis, list(enumerate(dsts)))
+        out = _permute_local_bits(z, lperm, cb_s)
+        return (out, flags) if check else out
 
     D = [b - cb_s for b in B]
     blocks = _split_blocks(amps, A, cb_s)
@@ -324,6 +425,7 @@ def apply_relayout(amps, perm, dev, axis: str, ndev: int,
         eD = eD | (((dev >> D[i]) & 1) << i)
         dD = dD | (((dev >> (R[cb_s + D[i]] - cb_s)) & 1) << i)
     recv = []
+    flag_list = []
     for w in range(1 << q):
         sent = lax.dynamic_index_in_dim(blocks, eD ^ w, axis=0,
                                         keepdims=False)
@@ -331,14 +433,27 @@ def apply_relayout(amps, perm, dev, axis: str, ndev: int,
         if dsts is None:  # w == 0 under identity relabel: block stays
             recv.append(sent)
             continue
-        recv.append(lax.ppermute(sent, axis, list(enumerate(dsts))))
+        if check:
+            # only the FIRST communicating round is armed for a
+            # scripted in-flight corruption (one deterministic hit per
+            # item); every round is verified
+            r, flag = _checked_ppermute(sent, axis,
+                                        list(enumerate(dsts)), dev,
+                                        fault, armed=not flag_list)
+            recv.append(r)
+            flag_list.append(flag)
+        else:
+            recv.append(lax.ppermute(sent, axis, list(enumerate(dsts))))
     rb = jnp.stack(recv)
     nb = jnp.stack([
         lax.dynamic_index_in_dim(rb, u ^ dD, axis=0, keepdims=False)
         for u in range(1 << q)
     ])
     z = _merge_blocks(nb, A, cb_s, amps.shape)
-    return _permute_local_bits(z, lperm, cb_s)
+    out = _permute_local_bits(z, lperm, cb_s)
+    if check:
+        return out, jnp.concatenate(flag_list).reshape(1, -1)
+    return out
 
 
 def apply_layout_perm(amps, perm, mesh):
@@ -374,6 +489,89 @@ def apply_layout_perm(amps, perm, mesh):
                           in_specs=(P(axis),),
                           out_specs=P(axis))
     return jax.jit(fn)(amps)
+
+
+def exchange_round_senders(item, num_vec_bits: int, dev_bits: int):
+    """STATIC sender maps of one plan item's communicating ppermute
+    rounds: ``senders[r][d]`` = the device whose round-``r`` payload
+    device ``d`` receives (``d`` itself where the round routes a
+    device's block back to itself).  Empty for items that move nothing
+    over the interconnect.
+
+    Round order matches the checked executors exactly — one round for
+    a half/full bitswap, ascending-``w`` over ``_relayout_dev_maps``'s
+    communicating rounds for a fused relayout — so a verification flag
+    at (device, round) attributes to one exact sender/receiver pair
+    (``resilience.wire_corruption``)."""
+    chunk_bits = num_vec_bits - dev_bits
+    ndev = 1 << dev_bits
+    cls = _swap_comm_class(item, chunk_bits)
+    if cls in (None, "local"):
+        return []
+    if cls == "half":
+        a, b = sorted(item[1:])
+        stride = 1 << (b - chunk_bits)
+        return [[d ^ stride for d in range(ndev)]]
+    if cls == "full":
+        o1, o2 = (x - chunk_bits for x in sorted(item[1:]))
+        stride = (1 << o1) | (1 << o2)
+        return [[d ^ stride if ((d >> o1) & 1) != ((d >> o2) & 1)
+                 else d for d in range(ndev)]]
+    _q, dst_rounds = _relayout_dev_maps(item[1], num_vec_bits, dev_bits)
+    senders = []
+    for w in sorted(dst_rounds):
+        send_of = [0] * ndev
+        for e, d in enumerate(dst_rounds[w]):  # dst maps are bijective
+            send_of[d] = e
+        senders.append(send_of)
+    return senders
+
+
+class _CheckedFn:
+    """One integrity-checked per-item program (the checksummed-
+    collectives half of quest_tpu.resilience's integrity layer): wraps
+    the jitted ``(amps, fault) -> (amps, flags)`` shard_map program
+    together with its STATIC per-round sender maps
+    (:func:`exchange_round_senders`), so ``observe_item`` can verify
+    the flags host-side and attribute any mismatch to the exact
+    sender/receiver pair."""
+
+    __slots__ = ("fn", "senders")
+
+    def __init__(self, fn, senders):
+        self.fn = fn
+        self.senders = senders
+
+    def __call__(self, amps):
+        # plain-call fallback (e.g. a traced execution where host-side
+        # verification is meaningless anyway): run with a zero fault
+        # vector and discard the flags — integrity VERIFICATION lives
+        # on the observed path (observe_item), which calls .fn directly
+        out, _flags = self.fn(amps, jnp.zeros((2,), jnp.int32))
+        return out
+
+
+def _poison_state(amps, code: int, param: int):
+    """Deterministic state poisoning for the ``run_item`` SDC fault
+    kinds (``resilience.sdc_params`` — and the SILENT outcome of a
+    ``mesh_exchange`` corruption when no checksummed collectives are
+    armed): code 1 flips bit ``param`` of storage element (0, 0) — the
+    real part of amplitude 0 — code 2 scales the whole state by
+    ``1 + param * 1e-6``.  Models an HBM/compute corruption the
+    invariant drift budget must catch; applied AFTER the item
+    executed, upstream of the health hook."""
+    idx = (0,) * amps.ndim
+    if code == 1:
+        ut = (jnp.uint32 if jnp.dtype(amps.dtype).itemsize == 4
+              else jnp.uint64)
+        # modulo the element width, same rationale as _corrupt_payload
+        param = param % (8 * jnp.dtype(amps.dtype).itemsize)
+        bits = lax.bitcast_convert_type(amps[idx], ut)
+        v = lax.bitcast_convert_type(
+            bits ^ (jnp.ones((), ut) << jnp.asarray(param, ut)),
+            amps.dtype)
+        return amps.at[idx].set(v)
+    return amps * jnp.asarray(1.0 + param * 1e-6, amps.dtype)
 
 
 def item_timeline_meta(item, num_vec_bits: int, dev_bits: int,
@@ -427,7 +625,20 @@ def observe_item(f, amps, meta: dict, hook=None):
       additionally fires on items that move data over the interconnect
       (comm class half/full/relayout).  Both support the straggler
       kinds ``delay:<ms>`` (sleeps under the watchdog wall) and
-      ``stall`` (blocks until the armed watchdog deadline).
+      ``stall`` (blocks until the armed watchdog deadline), and the
+      SDC kinds ``bitflip:<bit>`` / ``scale:<ppm>``: on
+      ``mesh_exchange`` the corruption rides INSIDE the collective —
+      between the send-side checksum fold and the receive-side
+      verification — when the integrity layer is armed (and lands in
+      the state silently when it is not, which is the point); on
+      ``run_item`` it poisons the produced state, modelling HBM/compute
+      corruption for the drift-budget detector.
+    * **Checksummed collectives** — an ``f`` built as a
+      :class:`_CheckedFn` (integrity layer armed at plan-build time)
+      returns per-round verification flags; any receive-side mismatch
+      is attributed to its static sender/receiver pair and raised as a
+      typed ``QuESTCorruptionError`` via ``resilience.wire_corruption``,
+      striking both devices in the mesh-health registry.
     * **Collective watchdog** — when armed
       (``resilience.watchdog_enabled``), the item is walled with a
       deadline priced from its exchange bytes (the SAME
@@ -458,17 +669,24 @@ def observe_item(f, amps, meta: dict, hook=None):
         args["stream_bytes"] = stream_elems * itemsize
     wd_meta = dict(args, kind=kind, ndev=ndev)
     wall = resilience.watchdog_begin(wd_meta, exchange_bytes, ndev)
+    chk = f if isinstance(f, _CheckedFn) else None
     # everything after the wall is armed runs under the cancel guard: a
     # raising fault seam must not leak a live timer that would later
     # fire and overwrite the real failure's flight dump
     try:
         poison = None
         stalled = False
+        wire_sdc = None
+        state_sdc = None
         if resilience.fault_active():
             fired = []
             if meta.get("comm_class") in ("half", "full", "relayout"):
-                fired.append(resilience.fault_point("mesh_exchange"))
-            fired.append(resilience.fault_point("run_item"))
+                fx = resilience.fault_point("mesh_exchange")
+                fired.append(fx)
+                wire_sdc = resilience.sdc_params(fx)
+            fr = resilience.fault_point("run_item")
+            fired.append(fr)
+            state_sdc = resilience.sdc_params(fr)
             poison = "nan" if "nan" in fired else None
             stalled = "stall" in fired
         metrics.flight_record(kind, shape=list(amps.shape),
@@ -477,23 +695,52 @@ def observe_item(f, amps, meta: dict, hook=None):
             # a simulated hung collective: blocks until the armed
             # deadline, then raises the breach (never returns)
             resilience.watchdog_stall(wall, wd_meta)
+        if chk is not None:
+            fvec = jnp.asarray(wire_sdc or (0, 0), jnp.int32)
+            run = lambda a: chk.fn(a, fvec)  # noqa: E731
+        else:
+            run = f
+        flags = None
         if metrics.timeline_active():
             with metrics.timeline_span(kind, args=args):
-                amps = f(amps)
-                jax.block_until_ready(amps)
+                out = run(amps)
+                jax.block_until_ready(out)
         elif wall is not None:
-            amps = f(amps)
-            jax.block_until_ready(amps)
+            out = run(amps)
+            jax.block_until_ready(out)
         else:
-            amps = f(amps)
+            out = run(amps)
+        if chk is not None:
+            amps, flags = out
+        else:
+            amps = out
     except BaseException:
         if wall is not None:
             wall.cancel()
         raise
     resilience.watchdog_end(wall)
+    if flags is not None:
+        # receive-side verification: flags[d, r] = device d's round-r
+        # payload failed its checksum refold; attribute via the static
+        # sender maps and raise (strikes both devices)
+        fl = jax.device_get(flags)
+        bad = [(r, chk.senders[r][d], d)
+               for d in range(fl.shape[0])
+               for r in range(min(fl.shape[1], len(chk.senders)))
+               if fl[d, r]]
+        if bad:
+            resilience.wire_corruption(wd_meta, bad)
+    elif wire_sdc is not None:
+        # scripted wire corruption with NO checksummed collectives
+        # armed: the damage lands in the state SILENTLY — exactly the
+        # failure mode the integrity layer exists to catch (the chaos
+        # drill asserts both sides of this)
+        amps = _poison_state(amps, *wire_sdc)
     if poison == "nan":
         # storage element (0, 0) is the real part of amplitude 0
         amps = amps.at[(0,) * amps.ndim].set(float("nan"))
+    if state_sdc is not None:
+        amps = _poison_state(amps, *state_sdc)
     if hook is not None:
         hook(amps, dict(meta, exchange_bytes=exchange_bytes))
     return amps
@@ -723,6 +970,8 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
     if per_item:
         import functools
 
+        from .. import resilience
+
         # one jitted program per UNIQUE plan item: repeated relayouts
         # and structurally identical segments reuse the same compiled
         # function (jit caches per function identity, so a fresh
@@ -732,14 +981,52 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
         # (shape, dtype, bytes).  Inputs are donated: every item updates
         # the state in place, so the per-item path holds ONE interleaved
         # state in device memory instead of two per step.
+        #
+        # With the integrity layer armed at build time, every item
+        # that moves data over the interconnect compiles as a CHECKED
+        # program instead — (amps, fault) -> (amps, flags), the fault
+        # vector replicated, per-device flags gathered — wrapped in a
+        # _CheckedFn carrying the static sender maps observe_item
+        # verifies against.  Comm-free items keep the plain build.
+        check_items = resilience.integrity_enabled()
+
+        def checked_item_body(item, amps, fault):
+            dev = lax.axis_index(axis)
+            if item[0] == "relayout":
+                return apply_relayout(amps, item[1], dev, axis, ndev,
+                                      chunk_bits, lane_bits, check=True,
+                                      fault=fault)
+            _, a, b = item
+            return bitswap_amps(amps, a, b, dev, axis, ndev,
+                                chunk_bits, lane_bits, check=True,
+                                fault=fault)
+
+        def shmap_checked(body):
+            return shard_map_compat(
+                body, mesh=mesh,
+                in_specs=(P(axis), P()),
+                out_specs=(P(axis), P(axis)),
+            )
+
         unique: dict = {}
         item_fns = []
         for item in plan:
-            key = _item_key(item)
+            senders = (exchange_round_senders(item, num_vec_bits,
+                                              dev_bits)
+                       if check_items else [])
+            key = (_item_key(item), bool(senders))
             f = unique.get(key)
             if f is None:
-                f = jax.jit(shmap(functools.partial(item_body, item)),
-                            donate_argnums=(0,) if donate else ())
+                if senders:
+                    jf = jax.jit(
+                        shmap_checked(functools.partial(
+                            checked_item_body, item)),
+                        donate_argnums=(0,) if donate else ())
+                    f = _CheckedFn(jf, senders)
+                else:
+                    f = jax.jit(
+                        shmap(functools.partial(item_body, item)),
+                        donate_argnums=(0,) if donate else ())
                 unique[key] = f
             item_fns.append(f)
         layouts = plan_layouts(plan, num_vec_bits)

@@ -278,13 +278,25 @@ class Qureg:
         from .circuit import check_state_health  # deferred: cycle
 
         # flush boundaries are always structural: gate runs carry
-        # complete density pairs and end in the canonical layout
+        # complete density pairs and end in the canonical layout.
+        # With the integrity layer armed, the drift allowance is the
+        # fp-model BUDGET (resilience.drift_budget) and a breach is
+        # counted as suspected silent data corruption — the eager/C
+        # driver's face of the per-item detector in circuit.py.
+        integ = resilience.integrity_enabled()
+        budget = None
+        if integ:
+            ndev = 1 if self.mesh is None else int(self.mesh.devices.size)
+            budget = resilience.drift_budget(n_ops, self._amps.dtype,
+                                             ndev)
         reason, _after = check_state_health(
             self._amps, is_density=self.is_density,
             num_qubits=self.num_qubits, mesh=self.mesh,
-            before=before, n_ops=n_ops)
+            before=before, n_ops=n_ops, drift_bound=budget)
         if reason is None:
             return
+        if integ and "drift budget" in reason:
+            reason = resilience.sdc_suspected(reason)
         offending = {"item": {"kind": "flush", "ops": n_ops,
                               "num_vec_qubits": self.num_vec_qubits}}
         path = metrics.flight_dump(f"health probe tripped: {reason}",
@@ -300,7 +312,10 @@ class Qureg:
         n_run = len(run)
         norm0 = self._norm_check(jax, "gate", n_run, None)
         h_before = None
-        k = metrics.health_every()
+        # the armed integrity layer probes EVERY flush (cadence 1):
+        # drift-budget detection needs per-flush attribution
+        k = metrics.health_every() \
+            or (1 if resilience.integrity_enabled() else 0)
         if k:
             _HEALTH_FLUSHES[0] += 1
             if _HEALTH_FLUSHES[0] % k == 0:

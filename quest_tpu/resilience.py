@@ -75,6 +75,42 @@ modes — slow chips, hung collectives, lost slices:
   and the remaining ops re-plan for the new mesh — bit-identical to a
   clean smaller-mesh run of the tail (docs/ROBUSTNESS.md).
 
+The silent-data-corruption defense (ISSUE-9) extends detection from
+"gross damage" (NaN, hangs) to the failure mode fleet operators
+actually report — mercurial cores that corrupt arithmetic without
+faulting (Hochschild et al., HotOS'21; Dixit et al., 2021):
+
+* **Integrity mode** — ``QUEST_INTEGRITY=1`` / :func:`set_integrity` /
+  C ``setIntegrityChecks`` routes ``Circuit.run`` onto the observed
+  per-item path and arms two detectors: **checksummed collectives**
+  (every ``bitswap``/``relayout`` ppermute round carries a folded
+  payload checksum verified on receipt — ``parallel/mesh_exec.py``; a
+  mismatch raises :class:`QuESTCorruptionError` via
+  :func:`wire_corruption`, naming the round, comm class and
+  sender/receiver pair, and STRIKES both devices in the mesh-health
+  registry) and **invariant drift budgets** (per-item norm/trace drift
+  priced against :func:`drift_budget` — an fp-model allowance from
+  gate count, dtype eps and device count, exactly as the watchdog
+  prices time from bytes — so a breach flags *suspected SDC* with
+  per-item attribution long before anything goes NaN).
+
+* **SDC fault kinds** — ``bitflip:<bit>`` and ``scale:<ppm>`` on the
+  ``mesh_exchange``/``run_item`` seams make both detectors drillable
+  with zero randomness: a ``mesh_exchange`` bitflip corrupts one
+  collective payload IN FLIGHT (between the send-side checksum and the
+  receive-side verification), a ``run_item`` bitflip/scale poisons the
+  produced state (modelling an HBM/compute corruption the drift budget
+  must catch).
+
+* **Self-healing rollback-and-quarantine** — on a checkpointed,
+  integrity-armed run, a detected corruption is automatically healed:
+  ``Circuit.run`` rolls back to the last good slot and replays
+  (:func:`self_heal`, bounded by :func:`integrity_rollbacks`);
+  :func:`heal_run` additionally QUARANTINES degraded devices by
+  routing the retry through the degraded-mesh resume path onto the
+  surviving topology.  Corruption becomes a counted, recovered ledger
+  event (``sdc_detected`` / ``sdc_recovered`` / ``rollbacks``).
+
 NOTE mid-run snapshots are RESUME POSITIONS, not canonical states: on a
 mesh, a plan item boundary may hold the register in a relabelled qubit
 layout that only the remaining plan items restore.  Resume them with
@@ -115,12 +151,21 @@ SEAMS = frozenset({
 #: straggler: the seam sleeps that many milliseconds before the item
 #: runs) and ``stall`` (a simulated hung collective: the seam blocks
 #: until the armed watchdog's deadline fires) are valid only on the
-#: :data:`STRAGGLER_SEAMS`.
+#: :data:`STRAGGLER_SEAMS`; the silent-data-corruption kinds
+#: ``bitflip:<bit>`` and ``scale:<ppm>`` (see :func:`sdc_params`) only
+#: on the :data:`SDC_SEAMS`.
 KINDS = ("io", "runtime", "nan", "stall")
 
 #: The seams that model slow/hung devices (``delay:<ms>`` / ``stall``):
 #: the ones walled by the collective watchdog.
 STRAGGLER_SEAMS = ("mesh_exchange", "run_item")
+
+#: The seams that model silently-corrupting hardware (``bitflip:<bit>``
+#: / ``scale:<ppm>``): ``mesh_exchange`` corrupts one collective
+#: payload in flight (the checksummed-collective detector's drill
+#: target), ``run_item`` poisons the produced state (the drift-budget
+#: detector's drill target).
+SDC_SEAMS = ("mesh_exchange", "run_item")
 
 #: Per-seam bounded retry budget (attempts AFTER the first).  Sinks are
 #: best-effort (they already degrade), so one retry; checkpoint I/O is
@@ -185,11 +230,35 @@ def _delay_ms(kind: str) -> int | None:
     return ms if ms >= 0 else None
 
 
+def sdc_params(kind) -> tuple[int, int] | None:
+    """The ``(code, param)`` of a silent-data-corruption fault kind —
+    ``"bitflip:<bit>"`` -> ``(1, bit)`` (flip storage bit ``bit``,
+    0..63, of the targeted element; reduced modulo the element width
+    at injection, so bit 40 of an f32 run flips bit 8 rather than
+    silently injecting nothing), ``"scale:<ppm>"`` -> ``(2, ppm)``
+    (scale by ``1 + ppm * 1e-6``; nonzero) — else None.  The code is
+    the traced fault-vector encoding the checked collectives consume
+    (``mesh_exec``)."""
+    if not isinstance(kind, str):
+        return None
+    head, _, tail = kind.partition(":")
+    if head not in ("bitflip", "scale") or not tail:
+        return None
+    try:
+        v = int(tail)
+    except ValueError:
+        return None
+    if head == "bitflip":
+        return (1, v) if 0 <= v <= 63 else None
+    return (2, v) if v != 0 else None
+
+
 def _parse_plan(spec) -> list[tuple[str, int, str]]:
     """Normalise a fault plan: a ``"seam:hit:kind[,...]"`` string (the
     ``QUEST_FAULT_PLAN`` format; ``;`` also separates entries; the
-    ``delay`` kind carries its milliseconds as a fourth field,
-    ``seam:hit:delay:250``) or an iterable of ``(seam, hit, kind)``
+    parameterised kinds carry their value as a fourth field —
+    ``seam:hit:delay:250``, ``seam:hit:bitflip:12``,
+    ``seam:hit:scale:1000``) or an iterable of ``(seam, hit, kind)``
     triples / dicts."""
     entries = []
     if isinstance(spec, str):
@@ -199,12 +268,14 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
             if not part:
                 continue
             bits = part.split(":")
-            if len(bits) == 4 and bits[2] == "delay":
-                bits = [bits[0], bits[1], f"delay:{bits[3]}"]
+            if len(bits) == 4 and bits[2] in ("delay", "bitflip",
+                                              "scale"):
+                bits = [bits[0], bits[1], f"{bits[2]}:{bits[3]}"]
             if len(bits) != 3:
                 raise QuESTValidationError(
                     f"bad fault-plan entry {part!r}: want seam:hit:kind "
-                    "(or seam:hit:delay:<ms>)")
+                    "(or seam:hit:delay:<ms> / seam:hit:bitflip:<bit> / "
+                    "seam:hit:scale:<ppm>)")
             entries.append((bits[0], bits[1], bits[2]))
     else:
         for e in spec:
@@ -217,15 +288,22 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
         if seam not in SEAMS:
             raise QuESTValidationError(
                 f"unknown fault seam {seam!r}; seams: {sorted(SEAMS)}")
-        if kind not in KINDS and _delay_ms(kind) is None:
+        if kind not in KINDS and _delay_ms(kind) is None \
+                and sdc_params(kind) is None:
             raise QuESTValidationError(
-                f"unknown fault kind {kind!r}; kinds: {list(KINDS)} or "
-                "delay:<ms>")
+                f"unknown fault kind {kind!r}; kinds: {list(KINDS)}, "
+                "delay:<ms>, bitflip:<bit> (0..63) or scale:<ppm> "
+                "(nonzero)")
         if (kind == "stall" or _delay_ms(kind) is not None) \
                 and seam not in STRAGGLER_SEAMS:
             raise QuESTValidationError(
                 f"fault kind {kind!r} models a straggler device and is "
                 f"valid only on the {sorted(STRAGGLER_SEAMS)} seams, "
+                f"not {seam!r}")
+        if sdc_params(kind) is not None and seam not in SDC_SEAMS:
+            raise QuESTValidationError(
+                f"fault kind {kind!r} models silent data corruption "
+                f"and is valid only on the {sorted(SDC_SEAMS)} seams, "
                 f"not {seam!r}")
         try:
             hit = int(hit)
@@ -299,8 +377,11 @@ def fault_point(name: str) -> str | None:
     deterministic straggler the collective watchdog then catches — and
     returns ``"delay"``; ``stall`` RETURNS ``"stall"`` and the caller
     (``mesh_exec.observe_item``) blocks on the armed watchdog deadline,
-    modelling a hung collective.  With no plan installed this is a
-    single dict lookup and returns None."""
+    modelling a hung collective; the SDC kinds ``bitflip:<bit>`` /
+    ``scale:<ppm>`` RETURN the spec string itself — the caller
+    (``observe_item``) corrupts the collective payload in flight
+    (``mesh_exchange``) or the produced state (``run_item``).  With no
+    plan installed this is a single dict lookup and returns None."""
     if _plan is None and not os.environ.get("QUEST_FAULT_PLAN"):
         return None
     plan = _current_plan()
@@ -324,6 +405,8 @@ def fault_point(name: str) -> str | None:
         return "delay"
     if fired == "stall":
         return "stall"
+    if sdc_params(fired) is not None:
+        return fired
     if fired == "io":
         raise OSError(f"scripted fault at seam {name!r} (hit {idx})")
     raise RuntimeError(f"scripted fault at seam {name!r} (hit {idx})")
@@ -639,6 +722,305 @@ def health_suffix() -> str:
             "(resilience.resume_run(..., allow_topology_change=True))")
 
 
+def mesh_health_snapshot() -> dict | None:
+    """JSON-serialisable form of the mesh-health registry for the
+    checkpoint ``run_position`` sidecar (None while the registry is
+    empty, keeping old sidecars byte-stable).  A resumed run then
+    INHERITS device quarantine (:func:`restore_mesh_health`) instead of
+    re-learning it strike by strike."""
+    with _lock:
+        if not _mesh_health["strikes"] and not _mesh_health["degraded"]:
+            return None
+        return {"strikes": {str(d): int(n)
+                            for d, n in _mesh_health["strikes"].items()},
+                "degraded": sorted(_mesh_health["degraded"])}
+
+
+def restore_mesh_health(snapshot: dict | None) -> None:
+    """Merge a sidecar's mesh-health snapshot into the live registry:
+    per-device strike counters take the MAX of saved and current (the
+    registry may have learned more since the snapshot), the degraded
+    set unions.  Called by :func:`resume_run` so quarantine survives a
+    process restart; a None/empty snapshot is a no-op."""
+    if not snapshot:
+        return
+    restored = []
+    with _lock:
+        for d, n in (snapshot.get("strikes") or {}).items():
+            d = int(d)
+            _mesh_health["strikes"][d] = max(
+                _mesh_health["strikes"].get(d, 0), int(n))
+        for d in snapshot.get("degraded") or ():
+            d = int(d)
+            if d not in _mesh_health["degraded"]:
+                _mesh_health["degraded"].append(d)
+                restored.append(d)
+    if restored:
+        metrics.trace(f"mesh health restored from checkpoint sidecar: "
+                      f"device(s) {restored} inherit DEGRADED status")
+
+
+# ---------------------------------------------------------------------------
+# In-run integrity layer: checksummed collectives + invariant budgets
+# ---------------------------------------------------------------------------
+#
+# The detectors live where the data moves (parallel/mesh_exec.py: every
+# bitswap/relayout ppermute round carries a folded payload checksum
+# verified on receipt; circuit._HealthProbe / register._health_probe:
+# per-item norm/trace drift against the fp-model budget below).  This
+# section owns the POLICY — the opt-in switch, the budget pricing, the
+# detection bookkeeping (counters + strikes + typed raise), and the
+# rollback-and-quarantine recovery loop.
+
+#: Self-healing rollback budget (attempts after a detected corruption);
+#: env override QUEST_INTEGRITY_ROLLBACKS, programmatic set_integrity.
+INTEGRITY_ROLLBACKS_DEFAULT = 2
+
+#: Drift-budget pricing factors (see :func:`drift_budget`); env
+#: overrides QUEST_DRIFT_OP_FACTOR / QUEST_DRIFT_DEV_FACTOR.
+DRIFT_OP_FACTOR_DEFAULT = 64.0
+DRIFT_DEV_FACTOR_DEFAULT = 16.0
+
+_integrity = {"on": False, "heal": None, "rollbacks": None}
+
+
+def set_integrity(enabled: bool = True, *, heal: bool | None = None,
+                  rollbacks: int | None = None) -> None:
+    """Programmatically arm (or disarm) the in-run integrity layer —
+    checksummed collectives + invariant drift budgets — and its
+    self-healing policy (the C API's ``setIntegrityChecks``).
+
+    ``heal``: whether a detected corruption on a checkpointed run is
+    automatically healed by rollback (:func:`self_heal`); ``None``
+    keeps the current override (default: healing ON while integrity is
+    armed — detection without recovery is a dead run, the outcome this
+    layer exists to prevent; ``QUEST_INTEGRITY_HEAL=0`` opts out).
+    ``rollbacks`` bounds the retry loop; a NON-POSITIVE value clears
+    the override back to the env/default, the same contract as
+    ``set_watchdog``.  The env knob ``QUEST_INTEGRITY=1`` arms the
+    layer for unmodified drivers."""
+    _integrity["on"] = bool(enabled)
+    if heal is not None:
+        _integrity["heal"] = bool(heal)
+    if rollbacks is not None:
+        r = int(rollbacks)
+        _integrity["rollbacks"] = r if r > 0 else None
+
+
+def integrity_enabled() -> bool:
+    """True when the integrity layer is armed (programmatic
+    :func:`set_integrity` or ``QUEST_INTEGRITY=1``).  An armed layer
+    routes ``Circuit.run`` onto the observed per-item path — the
+    collective checksums and per-item drift probes need per-item
+    programs, which the whole-plan jit cannot provide."""
+    return _integrity["on"] or os.environ.get("QUEST_INTEGRITY") == "1"
+
+
+def integrity_heal_enabled() -> bool:
+    """Whether a detected corruption on a checkpointed run self-heals
+    (:func:`self_heal`) instead of raising.  Defaults ON while the
+    integrity layer is armed; ``QUEST_INTEGRITY_HEAL=0`` or
+    ``set_integrity(heal=False)`` opts out."""
+    if _integrity["heal"] is not None:
+        return _integrity["heal"]
+    return os.environ.get("QUEST_INTEGRITY_HEAL") != "0"
+
+
+def integrity_rollbacks() -> int:
+    """Bounded rollback budget of the self-healing loop."""
+    v = _integrity["rollbacks"]
+    if v is not None:
+        return v
+    try:
+        return max(1, int(os.environ["QUEST_INTEGRITY_ROLLBACKS"]))
+    except (KeyError, ValueError):
+        return INTEGRITY_ROLLBACKS_DEFAULT
+
+
+def _drift_factor(env: str, default: float) -> float:
+    try:
+        return float(os.environ[env])
+    except (KeyError, ValueError):
+        return default
+
+
+def drift_budget(n_ops: int, dtype, ndev: int) -> float:
+    """Relative norm (sv) / trace (dm) drift budget for ``n_ops``
+    applied ops on an ``ndev``-device mesh at ``dtype`` — the fp-model
+    error allowance the integrity layer prices invariants against,
+    exactly as the watchdog prices time from bytes:
+
+    ``budget = eps * (op_factor * n_ops + dev_factor * (ndev - 1))``
+
+    The per-op term is the same generous roundoff-growth model the
+    health probes use (only kernel bugs or injected garbage should
+    trip); the per-device term covers the reduction-order spread of
+    sharded norm/trace sums.  A measured drift past this budget is
+    *suspected silent data corruption*: far above accumulated roundoff
+    yet possibly far below anything a NaN scan would ever see."""
+    from . import precision as _prec
+
+    eps = _prec.real_eps(dtype)
+    op_f = _drift_factor("QUEST_DRIFT_OP_FACTOR", DRIFT_OP_FACTOR_DEFAULT)
+    dev_f = _drift_factor("QUEST_DRIFT_DEV_FACTOR",
+                          DRIFT_DEV_FACTOR_DEFAULT)
+    return eps * (op_f * max(int(n_ops), 1)
+                  + dev_f * max(int(ndev) - 1, 0))
+
+
+def sdc_suspected(reason: str, meta: dict | None = None) -> str:
+    """Record one drift-budget breach as a suspected-SDC detection:
+    bumps ``resilience.sdc_detected`` and returns the annotated reason
+    string the probe raises with.  ``meta`` (the offending item's
+    timeline tags) rides along in the trace for attribution."""
+    metrics.counter_inc("resilience.sdc_detected")
+    metrics.trace("suspected silent data corruption: " + reason
+                  + (f" (item {meta.get('index')})" if meta else ""))
+    return ("suspected silent data corruption (invariant drift budget "
+            "breached): " + reason)
+
+
+def wire_corruption(meta: dict, failures) -> None:
+    """A checksummed collective failed verification: count the
+    detection, STRIKE every participating device in the mesh-health
+    registry, dump the flight ring, and raise a typed
+    :class:`QuESTCorruptionError` naming the plan item, its comm
+    class, and each corrupted round's sender/receiver pair.
+
+    ``failures`` is ``[(round, sender, receiver), ...]`` — receivers
+    whose recomputed payload checksum disagreed with the token that
+    travelled with the payload (``mesh_exec.observe_item``)."""
+    metrics.counter_inc("resilience.sdc_detected")
+    devices = sorted({d for _w, s, r in failures for d in (s, r)})
+    newly = suspect_devices(
+        devices, reason=f"collective checksum mismatch on item "
+                        f"{meta.get('index')}")
+    pairs = ", ".join(f"device {s} -> device {r} (round {w})"
+                      for w, s, r in failures)
+    path = metrics.flight_dump(
+        "checksummed collective failed verification",
+        offending={"item": dict(meta), "failures": list(failures),
+                   "struck_devices": devices})
+    raise QuESTCorruptionError(
+        f"integrity check failed on plan item {meta.get('index')} "
+        f"({meta.get('kind')}, comm class {meta.get('comm_class')}): "
+        f"collective payload failed its checksum on receipt — {pairs}; "
+        f"device(s) {devices} struck in the mesh-health registry"
+        + (f" (newly degraded: {newly})" if newly else "")
+        + (f"; flight recorder dumped to {path}" if path else
+           " (flight-recorder dump failed; see metrics.sink_errors)")
+        + health_suffix())
+
+
+def _rollback_retry(circuit, qureg, directory: str, pallas, last,
+                    label: str):
+    """The ONE bounded rollback-and-retry loop both healing entry
+    points share (:func:`self_heal`, :func:`heal_run`): restore the
+    last good slot and replay the remaining items, up to
+    :func:`integrity_rollbacks` attempts.  Each attempt counts
+    ``resilience.rollbacks``; success counts
+    ``resilience.sdc_recovered``; exhaustion counts
+    ``resilience.gave_up`` and re-raises wrapping the last failure."""
+    budget = integrity_rollbacks()
+    for attempt in range(budget):
+        metrics.counter_inc("resilience.rollbacks")
+        metrics.trace(f"{label}: rollback {attempt + 1}/{budget} "
+                      f"from {directory}"
+                      + (f" after: {last}" if last else ""))
+        try:
+            out = resume_run(circuit, qureg, directory, pallas=pallas)
+        except QuESTCorruptionError as e:
+            last = e
+            continue
+        metrics.counter_inc("resilience.sdc_recovered")
+        metrics.trace(f"{label}: corruption recovered by rollback "
+                      f"(attempt {attempt + 1})")
+        return out
+    metrics.counter_inc("resilience.gave_up")
+    raise QuESTCorruptionError(
+        f"{label} exhausted its {budget} rollback(s) from "
+        f"{directory}; last failure: {last}") from last
+
+
+def self_heal(circuit, qureg, directory: str, pallas, err):
+    """Bounded same-mesh rollback-and-retry after a detected corruption
+    (``Circuit.run``'s automatic healing path) — see
+    :func:`_rollback_retry` for the loop and its counters.
+
+    Refuses (re-raising with guidance) when the mesh-health registry
+    marks a device of THIS mesh degraded: an automatic same-mesh retry
+    would re-run on the struck hardware, so the recovery must quarantine
+    it instead — :func:`heal_run`, which routes through the
+    degraded-mesh resume onto the surviving topology."""
+    ndev = 1 if qureg.mesh is None else int(qureg.mesh.devices.size)
+    with _lock:
+        degraded = sorted(d for d in _mesh_health["degraded"]
+                          if d < ndev)
+    if degraded:
+        raise QuESTCorruptionError(
+            str(err) + f" — device(s) {degraded} of this mesh are "
+            "marked DEGRADED, so an automatic same-mesh rollback would "
+            "re-run on the struck hardware; quarantine it with "
+            "resilience.heal_run(circuit, qureg, directory) (a "
+            "degraded-mesh resume onto the surviving devices)") from err
+    return _rollback_retry(circuit, qureg, directory, pallas, err,
+                           "self-healing")
+
+
+def heal_run(circuit, qureg, directory: str, pallas: str = "auto"):
+    """Operator-facing rollback-AND-QUARANTINE recovery of a corrupted
+    checkpointed run.  Returns ``(result, register)`` — ``result`` is
+    what ``Circuit.run`` returns, and ``register`` is ``qureg`` for a
+    same-mesh rollback or a FRESH register on the surviving topology
+    when quarantine engaged.
+
+    When the mesh-health registry marks devices of ``qureg``'s mesh
+    degraded (struck past the circuit breaker by checksum mismatches or
+    watchdog breaches), the retry routes through the degraded-mesh
+    resume path (``resume_run(..., allow_topology_change=True)``): a
+    fresh environment built from the mesh's HEALTHY devices only — the
+    struck hardware is excluded by identity, not just by shrinking the
+    count — at the largest power-of-two size they support.  Only
+    op-aligned checkpoint boundaries support that route (the
+    degraded-resume contract); same-mesh rollbacks work anywhere.
+    Bounded by :func:`integrity_rollbacks`, counted like
+    :func:`self_heal`."""
+    ndev = 1 if qureg.mesh is None else int(qureg.mesh.devices.size)
+    degraded = [d for d in mesh_health()["degraded"] if d < ndev]
+    if not degraded:
+        return _rollback_retry(circuit, qureg, directory, pallas, None,
+                               "heal_run"), qureg
+    if ndev - len(degraded) < 1:
+        raise QuESTCorruptionError(
+            f"heal_run: every device of the {ndev}-device mesh is "
+            "marked degraded — no surviving topology to quarantine "
+            "onto (clear_mesh_health() after repair)")
+    from .env import create_env
+    from .register import create_density_qureg, create_qureg
+
+    # quarantine by IDENTITY: the registry's indices are positions on
+    # qureg's mesh, so the surviving environment is built from exactly
+    # the healthy members of that device list (a bare num_devices=k
+    # would take jax.devices()[:k] and could re-include the struck
+    # chip), truncated to the power-of-two mesh contract
+    healthy = [d for i, d in
+               enumerate(qureg.mesh.devices.reshape(-1).tolist())
+               if i not in degraded]
+    surviving = 1 << (len(healthy).bit_length() - 1)
+    metrics.trace(f"heal_run: quarantining degraded device(s) "
+                  f"{degraded}; degraded-mesh resume {ndev} -> "
+                  f"{surviving} device(s)")
+    new_env = create_env(devices=healthy[:surviving])
+    make = create_density_qureg if qureg.is_density else create_qureg
+    new_q = make(qureg.num_qubits, new_env, dtype=qureg.real_dtype)
+    metrics.counter_inc("resilience.rollbacks")
+    out = resume_run(circuit, new_q, directory, pallas=pallas,
+                     allow_topology_change=True)
+    metrics.counter_inc("resilience.sdc_recovered")
+    metrics.counter_inc("resilience.devices_quarantined", len(degraded))
+    return out, new_q
+
+
 # ---------------------------------------------------------------------------
 # Per-run resilience accounting
 # ---------------------------------------------------------------------------
@@ -647,7 +1029,10 @@ def health_suffix() -> str:
 #: record (process counters stay monotonic, per the metrics contract).
 _RUN_COUNTER_KEYS = ("resilience.retries", "resilience.gave_up",
                      "resilience.faults_injected",
-                     "resilience.watchdog_breaches")
+                     "resilience.watchdog_breaches",
+                     "resilience.sdc_detected",
+                     "resilience.sdc_recovered",
+                     "resilience.rollbacks")
 _run_base: dict = {}
 
 
@@ -656,7 +1041,15 @@ def begin_run() -> None:
     ledger-scope entry): snapshot the resilience counters and the
     per-seam fault-hit totals, so :func:`run_counters` — and the
     ``resilience`` annotation on the run's ledger record — reports
-    THIS run's numbers instead of process-lifetime totals."""
+    THIS run's numbers instead of process-lifetime totals.
+
+    NESTED runs do not re-anchor: a self-healing rollback (or any
+    resume) re-enters ``Circuit.run`` inside the outer run's ledger
+    scope, and only the OUTERMOST record is emitted — re-anchoring
+    there would erase the outer run's detection/rollback deltas from
+    the one record anyone reads."""
+    if metrics.run_depth() > 1:
+        return
     c = metrics.counters()
     with _lock:
         _run_base.clear()
@@ -836,7 +1229,7 @@ def load_snapshot(qureg, directory: str) -> dict:
             pos = _read_position(path, required=True)
             stateio.restore_checkpoint(qureg, path)
         except QuESTError as e:
-            errors.append(f"{slot}: {e}")
+            errors.append(f"{path}: {e}")
             fell_back = True
             continue
         if fell_back:
@@ -846,7 +1239,97 @@ def load_snapshot(qureg, directory: str) -> dict:
         pos["slot"] = path
         return pos
     raise QuESTCorruptionError(
-        f"no restorable checkpoint under {directory}: " + "; ".join(errors))
+        f"no restorable checkpoint under {directory} (every slot "
+        "failed its integrity check): " + "; ".join(errors)
+        + " — audit offline with resilience.verify_checkpoint / "
+          "tools/ckpt_fsck.py")
+
+
+def verify_checkpoint(directory: str) -> dict:
+    """Offline checkpoint fsck: re-run the stateio v2 per-array CRC32
+    check on every slot under ``directory`` WITHOUT touching a register
+    (``tools/ckpt_fsck.py`` is the CLI face).
+
+    Each two-slot rotation member (and a flat ``save_checkpoint``
+    directory) gets one report: the arrays are loaded under the shape
+    and dtype the ``qureg.json`` sidecar records and their checksums
+    recomputed against the recorded values.  v1 snapshots (no
+    checksums) report ``verified=False`` with an ``unverifiable``
+    detail — readable, but carrying no integrity evidence.  Returns::
+
+        {"directory", "latest",              # pointer target (or None)
+         "slots": [{"slot", "ok", "verified", "format_version",
+                    "position",              # run_position kind/index
+                    "detail"}, ...],
+         "ok": <at least one verified-healthy slot>}
+    """
+    from . import stateio
+
+    directory = os.path.abspath(directory)
+    latest = _read_pointer(directory)
+    candidates = [s for s in SLOTS
+                  if os.path.isdir(os.path.join(directory, s))]
+    if not candidates and os.path.isfile(
+            os.path.join(directory, stateio._META)):
+        candidates = [""]  # flat save_checkpoint directory
+    slots = []
+    for slot in candidates:
+        path = os.path.join(directory, slot) if slot else directory
+        rep = {"slot": slot or ".", "ok": False, "verified": False,
+               "format_version": None, "position": None, "detail": ""}
+        slots.append(rep)
+        try:
+            with open(os.path.join(path, stateio._META)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            rep["detail"] = (f"unreadable qureg.json "
+                            f"({type(e).__name__}: {e})")
+            continue
+        rep["format_version"] = int(meta.get("format_version", 1))
+        pos = _read_position(path)
+        if pos:
+            rep["position"] = {
+                "kind": pos.get("kind"),
+                "index": pos.get("item_index",
+                                 pos.get("flush_index"))}
+        elif slot:
+            # rotation slots ALWAYS carry a sidecar — its absence is
+            # the same damage load_snapshot treats as corrupt
+            rep["detail"] = "missing run_position sidecar"
+            continue
+        checksums = meta.get("checksums") or {}
+        if rep["format_version"] < 2 or not checksums:
+            rep["ok"] = True  # readable, but nothing to verify against
+            rep["detail"] = ("v1 snapshot: no recorded checksums — "
+                             "unverifiable")
+            continue
+        try:
+            arrays = stateio._load_snapshot_arrays(path, meta)
+        except (QuESTError, KeyError, TypeError, ValueError) as e:
+            # a damaged sidecar (missing num_qubits/dtype) is the same
+            # verdict as unreadable arrays: this slot is not healthy
+            rep["detail"] = f"{type(e).__name__}: {e}"
+            continue
+        bad = []
+        for name in ("re", "im"):
+            want = checksums.get(name)
+            if want is None:
+                continue
+            got = stateio._array_checksum(arrays[name])
+            if got != want:
+                bad.append(f"{name}: checksum {got} != recorded {want}")
+        if bad:
+            rep["detail"] = "; ".join(bad)
+            continue
+        rep["ok"] = True
+        rep["verified"] = True
+        rep["detail"] = "checksums verified"
+    return {
+        "directory": directory,
+        "latest": latest,
+        "slots": slots,
+        "ok": any(s["verified"] for s in slots),
+    }
 
 
 def _read_position(path: str, required: bool = False) -> dict:
@@ -1097,6 +1580,13 @@ def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
     want = plan_fingerprint(circuit, qureg, pallas)
     got = pos.get("fingerprint")
     if got == want:
+        # a resumed run inherits the writing run's device quarantine
+        # (the registry is otherwise process-local and would re-learn
+        # every strike from scratch after a restart).  Merged only
+        # AFTER the fingerprint accepted: a REFUSED resume against the
+        # wrong checkpoint must not pollute the live registry with an
+        # unrelated run's strikes
+        restore_mesh_health(pos.get("mesh_health"))
         metrics.counter_inc("resilience.resumes")
         every = int(pos.get("every") or 0)
         return circuit.run(qureg, pallas=pallas,
@@ -1124,6 +1614,7 @@ def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
             "matches, so this snapshot CAN resume onto the surviving "
             "mesh: pass allow_topology_change=True (degraded-mesh "
             "resume; C API resumeRunEx)")
+    restore_mesh_health(pos.get("mesh_health"))  # accepted: inherit
     return _resume_degraded(circuit, qureg, pos, pallas, named)
 
 
@@ -1237,8 +1728,8 @@ def maybe_eager_checkpoint(qureg) -> None:
 
 def reset() -> None:
     """Clear fault plans, hit counters, checkpoint policy, the eager
-    flush counter, the watchdog config, and the mesh-health registry
-    (test hook)."""
+    flush counter, the watchdog config, the integrity-layer config,
+    and the mesh-health registry (test hook)."""
     global _plan, _env_plan
     with _lock:
         _plan = None
@@ -1251,4 +1742,5 @@ def reset() -> None:
     _dir_owners.clear()
     _watchdog.update(on=False, gbps=None, slack=None, min_s=None,
                      strikes=None)
+    _integrity.update(on=False, heal=None, rollbacks=None)
     clear_mesh_health()

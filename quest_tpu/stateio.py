@@ -186,6 +186,43 @@ def _write_snapshot(amps, meta: dict, directory: str) -> None:
         seam="ckpt_save")
 
 
+def _load_snapshot_arrays(directory: str, meta: dict) -> dict:
+    """Load one snapshot's ``re``/``im`` arrays under the SAVED shape
+    and dtype onto the default device — the register-less path
+    ``resilience.verify_checkpoint`` (``tools/ckpt_fsck.py``) uses to
+    recompute checksums offline.  Failures surface as a
+    :class:`QuESTCorruptionError` naming the path, the same wrapping
+    :func:`restore_checkpoint` applies."""
+    import orbax.checkpoint as ocp
+
+    from . import resilience
+
+    arrays_dir = os.path.join(directory, _ARRAYS)
+    if not os.path.isdir(arrays_dir):
+        raise QuESTCorruptionError(
+            f"checkpoint at {directory} is missing its arrays "
+            f"directory ({arrays_dir})")
+    num_amps = 1 << (int(meta["num_qubits"])
+                     * (2 if meta.get("is_density") else 1))
+    shape = tuple(meta.get("shape")
+                  or state_shape(num_amps,
+                                 int(meta.get("num_devices", 1))))
+    dev0 = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    target = jax.ShapeDtypeStruct(shape, np.dtype(meta["dtype"]),
+                                  sharding=dev0)
+
+    def load():
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(arrays_dir, {"re": target, "im": target})
+
+    try:
+        return resilience.with_retries(load, seam="ckpt_load")
+    except Exception as e:
+        raise QuESTCorruptionError(
+            f"failed to restore checkpoint arrays from {arrays_dir}: "
+            f"{type(e).__name__}: {e}") from e
+
+
 def save_checkpoint(qureg: Qureg, directory: str) -> None:
     """Checkpoint the register to ``directory`` (created if missing):
     orbax-managed sharded arrays plus a checksummed JSON metadata
@@ -308,6 +345,16 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
                     f"checkpoint array {name!r} under {arrays_dir} failed "
                     f"its integrity check (checksum {got} != recorded "
                     f"{want}) — the shard data is corrupt")
+    else:
+        from . import metrics
+
+        metrics.warn_once(
+            "ckpt_v1_unverified",
+            f"checkpoint at {directory} is a v1 (checksum-less) "
+            "snapshot: restored UNVERIFIED — re-save it to get "
+            "per-array CRC32 coverage, and audit old directories "
+            "offline with resilience.verify_checkpoint / "
+            "tools/ckpt_fsck.py")
     if not same_shape:
         import jax.numpy as jnp
 

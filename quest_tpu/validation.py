@@ -53,8 +53,14 @@ class QuESTTimeoutError(QuESTError):
 
 class QuESTCorruptionError(QuESTError):
     """Data failed an integrity check: a checkpoint checksum mismatch,
-    a missing/garbled sidecar, or a numerically poisoned state caught
-    by a health probe (NaN/Inf, norm/trace/hermiticity drift)."""
+    a missing/garbled sidecar, a numerically poisoned state caught by
+    a health probe (NaN/Inf, norm/trace/hermiticity drift), a
+    checksummed collective whose payload failed verification on
+    receipt (silent data corruption on the wire — named sender/
+    receiver pair, both struck in the mesh-health registry), or an
+    invariant drift past the fp-model budget (*suspected* SDC).  On a
+    checkpointed, integrity-armed run these self-heal by rollback
+    (``resilience.self_heal`` / ``heal_run``) instead of surfacing."""
 
     code = 4
 

@@ -139,6 +139,19 @@ def multiprocess_collectives():
                     "failed)")
 
 
+@pytest.fixture(autouse=True)
+def _reset_strike_and_fault_state():
+    """Strike/fault state must never leak across tests: the mesh-health
+    registry and the fault plan are process-global, so a leftover
+    strike (a degraded device from a watchdog/integrity test) or a
+    still-armed scripted fault would fire inside an unrelated test's
+    run.  Previously each test file managed this ad hoc; this autouse
+    reset makes the isolation structural."""
+    yield
+    qt.resilience.clear_fault_plan()
+    qt.resilience.clear_mesh_health()
+
+
 def random_statevector(n, seed):
     rng = np.random.RandomState(seed)
     v = rng.randn(2**n) + 1j * rng.randn(2**n)

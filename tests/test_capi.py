@@ -148,6 +148,8 @@ def lib():
     L.getLastErrorString.argtypes = [QuESTEnv, ct.c_char_p, ct.c_int]
     L.setCollectiveWatchdog.argtypes = [QuESTEnv, ct.c_int, ct.c_double,
                                         ct.c_double, ct.c_double]
+    L.setIntegrityChecks.argtypes = [QuESTEnv, ct.c_int, ct.c_int,
+                                     ct.c_int]
     return L
 
 
@@ -388,6 +390,26 @@ def test_error_taxonomy_c_api(lib, cenv, tmp_path):
     assert lib.getLastErrorCode(cenv) == 0
     for h in (q, q2, q3):
         lib.destroyQureg(h, cenv)
+
+
+def test_set_integrity_checks_c_api(lib, cenv):
+    """setIntegrityChecks forwards to resilience.set_integrity — the
+    shim shares this interpreter, so the armed config is directly
+    visible (non-positive maxRollbacks clears the override, the
+    setCollectiveWatchdog contract)."""
+    from quest_tpu import resilience
+
+    try:
+        lib.setIntegrityChecks(cenv, 1, 1, 4)
+        assert resilience.integrity_enabled()
+        assert resilience.integrity_heal_enabled()
+        assert resilience.integrity_rollbacks() == 4
+        lib.setIntegrityChecks(cenv, 1, 1, 0)
+        assert resilience.integrity_rollbacks() == \
+            resilience.INTEGRITY_ROLLBACKS_DEFAULT
+    finally:
+        resilience.reset()
+    assert not resilience.integrity_enabled()
 
 
 def test_precision_code(lib):

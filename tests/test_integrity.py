@@ -1,0 +1,417 @@
+"""In-run integrity layer (quest_tpu.resilience, ISSUE-9 acceptance).
+
+Silent-data-corruption defense: (a) the SDC fault kinds
+(``bitflip:<bit>`` / ``scale:<ppm>``) validate and fire
+deterministically; (b) CHECKSUMMED COLLECTIVES — an armed integrity
+layer verifies every relayout/bitswap ppermute round with a folded
+payload checksum, a clean run stays BIT-IDENTICAL to the unchecked
+executor, and an injected in-flight bitflip is caught at the injected
+round with EXACTLY the participating devices struck in the mesh-health
+registry (while the same injection lands silently when the layer is
+off — the failure mode the layer exists for); (c) INVARIANT DRIFT
+BUDGETS — a scripted ``scale`` poison breaches the fp-model budget and
+is flagged as suspected SDC, while a clean deep random circuit at f32
+stays under budget at 2/4/8 devices (the false-positive guard);
+(d) SELF-HEALING — a detected corruption on a checkpointed run rolls
+back to the last good slot and completes bit-identical to an
+uninjected run, with ``sdc_detected``/``sdc_recovered``/``rollbacks``
+counted per run, and ``heal_run`` QUARANTINES degraded devices through
+the degraded-mesh resume; (e) checkpoint hygiene — both-slots-corrupt
+resumes name BOTH slot paths, ``verify_checkpoint``/``ckpt_fsck``
+audit slots offline, v1 restores warn once, and the mesh-health
+registry persists through the checkpoint sidecar.
+"""
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import capi_bridge, metrics, models, resilience
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from chaos_drill import corrupt_slot_arrays  # noqa: E402
+
+N = 8  # enough qubits for multi-item mesh plans at 8 devices
+
+
+@pytest.fixture(autouse=True)
+def _clean_integrity(monkeypatch):
+    for var in ("QUEST_FAULT_PLAN", "QUEST_INTEGRITY",
+                "QUEST_INTEGRITY_HEAL", "QUEST_INTEGRITY_ROLLBACKS",
+                "QUEST_CKPT_DIR", "QUEST_CKPT_EVERY",
+                "QUEST_HEALTH_EVERY"):
+        monkeypatch.delenv(var, raising=False)
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+def _ref_state(circ, env, pallas="auto"):
+    q = qt.create_qureg(circ.num_qubits, env)
+    circ.run(q, pallas=pallas)
+    return qt.get_state_vector(q)
+
+
+# ---------------------------------------------------------------------------
+# (a) SDC fault-kind validation
+# ---------------------------------------------------------------------------
+
+
+def test_sdc_params_parsing():
+    assert resilience.sdc_params("bitflip:12") == (1, 12)
+    assert resilience.sdc_params("scale:1000") == (2, 1000)
+    assert resilience.sdc_params("scale:-500") == (2, -500)
+    assert resilience.sdc_params("bitflip:64") is None   # > f64 bits
+    assert resilience.sdc_params("scale:0") is None      # identity
+    assert resilience.sdc_params("bitflip:x") is None
+    assert resilience.sdc_params("delay:250") is None
+    assert resilience.sdc_params(None) is None
+
+
+def test_sdc_kinds_validated_in_parse_plan():
+    # the 4-field env spelling parses like delay's
+    resilience.set_fault_plan("mesh_exchange:0:bitflip:12")
+    resilience.set_fault_plan("run_item:3:scale:1000")
+    resilience.clear_fault_plan()
+    with pytest.raises(qt.QuESTError, match="silent data corruption"):
+        resilience.set_fault_plan([("ckpt_save", 0, "bitflip:3")])
+    with pytest.raises(qt.QuESTError, match="unknown fault kind"):
+        resilience.set_fault_plan([("run_item", 0, "bitflip:64")])
+    with pytest.raises(qt.QuESTError, match="unknown fault kind"):
+        resilience.set_fault_plan([("run_item", 0, "scale:0")])
+
+
+def test_set_integrity_and_capi_bridge_contract():
+    assert not resilience.integrity_enabled()
+    capi_bridge.setIntegrityChecks(1, 1, 5)
+    assert resilience.integrity_enabled()
+    assert resilience.integrity_heal_enabled()
+    assert resilience.integrity_rollbacks() == 5
+    # non-positive rollbacks CLEARS the override (watchdog contract)
+    capi_bridge.setIntegrityChecks(1, 0, 0)
+    assert not resilience.integrity_heal_enabled()
+    assert resilience.integrity_rollbacks() == \
+        resilience.INTEGRITY_ROLLBACKS_DEFAULT
+    capi_bridge.setIntegrityChecks(0, 1, 0)
+    assert not resilience.integrity_enabled()
+
+
+# ---------------------------------------------------------------------------
+# (b) checksummed collectives
+# ---------------------------------------------------------------------------
+
+
+def test_integrity_clean_run_bit_identical(env8):
+    """The checked executor must be a pure observer: an armed integrity
+    layer changes NO amplitude bits on a clean run."""
+    circ = models.qft(N)
+    ref = _ref_state(circ, env8)
+    resilience.set_integrity(True)
+    q = qt.create_qureg(N, env8)
+    circ.run(q, pallas="auto")
+    assert np.array_equal(qt.get_state_vector(q), ref)
+
+
+def test_wire_bitflip_detected_and_strikes_participants(env8):
+    """An injected in-flight bitflip is caught by the collective check
+    at the injected round, and EXACTLY the participating sender/
+    receiver devices are struck in the mesh-health registry."""
+    circ = models.qft(N)
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 1, "bitflip:12")])
+    q = qt.create_qureg(N, env8)
+    with pytest.raises(qt.QuESTCorruptionError) as ei:
+        circ.run(q, pallas="auto")
+    msg = str(ei.value)
+    assert "integrity check failed" in msg
+    assert "failed its checksum" in msg
+    assert "comm class" in msg
+    pairs = re.findall(r"device (\d+) -> device (\d+)", msg)
+    assert pairs, msg
+    participants = {int(d) for pair in pairs for d in pair}
+    health = resilience.mesh_health()
+    assert set(health["strikes"]) == participants
+    assert all(v == 1 for v in health["strikes"].values())
+    # detection is counted, and the register survives (observed runs
+    # never donate)
+    assert metrics.counters().get("resilience.sdc_detected", 0) >= 1
+    assert abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+
+
+def test_wire_scale_detected_too(env8):
+    """A rescaled payload rewrites mantissas, so the folded checksum
+    catches scale corruption on the wire as well."""
+    circ = models.qft(N)
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 0, "scale:1000")])
+    q = qt.create_qureg(N, env8)
+    with pytest.raises(qt.QuESTCorruptionError,
+                       match="failed its checksum"):
+        circ.run(q, pallas="auto")
+
+
+def test_wire_bitflip_silent_without_integrity(env8, tmp_path):
+    """The same injection with the layer DISARMED lands in the state
+    silently — the run completes with wrong amplitudes.  This is the
+    baseline failure mode the checksummed collectives exist to close
+    (the observed path is forced via checkpointing so the fault seam
+    fires at all)."""
+    circ = models.qft(N)
+    ref = _ref_state(circ, env8)
+    before = metrics.counters().get("resilience.sdc_detected", 0)
+    resilience.set_fault_plan([("mesh_exchange", 1, "bitflip:12")])
+    q = qt.create_qureg(N, env8)
+    circ.run(q, pallas="auto", checkpoint_dir=str(tmp_path / "ck"),
+             checkpoint_every=10**6)
+    got = qt.get_state_vector(q)
+    assert not np.array_equal(got, ref)          # silently corrupted
+    assert np.abs(got - ref).max() < 1e-3        # ...and subtly so
+    assert metrics.counters().get("resilience.sdc_detected", 0) \
+        == before
+
+
+# ---------------------------------------------------------------------------
+# (c) invariant drift budgets
+# ---------------------------------------------------------------------------
+
+
+def test_drift_budget_formula(monkeypatch):
+    from quest_tpu import precision
+
+    eps32 = precision.real_eps(np.float32)
+    b = resilience.drift_budget(10, np.float32, 8)
+    assert b == pytest.approx(eps32 * (64.0 * 10 + 16.0 * 7))
+    monkeypatch.setenv("QUEST_DRIFT_OP_FACTOR", "128")
+    monkeypatch.setenv("QUEST_DRIFT_DEV_FACTOR", "0")
+    assert resilience.drift_budget(10, np.float32, 8) == \
+        pytest.approx(eps32 * 128.0 * 10)
+
+
+def test_scale_injection_breaches_budget(env8):
+    """A run_item scale poison (an HBM/compute corruption, invisible to
+    the wire check) is flagged by the drift budget as suspected SDC,
+    with the offending item named."""
+    circ = models.qft(N)
+    before = metrics.counters().get("resilience.sdc_detected", 0)
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("run_item", 3, "scale:1000")])
+    q = qt.create_qureg(N, env8)
+    with pytest.raises(qt.QuESTCorruptionError) as ei:
+        circ.run(q, pallas="auto")
+    msg = str(ei.value)
+    assert "suspected silent data corruption" in msg
+    assert "drift budget" in msg
+    assert "after plan item" in msg
+    assert metrics.counters().get("resilience.sdc_detected", 0) \
+        == before + 1
+
+
+@pytest.mark.parametrize("ndev", [2, 4, 8])
+def test_drift_budget_false_positive_guard(ndev):
+    """The budget must not cry wolf: a clean, deep random circuit at
+    f32 — the precision where roundoff accumulates fastest — stays
+    under budget on 2/4/8-device meshes."""
+    env = qt.create_env(num_devices=ndev)
+    circ = models.random_circuit(N, depth=12, seed=7)
+    before = metrics.counters().get("resilience.sdc_detected", 0)
+    resilience.set_integrity(True)
+    q = qt.create_qureg(N, env, dtype=np.float32)
+    circ.run(q, pallas="auto")  # a budget breach would raise here
+    assert abs(qt.calc_total_prob(q) - 1.0) < 1e-4
+    assert metrics.counters().get("resilience.sdc_detected", 0) \
+        == before
+
+
+# ---------------------------------------------------------------------------
+# (d) self-healing rollback and quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_self_heal_rollback_bit_identical(env8, tmp_path):
+    """ISSUE-9 acceptance: a planted mesh_exchange bitflip on an
+    8-device checkpointed QFT run is detected, the run rolls back to
+    the last good slot automatically, completes, and the final
+    amplitudes are BIT-IDENTICAL to an uninjected run — with the
+    detection/recovery counted on the run's ledger record."""
+    circ = models.qft(N)
+    ref = _ref_state(circ, env8)
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 2, "bitflip:7")])
+    before = metrics.counters()
+    q = qt.create_qureg(N, env8)
+    circ.run(q, pallas="auto", checkpoint_dir=str(tmp_path / "ck"),
+             checkpoint_every=2)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+    after = metrics.counters()
+    for key in ("resilience.sdc_detected", "resilience.sdc_recovered",
+                "resilience.rollbacks"):
+        assert after.get(key, 0) - before.get(key, 0) >= 1, key
+    res = metrics.get_run_ledger()["meta"]["resilience"]
+    assert res["sdc_detected"] >= 1
+    assert res["sdc_recovered"] >= 1
+    assert res["rollbacks"] >= 1
+
+
+def test_self_heal_disabled_raises(env8, tmp_path):
+    """set_integrity(heal=False): detection still fires, recovery is
+    the operator's call."""
+    circ = models.qft(N)
+    resilience.set_integrity(True, heal=False)
+    resilience.set_fault_plan([("mesh_exchange", 2, "bitflip:7")])
+    q = qt.create_qureg(N, env8)
+    with pytest.raises(qt.QuESTCorruptionError,
+                       match="failed its checksum"):
+        circ.run(q, pallas="auto", checkpoint_dir=str(tmp_path / "ck"),
+                 checkpoint_every=2)
+
+
+def test_heal_run_quarantines_degraded_devices(env8, tmp_path):
+    """With a 1-strike breaker, the detected corruption DEGRADES the
+    struck devices; the automatic same-mesh rollback refuses (it would
+    re-run on the struck hardware) and heal_run routes the retry
+    through the degraded-mesh resume — the struck device is
+    quarantined out and the run completes on the surviving topology."""
+    circ = models.qft(N)
+    env_half = qt.create_env(num_devices=4)
+    oracle = _ref_state(circ, env_half)
+    resilience.set_integrity(True)
+    resilience.set_watchdog(False, strikes=1)  # 1 strike -> degraded
+    resilience.set_fault_plan([("mesh_exchange", 2, "bitflip:7")])
+    q = qt.create_qureg(N, env8)
+    with pytest.raises(qt.QuESTCorruptionError) as ei:
+        circ.run(q, pallas="auto", checkpoint_dir=str(tmp_path / "ck"),
+                 checkpoint_every=1)
+    assert "heal_run" in str(ei.value)  # refusal points at quarantine
+    assert resilience.mesh_health()["degraded"]
+    out, healed_q = resilience.heal_run(circ, q,
+                                        str(tmp_path / "ck"))
+    assert healed_q is not q
+    assert int(healed_q.mesh.devices.size) == 4
+    got = qt.get_state_vector(healed_q)
+    assert np.abs(got - oracle).max() < 1e-10
+    c = metrics.counters()
+    assert c.get("resilience.sdc_recovered", 0) >= 1
+    assert c.get("resilience.devices_quarantined", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# (e) checkpoint hygiene: fsck, both-slot corruption, sidecar health
+# ---------------------------------------------------------------------------
+
+
+def _killed_checkpointed_run(circ, env, d, kill_at=5, every=2):
+    # per-gate path: a 1-device fused plan can collapse to one item,
+    # leaving no mid-plan kill point (same choice as chaos_drill)
+    q = qt.create_qureg(circ.num_qubits, env)
+    resilience.set_fault_plan([("run_item", kill_at, "runtime")])
+    try:
+        with pytest.raises(RuntimeError):
+            circ.run(q, pallas=False, checkpoint_dir=d,
+                     checkpoint_every=every)
+    finally:
+        resilience.clear_fault_plan()
+    return q
+
+
+def test_both_slots_corrupt_resume_names_both_paths(env1, tmp_path):
+    circ = models.qft(6)
+    d = str(tmp_path / "ck")
+    q = _killed_checkpointed_run(circ, env1, d)
+    for slot in resilience.SLOTS:
+        assert corrupt_slot_arrays(os.path.join(d, slot)) > 0
+    with pytest.raises(qt.QuESTCorruptionError) as ei:
+        resilience.resume_run(circ, q, d, pallas=False)
+    msg = str(ei.value)
+    assert "no restorable checkpoint" in msg
+    for slot in resilience.SLOTS:  # BOTH slot paths named
+        assert os.path.join(d, slot) in msg, (slot, msg)
+
+
+def test_verify_checkpoint_reports_per_slot_health(env1, tmp_path):
+    circ = models.qft(6)
+    d = str(tmp_path / "ck")
+    _killed_checkpointed_run(circ, env1, d)
+    rep = resilience.verify_checkpoint(d)
+    assert rep["ok"]
+    assert rep["latest"] in resilience.SLOTS
+    assert {s["slot"] for s in rep["slots"]} == set(resilience.SLOTS)
+    assert all(s["verified"] for s in rep["slots"])
+    assert all(s["position"]["kind"] == "circuit_run"
+               for s in rep["slots"])
+    # corrupt the newest slot: per-slot verdicts diverge, overall ok
+    corrupt_slot_arrays(os.path.join(d, rep["latest"]))
+    rep2 = resilience.verify_checkpoint(d)
+    bad = [s for s in rep2["slots"] if s["slot"] == rep2["latest"]][0]
+    good = [s for s in rep2["slots"] if s["slot"] != rep2["latest"]][0]
+    assert not bad["ok"] and good["verified"] and rep2["ok"]
+    # corrupt the other too: nothing healthy left
+    other = [s for s in resilience.SLOTS if s != rep2["latest"]][0]
+    corrupt_slot_arrays(os.path.join(d, other))
+    assert not resilience.verify_checkpoint(d)["ok"]
+
+
+def test_ckpt_fsck_cli(env1, tmp_path, capsys):
+    import ckpt_fsck
+
+    circ = models.qft(6)
+    d = str(tmp_path / "ck")
+    _killed_checkpointed_run(circ, env1, d)
+    assert ckpt_fsck.main([d]) == 0
+    out = capsys.readouterr().out
+    assert "slot-0" in out and "slot-1" in out
+    for slot in resilience.SLOTS:
+        corrupt_slot_arrays(os.path.join(d, slot))
+    assert ckpt_fsck.main([d]) == 1
+    assert ckpt_fsck.main([str(tmp_path / "nowhere")]) == 2
+
+
+def test_v1_restore_warns_once_unverified(env1, tmp_path, capfd):
+    """A v1 (checksum-less) checkpoint restores — but says so, once."""
+    q = qt.create_qureg(4, env1)
+    qt.hadamard(q, 1)
+    d = str(tmp_path / "v1")
+    qt.save_checkpoint(q, d)
+    meta_path = os.path.join(d, "qureg.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["format_version"] = 1
+    meta.pop("checksums", None)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    metrics.reset()  # clear any earlier one-shot warnings
+    qt.restore_checkpoint(qt.create_qureg(4, env1), d)
+    err = capfd.readouterr().err
+    assert "v1" in err and "UNVERIFIED" in err
+    qt.restore_checkpoint(qt.create_qureg(4, env1), d)
+    assert "UNVERIFIED" not in capfd.readouterr().err  # one-shot
+    # and the offline fsck reports the same unverifiability
+    rep = resilience.verify_checkpoint(d)
+    assert rep["slots"][0]["ok"]
+    assert not rep["slots"][0]["verified"]
+    assert "unverifiable" in rep["slots"][0]["detail"]
+
+
+def test_mesh_health_persists_through_checkpoint_resume(env1, tmp_path):
+    """The registry rides the run_position sidecar: a resumed run
+    INHERITS device quarantine instead of re-learning it strike by
+    strike (the registry is otherwise process-local)."""
+    circ = models.qft(6)
+    resilience.set_watchdog(False, strikes=1)
+    resilience.suspect_devices([3], reason="test quarantine")
+    assert resilience.mesh_health()["degraded"] == [3]
+    d = str(tmp_path / "ck")
+    q = _killed_checkpointed_run(circ, env1, d)
+    # simulate the process restart that loses the in-memory registry
+    resilience.clear_mesh_health()
+    assert resilience.mesh_health()["degraded"] == []
+    resilience.resume_run(circ, q, d, pallas=False)
+    health = resilience.mesh_health()
+    assert health["degraded"] == [3]
+    assert health["strikes"].get(3, 0) >= 1

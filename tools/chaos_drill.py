@@ -36,6 +36,21 @@ each scenario's recovery contract:
 * ``breaker_trip``     — repeated watchdog breaches must trip the
   k-strike circuit breaker: devices marked degraded in the mesh-health
   registry and named by subsequent failure messages.
+* ``sdc_on_wire``      — a scripted ``bitflip`` corrupts one collective
+  payload IN FLIGHT with the integrity layer armed
+  (``QUEST_INTEGRITY`` / ``resilience.set_integrity``): the
+  checksummed collective must catch it at the injected round, name the
+  sender/receiver pair in a typed ``QuESTCorruptionError``, and strike
+  exactly the participating devices in the mesh-health registry.
+* ``sdc_drift``        — a scripted ``scale:<ppm>`` poisons the state
+  at a plan item (an HBM/compute corruption no wire check can see):
+  the invariant drift budget must flag it as *suspected silent data
+  corruption* naming the item, long before anything goes NaN.
+* ``sdc_rollback``     — a ``bitflip`` mid-checkpointed-run with
+  integrity + healing armed: the corruption must be detected, the run
+  roll back to the last good slot AUTOMATICALLY and complete, with
+  final amplitudes BIT-IDENTICAL to an uninjected run and the
+  ``sdc_detected``/``sdc_recovered``/``rollbacks`` counters recorded.
 
 Every scenario must end in either a clean recovery (with the
 resilience counters recorded) or a ``QuESTError`` naming the seam —
@@ -453,6 +468,96 @@ def drill_breaker_trip(circ, env, ndev, pallas):
     resilience.clear_mesh_health()
 
 
+def drill_sdc_on_wire(circ, env, ndev, pallas):
+    if ndev < 2:
+        record("sdc_on_wire", True, skipped="checksummed collectives "
+               "need a multi-device mesh (no exchanges on 1 device)")
+        return
+    resilience.clear_mesh_health()
+    before = metrics.counters()
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 0, "bitflip:12")])
+    q = qt.create_qureg(N_QUBITS, env)
+    caught = named_pair = named_round = False
+    try:
+        circ.run(q, pallas=pallas)
+    except qt.QuESTCorruptionError as e:
+        msg = str(e)
+        caught = "failed its checksum" in msg
+        named_pair = "-> device" in msg
+        named_round = "round" in msg and "comm class" in msg
+    finally:
+        resilience.set_integrity(False)
+        resilience.clear_fault_plan()
+    struck = sorted(resilience.mesh_health()["strikes"])
+    delta = counters_delta(before, ("resilience.sdc_detected",))
+    unbricked = abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+    ok = caught and named_pair and named_round and bool(struck) \
+        and delta["resilience.sdc_detected"] >= 1 and unbricked
+    record("sdc_on_wire", ok, caught=caught, named_pair=named_pair,
+           named_round=named_round, struck_devices=struck,
+           register_unbricked=unbricked, **delta)
+    resilience.clear_mesh_health()
+
+
+def drill_sdc_drift(circ, env, pallas):
+    before = metrics.counters()
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("run_item", KILL_AT, "scale:1000")])
+    q = qt.create_qureg(N_QUBITS, env)
+    caught = named_budget = named_item = False
+    try:
+        circ.run(q, pallas=pallas)
+    except qt.QuESTCorruptionError as e:
+        msg = str(e)
+        caught = "suspected silent data corruption" in msg
+        named_budget = "drift budget" in msg
+        named_item = f"after plan item {KILL_AT}" in msg
+    finally:
+        resilience.set_integrity(False)
+        resilience.clear_fault_plan()
+    delta = counters_delta(before, ("resilience.sdc_detected",))
+    unbricked = abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+    ok = caught and named_budget and named_item and unbricked \
+        and delta["resilience.sdc_detected"] >= 1
+    record("sdc_drift", ok, caught=caught, named_budget=named_budget,
+           named_item=named_item, register_unbricked=unbricked, **delta)
+
+
+def drill_sdc_rollback(circ, env, ndev, pallas, ref):
+    if ndev < 2:
+        record("sdc_rollback", True, skipped="the wire-corruption "
+               "detector needs a multi-device mesh")
+        return
+    resilience.clear_mesh_health()
+    d = tempfile.mkdtemp(prefix="chaos-sdc-")
+    before = metrics.counters()
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 2, "bitflip:7")])
+    q = qt.create_qureg(N_QUBITS, env)
+    err = None
+    try:
+        circ.run(q, pallas=pallas, checkpoint_dir=d,
+                 checkpoint_every=CKPT_EVERY)
+    except qt.QuESTError as e:  # healing should make this unreachable
+        err = f"{type(e).__name__}: {e}"
+    finally:
+        resilience.set_integrity(False)
+        resilience.clear_fault_plan()
+    got = qt.get_state_vector(q)
+    bit_identical = bool(np.array_equal(got, ref))
+    delta = counters_delta(before, ("resilience.sdc_detected",
+                                    "resilience.sdc_recovered",
+                                    "resilience.rollbacks"))
+    ok = err is None and bit_identical \
+        and all(delta[k] >= 1 for k in delta)
+    record("sdc_rollback", ok, healed=err is None,
+           bit_identical=bit_identical,
+           **(dict(error=err) if err else {}), **delta)
+    shutil.rmtree(d, ignore_errors=True)
+    resilience.clear_mesh_health()
+
+
 def main():
     rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 6
     sw = stopwatch()
@@ -480,6 +585,9 @@ def main():
     drill_straggler_watchdog(circ, env, ndev, pallas)
     drill_degraded_resume(circ, env, ndev, pallas)
     drill_breaker_trip(circ, env, ndev, pallas)
+    drill_sdc_on_wire(circ, env, ndev, pallas)
+    drill_sdc_drift(circ, env, pallas)
+    drill_sdc_rollback(circ, env, ndev, pallas, ref)
 
     n_fail = sum(1 for r in results if not r["ok"])
     doc = {
@@ -499,6 +607,11 @@ def main():
             "slack": 4.0,
             "gbps_default": resilience.WATCHDOG_GBPS_DEFAULT,
             "breaker_strikes": 2,
+        },
+        "integrity": {
+            "rollbacks_default": resilience.INTEGRITY_ROLLBACKS_DEFAULT,
+            "drift_op_factor": resilience.DRIFT_OP_FACTOR_DEFAULT,
+            "drift_dev_factor": resilience.DRIFT_DEV_FACTOR_DEFAULT,
         },
         "scenarios": results,
         "failures": n_fail,

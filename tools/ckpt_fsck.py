@@ -1,0 +1,81 @@
+"""Offline checkpoint fsck: re-run the stateio v2 per-array CRC32
+check on every slot of a checkpoint directory WITHOUT touching a
+register (``resilience.verify_checkpoint``).
+
+Prints one line per slot — verified / corrupt (with the failing
+checksum or path) / unverifiable (v1, no recorded checksums) — plus
+the ``latest`` pointer target, so an operator can audit a rotation
+before trusting a resume to it (a both-slots-corrupt rotation is
+better discovered here than mid-recovery).
+
+Usage::
+
+    python tools/ckpt_fsck.py DIRECTORY [DIRECTORY ...]
+
+Exit status: 0 every directory has at least one verified-healthy slot,
+1 some directory has none, 2 usage error / no checkpoint found.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def fsck(directory: str) -> bool:
+    """Report one directory; returns True when a verified slot exists."""
+    import jax
+
+    # x64 must be live BEFORE the arrays load: an f64 checkpoint
+    # verified through a default (x64-off) interpreter would silently
+    # restore downcast and fail every checksum — reporting a healthy
+    # rotation as corrupt
+    jax.config.update("jax_enable_x64", True)
+    from quest_tpu import resilience
+
+    rep = resilience.verify_checkpoint(directory)
+    print(f"{rep['directory']}  (latest -> {rep['latest'] or '-'})")
+    if not rep["slots"]:
+        print("  no checkpoint slots found")
+        return False
+    for s in rep["slots"]:
+        verdict = ("VERIFIED" if s["verified"]
+                   else "unverifiable" if s["ok"] else "CORRUPT")
+        pos = s.get("position") or {}
+        where = (f" [{pos.get('kind')}@{pos.get('index')}]"
+                 if pos.get("kind") else "")
+        detail = s["detail"]
+        if len(detail) > 220:  # orbax/tensorstore errors are verbose
+            detail = detail[:220] + " ..."
+        print(f"  {s['slot']:8s} {verdict:12s} "
+              f"v{s['format_version'] or '?'}{where}  {detail}")
+    return bool(rep["ok"])
+
+
+def main(argv) -> int:
+    dirs = [a for a in argv if not a.startswith("-")]
+    if not dirs:
+        print(__doc__)
+        return 2
+    ok = True
+    found_any = False
+    for d in dirs:
+        if not os.path.isdir(d):
+            print(f"{d}: not a directory")
+            ok = False
+            continue
+        found_any = True
+        ok = fsck(d) and ok
+    if not found_any:
+        return 2
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

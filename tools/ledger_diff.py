@@ -49,6 +49,14 @@ DEFAULT_RULES = [
     # limit must be strictly negative — -0.0 compares >= 0 and would
     # invert the rule into increase-is-bad
     ("counters.resilience.watchdog_breaches", -0.001, False),
+    # SDC detector health, strictly regressive in both directions: the
+    # drill's fault matrix injects a FIXED number of corruptions, so
+    # MORE detections than baseline = the integrity layer grew false
+    # positives (+0 cost rule), while FEWER recoveries = a detector or
+    # the rollback path stopped firing under injection (strictly
+    # negative, same -0.0 caveat as above)
+    ("counters.resilience.sdc_detected", +0.0, False),
+    ("counters.resilience.sdc_recovered", -0.001, False),
     # structural / communication metrics: tight, config-independent
     ("mesh_exchange_bytes_qft30", +0.01, False),
     ("counters.exec.exchange_bytes", +0.01, False),

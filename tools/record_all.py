@@ -41,9 +41,11 @@ RECORDERS = [
 def chaos_drill_smoke(summary, rnd) -> None:
     """Tier-2 smoke: the full chaos drill (tools/chaos_drill.py) at a
     small size — kill+resume bit-identity, checkpoint-slot corruption
-    fallback, transient AOT/sink I/O retries, injected NaN.  A
-    recovery-path regression fails the recording round immediately
-    instead of surfacing in the next preemption."""
+    fallback, transient AOT/sink I/O retries, injected NaN, straggler
+    watchdog, degraded resume, breaker trip, and the SDC matrix
+    (wire bitflip detect+strike, drift-budget breach, self-healing
+    rollback).  A recovery-path regression fails the recording round
+    immediately instead of surfacing in the next preemption."""
     env = dict(os.environ)
     env.setdefault("QUEST_CHAOS_QUBITS", "10")
     t0 = time.time()
