@@ -261,6 +261,12 @@ def main():
         "vs_baseline": round(gates_per_sec / baseline, 1),
         "gates": ngates,
         "seconds": round(secs, 4),
+        # per-application wall of the donated whole-program fast path
+        # (best rep / inner chained applications): the figure the
+        # ledger_diff "fastpath_wall_s" +1% rule gates, so always-on
+        # telemetry (histograms, run ids; sampling disabled) can never
+        # silently tax the hot path
+        "fastpath_wall_s": round(secs / inner, 6),
         "gates_per_pass": round(ngates / npasses, 2),
         "hbm_gbps": round(hbm_gbps, 1),
         "hbm_gbps_modelled": round(hbm_gbps_modelled, 1),

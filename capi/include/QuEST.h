@@ -92,6 +92,14 @@ void getEnvironmentString(QuESTEnv env, Qureg qureg, char str[200]);
  * "{}" before any run) — counters, spans, exchange-byte accounting for
  * the last circuit run.  Truncated to maxLen-1 chars + NUL. */
 void getRunLedgerString(QuESTEnv env, char *str, int maxLen);
+/* quest_tpu extension: the always-on production telemetry surface as
+ * Prometheus text exposition format — every process counter, the SLO
+ * histograms (run wall time, per-item-kind device time, exchange
+ * bytes per collective, probe drift; log2 buckets with cumulative
+ * _bucket/_sum/_count series), and the mesh-health gauges.  Scrape it
+ * from a driver-embedded endpoint, or serve it out of process with
+ * tools/metrics_serve.py.  Truncated to maxLen-1 chars + NUL. */
+void getMetricsText(QuESTEnv env, char *str, int maxLen);
 /* quest_tpu extension: per-item device-time timeline capture.  Between
  * start and stop, every executed plan item (fused pass, relayout
  * exchange, deferred gate stream) is walled with a device sync and

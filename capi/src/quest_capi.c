@@ -379,6 +379,24 @@ void getRunLedgerString(QuESTEnv env, char *str, int maxLen) {
     PyGILState_Release(g);
 }
 
+void getMetricsText(QuESTEnv env, char *str, int maxLen) {
+    /* Scrapeable production telemetry: counters + SLO histograms +
+     * mesh-health gauges as Prometheus text format (quest_tpu.metrics
+     * export_text).  Truncated to maxLen-1 chars. */
+    (void)env;
+    if (!str || maxLen <= 0)
+        return;
+    PyObject *r = bcall("getMetricsText", "()");
+    PyGILState_STATE g = PyGILState_Ensure();
+    const char *s = PyUnicode_AsUTF8(r);
+    if (!s)
+        fatal("getMetricsText");
+    strncpy(str, s, (size_t)maxLen - 1);
+    str[maxLen - 1] = '\0';
+    Py_DECREF(r);
+    PyGILState_Release(g);
+}
+
 void startTimelineCapture(QuESTEnv env) {
     (void)env;
     BVOID("startTimelineCapture", "()");

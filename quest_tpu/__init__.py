@@ -118,6 +118,7 @@ from .stateio import (
     restore_checkpoint,
 )
 from . import metrics
+from . import telemetry
 from . import resilience
 from .resilience import (
     set_fault_plan,
@@ -140,6 +141,7 @@ from .reporting import (
     get_environment_string,
     get_run_ledger,
     get_run_ledger_string,
+    get_metrics_text,
     report_run_ledger,
     stopwatch,
     time_fn,
@@ -226,6 +228,7 @@ reportQuregParams = report_qureg_params
 reportStateToScreen = report_state_to_screen
 getEnvironmentString = get_environment_string
 getRunLedgerString = get_run_ledger_string
+getMetricsText = get_metrics_text
 setCheckpointEvery = set_checkpoint_policy
 resumeRun = resume_state
 startRecordingQASM = start_recording_qasm

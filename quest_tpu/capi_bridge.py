@@ -184,6 +184,14 @@ def getRunLedgerString() -> str:
     return _qt.get_run_ledger_string()
 
 
+def getMetricsText() -> str:
+    """Process telemetry as Prometheus text exposition format
+    (counters, SLO histograms, mesh-health gauges — quest_tpu.metrics
+    ``export_text``): the scrapeable-production-metrics hook for
+    unmodified C drivers."""
+    return _qt.get_metrics_text()
+
+
 def startTimelineCapture() -> int:
     """Begin per-item timeline capture (quest_tpu.metrics): subsequent
     flushes / circuit runs wall each executed item with

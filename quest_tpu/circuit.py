@@ -18,6 +18,7 @@ pass, one donated buffer per run.
 
 from __future__ import annotations
 
+import contextlib
 import math
 from dataclasses import dataclass, field
 
@@ -29,6 +30,7 @@ from .ops.lattice import amps_shape, run_kernel, state_shape
 from .ops import gates as _g
 from . import metrics
 from . import precision as _prec
+from . import telemetry as _tm
 from . import validation as _v
 
 
@@ -91,6 +93,11 @@ def check_state_health(amps, *, is_density: bool, num_qubits: int,
     after = measure_state_weight(amps, is_density, num_qubits, mesh)
     if before is not None:
         drift = abs(after - before)
+        # probe-drift SLO histogram: the measured relative drift of
+        # every structural probe, healthy or not — the distribution an
+        # operator tunes QUEST_DRIFT_*_FACTOR budgets against
+        metrics.hist_record("probe.drift_rel",
+                            drift / max(abs(before), 1.0))
         rel = bound if drift_bound is None else drift_bound
         lim = rel * max(abs(before), 1.0)
         if not _math.isfinite(after) or drift > lim:
@@ -973,16 +980,38 @@ class Circuit:
                         self, qureg, pallas),
                     "parts": resilience.plan_fingerprint_parts(
                         self, qureg, pallas)}
-        with metrics.run_ledger("circuit_run"):
+        # trace correlation (quest_tpu.telemetry): every run mints a
+        # run_id; the FIRST run of a chain stamps it as the trace_id,
+        # and nested re-entries (a self-healing rollback's resume, a
+        # degraded tail) inherit the chain's id through the live scope
+        # — resume_run threads it across process restarts via the
+        # checkpoint sidecar
+        run_id = _tm.new_run_id()
+        with _tm.trace_scope(_tm.current_trace_id() or run_id), \
+                metrics.run_ledger("circuit_run"):
             # per-run resilience baseline: the record's `resilience`
             # annotation reports THIS run's retry/fault numbers, not
             # process-lifetime totals
             resilience.begin_run()
+            metrics.annotate_run("run_id", run_id)
+            metrics.annotate_run("trace_id", _tm.current_trace_id())
             metrics.annotate_run("num_qubits", self.num_qubits)
             metrics.annotate_run("is_density", self.is_density)
             metrics.annotate_run(
                 "num_devices",
                 1 if qureg.mesh is None else int(qureg.mesh.devices.size))
+            # sampled deep tracing (QUEST_TRACE_SAMPLE=N): the Nth
+            # eligible run — outermost, not a resume re-entry, no
+            # capture already live — pays for a full per-item timeline;
+            # the other N-1 keep the fast whole-program jit.  The
+            # decision is a deterministic counter, never a coin flip.
+            own_capture = False
+            if (_resume is None and metrics.run_depth() == 1
+                    and not metrics.timeline_active()
+                    and _tm.trace_sample_due()):
+                metrics.start_timeline()
+                metrics.annotate_run("trace_sampled", True)
+                own_capture = True
             observed = (metrics.timeline_active()
                         or metrics.health_every() > 0
                         or ckpt is not None or _resume is not None
@@ -1038,6 +1067,15 @@ class Circuit:
                     return resilience.self_heal(
                         self, qureg, ckpt["directory"], pallas, e)
             finally:
+                if own_capture:
+                    # close the sampled capture even when the run
+                    # raised: the timeline document (optionally dumped
+                    # to $QUEST_TRACE_DIR) is retained for inspection
+                    # and the NEXT run returns to the fast path
+                    doc = metrics.stop_timeline(
+                        _tm.trace_sample_path(run_id))
+                    metrics.annotate_run("timeline_events",
+                                         len(doc["traceEvents"]))
                 metrics.annotate_run("resilience",
                                      resilience.run_counters())
 
@@ -1191,6 +1229,10 @@ class _HealthProbe:
             # re-learning it strike by strike (restored by
             # resilience.resume_run; None while the registry is empty)
             "mesh_health": resilience.mesh_health_snapshot(),
+            # trace correlation: resume_run threads the chain's id
+            # through this sidecar, so a kill -> resume -> heal chain
+            # stays ONE queryable trace across process restarts
+            "trace_id": _tm.current_trace_id(),
         }
         path = resilience.snapshot(
             amps, num_qubits=self._c.num_qubits,
@@ -1232,11 +1274,29 @@ class _HealthProbe:
                     else int(self._mesh.devices.size))
             budget = resilience.drift_budget(self._ops_since,
                                              amps.dtype, ndev)
-        reason, val = check_state_health(
-            amps, is_density=self._c.is_density,
-            num_qubits=self._c.num_qubits, mesh=self._mesh,
-            before=self._ref, n_ops=self._ops_since,
-            structural=structural, drift_bound=budget)
+        # under timeline capture the probe itself is a walled item
+        # (kind "probe", tagged by trigger), so sampled/observed
+        # timelines show what the observability layer COSTS next to
+        # what the plan items cost; check_state_health syncs on its
+        # reductions, so the duration is honest device time.  The tag
+        # names the condition that actually FIRED this probe — a
+        # cadence knob that is set but not due at this item must not
+        # claim a checkpoint-boundary check
+        trigger = ("integrity" if integ else
+                   "health-every" if k and self._count % k == 0
+                   else "checkpoint")
+        wall = (metrics.timeline_span(
+                    "probe", args={"trigger": trigger,
+                                   "index": meta.get("index"),
+                                   "structural": structural})
+                if metrics.timeline_active()
+                else contextlib.nullcontext())
+        with wall:
+            reason, val = check_state_health(
+                amps, is_density=self._c.is_density,
+                num_qubits=self._c.num_qubits, mesh=self._mesh,
+                before=self._ref, n_ops=self._ops_since,
+                structural=structural, drift_bound=budget)
         if reason is None:
             if structural:
                 self._ref = val if val is not None else self._ref

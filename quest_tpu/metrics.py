@@ -56,6 +56,23 @@ timeline + health surface:
   the dump names the offending item instead of a soak run failing
   thousands of ops later.
 
+The always-on production surface (ISSUE-10, ``docs/OBSERVABILITY.md``):
+
+* **SLO histograms** — fixed-bucket log2 histograms (``hist_record`` /
+  ``histograms``; run wall time per label, per-item-kind device time,
+  exchange bytes per collective, probe drift) with p50/p90/p99
+  derivable from bucket counts; every ledger record carries its own
+  run's buckets under ``hist``.
+* **Prometheus export** — ``export_text()`` renders counters,
+  histograms, and mesh-health gauges as the text exposition format
+  (C API ``getMetricsText``; ``tools/metrics_serve.py`` serves it at
+  ``/metrics`` with ``/healthz`` wired to the mesh-health registry).
+* **Trace correlation** — ledger records, timeline documents, and
+  flight dumps carry the ``run_id``/``trace_id`` identity minted by
+  ``quest_tpu.telemetry``, and ``QUEST_TRACE_SAMPLE=N`` deep-traces
+  every Nth ``Circuit.run`` (deterministic counter sampling) while the
+  rest stay on the fast whole-program jit.
+
 Instrumentation timing discipline: this module and ``reporting.py`` are
 the ONLY places in ``quest_tpu`` allowed to call ``time.perf_counter``
 or print to stderr (enforced by ``tests/test_metrics.py``'s lint, which
@@ -70,10 +87,13 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import sys
 import threading
 import time
+
+from . import telemetry
 
 #: Ledger schema tag, bumped on incompatible record-shape changes.
 SCHEMA = "quest-tpu-run-ledger/1"
@@ -326,7 +346,17 @@ def _finalize(rec: dict, wall: float) -> None:
     rec["wall_s"] = round(wall, 6)
     rec["spans"] = {k: {"seconds": round(v[0], 6), "count": v[1]}
                     for k, v in rec["spans"].items()}
+    # the run's own wall time lands in the per-label SLO histogram
+    # (process-wide AND on this record, which is already off the
+    # attribution stack — so the bucket is added to both by hand)
+    e = _bucket_exp(wall) if wall > 0 else None
     with _lock:
+        _hist_add(_hists.setdefault(f"run.wall_s.{rec['label']}",
+                                    _hist_new()), wall, e)
+        _hist_add(rec.setdefault("hist", {}).setdefault(
+            "run.wall_s", _hist_new()), wall, e)
+        rec["hist"] = {name: _hist_serialize(h)
+                       for name, h in rec["hist"].items()}
         _records.append(rec)
         del _records[:-_RECORDS_MAX]
     path = os.environ.get("QUEST_METRICS_FILE")
@@ -367,6 +397,134 @@ def record_timing(label: str, reps: int, best: float, mean: float) -> None:
 
 
 # ---------------------------------------------------------------------------
+# SLO histograms (fixed-bucket log2, O(1) memory, always-on)
+# ---------------------------------------------------------------------------
+#
+# The ledger's counters answer "how much total"; serving SLOs need the
+# DISTRIBUTION — p50/p90/p99 of run wall time, per-item-kind device
+# time, exchange bytes per collective, probe drift.  Each histogram is
+# a sparse map of log2 buckets (value v lands in the bucket with upper
+# bound 2^e where 2^(e-1) < v <= 2^e), so recording is one frexp + two
+# dict updates under the existing lock — cheap enough to leave on in
+# production, with percentiles derivable from the bucket counts at
+# read time (bucket-resolution quantiles: within a factor of 2, which
+# is what log2 buckets buy for O(1) memory).  Histograms attribute to
+# the active run record(s) exactly like counters, so every ledger
+# record carries its own run's buckets.
+
+_hists: dict[str, dict] = {}
+
+
+def _bucket_exp(v: float) -> int:
+    """Log2 bucket exponent of a positive value: the smallest ``e``
+    with ``v <= 2**e`` (so the bucket's Prometheus ``le`` bound is
+    ``2.0**e``)."""
+    m, e = math.frexp(v)
+    return e - 1 if m == 0.5 else e
+
+
+def _hist_new() -> dict:
+    return {"buckets": {}, "count": 0, "sum": 0.0, "zeros": 0}
+
+
+def _hist_add(h: dict, v: float, e: int | None) -> None:
+    """Fold one observation into histogram state ``h`` (``e`` = its
+    bucket exponent, None for the zeros underflow bucket).  Caller
+    holds the lock.  The ONE update used for process histograms,
+    per-record attribution, and the finalize-time run-wall fold — so
+    the three can never diverge in shape."""
+    h["count"] += 1
+    h["sum"] += v
+    if e is None:
+        h["zeros"] += 1
+    else:
+        h["buckets"][e] = h["buckets"].get(e, 0) + 1
+
+
+def hist_record(name: str, value) -> None:
+    """Record one observation into histogram ``name`` (process-wide and
+    into this thread's active run records).  Non-positive values count
+    in the ``zeros`` underflow bucket."""
+    if getattr(_tls, "suppress", False):
+        return
+    v = float(value)
+    e = None if v <= 0 or not math.isfinite(v) else _bucket_exp(v)
+    with _lock:
+        _hist_add(_hists.setdefault(name, _hist_new()), v, e)
+        for rec in _stack():
+            _hist_add(rec.setdefault("hist", {}).setdefault(
+                name, _hist_new()), v, e)
+
+
+def _hist_quantile(zeros: int, entries: list, total: int,
+                   q: float) -> float | None:
+    """Bucket-resolution quantile: the upper bound of the bucket where
+    the cumulative count first reaches ``q * total``."""
+    if total <= 0:
+        return None
+    target = q * total
+    cum = zeros
+    if cum >= target:
+        return 0.0
+    for e, n in entries:
+        cum += n
+        if cum >= target:
+            return 2.0 ** e
+    return 2.0 ** entries[-1][0] if entries else 0.0
+
+
+def _hist_snapshot(h: dict) -> dict:
+    entries = sorted(h["buckets"].items())
+    return {
+        "count": h["count"],
+        "sum": round(h["sum"], 9),
+        "zeros": h["zeros"],
+        "buckets": [[2.0 ** e, n] for e, n in entries],
+        "p50": _hist_quantile(h["zeros"], entries, h["count"], 0.50),
+        "p90": _hist_quantile(h["zeros"], entries, h["count"], 0.90),
+        "p99": _hist_quantile(h["zeros"], entries, h["count"], 0.99),
+    }
+
+
+def histograms() -> dict:
+    """Snapshot of every process histogram: ``{name: {"count", "sum",
+    "zeros", "buckets": [[le, count], ...], "p50", "p90", "p99"}}`` —
+    ``buckets`` are per-bucket (non-cumulative) counts in ascending
+    ``le`` order, and the percentiles are bucket-resolution (each is
+    the ``le`` bound of the bucket containing that quantile)."""
+    with _lock:
+        return {name: _hist_snapshot(h) for name, h in _hists.items()}
+
+
+def _hist_serialize(h: dict) -> dict:
+    """Ledger-record form of one per-run histogram: sparse string-keyed
+    bucket exponents (JSON keys must be strings)."""
+    return {"buckets": {str(e): n for e, n in sorted(h["buckets"].items())},
+            "count": h["count"], "sum": round(h["sum"], 9),
+            "zeros": h["zeros"]}
+
+
+def export_text() -> str:
+    """The process telemetry as Prometheus text exposition format —
+    every counter, every SLO histogram (cumulative ``_bucket``/
+    ``_sum``/``_count`` series), and the mesh-health gauges — the
+    payload of the C API's ``getMetricsText`` and of
+    ``tools/metrics_serve.py``'s ``/metrics`` endpoint."""
+    from . import resilience  # deferred: resilience imports metrics
+
+    health = resilience.mesh_health()
+    gauges = {
+        "up": 1,
+        "mesh.degraded_devices": len(health["degraded"]),
+        "mesh.strikes_total": sum(health["strikes"].values()),
+        "timeline.active": 1 if timeline_active() else 0,
+        "trace.sample_every": telemetry.trace_sample_every(),
+    }
+    return telemetry.render_prometheus(counters(), histograms(),
+                                       gauges=gauges)
+
+
+# ---------------------------------------------------------------------------
 # Per-item timeline (Chrome trace format)
 # ---------------------------------------------------------------------------
 
@@ -402,6 +560,9 @@ def timeline_event(name: str, t0: float, dur_s: float,
     ``t0`` is a ``perf_counter`` reading (the capture's first event
     defines ts=0); ts/dur are emitted in microseconds as the trace
     format requires."""
+    # per-item-kind device-time SLO histogram: every walled item feeds
+    # it, so sampled production runs accumulate p50/p90/p99 per kind
+    hist_record(f"item.device_s.{name}", dur_s)
     with _lock:
         if _timeline["t0"] is None:
             _timeline["t0"] = t0
@@ -440,13 +601,17 @@ def timeline_events() -> list[dict]:
 
 
 def timeline_trace() -> dict:
-    """The capture as a Chrome-trace/Perfetto document."""
+    """The capture as a Chrome-trace/Perfetto document.  ``otherData``
+    carries the active (or most recent) ``trace_id``, so a sampled
+    run's timeline file joins the same queryable chain as its ledger
+    record and any flight dumps."""
     with _lock:
         return {
             "traceEvents": json.loads(json.dumps(_timeline["events"])),
             "displayTimeUnit": "ms",
             "otherData": {"schema": "quest-tpu-timeline/1",
-                          "dropped_events": _timeline["dropped"]},
+                          "dropped_events": _timeline["dropped"],
+                          "trace_id": telemetry.effective_trace_id()},
         }
 
 
@@ -552,9 +717,19 @@ def flight_dump(reason: str, offending: dict | None = None,
     returns the path (None if the sink failed)."""
     path = path or os.environ.get("QUEST_FLIGHT_FILE") \
         or os.path.join(flight_dir(), f"quest-flight-{os.getpid()}.json")
+    # self-contained post-mortem header: the trace id of the chain the
+    # dump belongs to, the mesh-health registry, and the active fault
+    # plan are captured INTO the dump — process state like strikes or
+    # an armed drill plan may have been reset by the time anyone reads
+    # it
+    from . import resilience  # deferred: resilience imports metrics
+
     doc = {
         "schema": "quest-tpu-flight/1",
         "reason": reason,
+        "trace_id": telemetry.effective_trace_id(),
+        "mesh_health": resilience.mesh_health(),
+        "fault_plan": resilience.fault_plan_snapshot(),
         "offending": offending,
         "items": flight_entries(),
         "counters": counters(),
@@ -565,16 +740,29 @@ def flight_dump(reason: str, offending: dict | None = None,
     return None
 
 
+def clear_warn_once() -> None:
+    """Forget which one-shot warnings already fired, so the NEXT
+    failure of each kind warns again.  Part of :func:`reset` and of the
+    test suite's autouse isolation fixture (``tests/conftest.py``):
+    leaked warn-once state would let one test's degraded sink silently
+    mask an unrelated test's first warning."""
+    with _lock:
+        _SINK_WARNED.clear()
+
+
 def reset() -> None:
-    """Zero all counters/spans and drop retained records, timeline
-    events, and flight entries (test hook)."""
+    """Zero all counters/spans/histograms, drop retained records,
+    timeline events, and flight entries, clear the warn-once registry,
+    and reset the telemetry identity/sampling counters (test hook)."""
     with _lock:
         _counters.clear()
         _span_totals.clear()
+        _hists.clear()
         _records.clear()
         _timeline["on"] = False
         _timeline["events"] = []
         _timeline["t0"] = None
         _timeline["dropped"] = 0
         del _flight[:]
-        _SINK_WARNED.clear()
+    clear_warn_once()
+    telemetry.reset()

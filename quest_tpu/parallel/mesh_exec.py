@@ -925,6 +925,13 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
                                                   dev_bits)
     plan_stats = {"passes": n_passes, "relayouts": n_relayouts,
                   "exchange_elems": exch_elems}
+    # static per-collective exchange volumes of this plan (elements):
+    # each execution feeds them into the exchange-bytes SLO histogram,
+    # the same per-item accounting the timeline tags carry
+    comm_item_elems = [
+        e for e in (plan_exchange_elems([it], num_vec_bits, dev_bits)[1]
+                    for it in plan if it[0] in ("swap", "relayout"))
+        if e]
 
     def _record_execution(amps):
         if isinstance(amps, jax.core.Tracer):
@@ -934,6 +941,9 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
         metrics.counter_inc("mesh.relayouts", n_relayouts)
         metrics.counter_inc("mesh.exchange_bytes",
                             exch_elems * amps.dtype.itemsize)
+        for e in comm_item_elems:
+            metrics.hist_record("exchange.bytes_per_collective",
+                                e * amps.dtype.itemsize)
 
     def item_body(item, amps):
         dev = lax.axis_index(axis)

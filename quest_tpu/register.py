@@ -32,6 +32,7 @@ from . import metrics
 from . import precision
 from . import qasm
 from . import resilience
+from . import telemetry
 from .env import QuESTEnv
 from .ops.lattice import (amp_sharding, amps_shape, lru_get, merge_amps,
                           split_amps, state_shape)
@@ -164,6 +165,15 @@ class Qureg:
         # — e.g. a flush forced inside Circuit.run's property reads —
         # fold into the outermost record instead of emitting their own).
         with metrics.run_ledger("flush"):
+            # the eager/C-driver path gets the same run identity as
+            # Circuit.run: a flush nested inside a circuit run folds
+            # into that record (whose run_id wins, annotate_run outer
+            # setdefault semantics); a standalone flush record carries
+            # its own id
+            metrics.annotate_run("run_id", telemetry.new_run_id())
+            tid = telemetry.current_trace_id()
+            if tid is not None:
+                metrics.annotate_run("trace_id", tid)
             metrics.annotate_run("num_vec_qubits", self.num_vec_qubits)
             metrics.counter_inc("flush.runs")
             metrics.counter_inc("flush.ops", len(self._pending))

@@ -143,6 +143,15 @@ def report_run_ledger() -> None:
     print(get_run_ledger_string())
 
 
+def get_metrics_text() -> str:
+    """The process telemetry — counters, SLO histograms, mesh-health
+    gauges — as Prometheus text exposition format
+    (``quest_tpu.metrics.export_text``): the payload behind the C API's
+    ``getMetricsText`` and ``tools/metrics_serve.py``'s ``/metrics``
+    scrape endpoint."""
+    return metrics.export_text()
+
+
 class Stopwatch:
     """A running wall-clock started at construction (the sanctioned
     timing primitive for ``tools/``: the instrumentation lint forbids

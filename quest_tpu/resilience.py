@@ -121,6 +121,7 @@ final states via :func:`resume_state`.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import shutil
@@ -128,6 +129,7 @@ import threading
 import time
 
 from . import metrics
+from . import telemetry
 from .validation import (QuESTError, QuESTCorruptionError,
                          QuESTTimeoutError, QuESTTopologyError,
                          QuESTValidationError)
@@ -345,6 +347,24 @@ def fault_hits() -> dict:
     """Snapshot of the per-seam invocation counters (test hook)."""
     with _lock:
         return dict(_hits)
+
+
+def fault_plan_snapshot() -> dict | None:
+    """JSON-serialisable view of the ACTIVE fault plan and its per-seam
+    hit counters (None when no plan is installed) — captured into every
+    flight-dump header so a post-mortem names the drill that was armed
+    even after the plan has been cleared or the process restarted."""
+    if not fault_active():
+        return None
+    try:
+        plan = _current_plan()
+    except QuESTValidationError as e:
+        return {"error": f"unparseable fault plan: {e}"}
+    with _lock:
+        hits = dict(_hits)
+    return {"entries": [{"seam": s, "hit": h, "kind": k}
+                        for s, h, k in plan],
+            "hits": hits}
 
 
 def _current_plan() -> list:
@@ -1589,9 +1609,11 @@ def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
         restore_mesh_health(pos.get("mesh_health"))
         metrics.counter_inc("resilience.resumes")
         every = int(pos.get("every") or 0)
-        return circuit.run(qureg, pallas=pallas,
-                           checkpoint_dir=directory if every else None,
-                           checkpoint_every=every, _resume=pos)
+        with _inherited_trace(pos):
+            return circuit.run(qureg, pallas=pallas,
+                               checkpoint_dir=directory if every
+                               else None,
+                               checkpoint_every=every, _resume=pos)
     want_parts = plan_fingerprint_parts(circuit, qureg, pallas)
     got_parts = pos.get("fingerprint_parts")
     base = (f"checkpoint at {pos['slot']} was written by a different "
@@ -1615,7 +1637,19 @@ def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
             "mesh: pass allow_topology_change=True (degraded-mesh "
             "resume; C API resumeRunEx)")
     restore_mesh_health(pos.get("mesh_health"))  # accepted: inherit
-    return _resume_degraded(circuit, qureg, pos, pallas, named)
+    with _inherited_trace(pos):
+        return _resume_degraded(circuit, qureg, pos, pallas, named)
+
+
+def _inherited_trace(pos: dict):
+    """Trace context of a resumed run: the ``trace_id`` the interrupted
+    run recorded in its ``run_position`` sidecar — so a kill → resume →
+    self-heal chain stays ONE queryable trace across process restarts.
+    A sidecar without one (pre-telemetry checkpoints) falls through to
+    any live scope (a self-healing rollback already inside the outer
+    run's trace), else a no-op and the resumed run mints its own id."""
+    tid = pos.get("trace_id") or telemetry.current_trace_id()
+    return telemetry.trace_scope(tid) if tid else contextlib.nullcontext()
 
 
 def _resume_degraded(circuit, qureg, pos: dict, pallas, named: str):
@@ -1723,7 +1757,8 @@ def maybe_eager_checkpoint(qureg) -> None:
              is_density=qureg.is_density, mesh=qureg.mesh,
              directory=directory, owner=f"register:{uid}",
              position={"format_version": 1, "kind": "flush",
-                       "flush_index": n, "register_uid": uid})
+                       "flush_index": n, "register_uid": uid,
+                       "trace_id": telemetry.current_trace_id()})
 
 
 def reset() -> None:

@@ -27,6 +27,7 @@ import os
 import numpy as np
 import jax
 
+from . import telemetry
 from .register import Qureg
 from .validation import (QuESTError, QuESTCorruptionError,
                          QuESTValidationError)
@@ -115,14 +116,23 @@ def checkpoint_meta(*, num_qubits: int, is_density: bool, dtype,
 
     ``num_devices`` records the SAVING topology for the human reading
     the sidecar; restore ignores it — arrays land in the RESTORING
-    register's sharding (see :func:`restore_checkpoint`)."""
-    return {
+    register's sharding (see :func:`restore_checkpoint`).
+
+    A snapshot written inside a traced run additionally records the
+    run chain's ``trace_id`` (quest_tpu.telemetry), so a checkpoint
+    found on disk names the incident it belongs to; snapshots taken
+    outside any run keep the historical key set byte-stable."""
+    meta = {
         "format_version": _FORMAT_VERSION,
         "num_qubits": int(num_qubits),
         "is_density": bool(is_density),
         "dtype": str(np.dtype(dtype)),
         "num_devices": int(num_devices),
     }
+    tid = telemetry.current_trace_id()
+    if tid is not None:
+        meta["trace_id"] = tid
+    return meta
 
 
 def _array_checksum(arr) -> str:

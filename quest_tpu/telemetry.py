@@ -1,0 +1,230 @@
+"""Production telemetry primitives: run/trace identity and sampled
+deep tracing.
+
+The run ledger (``quest_tpu.metrics``) records WHAT one run did; this
+module gives every run an IDENTITY and decides which runs pay for deep
+observation, so a production serving stack can run the telemetry layer
+always-on:
+
+* **Run ids** — every ``Circuit.run`` (and every eager flush record)
+  gets a process-unique ``run_id`` (:func:`new_run_id`; a monotonic
+  counter, zero randomness).
+
+* **Trace correlation** — a *chain* of runs that belong to one logical
+  piece of work — kill → ``resume_run`` → ``self_heal`` rollback →
+  ``heal_run`` quarantine — shares ONE ``trace_id``: the first run of
+  the chain stamps its own ``run_id`` as the trace id, every nested or
+  resumed run inherits it (:func:`trace_scope` /
+  :func:`current_trace_id`), and the id threads through ledger
+  records, timeline documents, flight dumps, checkpoint
+  ``run_position`` sidecars (how the chain survives a process
+  restart), and chaos-drill rows.  One grep over the JSONL ledger
+  reconstructs the whole incident.
+
+* **Sampled deep tracing** — ``QUEST_TRACE_SAMPLE=N`` routes every Nth
+  ``Circuit.run`` through the observed per-item path with a full
+  timeline capture while all other runs keep the fast whole-program
+  jit.  Sampling is COUNTER-based (:func:`trace_sample_due` — the Nth,
+  2Nth, ... eligible run fires), never random: a drill reproduces the
+  exact same sampled runs every time, and the hot path stays hot for
+  the other N-1 of N runs.
+
+* **Prometheus rendering** — :func:`render_prometheus` turns counter
+  and histogram snapshots into the Prometheus text exposition format
+  (the payload of ``metrics.export_text`` / the C API's
+  ``getMetricsText`` / ``tools/metrics_serve.py``'s ``/metrics``).
+
+This module is deliberately leaf-level (stdlib only, no quest_tpu
+imports), so ``metrics`` can import it without cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+_lock = threading.Lock()
+
+#: Monotonic run-id counter (process-wide; ids are unique per process
+#: and prefixed with the pid so multi-process pod logs stay grep-able).
+_run_ids = {"next": 0}
+
+#: Deterministic sampling state: eligible-run counter for
+#: ``QUEST_TRACE_SAMPLE`` (counted only while the knob is set, so the
+#: "every Nth run" contract anchors at the moment sampling was armed).
+_sample = {"count": 0}
+
+#: The most recently ENTERED trace id — post-mortem consumers (a manual
+#: ``flight_dump`` after the chain already unwound) still get the
+#: incident's id via :func:`effective_trace_id`.
+_last = {"trace_id": None}
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "trace_stack", None)
+    if s is None:
+        s = _tls.trace_stack = []
+    return s
+
+
+def new_run_id() -> str:
+    """A process-unique run identifier, e.g. ``run-1a2b-000007``:
+    pid (hex) + a monotonic counter.  Deterministic — no randomness,
+    so drills and tests reproduce ids exactly (modulo pid)."""
+    with _lock:
+        _run_ids["next"] += 1
+        n = _run_ids["next"]
+    return f"run-{os.getpid():x}-{n:06x}"
+
+
+@contextlib.contextmanager
+def trace_scope(trace_id: str):
+    """Enter a trace context: :func:`current_trace_id` returns
+    ``trace_id`` for the scope (per thread), so nested runs — a
+    self-healing rollback's ``resume_run``, a degraded-resume tail —
+    inherit the chain's id instead of minting their own."""
+    tid = str(trace_id)
+    s = _stack()
+    s.append(tid)
+    with _lock:
+        _last["trace_id"] = tid
+    try:
+        yield tid
+    finally:
+        s.pop()
+
+
+def current_trace_id() -> str | None:
+    """The trace id of this thread's innermost active scope (None
+    outside any traced run)."""
+    s = _stack()
+    return s[-1] if s else None
+
+
+def effective_trace_id() -> str | None:
+    """The active trace id, else the most recently entered one — the
+    post-mortem form: a flight dump taken after a failed chain already
+    unwound still names the incident it belongs to."""
+    return current_trace_id() or _last["trace_id"]
+
+
+# ---------------------------------------------------------------------------
+# Deterministic trace sampling (QUEST_TRACE_SAMPLE=N)
+# ---------------------------------------------------------------------------
+
+
+def trace_sample_every() -> int:
+    """The ``QUEST_TRACE_SAMPLE=N`` knob: deep-trace every Nth
+    eligible ``Circuit.run`` (0 = off, 1 = every run)."""
+    try:
+        n = int(os.environ.get("QUEST_TRACE_SAMPLE", "0"))
+    except ValueError:
+        return 0
+    return n if n >= 1 else 0
+
+
+def trace_sample_due() -> bool:
+    """Count one eligible run and decide whether it is the sampled one
+    (the Nth, 2Nth, ... since sampling was armed).  Pure counter
+    arithmetic under the module lock — zero randomness, so production
+    timeline coverage is reproducible run-for-run.  Always False while
+    the knob is unset (and the counter does not advance, so arming the
+    knob anchors the cadence at that moment)."""
+    n = trace_sample_every()
+    if not n:
+        return False
+    with _lock:
+        _sample["count"] += 1
+        return _sample["count"] % n == 0
+
+
+def trace_sample_path(run_id: str) -> str | None:
+    """Where a sampled run's timeline document lands:
+    ``$QUEST_TRACE_DIR/trace-<run_id>.json`` — or None (the capture is
+    retained in memory only) when the knob is unset.  The write itself
+    goes through the metrics sink discipline, so an unwritable
+    directory degrades instead of failing the run."""
+    d = os.environ.get("QUEST_TRACE_DIR")
+    if not d:
+        return None
+    with contextlib.suppress(OSError):
+        os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"trace-{run_id}.json")
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition rendering
+# ---------------------------------------------------------------------------
+
+#: Metric-name prefix of every exported sample.
+PROM_PREFIX = "quest_"
+
+
+def _prom_name(name: str) -> str:
+    """Sanitise a ledger counter/histogram name into a Prometheus
+    metric name: dots and other non-identifier characters become
+    underscores."""
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return PROM_PREFIX + s
+
+
+def _prom_num(v) -> str:
+    """Prometheus sample-value formatting (integers stay integral)."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(counters: dict, histograms: dict,
+                      gauges: dict | None = None) -> str:
+    """Render counter / histogram / gauge snapshots as the Prometheus
+    text exposition format (version 0.0.4).
+
+    ``counters`` is ``{name: value}`` (monotonic — exported with
+    ``# TYPE ... counter``); ``histograms`` is the
+    ``metrics.histograms()`` shape (``buckets`` as ``[le, count]``
+    pairs, plus ``count``/``sum``/``zeros``) — exported as cumulative
+    ``_bucket{le=...}`` series with ``+Inf``, ``_sum`` and ``_count``;
+    ``gauges`` is ``{name: value}`` point-in-time values."""
+    lines = []
+    for name in sorted(counters):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_prom_num(counters[name])}")
+    for name, g in sorted((gauges or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_prom_num(g)}")
+    for name in sorted(histograms):
+        h = histograms[name]
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        # zeros (observations <= 0) are <= every finite bound, so they
+        # seed the cumulative count of the first bucket
+        cum = int(h.get("zeros", 0))
+        for le, count in h["buckets"]:
+            cum += int(count)
+            lines.append(f'{pn}_bucket{{le="{le:.9g}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {int(h["count"])}')
+        lines.append(f"{pn}_sum {_prom_num(h['sum'])}")
+        lines.append(f"{pn}_count {int(h['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+def reset() -> None:
+    """Zero the run-id and sampling counters and drop the remembered
+    trace id (test hook; active trace scopes are per thread and unwound
+    by their own ``with`` blocks)."""
+    with _lock:
+        _run_ids["next"] = 0
+        _sample["count"] = 0
+        _last["trace_id"] = None

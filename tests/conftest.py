@@ -146,10 +146,17 @@ def _reset_strike_and_fault_state():
     strike (a degraded device from a watchdog/integrity test) or a
     still-armed scripted fault would fire inside an unrelated test's
     run.  Previously each test file managed this ad hoc; this autouse
-    reset makes the isolation structural."""
+    reset makes the isolation structural.
+
+    The metrics warn-once registry resets too: one-shot warning state
+    is equally process-global, and a test that degraded a sink would
+    otherwise silently swallow the FIRST warning an unrelated later
+    test asserts on (masking repeat warnings is exactly the registry's
+    production job — in the suite it is cross-test leakage)."""
     yield
     qt.resilience.clear_fault_plan()
     qt.resilience.clear_mesh_health()
+    qt.metrics.clear_warn_once()
 
 
 def random_statevector(n, seed):

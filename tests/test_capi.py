@@ -135,6 +135,7 @@ def lib():
     L.startRecordingQASM.argtypes = [Qureg]
     L.getEnvironmentString.argtypes = [QuESTEnv, Qureg, ct.c_char * 200]
     L.getRunLedgerString.argtypes = [QuESTEnv, ct.c_char_p, ct.c_int]
+    L.getMetricsText.argtypes = [QuESTEnv, ct.c_char_p, ct.c_int]
     L.startTimelineCapture.argtypes = [QuESTEnv]
     L.stopTimelineCapture.restype = ct.c_int
     L.stopTimelineCapture.argtypes = [QuESTEnv, ct.c_char_p]
@@ -301,6 +302,29 @@ def test_run_ledger_string(lib, cenv):
     rec = json.loads(buf.value.decode())
     assert rec.get("schema") == "quest-tpu-run-ledger/1"
     assert rec["counters"].get("flush.runs", 0) >= 1
+    lib.destroyQureg(q, cenv)
+
+
+def test_metrics_text_c_api(lib, cenv):
+    """getMetricsText: the scrapeable Prometheus telemetry payload
+    crosses the C ABI and parses with the serving-side parser."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import metrics_serve
+
+    q = lib.createQureg(4, cenv)
+    lib.hadamard(q, 0)
+    lib.getProbAmp(q, 0)  # state read: flushes the deferred stream
+    buf = ct.create_string_buffer(1 << 20)
+    lib.getMetricsText(cenv, buf, 1 << 20)
+    text = buf.value.decode()
+    assert "quest_up 1" in text
+    samples = metrics_serve.parse_text(text)
+    assert samples.get("quest_flush_runs", 0) >= 1
     lib.destroyQureg(q, cenv)
 
 

@@ -1,0 +1,388 @@
+"""Always-on production telemetry (ISSUE-10 acceptance criteria).
+
+Covers: (a) the default ``Circuit.run`` path stays on the fast
+whole-program jit with histograms always-on and ``QUEST_TRACE_SAMPLE``
+unset (no per-item walls, no timeline — but the ledger record carries
+histogram buckets); (b) deterministic sampled deep tracing
+(``QUEST_TRACE_SAMPLE=2``: second run emits a full timeline whose
+summed exchange bytes EQUAL the ledger's accounting, first run does
+not); (c) one ``trace_id`` spans a kill -> resume chain — ledger
+records, the checkpoint sidecar, and flight dumps all carry it;
+(d) log2 histogram bucketing/percentile semantics and the Prometheus
+export surface (``metrics.export_text`` / ``getMetricsText`` /
+``tools/metrics_serve.py``); (e) timeline x integrity composition —
+checked-collective programs must not perturb the exchange-byte pins;
+(f) the flight-dump post-mortem header (mesh health + fault plan);
+(g) the ``ledger_diff`` fast-path wall-time rule.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics, resilience, telemetry
+from quest_tpu.circuit import Circuit
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import ledger_diff  # noqa: E402
+import metrics_serve  # noqa: E402
+import trace_view  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_cleanup():
+    """No capture, sampling state, or integrity arming may leak."""
+    yield
+    metrics.stop_timeline()
+    resilience.set_integrity(False)
+
+
+def _mesh_circuit(n):
+    """Gates with mixing targets on device bits -> relayout exchanges."""
+    c = Circuit(n)
+    for t in range(n):
+        c.hadamard(t)
+    c.controlled_not(n - 1, 0)
+    c.t_gate(n - 1)
+    c.rotate_y(n - 2, 0.37)
+    c.controlled_not(n - 2, 1)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# (a) fast path stays fast with telemetry always-on
+# ---------------------------------------------------------------------------
+
+
+def test_default_run_stays_on_fast_path_with_histograms(env1, monkeypatch):
+    """QUEST_TRACE_SAMPLE unset: the run takes the whole-program jit
+    (never the observed per-item path — no 'observed' annotation, no
+    timeline events), yet its ledger record carries run_id/trace_id
+    AND histogram buckets."""
+    monkeypatch.delenv("QUEST_TRACE_SAMPLE", raising=False)
+    monkeypatch.delenv("QUEST_TIMELINE", raising=False)
+    metrics.reset()
+    q = qt.create_qureg(6, env1)
+    Circuit(6).hadamard(0).controlled_not(0, 3).run(q)
+    led = metrics.get_run_ledger()
+    assert "observed" not in led["meta"]
+    assert "trace_sampled" not in led["meta"]
+    assert metrics.timeline_events() == []
+    # identity: a fresh chain stamps run_id as trace_id
+    assert led["meta"]["run_id"] == led["meta"]["trace_id"]
+    # SLO buckets on the record itself, and in the process histograms
+    own = led["hist"]["run.wall_s"]
+    assert own["count"] == 1 and sum(own["buckets"].values()) == 1
+    assert "run.wall_s.circuit_run" in metrics.histograms()
+
+
+# ---------------------------------------------------------------------------
+# (b) deterministic sampled deep tracing
+# ---------------------------------------------------------------------------
+
+
+def test_trace_sample_every_second_run(env8, monkeypatch):
+    """QUEST_TRACE_SAMPLE=2: run 1 fast (histograms, no timeline),
+    run 2 sampled (full timeline whose exchange bytes EQUAL the
+    ledger's), run 3 fast again — pure counter arithmetic."""
+    monkeypatch.setenv("QUEST_TRACE_SAMPLE", "2")
+    metrics.reset()  # re-anchors the sampling counter (telemetry.reset)
+    n = 12
+    circ = _mesh_circuit(n)
+
+    q = qt.create_qureg(n, env8)
+    circ.run(q)
+    led1 = metrics.get_run_ledger()
+    assert "trace_sampled" not in led1["meta"]
+    assert metrics.timeline_events() == []
+    assert led1["hist"]["run.wall_s"]["count"] == 1  # buckets, no trace
+
+    q2 = qt.create_qureg(n, env8)
+    circ.run(q2)
+    led2 = metrics.get_run_ledger()
+    ev = metrics.timeline_events()
+    assert led2["meta"]["trace_sampled"] is True
+    assert led2["meta"]["observed"] is True
+    assert led2["meta"]["timeline_events"] == len(ev) > 0
+    tl_bytes = sum(e["args"].get("exchange_bytes", 0) for e in ev)
+    assert tl_bytes > 0
+    assert tl_bytes == led2["counters"]["exec.exchange_bytes"]
+    # the capture closed with the run: the next run is fast again
+    assert not metrics.timeline_active()
+
+    q3 = qt.create_qureg(n, env8)
+    circ.run(q3)
+    assert "trace_sampled" not in metrics.get_run_ledger()["meta"]
+
+
+def test_sampled_timeline_lands_in_trace_dir(env1, monkeypatch, tmp_path):
+    monkeypatch.setenv("QUEST_TRACE_SAMPLE", "1")
+    monkeypatch.setenv("QUEST_TRACE_DIR", str(tmp_path))
+    metrics.reset()
+    q = qt.create_qureg(5, env1)
+    Circuit(5).hadamard(0).run(q)
+    led = metrics.get_run_ledger()
+    path = tmp_path / f"trace-{led['meta']['run_id']}.json"
+    doc = json.loads(path.read_text())
+    assert doc["traceEvents"]
+    assert doc["otherData"]["trace_id"] == led["meta"]["trace_id"]
+
+
+def test_trace_sampling_is_counter_deterministic(monkeypatch):
+    monkeypatch.setenv("QUEST_TRACE_SAMPLE", "3")
+    telemetry.reset()
+    assert [telemetry.trace_sample_due() for _ in range(7)] == \
+        [False, False, True, False, False, True, False]
+    monkeypatch.delenv("QUEST_TRACE_SAMPLE")
+    # knob off: never due, counter frozen
+    assert not telemetry.trace_sample_due()
+
+
+# ---------------------------------------------------------------------------
+# (c) one trace_id spans the kill -> resume chain
+# ---------------------------------------------------------------------------
+
+
+def test_trace_id_spans_kill_resume_chain(env8, tmp_path, monkeypatch):
+    """The acceptance pin: a mid-run kill, then resume_run — the killed
+    run's ledger, the sidecar, the resumed run's ledger, AND a
+    post-mortem flight dump all carry ONE trace_id (with distinct
+    run_ids per run)."""
+    monkeypatch.setenv("QUEST_FLIGHT_DIR", str(tmp_path))
+    from quest_tpu import models
+
+    n = 10
+    circ = models.qft(n)
+    d = str(tmp_path / "ckpt")
+
+    ref = qt.create_qureg(n, env8)
+    circ.run(ref, pallas="auto")
+    expect = qt.get_state_vector(ref)
+
+    q = qt.create_qureg(n, env8)
+    resilience.set_fault_plan([("run_item", 5, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas="auto", checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    killed = metrics.get_run_ledger()
+    tid = killed["meta"]["trace_id"]
+    assert tid
+
+    with open(os.path.join(d, "latest")) as f:
+        latest = f.read().strip()
+    pos = resilience._read_position(os.path.join(d, latest),
+                                    required=True)
+    assert pos["trace_id"] == tid
+
+    resilience.resume_run(circ, q, d, pallas="auto")
+    resumed = metrics.get_run_ledger()
+    assert resumed["meta"]["trace_id"] == tid
+    assert resumed["meta"]["run_id"] != killed["meta"]["run_id"]
+    assert np.array_equal(qt.get_state_vector(q), expect)
+
+    path = metrics.flight_dump("post-mortem")
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["trace_id"] == tid
+
+
+def test_independent_runs_get_independent_trace_ids(env1):
+    q = qt.create_qureg(4, env1)
+    circ = Circuit(4).hadamard(0)
+    circ.run(q)
+    t1 = metrics.get_run_ledger()["meta"]["trace_id"]
+    circ.run(q)
+    t2 = metrics.get_run_ledger()["meta"]["trace_id"]
+    assert t1 != t2  # separate chains, not one sticky id
+
+
+# ---------------------------------------------------------------------------
+# (d) histogram semantics + Prometheus export
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_log2_buckets_and_percentiles():
+    metrics.reset()
+    for v in (3.0, 3.5, 4.0, 5.0, 100.0, 0.0):
+        metrics.hist_record("t.h", v)
+    h = metrics.histograms()["t.h"]
+    assert h["count"] == 6 and h["zeros"] == 1
+    assert h["sum"] == pytest.approx(115.5)
+    buckets = dict((le, n) for le, n in h["buckets"])
+    # le semantics: 2^(e-1) < v <= 2^e, so 4.0 lands in le=4, 5.0 in
+    # le=8, 100.0 in le=128
+    assert buckets == {4.0: 3, 8.0: 1, 128.0: 1}
+    assert h["p50"] == 4.0
+    assert h["p99"] == 128.0
+
+
+def test_histograms_attribute_to_run_records():
+    with metrics.run_ledger("houter") as outer:
+        metrics.hist_record("t.attr", 1.5)
+        with metrics.run_ledger("hinner") as inner:
+            metrics.hist_record("t.attr", 3.0)
+    assert inner["hist"]["t.attr"]["count"] == 1
+    assert outer["hist"]["t.attr"]["count"] == 2
+    # suppressed scopes record nothing, like counters
+    before = metrics.histograms().get("t.attr", {}).get("count", 0)
+    with metrics.suppressed():
+        metrics.hist_record("t.attr", 9.0)
+    assert metrics.histograms()["t.attr"]["count"] == before
+
+
+def test_export_text_parses_and_is_cumulative(env1):
+    metrics.reset()
+    q = qt.create_qureg(5, env1)
+    Circuit(5).hadamard(0).run(q)
+    text = metrics.export_text()
+    samples = metrics_serve.parse_text(text)
+    assert samples["quest_exec_runs"] == 1.0
+    assert samples["quest_up"] == 1.0
+    # histogram series: cumulative buckets ending at +Inf == _count
+    h = metrics.histograms()["run.wall_s.circuit_run"]
+    prefix = "quest_run_wall_s_circuit_run"
+    buckets = [(k, v) for k, v in samples.items()
+               if k.startswith(prefix + "_bucket")]
+    assert buckets
+    vals = [v for _, v in buckets]
+    assert vals == sorted(vals)  # cumulative => monotone
+    assert samples[prefix + '_bucket{le="+Inf"}'] == h["count"]
+    assert samples[prefix + "_count"] == h["count"]
+    # the C-ABI spelling serves the same payload
+    assert qt.getMetricsText() == qt.get_metrics_text()
+    metrics_serve.parse_text(qt.getMetricsText())
+
+
+def test_metrics_serve_in_process_endpoints(env1):
+    """tools/metrics_serve.py: /metrics parses, /healthz flips 200->503
+    with the mesh-health registry."""
+    q = qt.create_qureg(4, env1)
+    Circuit(4).hadamard(0).run(q)
+    server, port = metrics_serve.start_in_thread(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            assert r.status == 200
+            samples = metrics_serve.parse_text(r.read().decode())
+        assert any(k.startswith("quest_") for k in samples)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            health = json.loads(r.read().decode())
+        assert health["ok"] is True
+        # trip the breaker: /healthz must go 503 and name the device
+        for _ in range(resilience.watchdog_strikes()):
+            resilience.suspect_devices([1], reason="test")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read().decode())["degraded"] == [1]
+    finally:
+        server.shutdown()
+        resilience.clear_mesh_health()
+
+
+def test_parse_text_rejects_garbage():
+    with pytest.raises(ValueError):
+        metrics_serve.parse_text("quest_x not-a-number")
+    with pytest.raises(ValueError):
+        metrics_serve.parse_text("bad name{} 1")
+
+
+# ---------------------------------------------------------------------------
+# (e) timeline x integrity composition
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_under_integrity_keeps_exchange_byte_pins(env8):
+    """QUEST_INTEGRITY + timeline capture: the checked-collective
+    (amps, fault) -> (amps, flags) programs must not perturb the
+    per-item exchange-byte accounting — summed timeline bytes still
+    EQUAL the ledger's plan accounting, and probe items appear as
+    their own walled kind."""
+    n = 12
+    circ = _mesh_circuit(n)
+    q = qt.create_qureg(n, env8)
+    resilience.set_integrity(True)
+    metrics.start_timeline()
+    try:
+        circ.run(q)
+        ev = metrics.timeline_events()
+        led = metrics.get_run_ledger()
+    finally:
+        metrics.stop_timeline()
+        resilience.set_integrity(False)
+    tl_bytes = sum(e["args"].get("exchange_bytes", 0) for e in ev)
+    assert tl_bytes > 0
+    assert tl_bytes == led["counters"]["exec.exchange_bytes"]
+    probes = [e for e in ev if e["name"] == "probe"]
+    assert probes and all(e["args"]["trigger"] == "integrity"
+                          for e in probes)
+    # trace_view classifies probes as the observability class and
+    # reports the (currently zero) comm-overlap fraction
+    out = trace_view.summarize(ev)
+    assert "comm_hidden_frac: 0.000" in out
+    table = trace_view.by_kind_table(ev)
+    assert "probe" in table
+    total, hidden = trace_view.comm_hidden_us(ev)
+    assert total > 0 and hidden == 0.0
+
+
+# ---------------------------------------------------------------------------
+# (f) flight-dump post-mortem header
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dump_header_self_contained(tmp_path):
+    resilience.set_fault_plan([("run_item", 3, "nan")])
+    for _ in range(resilience.watchdog_strikes()):
+        resilience.suspect_devices([2], reason="test")
+    try:
+        metrics.flight_record("test-item", ops=1)
+        path = metrics.flight_dump("unit test",
+                                   path=str(tmp_path / "f.json"))
+        doc = json.loads((tmp_path / "f.json").read_text())
+    finally:
+        resilience.clear_fault_plan()
+        resilience.clear_mesh_health()
+    assert doc["mesh_health"]["degraded"] == [2]
+    assert doc["fault_plan"]["entries"] == [
+        {"seam": "run_item", "hit": 3, "kind": "nan"}]
+    assert path  # sink succeeded
+
+
+def test_warn_once_registry_clears(capfd):
+    metrics.warn_once("t_kind", "first warning")
+    metrics.warn_once("t_kind", "suppressed")
+    metrics.clear_warn_once()
+    metrics.warn_once("t_kind", "second warning")
+    err = capfd.readouterr().err
+    assert err.count("quest-tpu:") == 2
+    assert "suppressed" not in err
+
+
+# ---------------------------------------------------------------------------
+# (g) ledger_diff fast-path wall-time rule
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_diff_gates_fastpath_wall():
+    old = {"metric": "gate_ops_per_sec_30q", "fastpath_wall_s": 1.0}
+    ok = {"metric": "gate_ops_per_sec_30q", "fastpath_wall_s": 1.005}
+    bad = {"metric": "gate_ops_per_sec_30q", "fastpath_wall_s": 1.02}
+    v, checked, _ = ledger_diff.gate(old, ok)
+    assert not v and any(c["key"] == "fastpath_wall_s" for c in checked)
+    v, _, _ = ledger_diff.gate(old, bad)
+    assert any(x["key"] == "fastpath_wall_s" for x in v)
+    # config-bound: a different-size smoke must skip, not fail
+    smoke = {"metric": "gate_ops_per_sec_20q", "fastpath_wall_s": 9.9}
+    v, _, skipped = ledger_diff.gate(old, smoke)
+    assert not any(x["key"] == "fastpath_wall_s" for x in v)
+    assert ("fastpath_wall_s", "config mismatch") in skipped

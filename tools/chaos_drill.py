@@ -157,14 +157,25 @@ def drill_kill_resume(circ, env, pallas, ref):
         killed = True
     finally:
         resilience.clear_fault_plan()
+    # trace correlation: the killed run's ledger record names the
+    # chain's trace_id; the resumed run must inherit it through the
+    # checkpoint sidecar, so the whole kill -> resume incident is ONE
+    # queryable id in the drill artifact
+    killed_tid = (metrics.get_run_ledger() or {}).get("meta",
+                                                      {}).get("trace_id")
     resilience.resume_run(circ, q, d, pallas=pallas)
+    resumed_tid = (metrics.get_run_ledger() or {}).get(
+        "meta", {}).get("trace_id")
     got = qt.get_state_vector(q)
     delta = counters_delta(before, ("resilience.checkpoints",
                                     "resilience.resumes",
                                     "resilience.faults_injected"))
-    ok = killed and bool(np.array_equal(got, ref))
+    chain_intact = bool(killed_tid) and killed_tid == resumed_tid
+    ok = killed and bool(np.array_equal(got, ref)) and chain_intact
     record("kill_resume", ok, killed=killed,
-           bit_identical=bool(np.array_equal(got, ref)), **delta)
+           bit_identical=bool(np.array_equal(got, ref)),
+           trace_id=resumed_tid, trace_chain_intact=chain_intact,
+           **delta)
     return d
 
 
@@ -546,13 +557,17 @@ def drill_sdc_rollback(circ, env, ndev, pallas, ref):
         resilience.clear_fault_plan()
     got = qt.get_state_vector(q)
     bit_identical = bool(np.array_equal(got, ref))
+    # the self-healed run and its internal rollback resume share one
+    # trace_id (the outer run's), recorded on the row like kill_resume
+    healed_tid = (metrics.get_run_ledger() or {}).get("meta",
+                                                      {}).get("trace_id")
     delta = counters_delta(before, ("resilience.sdc_detected",
                                     "resilience.sdc_recovered",
                                     "resilience.rollbacks"))
     ok = err is None and bit_identical \
         and all(delta[k] >= 1 for k in delta)
     record("sdc_rollback", ok, healed=err is None,
-           bit_identical=bit_identical,
+           bit_identical=bit_identical, trace_id=healed_tid,
            **(dict(error=err) if err else {}), **delta)
     shutil.rmtree(d, ignore_errors=True)
     resilience.clear_mesh_health()

@@ -65,6 +65,17 @@ DEFAULT_RULES = [
     ("counters.exec.passes", +0.0, True),
     ("counters.exec.stream_bytes", +0.01, True),
     ("gates_per_pass", -0.01, True),
+    # always-on-telemetry overhead guard, config-bound and TIGHT: the
+    # donated whole-program fast path's per-application wall time
+    # (bench.py "fastpath_wall_s", sampling disabled).  Histograms and
+    # run/trace ids are supposed to be free on the hot path — a >1%
+    # regression here means the telemetry layer leaked into it.  1% is
+    # deliberately below the ±25% noise allowance of the other wall
+    # rules: the figure is best-of-reps amortised over the bench's
+    # inner chained applications (32 by default), which is the
+    # least-noisy wall number the bench produces — gate failures on a
+    # loaded host should be re-run solo before being believed
+    ("fastpath_wall_s", +0.01, True),
     # device / wall time: loose (measurement noise), config-bound
     ("value", -0.25, True),
     ("seconds", +0.25, True),

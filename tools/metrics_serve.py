@@ -1,0 +1,182 @@
+"""Scrapeable telemetry endpoint: /metrics (Prometheus) + /healthz.
+
+A stdlib-only HTTP server over the always-on telemetry layer
+(``quest_tpu.metrics``):
+
+* ``GET /metrics``  — the process counters, SLO histograms and
+  mesh-health gauges as Prometheus text exposition format
+  (``metrics.export_text``; same payload as the C API's
+  ``getMetricsText``).
+* ``GET /healthz``  — JSON verdict wired to the mesh-health registry
+  (``resilience.mesh_health``): HTTP 200 while no device is marked
+  DEGRADED, 503 once the circuit breaker has tripped — the liveness/
+  readiness shape a serving stack points its prober at.
+
+Two deployment shapes:
+
+* **In-process** (the production shape): the simulator process itself
+  calls :func:`start_in_thread`, so the scrape sees the live counters
+  of the process doing the work::
+
+      from tools.metrics_serve import start_in_thread
+      server, port = start_in_thread(9105)
+
+* **CLI** (``python tools/metrics_serve.py [--port N] [--demo]``): a
+  standalone process — with ``--demo`` it first runs a small circuit so
+  the endpoint has non-trivial content (the ``record_all.py`` tier-2
+  smoke scrapes exactly this).  ``--port 0`` binds an ephemeral port;
+  the chosen port is printed on stdout.
+
+:func:`parse_text` is a strict little parser for the exposition format
+(names, labels, float values; histogram bucket monotonicity is the
+caller's assertion) used by the smoke and the test suite to prove the
+output actually parses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+class MetricsHandler(BaseHTTPRequestHandler):
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        payload = body.encode()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            # a scraper that timed out / aborted mid-response: without
+            # this, socketserver's default handle_error prints a full
+            # traceback to the simulator's console — the exact spam the
+            # log_message override below exists to prevent
+            pass
+
+    def do_GET(self):  # noqa: N802 (stdlib spelling)
+        from quest_tpu import metrics, resilience
+
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            self._send(200, metrics.export_text(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            health = resilience.mesh_health()
+            ok = not health["degraded"]
+            doc = {"ok": ok, "degraded": health["degraded"],
+                   "strikes": health["strikes"],
+                   "strikes_to_degrade": health["strikes_to_degrade"]}
+            self._send(200 if ok else 503, json.dumps(doc) + "\n",
+                       "application/json")
+        elif path == "/":
+            self._send(200, "quest-tpu metrics endpoint: "
+                            "/metrics /healthz\n", "text/plain")
+        else:
+            self._send(404, "not found\n", "text/plain")
+
+    def log_message(self, fmt, *args):
+        # silence the stdlib's per-request stderr line: a scrape every
+        # few seconds must not spam the simulator's console (and the
+        # repo's instrumentation lint forbids ad-hoc stderr output)
+        pass
+
+
+def start_in_thread(port: int = 0,
+                    host: str = "127.0.0.1"):
+    """Start the endpoint on a daemon thread inside the CURRENT process
+    (so scrapes see this process's live telemetry).  Returns
+    ``(server, port)``; stop with ``server.shutdown()``."""
+    server = ThreadingHTTPServer((host, port), MetricsHandler)
+    t = threading.Thread(target=server.serve_forever,
+                         name="quest-metrics-serve", daemon=True)
+    t.start()
+    return server, server.server_address[1]
+
+
+def parse_text(text: str) -> dict:
+    """Parse Prometheus text exposition format into
+    ``{sample_name_with_labels: float_value}``; raises ``ValueError``
+    on any malformed line — the validation the tier-2 smoke and the
+    test suite run over a real scrape."""
+    samples: dict = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            if line.startswith("#") and not line.startswith(("# TYPE",
+                                                             "# HELP")):
+                raise ValueError(f"line {lineno}: bad comment {line!r}")
+            continue
+        # NAME{labels} VALUE | NAME VALUE
+        if "}" in line:
+            head, _, tail = line.partition("}")
+            name = head + "}"
+            value = tail.strip()
+            if "{" not in head or not head.split("{", 1)[0]:
+                raise ValueError(f"line {lineno}: bad sample {line!r}")
+        else:
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"line {lineno}: bad sample {line!r}")
+            name, value = parts
+        base = name.split("{", 1)[0]
+        if not all(c.isalnum() or c in "_:" for c in base):
+            raise ValueError(f"line {lineno}: bad metric name {base!r}")
+        try:
+            samples[name] = float(value)
+        except ValueError:
+            raise ValueError(f"line {lineno}: bad value {value!r}")
+    return samples
+
+
+def _demo_run() -> None:
+    """Populate the telemetry with one small real workload, so a
+    standalone serve has non-trivial counters and histograms."""
+    import quest_tpu as qt
+    from quest_tpu import models
+
+    env = qt.create_env(num_devices=1)
+    q = qt.create_qureg(6, env)
+    models.qft(6).run(q)
+
+
+def main(argv) -> int:
+    args = list(argv)
+    port = 9105
+    if "--port" in args:
+        i = args.index("--port")
+        try:
+            port = int(args[i + 1])
+        except (IndexError, ValueError):
+            print(__doc__)
+            return 2
+        del args[i:i + 2]
+    demo = "--demo" in args
+    args = [a for a in args if a != "--demo"]
+    if args:
+        print(__doc__)
+        return 2
+    if demo:
+        _demo_run()
+    server, bound = start_in_thread(port)
+    print(f"metrics-serve: listening on http://127.0.0.1:{bound} "
+          "(/metrics /healthz)", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

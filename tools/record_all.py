@@ -124,6 +124,65 @@ def roofline_attr_smoke(summary) -> None:
         print(err[-1500:])
 
 
+def metrics_serve_smoke(summary) -> None:
+    """Tier-2 smoke: start tools/metrics_serve.py (--demo populates the
+    telemetry with one small run), scrape /metrics and /healthz over
+    real HTTP, and validate that the Prometheus text format parses and
+    carries quest_ counters AND at least one SLO histogram.  A broken
+    exposition format or a dead endpoint fails the recording round
+    before any scraper in production sees it."""
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_serve
+
+    import selectors
+
+    t0 = time.time()
+    ok, detail = False, ""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "metrics_serve.py"),
+         "--port", "0", "--demo"],
+        stdout=subprocess.PIPE, text=True, cwd=REPO)
+    try:
+        # bounded startup wait: a hung child (slow backend init) must
+        # produce a FAIL row like every sibling smoke, not wedge the
+        # whole recording round on a blocking readline
+        sel = selectors.DefaultSelector()
+        sel.register(proc.stdout, selectors.EVENT_READ)
+        if not sel.select(timeout=300):
+            raise TimeoutError("server did not print its banner "
+                               "within 300s")
+        line = proc.stdout.readline()
+        port = int(line.rsplit(":", 2)[-1].split()[0].rstrip("/"))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        samples = metrics_serve.parse_text(text)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            health = r.read().decode()
+        ok = (any(k.startswith("quest_") for k in samples)
+              and any("_bucket{" in k for k in samples)
+              and '"ok": true' in health)
+        if not ok:
+            detail = f"samples={len(samples)} health={health[:100]}"
+    except Exception as e:  # endpoint dead / hung startup / bad scrape
+        detail = f"{type(e).__name__}: {e}"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    secs = time.time() - t0
+    summary.append(("metrics_serve", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'metrics_serve':22s} {secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 def main():
     rnd = sys.argv[1] if len(sys.argv) > 1 else "2"
     summary = []
@@ -152,6 +211,7 @@ def main():
             print(err[-1500:])
     bench_gate_smoke(summary)
     roofline_attr_smoke(summary)
+    metrics_serve_smoke(summary)
     chaos_drill_smoke(summary, rnd)
     n_fail = sum(1 for _, ok, _ in summary if not ok)
     print(f"{len(summary)} recorders, {n_fail} failed")

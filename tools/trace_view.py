@@ -1,13 +1,23 @@
 """Top-k table over a per-item timeline capture (``timeline.json``).
 
 Summarises a Chrome-trace file produced by ``QUEST_TIMELINE=1`` /
-``stopTimelineCapture`` / ``metrics.write_timeline``: total walled
-device time, the per-kind aggregate (count, total, share), the
-exchange-byte attribution carried on relayout/bitswap items, and the
-top-k slowest individual items with their tags — the "which plan item
-is slow on device" answer without opening Perfetto.
+``QUEST_TRACE_SAMPLE=N`` / ``stopTimelineCapture`` /
+``metrics.write_timeline``: total walled device time, the per-kind
+aggregate (count, total, share), the exchange-byte attribution carried
+on relayout/bitswap items, the comm-vs-compute wall split with the
+aggregate ``comm_hidden_frac`` (the fraction of exchange time
+overlapped by compute — 0.0 under today's serial executor; the future
+gate metric for compute/exchange overlap), and the top-k slowest
+individual items with their tags — the "which plan item is slow on
+device" answer without opening Perfetto.
 
-Usage: python tools/trace_view.py timeline.json [-k N]
+Item kinds: ``pallas-pass``/``xla-segment`` (compute sweeps),
+``bitswap``/``relayout`` (collective exchange), ``stream``/
+``xla-stream`` (eager flush dispatch), and ``probe`` (health/
+integrity/checkpoint probes — the observability layer's own walled
+cost, tagged with its trigger).
+
+Usage: python tools/trace_view.py timeline.json [-k N] [--by-kind]
 """
 
 from __future__ import annotations
@@ -15,6 +25,14 @@ from __future__ import annotations
 import json
 import sys
 from collections import defaultdict
+
+#: Items that move amplitudes over the interconnect.
+COMM_KINDS = {"bitswap", "relayout"}
+#: Items that stream the state through the compute units.
+COMPUTE_KINDS = {"pallas-pass", "xla-segment", "stream", "xla-stream"}
+#: The observability layer's own walled items (health / integrity /
+#: checkpoint probes — kind "probe", tagged with a ``trigger`` arg).
+PROBE_KINDS = {"probe"}
 
 
 def load_events(path: str) -> list[dict]:
@@ -24,15 +42,109 @@ def load_events(path: str) -> list[dict]:
     return [e for e in events if e.get("ph") == "X"]
 
 
-def summarize(events: list[dict], top_k: int = 10) -> str:
-    total_us = sum(e.get("dur", 0.0) for e in events)
+def classify(event: dict) -> str:
+    """``comm`` / ``compute`` / ``probe`` / ``other`` for one item."""
+    name = event.get("name", "?")
+    if name in COMM_KINDS:
+        return "comm"
+    if name in COMPUTE_KINDS:
+        return "compute"
+    if name in PROBE_KINDS:
+        return "probe"
+    return "other"
+
+
+def _merged_intervals(events: list[dict]) -> list:
+    """Union of the events' [ts, ts+dur) windows, sorted and merged."""
+    spans = sorted((e.get("ts", 0.0), e.get("ts", 0.0) + e.get("dur", 0.0))
+                   for e in events)
+    merged: list = []
+    for a, b in spans:
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    return merged
+
+
+def comm_hidden_us(events: list[dict]) -> tuple[float, float]:
+    """``(total_comm_us, hidden_comm_us)``: total walled exchange time,
+    and how much of it overlaps a compute item's wall — the measured
+    numerator/denominator of ``comm_hidden_frac``.  Under the serial
+    per-item executor nothing overlaps, so hidden is 0.0; a pipelined
+    mesh executor (ROADMAP item 2) raises it, and this summary is the
+    gateable readout."""
+    compute = _merged_intervals([e for e in events
+                                 if classify(e) == "compute"])
+    total = hidden = 0.0
+    for e in events:
+        if classify(e) != "comm":
+            continue
+        a = e.get("ts", 0.0)
+        b = a + e.get("dur", 0.0)
+        total += b - a
+        for ca, cb in compute:
+            if cb <= a:
+                continue
+            if ca >= b:
+                break
+            hidden += min(b, cb) - max(a, ca)
+    return total, hidden
+
+
+def _kind_rows(events: list[dict]):
     by_kind: dict = defaultdict(lambda: {"count": 0, "us": 0.0,
-                                         "bytes": 0})
+                                         "max_us": 0.0, "bytes": 0})
     for e in events:
         k = by_kind[e.get("name", "?")]
         k["count"] += 1
-        k["us"] += e.get("dur", 0.0)
+        dur = e.get("dur", 0.0)
+        k["us"] += dur
+        k["max_us"] = max(k["max_us"], dur)
         k["bytes"] += int(e.get("args", {}).get("exchange_bytes", 0))
+    return by_kind
+
+
+def by_kind_table(events: list[dict]) -> str:
+    """The ``--by-kind`` aggregation: per item kind, count / total /
+    mean / max device time, wall share, exchange MB, and the
+    comm/compute/probe class."""
+    by_kind = _kind_rows(events)
+    total_us = sum(k["us"] for k in by_kind.values())
+    lines = [f"{'kind':<14}{'class':>9}{'count':>7}{'total ms':>11}"
+             f"{'mean ms':>10}{'max ms':>10}{'share':>8}{'exch MB':>10}"]
+    for name, k in sorted(by_kind.items(), key=lambda kv: -kv[1]["us"]):
+        share = k["us"] / total_us if total_us else 0.0
+        cls = classify({"name": name})
+        mean = k["us"] / k["count"] if k["count"] else 0.0
+        lines.append(f"{name:<14}{cls:>9}{k['count']:>7}"
+                     f"{k['us'] / 1e3:>11.2f}{mean / 1e3:>10.3f}"
+                     f"{k['max_us'] / 1e3:>10.3f}{share:>8.1%}"
+                     f"{k['bytes'] / 1e6:>10.2f}")
+    return "\n".join(lines)
+
+
+def comm_compute_summary(events: list[dict]) -> str:
+    """Comm-vs-compute wall split + the aggregate ``comm_hidden_frac``
+    (exchange time overlapped by compute / total exchange time)."""
+    cls_us: dict = defaultdict(float)
+    for e in events:
+        cls_us[classify(e)] += e.get("dur", 0.0)
+    total_comm, hidden = comm_hidden_us(events)
+    frac = hidden / total_comm if total_comm else 0.0
+    lines = ["comm vs compute wall time:"]
+    for cls in ("compute", "comm", "probe", "other"):
+        if cls_us.get(cls):
+            lines.append(f"  {cls:<8}{cls_us[cls] / 1e3:>11.2f} ms")
+    lines.append(f"comm_hidden_frac: {frac:.3f} "
+                 f"({hidden / 1e3:.2f} of {total_comm / 1e3:.2f} ms of "
+                 "exchange overlapped by compute)")
+    return "\n".join(lines)
+
+
+def summarize(events: list[dict], top_k: int = 10) -> str:
+    total_us = sum(e.get("dur", 0.0) for e in events)
+    by_kind = _kind_rows(events)
     lines = [f"{len(events)} items, total device time "
              f"{total_us / 1e6:.3f} s"]
     lines.append(f"{'kind':<14}{'count':>7}{'total ms':>12}"
@@ -43,12 +155,14 @@ def summarize(events: list[dict], top_k: int = 10) -> str:
                      f"{share:>8.1%}{k['bytes'] / 1e6:>10.2f}")
     exch = sum(k["bytes"] for k in by_kind.values())
     lines.append(f"exchange bytes (all items): {exch}")
+    lines.append(comm_compute_summary(events))
     lines.append(f"top {min(top_k, len(events))} items by device time:")
     for e in sorted(events, key=lambda e: -e.get("dur", 0.0))[:top_k]:
         args = e.get("args", {})
         tags = ", ".join(f"{k}={args[k]}" for k in
                          ("index", "ops", "targets", "high_bits",
-                          "comm_class", "exchange_bytes") if k in args)
+                          "comm_class", "exchange_bytes", "trigger")
+                         if k in args)
         lines.append(f"  {e.get('dur', 0.0) / 1e3:>10.2f} ms  "
                      f"{e.get('name', '?'):<12} {tags}")
     return "\n".join(lines)
@@ -65,6 +179,8 @@ def main(argv) -> int:
             print(__doc__)
             return 2
         del args[i:i + 2]
+    by_kind = "--by-kind" in args
+    args = [a for a in args if a != "--by-kind"]
     if len(args) != 1:
         print(__doc__)
         return 2
@@ -74,6 +190,9 @@ def main(argv) -> int:
         print(f"trace-view: {args[0]}: {e}")
         return 2
     print(summarize(events, top_k=top_k))
+    if by_kind:
+        print()
+        print(by_kind_table(events))
     return 0
 
 
