@@ -143,11 +143,21 @@ enum QuESTErrorCode {
     QUEST_SUCCESS = 0,
     QUEST_ERROR = 1,            /* unclassified QuESTError            */
     QUEST_ERROR_VALIDATION = 2, /* invalid input / refused operation  */
-    QUEST_ERROR_TIMEOUT = 3,    /* collective watchdog deadline breach */
+    QUEST_ERROR_TIMEOUT = 3,    /* collective watchdog deadline breach,
+                                 * or a run-deadline drain (the run
+                                 * checkpointed before raising)       */
     QUEST_ERROR_CORRUPTION = 4, /* integrity check failed (checksum,
                                  * sidecar, poisoned state)           */
-    QUEST_ERROR_TOPOLOGY = 5    /* snapshot from a different mesh and
+    QUEST_ERROR_TOPOLOGY = 5,   /* snapshot from a different mesh and
                                  * no allowTopologyChange             */
+    QUEST_ERROR_PREEMPTED = 6,  /* cooperative preemption drain: the
+                                 * state was checkpointed (when a
+                                 * policy is armed) and the run is
+                                 * resumable via resumeRun / a
+                                 * tools/supervise.py restart         */
+    QUEST_ERROR_OVERLOAD = 7    /* admission gate shed the run (mesh
+                                 * unhealthy, concurrency cap, or SLO
+                                 * p99 breach); retry after backoff   */
 };
 /* Code/message of the most recent recoverable failure (0 / "" when the
  * last recoverable call succeeded). */
@@ -181,6 +191,18 @@ void setCollectiveWatchdog(QuESTEnv env, int enabled, double gbps,
  * of healing). */
 void setIntegrityChecks(QuESTEnv env, int enabled, int heal,
                         int maxRollbacks);
+/* quest_tpu extension: graceful preemption (quest_tpu.supervisor).
+ * With enabled nonzero, installs a SIGTERM/SIGINT handler that flips
+ * a cooperative preempt flag: the next flush boundary (eager/C path)
+ * or plan-item boundary (circuit runs) takes ONE emergency snapshot
+ * into the armed checkpoint rotation (setCheckpointEvery), dumps the
+ * flight ring, and fails with QUEST_ERROR_PREEMPTED — so a preempted
+ * driver loses nothing and resumeRun (or a tools/supervise.py
+ * restart loop keying on the exit code) continues bit-identically
+ * under the same trace id.  enabled == 0 uninstalls and restores the
+ * previous handlers.  Env knob for unmodified drivers:
+ * QUEST_PREEMPT=1. */
+void setPreemptionHandler(QuESTEnv env, int enabled);
 void seedQuESTDefault(void);
 void seedQuEST(unsigned long int *seedArray, int numSeeds);
 

@@ -40,10 +40,31 @@
 static PyObject *bridge = NULL;
 
 static void fatal(const char *what) {
+    /* Exit status: the QuESTError taxonomy code when the pending
+     * exception carries one (QuESTErrorCode in QuEST.h) — so a
+     * preemption drain on the eager path ends the driver process with
+     * QUEST_ERROR_PREEMPTED (6), and a supervisor (tools/supervise.py)
+     * can key its automatic resume on the exit code alone. */
+    int status = EXIT_FAILURE;
     fprintf(stderr, "QuEST-TPU: fatal error in %s\n", what);
-    if (PyErr_Occurred())
+    if (PyErr_Occurred()) {
+        PyObject *type, *value, *tb;
+        PyErr_Fetch(&type, &value, &tb);
+        PyErr_NormalizeException(&type, &value, &tb);
+        if (value) {
+            PyObject *code = PyObject_GetAttrString(value, "code");
+            if (code && PyLong_Check(code)) {
+                long c = PyLong_AsLong(code);
+                if (c > 0 && c < 126)
+                    status = (int)c;
+            }
+            Py_XDECREF(code);
+            PyErr_Clear(); /* a missing .code must not mask the error */
+        }
+        PyErr_Restore(type, value, tb);
         PyErr_Print();
-    exit(EXIT_FAILURE);
+    }
+    exit(status);
 }
 
 /* Initialise (or attach to) the interpreter and import the bridge.
@@ -467,6 +488,11 @@ void setIntegrityChecks(QuESTEnv env, int enabled, int heal,
                         int maxRollbacks) {
     (void)env;
     BVOID("setIntegrityChecks", "(iii)", enabled, heal, maxRollbacks);
+}
+
+void setPreemptionHandler(QuESTEnv env, int enabled) {
+    (void)env;
+    BVOID("setPreemptionHandler", "(i)", enabled);
 }
 
 void seedQuESTDefault(void) { BVOID("seedQuESTDefault", "()"); }

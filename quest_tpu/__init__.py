@@ -62,6 +62,8 @@ from .validation import (
     QuESTTimeoutError,
     QuESTCorruptionError,
     QuESTTopologyError,
+    QuESTPreemptedError,
+    QuESTOverloadError,
 )
 from .ops.gates import (
     hadamard,
@@ -133,6 +135,15 @@ from .resilience import (
     verify_checkpoint,
     mesh_health,
     clear_mesh_health,
+)
+from . import supervisor
+from .supervisor import (
+    install_preemption_handler,
+    uninstall_preemption_handler,
+    set_preemption_handler,
+    request_preemption,
+    configure_gate,
+    run_or_resume,
 )
 from . import reporting
 from .reporting import (
@@ -231,6 +242,10 @@ getRunLedgerString = get_run_ledger_string
 getMetricsText = get_metrics_text
 setCheckpointEvery = set_checkpoint_policy
 resumeRun = resume_state
+# flag-style like the C signature setPreemptionHandler(env, enabled):
+# qt.setPreemptionHandler(1) installs, qt.setPreemptionHandler(0)
+# uninstalls (a bare alias of install_ would crash on the int flag)
+setPreemptionHandler = set_preemption_handler
 startRecordingQASM = start_recording_qasm
 stopRecordingQASM = stop_recording_qasm
 clearRecordedQASM = clear_recorded_qasm

@@ -312,6 +312,20 @@ def setIntegrityChecks(enabled: int, heal: int, max_rollbacks: int) -> int:
     return 0
 
 
+def setPreemptionHandler(enabled: int) -> int:
+    """Arm/disarm graceful preemption from C (quest_tpu.supervisor):
+    nonzero installs the SIGTERM/SIGINT handler that drains runs at
+    their next flush/item boundary with an emergency checkpoint and a
+    QUEST_ERROR_PREEMPTED failure; zero uninstalls, restoring the
+    previous handlers.  The embedded interpreter's main thread owns
+    signal dispatch, so the handler lands exactly where a C driver's
+    own SIGTERM would."""
+    from . import supervisor
+
+    supervisor.set_preemption_handler(bool(enabled))
+    return 0
+
+
 def seedQuESTDefault() -> int:
     _qt.seed_quest_default()
     return 0

@@ -926,6 +926,7 @@ class Circuit:
     def run(self, qureg, pallas: str = "auto", key=None, *,
             checkpoint_dir: str | None = None,
             checkpoint_every: int | None = None,
+            deadline_s: float | None = None,
             _resume: dict | None = None):
         """Apply to a register (mutating facade, like the eager API).
 
@@ -953,8 +954,23 @@ class Circuit:
         a passing health check — a two-slot atomic rotation with a
         ``run_position`` sidecar, so a run killed mid-plan resumes
         bit-identically via ``resilience.resume_run`` (which supplies
-        ``_resume``, the restored position — not a user argument)."""
+        ``_resume``, the restored position — not a user argument).
+
+        Supervised execution (quest_tpu.supervisor): with graceful
+        preemption armed (``QUEST_PREEMPT=1`` /
+        ``supervisor.install_preemption_handler`` / C
+        ``setPreemptionHandler``) or a wall-clock ``deadline_s``
+        budget (``QUEST_DEADLINE_S``), the run also executes per plan
+        item: a requested preemption — or an item whose priced cost
+        exceeds the remaining deadline budget — drains the run at the
+        item boundary (emergency checkpoint into the two-slot
+        rotation, flight dump, typed ``QuESTPreemptedError`` /
+        ``QuESTTimeoutError``) so the caller resumes exactly there.
+        An armed admission gate (``supervisor.configure_gate`` /
+        ``QUEST_ADMISSION=1``) may shed the run at entry with
+        ``QuESTOverloadError`` instead of executing it."""
         from . import resilience
+        from . import supervisor
 
         ck_dir = (checkpoint_dir if checkpoint_dir is not None
                   else resilience.checkpoint_dir())
@@ -980,6 +996,20 @@ class Circuit:
                         self, qureg, pallas),
                     "parts": resilience.plan_fingerprint_parts(
                         self, qureg, pallas)}
+        dl = (deadline_s if deadline_s is not None
+              else supervisor.deadline_env_s())
+        # the QUEST_PREEMPT=1 handler installs on EVERY run entry —
+        # resumes included: a supervised relaunch enters through
+        # resume_run, and the SECOND preemption of a chain must drain
+        # as gracefully as the first
+        supervisor.maybe_autoinstall()
+        # lifecycle gate (quest_tpu.supervisor): outermost NEW runs
+        # pass admission — resumes and nested re-entries (rollbacks,
+        # degraded tails) are recovery work and must never be shed
+        outermost = metrics.run_depth() == 0
+        if outermost and _resume is None \
+                and not supervisor.in_recovery():
+            supervisor.admit("circuit_run")
         # trace correlation (quest_tpu.telemetry): every run mints a
         # run_id; the FIRST run of a chain stamps it as the trace_id,
         # and nested re-entries (a self-healing rollback's resume, a
@@ -987,7 +1017,8 @@ class Circuit:
         # — resume_run threads it across process restarts via the
         # checkpoint sidecar
         run_id = _tm.new_run_id()
-        with _tm.trace_scope(_tm.current_trace_id() or run_id), \
+        with supervisor.run_scope(dl, outermost=outermost), \
+                _tm.trace_scope(_tm.current_trace_id() or run_id), \
                 metrics.run_ledger("circuit_run"):
             # per-run resilience baseline: the record's `resilience`
             # annotation reports THIS run's retry/fault numbers, not
@@ -1000,6 +1031,12 @@ class Circuit:
             metrics.annotate_run(
                 "num_devices",
                 1 if qureg.mesh is None else int(qureg.mesh.devices.size))
+            if outermost and _resume is None \
+                    and not supervisor.in_recovery() \
+                    and supervisor.gate_enabled():
+                # reaching here means the gate admitted this run: the
+                # decision lands on the record (sheds never get one)
+                metrics.annotate_run("admission", "admitted")
             # sampled deep tracing (QUEST_TRACE_SAMPLE=N): the Nth
             # eligible run — outermost, not a resume re-entry, no
             # capture already live — pays for a full per-item timeline;
@@ -1016,9 +1053,22 @@ class Circuit:
                         or metrics.health_every() > 0
                         or ckpt is not None or _resume is not None
                         or resilience.watchdog_enabled()
-                        or resilience.integrity_enabled())
+                        or resilience.integrity_enabled()
+                        # supervised lifecycle: preemption drains and
+                        # deadline repricing need item boundaries,
+                        # which the whole-program jit cannot provide
+                        or supervisor.preempt_enabled()
+                        or dl is not None)
             if observed:
                 metrics.annotate_run("observed", True)
+            if dl is not None:
+                metrics.annotate_run("deadline_s", float(dl))
+            attempt = _tm.supervise_attempt()
+            if attempt is not None:
+                # supervised restart chains (tools/supervise.py): the
+                # attempt ordinal ties this run's ledger record to its
+                # position in the kill -> resume chain
+                metrics.annotate_run("supervise_attempt", attempt)
             try:
                 draws = self._has_nonunitary and self.num_measurements > 0
                 if draws and key is None:
@@ -1200,6 +1250,57 @@ class _HealthProbe:
         (a run may start from any state, not just norm 1)."""
         self._ref = measure_state_weight(amps, self._c.is_density,
                                          self._c.num_qubits, self._mesh)
+
+    def preflight(self, amps, meta: dict, exchange_bytes: int = 0,
+                  ndev: int = 1) -> None:
+        """Item-boundary lifecycle check (quest_tpu.supervisor),
+        invoked by ``observe_item`` BEFORE the item is counted,
+        recorded, or launched: a requested preemption — or a deadline
+        whose remaining budget cannot cover this item's priced cost —
+        drains the run here (emergency checkpoint, flight dump, typed
+        raise), so the refused item leaves no cursor advance and no
+        timeline event."""
+        from . import supervisor
+
+        supervisor.preflight_item(self, amps, meta, exchange_bytes,
+                                  ndev)
+
+    def emergency_snapshot(self, amps):
+        """One off-cadence drain snapshot into the run's two-slot
+        rotation (preemption / deadline expiry).  Returns
+        ``(slot_path | None, detail)``; never raises — a drain must
+        report its typed lifecycle error, not a checkpoint I/O error.
+        The state passes the NaN-scan health gate first (a poisoned
+        state must never overwrite a good checkpoint), and any
+        skip/failure counts ``supervisor.preempt_ckpt_failures`` (a
+        strictly-regressive ``ledger_diff`` rule watches it)."""
+        if self._ckpt is None:
+            return None, ("no checkpoint directory armed on this run "
+                          "— the drain point cannot be resumed")
+        reason, _ = check_state_health(
+            amps, is_density=self._c.is_density,
+            num_qubits=self._c.num_qubits, mesh=self._mesh,
+            before=None, n_ops=1, structural=False)
+        if reason is not None:
+            metrics.counter_inc("supervisor.preempt_ckpt_failures")
+            return None, (f"drain snapshot SKIPPED — state failed its "
+                          f"health gate ({reason}); last good "
+                          f"checkpoint: {self._last_snapshot}")
+        try:
+            self._snapshot(amps)
+        except Exception as e:
+            metrics.counter_inc("supervisor.preempt_ckpt_failures")
+            return None, (f"drain snapshot FAILED "
+                          f"({type(e).__name__}: {e}); last good "
+                          f"checkpoint: {self._last_snapshot}")
+        if self._last_snapshot is None:
+            # _snapshot skipped: the directory is owned by another
+            # writer (resilience.snapshot's one-rotation-one-owner
+            # contract) — nothing restorable was written here
+            metrics.counter_inc("supervisor.preempt_ckpt_failures")
+            return None, ("drain snapshot skipped (checkpoint "
+                          "directory owned by another writer)")
+        return self._last_snapshot, "emergency checkpoint written"
 
     def _snapshot(self, amps) -> None:
         from . import resilience

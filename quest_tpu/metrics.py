@@ -511,6 +511,7 @@ def export_text() -> str:
     payload of the C API's ``getMetricsText`` and of
     ``tools/metrics_serve.py``'s ``/metrics`` endpoint."""
     from . import resilience  # deferred: resilience imports metrics
+    from . import supervisor  # deferred: supervisor imports metrics
 
     health = resilience.mesh_health()
     gauges = {
@@ -519,6 +520,12 @@ def export_text() -> str:
         "mesh.strikes_total": sum(health["strikes"].values()),
         "timeline.active": 1 if timeline_active() else 0,
         "trace.sample_every": telemetry.trace_sample_every(),
+        # lifecycle gauges (quest_tpu.supervisor): what an autoscaler
+        # or load balancer needs next to the SLO histograms — is this
+        # replica draining, and how loaded is it right now
+        "supervisor.draining": 1 if supervisor.preempt_requested() else 0,
+        "supervisor.inflight": supervisor.inflight(),
+        "supervisor.gate_enabled": 1 if supervisor.gate_enabled() else 0,
     }
     return telemetry.render_prometheus(counters(), histograms(),
                                        gauges=gauges)

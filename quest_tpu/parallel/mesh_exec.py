@@ -650,7 +650,10 @@ def observe_item(f, amps, meta: dict, hook=None):
     from .. import resilience
 
     cur = getattr(hook, "cursor", None) if hook is not None else None
-    if cur is not None and not cur.take():
+    if cur is not None and cur.executed < cur.skip:
+        # resume skip-replay: the restored state already carries this
+        # item; no preflight, no flight/timeline/hook activity
+        cur.take()
         return amps
     itemsize = jnp.dtype(amps.dtype).itemsize
     args = dict(meta)
@@ -668,6 +671,16 @@ def observe_item(f, amps, meta: dict, hook=None):
         # the same one-sweep figure the ledger's exec.stream_bytes uses
         args["stream_bytes"] = stream_elems * itemsize
     wd_meta = dict(args, kind=kind, ndev=ndev)
+    # lifecycle preflight (quest_tpu.supervisor): a requested
+    # preemption, or a deadline whose remaining budget cannot cover
+    # this item's priced cost, drains the run HERE — before the item
+    # is counted, flight-recorded, walled, or launched, so a refused
+    # item leaves no cursor advance and no timeline event
+    pre = getattr(hook, "preflight", None) if hook is not None else None
+    if pre is not None:
+        pre(amps, wd_meta, exchange_bytes, ndev)
+    if cur is not None:
+        cur.take()
     wall = resilience.watchdog_begin(wd_meta, exchange_bytes, ndev)
     chk = f if isinstance(f, _CheckedFn) else None
     # everything after the wall is armed runs under the cancel guard: a

@@ -32,6 +32,7 @@ from . import metrics
 from . import precision
 from . import qasm
 from . import resilience
+from . import supervisor
 from . import telemetry
 from .env import QuESTEnv
 from .ops.lattice import (amp_sharding, amps_shape, lru_get, merge_amps,
@@ -178,6 +179,14 @@ class Qureg:
             metrics.counter_inc("flush.runs")
             metrics.counter_inc("flush.ops", len(self._pending))
             self._flush_inner()
+            # Graceful-preemption drain, symmetric with Circuit.run's
+            # item-boundary drain — AFTER the whole pending stream
+            # (gate runs AND the non-gate channel/collapse chains) has
+            # been applied, so the emergency snapshot captures every
+            # op the driver issued: a requested preemption forces one
+            # off-cadence flush snapshot (when the policy is armed)
+            # and raises QuESTPreemptedError at this flush boundary.
+            supervisor.maybe_drain_eager(self)
 
     def _flush_inner(self) -> None:
         import jax

@@ -155,8 +155,11 @@ SEAMS = frozenset({
 #: until the armed watchdog's deadline fires) are valid only on the
 #: :data:`STRAGGLER_SEAMS`; the silent-data-corruption kinds
 #: ``bitflip:<bit>`` and ``scale:<ppm>`` (see :func:`sdc_params`) only
-#: on the :data:`SDC_SEAMS`.
-KINDS = ("io", "runtime", "nan", "stall")
+#: on the :data:`SDC_SEAMS`; ``preempt`` (a deterministic SIGTERM: the
+#: seam flips the cooperative preempt flag, so the run drains at its
+#: NEXT item boundary exactly as a real signal would — zero
+#: randomness) only on the :data:`PREEMPT_SEAMS`.
+KINDS = ("io", "runtime", "nan", "stall", "preempt")
 
 #: The seams that model slow/hung devices (``delay:<ms>`` / ``stall``):
 #: the ones walled by the collective watchdog.
@@ -168,6 +171,11 @@ STRAGGLER_SEAMS = ("mesh_exchange", "run_item")
 #: target), ``run_item`` poisons the produced state (the drift-budget
 #: detector's drill target).
 SDC_SEAMS = ("mesh_exchange", "run_item")
+
+#: The seams that may script a deterministic ``preempt`` (the observed
+#: per-item seams: a preemption drill fires at a scripted plan item,
+#: modelling a SIGTERM that arrived while that item executed).
+PREEMPT_SEAMS = ("mesh_exchange", "run_item")
 
 #: Per-seam bounded retry budget (attempts AFTER the first).  Sinks are
 #: best-effort (they already degrade), so one retry; checkpoint I/O is
@@ -184,6 +192,24 @@ RETRY_POLICY = {
 #: Backoff base delay in seconds; attempt i sleeps base * 2^(i-1) —
 #: deterministic, no jitter (a drill must reproduce exactly).
 RETRY_BASE_DELAY = 0.02
+
+
+def retry_policy_table_md() -> str:
+    """:data:`RETRY_POLICY` rendered as the markdown table embedded in
+    ``docs/ROBUSTNESS.md`` (between the ``RETRY_POLICY`` generated
+    markers) — one row per seam with its retried-attempt budget and
+    the exact deterministic backoff sleeps (``RETRY_BASE_DELAY *
+    2^(i-1)`` before retry i).  The doc is GENERATED from this
+    function and a test pins file == code, so the published policy can
+    never rot away from the one that actually runs."""
+    lines = ["| seam | retried attempts | backoff before retry i |",
+             "|---|---|---|"]
+    for seam in sorted(RETRY_POLICY):
+        n = RETRY_POLICY[seam]
+        sleeps = ", ".join(f"{RETRY_BASE_DELAY * (1 << i):g} s"
+                           for i in range(n))
+        lines.append(f"| `{seam}` | {n} | {sleeps} |")
+    return "\n".join(lines)
 
 #: Two-slot rotation directory names inside a checkpoint directory.
 SLOTS = ("slot-0", "slot-1")
@@ -307,6 +333,11 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
                 f"fault kind {kind!r} models silent data corruption "
                 f"and is valid only on the {sorted(SDC_SEAMS)} seams, "
                 f"not {seam!r}")
+        if kind == "preempt" and seam not in PREEMPT_SEAMS:
+            raise QuESTValidationError(
+                f"fault kind 'preempt' models a mid-run SIGTERM and "
+                f"is valid only on the {sorted(PREEMPT_SEAMS)} seams, "
+                f"not {seam!r}")
         try:
             hit = int(hit)
         except (TypeError, ValueError):
@@ -400,8 +431,12 @@ def fault_point(name: str) -> str | None:
     modelling a hung collective; the SDC kinds ``bitflip:<bit>`` /
     ``scale:<ppm>`` RETURN the spec string itself — the caller
     (``observe_item``) corrupts the collective payload in flight
-    (``mesh_exchange``) or the produced state (``run_item``).  With no
-    plan installed this is a single dict lookup and returns None."""
+    (``mesh_exchange``) or the produced state (``run_item``);
+    ``preempt`` flips the cooperative preemption flag
+    (``supervisor.request_preemption``) and RETURNS ``"preempt"`` —
+    the item completes and the run drains at its next boundary, a
+    deterministic SIGTERM.  With no plan installed this is a single
+    dict lookup and returns None."""
     if _plan is None and not os.environ.get("QUEST_FAULT_PLAN"):
         return None
     plan = _current_plan()
@@ -425,6 +460,16 @@ def fault_point(name: str) -> str | None:
         return "delay"
     if fired == "stall":
         return "stall"
+    if fired == "preempt":
+        # a deterministic SIGTERM: flip the cooperative flag here (the
+        # current item still completes) so the run drains at its NEXT
+        # boundary — exactly the real signal's semantics, with an
+        # exactly-scripted arrival point
+        from . import supervisor  # deferred: supervisor is a sibling
+
+        supervisor.request_preemption(
+            source=f"fault:{name}:{idx}")
+        return "preempt"
     if sdc_params(fired) is not None:
         return fired
     if fired == "io":
@@ -609,7 +654,13 @@ class _WatchdogWall:
 def watchdog_begin(meta: dict, exchange_bytes: int,
                    ndev: int) -> "_WatchdogWall | None":
     """Arm the per-item deadline for one observed plan item; returns
-    None when the watchdog is disarmed (the common case — zero cost)."""
+    None when the watchdog is disarmed (the common case — zero cost).
+
+    Under a supervisor run deadline (``Circuit.run(deadline_s=...)``)
+    no extra clamp is needed here: the preflight refusal
+    (``supervisor.preflight_item``) only lets an item launch when this
+    SAME priced budget fits the remaining wall-clock budget, so an
+    armed wall always fires before the run's deadline would."""
     if not watchdog_enabled():
         return None
     return _WatchdogWall(meta, watchdog_budget_s(exchange_bytes, ndev))
@@ -1230,6 +1281,18 @@ def load_snapshot(qureg, directory: str) -> dict:
     candidates = [s for s in order
                   if os.path.isdir(os.path.join(directory, s))]
     if not candidates:
+        if not os.path.isfile(os.path.join(directory, stateio._META)):
+            # nothing here at all — neither rotation slot nor a flat
+            # snapshot.  Name the directory AND both expected slot
+            # paths (mirroring the every-slot-failed message below),
+            # so "wrong directory" reads instantly from the error
+            raise QuESTValidationError(
+                f"no checkpoint under {directory}: neither rotation "
+                f"slot exists "
+                f"({os.path.join(directory, SLOTS[0])}, "
+                f"{os.path.join(directory, SLOTS[1])}) and no flat "
+                f"snapshot ({stateio._META}) is present — was this "
+                "run ever checkpointed into this directory?")
         # no rotation: a flat save_checkpoint directory
         stateio.restore_checkpoint(qureg, directory)
         pos = _read_position(directory)
@@ -1560,7 +1623,8 @@ def _describe_fingerprint_diff(got_parts: dict, want_parts: dict) -> list:
 
 
 def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
-               allow_topology_change: bool = False):
+               allow_topology_change: bool = False,
+               deadline_s: float | None = None):
     """Resume an interrupted ``Circuit.run``: restore the last-good
     snapshot under ``directory`` into ``qureg``, validate the plan
     fingerprint, and replay ONLY the remaining plan items (skipped
@@ -1590,7 +1654,24 @@ def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
     target).  Only op-aligned checkpoint boundaries support a degraded
     resume (the sidecar's ``ops_applied``); a mid-segment-batch cut is
     refused because the scheduler's in-batch reordering leaves no
-    op-aligned prefix there."""
+    op-aligned prefix there.
+
+    ``deadline_s`` arms a FRESH wall-clock budget for the resumed run
+    (``Circuit.run(deadline_s=...)``) — the supervised-restart loop's
+    contract: a deadline-expired run checkpoints-then-raises, and its
+    resume continues under a new budget.  Resumes always BYPASS the
+    admission gate (``supervisor.recovery_scope``): shedding recovery
+    work would turn a survivable preemption into a lost run."""
+    from . import supervisor  # deferred: sibling lifecycle module
+
+    with supervisor.recovery_scope():
+        return _resume_run_inner(circuit, qureg, directory, pallas,
+                                 allow_topology_change, deadline_s)
+
+
+def _resume_run_inner(circuit, qureg, directory: str, pallas,
+                      allow_topology_change: bool,
+                      deadline_s: float | None):
     pos = load_snapshot(qureg, directory)
     if "item_index" not in pos:
         raise QuESTValidationError(
@@ -1613,7 +1694,8 @@ def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
             return circuit.run(qureg, pallas=pallas,
                                checkpoint_dir=directory if every
                                else None,
-                               checkpoint_every=every, _resume=pos)
+                               checkpoint_every=every,
+                               deadline_s=deadline_s, _resume=pos)
     want_parts = plan_fingerprint_parts(circuit, qureg, pallas)
     got_parts = pos.get("fingerprint_parts")
     base = (f"checkpoint at {pos['slot']} was written by a different "
@@ -1638,7 +1720,8 @@ def resume_run(circuit, qureg, directory: str, pallas: str = "auto",
             "resume; C API resumeRunEx)")
     restore_mesh_health(pos.get("mesh_health"))  # accepted: inherit
     with _inherited_trace(pos):
-        return _resume_degraded(circuit, qureg, pos, pallas, named)
+        return _resume_degraded(circuit, qureg, pos, pallas, named,
+                                deadline_s)
 
 
 def _inherited_trace(pos: dict):
@@ -1652,7 +1735,8 @@ def _inherited_trace(pos: dict):
     return telemetry.trace_scope(tid) if tid else contextlib.nullcontext()
 
 
-def _resume_degraded(circuit, qureg, pos: dict, pallas, named: str):
+def _resume_degraded(circuit, qureg, pos: dict, pallas, named: str,
+                     deadline_s: float | None = None):
     """Degraded-mesh resume onto ``qureg``'s (smaller/different) mesh;
     the snapshot state is ALREADY restored into ``qureg``'s sharding
     (``load_snapshot`` in :func:`resume_run`).  See the contract in
@@ -1699,13 +1783,14 @@ def _resume_degraded(circuit, qureg, pos: dict, pallas, named: str):
         # program)
         resume = {"item_index": 0, "outcomes": [], "key": pos.get("key"),
                   "preseed": preseed, "slot": pos.get("slot")}
-        return tail.run(qureg, pallas=pallas, _resume=resume)
+        return tail.run(qureg, pallas=pallas, deadline_s=deadline_s,
+                        _resume=resume)
     if tail.num_measurements:
         # no prior draws: a plain clean run with the stored key is
         # exactly the uninterrupted smaller-mesh run of the tail
-        return tail.run(qureg, pallas=pallas,
+        return tail.run(qureg, pallas=pallas, deadline_s=deadline_s,
                         key=decode_prng_key(pos.get("key")))
-    out = tail.run(qureg, pallas=pallas)
+    out = tail.run(qureg, pallas=pallas, deadline_s=deadline_s)
     if preseed:
         # every recorded draw happened before the cut: the outcomes
         # vector is exactly the replayed prefix
@@ -1759,6 +1844,62 @@ def maybe_eager_checkpoint(qureg) -> None:
              position={"format_version": 1, "kind": "flush",
                        "flush_index": n, "register_uid": uid,
                        "trace_id": telemetry.current_trace_id()})
+
+
+def eager_emergency_checkpoint(qureg):
+    """One OFF-CADENCE flush snapshot for the eager/C path's
+    preemption drain (``supervisor.maybe_drain_eager``): when the
+    process checkpoint policy is armed, snapshot the register at this
+    flush boundary regardless of the cadence, so the drained driver
+    loses nothing.  Returns ``(slot_path | None, detail)`` and never
+    raises — the drain must surface its typed
+    ``QuESTPreemptedError``, not a checkpoint I/O error; skips and
+    failures count ``supervisor.preempt_ckpt_failures`` (watched by a
+    strictly-regressive ``ledger_diff`` rule).  Flush boundaries are
+    canonical layout, so the snapshot restores as a plain final state
+    (:func:`resume_state` / C ``resumeRun``)."""
+    every = checkpoint_every()
+    directory = checkpoint_dir()
+    if not every or not directory:
+        return None, ("no process checkpoint policy armed "
+                      "(setCheckpointEvery / QUEST_CKPT_DIR + "
+                      "QUEST_CKPT_EVERY) — the drain point cannot be "
+                      "resumed")
+    uid = getattr(qureg, "_res_uid", None)
+    if uid is None:
+        _uid_counter[0] += 1
+        uid = _uid_counter[0]
+        qureg._res_uid = uid
+    n = _eager_flush_counts.get(uid, 0)
+    from .circuit import check_state_health  # deferred: import cycle
+
+    reason, _ = check_state_health(
+        qureg._amps, is_density=qureg.is_density,
+        num_qubits=qureg.num_qubits, mesh=qureg.mesh, before=None,
+        n_ops=1)
+    if reason is not None:
+        metrics.counter_inc("supervisor.preempt_ckpt_failures")
+        return None, (f"drain snapshot SKIPPED — state failed its "
+                      f"health gate ({reason}); the previous "
+                      "checkpoint, if any, is the last good state")
+    try:
+        path = snapshot(
+            qureg._amps, num_qubits=qureg.num_qubits,
+            is_density=qureg.is_density, mesh=qureg.mesh,
+            directory=directory, owner=f"register:{uid}",
+            position={"format_version": 1, "kind": "flush",
+                      "flush_index": n, "register_uid": uid,
+                      "preempted": True,
+                      "trace_id": telemetry.current_trace_id()})
+    except Exception as e:
+        metrics.counter_inc("supervisor.preempt_ckpt_failures")
+        return None, (f"drain snapshot FAILED "
+                      f"({type(e).__name__}: {e})")
+    if path is None:
+        metrics.counter_inc("supervisor.preempt_ckpt_failures")
+        return None, ("drain snapshot skipped (checkpoint directory "
+                      "owned by another writer)")
+    return path, "emergency flush checkpoint written"
 
 
 def reset() -> None:

@@ -1,0 +1,728 @@
+"""Supervised execution: preemption drain, run deadlines, admission.
+
+The resilience layer (``quest_tpu.resilience``) makes a *run*
+survivable — checkpoint/resume, watchdogs, degraded-mesh resume,
+self-healing rollback — and the telemetry layer makes it observable.
+This module makes the *process* survivable: on real TPU pods the
+dominant failure mode is the scheduler preempting the VM mid-run, and
+a serving front end melting down when demand exceeds capacity.  Three
+lifecycle subsystems, all strictly opt-in (the default path never
+consults any of them beyond a flag read):
+
+* **Graceful preemption** — :func:`install_preemption_handler` (env
+  ``QUEST_PREEMPT=1``, C ``setPreemptionHandler``) registers a
+  SIGTERM/SIGINT handler that flips a cooperative *preempt flag*
+  (:func:`request_preemption` — also callable directly, and fired
+  deterministically by the ``preempt`` fault kind).  An observed
+  ``Circuit.run`` checks the flag at every plan-item boundary
+  (``mesh_exec.observe_item`` → ``_HealthProbe.preflight``): when set,
+  the run takes ONE emergency checkpoint into its existing two-slot
+  rotation (same sidecar, same trace_id — the chain survives the
+  restart), dumps the flight ring, and raises a typed
+  :class:`~quest_tpu.validation.QuESTPreemptedError` (ABI code 6).
+  The eager/C flush path drains symmetrically at flush boundaries
+  (:func:`maybe_drain_eager`).
+
+* **Run deadlines** — ``Circuit.run(deadline_s=...)`` /
+  ``QUEST_DEADLINE_S`` threads a wall-clock budget into the run
+  (:func:`deadline_scope`).  The remaining budget reprices the
+  per-item watchdog deadlines (``resilience.watchdog_begin`` caps its
+  wall at the remaining budget), and :func:`preflight_item` refuses an
+  item whose priced cost (``resilience.watchdog_budget_s`` — the SAME
+  exchange-byte pricing the ledger and watchdog use) exceeds the
+  remaining budget: the run checkpoints and raises
+  ``QuESTTimeoutError`` *before* the item launches, never after a
+  hang, so the caller resumes with a fresh budget.
+
+* **Admission control** — :func:`configure_gate` (env
+  ``QUEST_ADMISSION=1`` + ``QUEST_MAX_INFLIGHT`` /
+  ``QUEST_SLO_P99_S`` / ``QUEST_RETRY_AFTER_S``) arms a gate consulted
+  at every outermost ``Circuit.run`` entry (:func:`admit`): runs are
+  shed with a typed :class:`~quest_tpu.validation.QuESTOverloadError`
+  (ABI code 7, ``retry_after_s`` hint) when the mesh-health breaker
+  reports DEGRADED devices (``shed_unhealthy``), the in-flight cap is
+  saturated, or the live ``run.wall_s.<label>`` p99 from the SLO
+  histograms breaches the configured bound (both ``shed_overload``).
+  Every decision is counted (``supervisor.admitted`` /
+  ``shed_overload`` / ``shed_unhealthy``) and admitted runs are
+  annotated on their ledger record; ``/readyz``
+  (``tools/metrics_serve.py``) serves the same verdict as HTTP
+  200/503.  :func:`serve` is the bounded-concurrency in-process run
+  queue on top of the gate.
+
+``tools/supervise.py`` is the out-of-process face: a stdlib-only
+restart loop that relaunches a run script whenever it exits with the
+preempted/deadline codes, making kill→resume chains fully automatic
+(:func:`run_or_resume` / :func:`supervised_main` are the script-side
+helpers).  Everything here is deterministic — no randomness in
+sampling, shedding, or backoff — so every lifecycle drill reproduces
+exactly (``tools/chaos_drill.py`` rows ``preempt_drain`` /
+``deadline_budget`` / ``overload_shed``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import sys
+import threading
+
+from . import metrics
+from . import telemetry
+from .validation import (QuESTOverloadError, QuESTPreemptedError,
+                         QuESTTimeoutError, QuESTValidationError)
+
+#: Default retry_after_s hint carried by shed runs (override via
+#: configure_gate / QUEST_RETRY_AFTER_S).
+RETRY_AFTER_S_DEFAULT = 1.0
+
+#: Ledger label whose run.wall_s histogram the SLO check reads by
+#: default (Circuit.run's label).
+SLO_LABEL_DEFAULT = "circuit_run"
+
+_lock = threading.Lock()
+
+#: Cooperative preempt flag + handler bookkeeping.  The flag is a plain
+#: dict read on the hot(ish) observed path — no lock needed to test it.
+_preempt = {"flag": False, "source": None}
+_handlers: dict[int, object] = {}   # signum -> previous handler
+
+#: Admission gate config (programmatic wins over env, set_watchdog
+#: contract: None keeps, non-positive clears back to env/default).
+_gate = {"on": False, "max_inflight": None, "slo_p99_s": None,
+         "retry_after_s": None, "slo_label": None}
+
+#: Outermost runs currently executing in this process (admission cap
+#: denominator); guarded by _lock.
+_inflight = [0]
+
+_tls = threading.local()
+
+
+# ---------------------------------------------------------------------------
+# Graceful preemption
+# ---------------------------------------------------------------------------
+
+
+def request_preemption(source: str = "manual") -> None:
+    """Flip the cooperative preempt flag: every observed run drains at
+    its next plan-item boundary (emergency checkpoint → flight dump →
+    :class:`QuESTPreemptedError`), and the eager path drains at its
+    next flush.  Called by the installed signal handler, by the
+    scripted ``preempt`` fault kind (deterministic drills), or
+    directly."""
+    already = _preempt["flag"]
+    _preempt["flag"] = True
+    _preempt["source"] = source
+    if not already:
+        metrics.counter_inc("supervisor.preempt_requests")
+        metrics.trace(f"preemption requested ({source}): runs will "
+                      "drain at their next item/flush boundary")
+
+
+def clear_preemption() -> None:
+    """Drop the preempt flag (an operator resuming IN-PROCESS after a
+    drain; a supervised restart clears it by being a fresh process)."""
+    _preempt["flag"] = False
+    _preempt["source"] = None
+
+
+def preempt_requested() -> bool:
+    """True once :func:`request_preemption` fired (a signal arrived, a
+    drill scripted it, or a caller asked): the process is draining."""
+    return _preempt["flag"]
+
+
+def _on_signal(signum, frame) -> None:  # pragma: no cover - signal path
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    request_preemption(source=f"signal:{name}")
+
+
+def install_preemption_handler(signals=(signal.SIGTERM,
+                                        signal.SIGINT)) -> None:
+    """Install the cooperative preemption handler on ``signals``
+    (default SIGTERM + SIGINT — the pod scheduler's and the operator's
+    spellings of "wrap up").  The handler only flips the preempt flag;
+    the run itself drains at its next boundary, so no signal-unsafe
+    work happens in the handler.  Previous handlers are remembered and
+    restored by :func:`uninstall_preemption_handler`.  Signal handlers
+    are a main-thread-only facility; installing from another thread
+    raises the underlying ``ValueError``."""
+    for s in signals:
+        s = int(s)
+        if s not in _handlers:
+            _handlers[s] = signal.signal(s, _on_signal)
+        else:
+            signal.signal(s, _on_signal)
+
+
+def uninstall_preemption_handler() -> None:
+    """Restore the pre-install handlers and forget them (idempotent)."""
+    while _handlers:
+        s, prev = _handlers.popitem()
+        with contextlib.suppress(ValueError, TypeError, OSError):
+            signal.signal(s, prev if prev is not None
+                          else signal.SIG_DFL)
+
+
+def set_preemption_handler(enabled: bool = True) -> None:
+    """Flag-style spelling of install/uninstall — the C ABI's
+    ``setPreemptionHandler(env, enabled)`` contract (and the
+    ``qt.setPreemptionHandler`` camelCase alias): truthy installs the
+    SIGTERM/SIGINT handler, falsy uninstalls and restores the previous
+    handlers."""
+    if enabled:
+        install_preemption_handler()
+    else:
+        uninstall_preemption_handler()
+
+
+def handler_installed() -> bool:
+    """True while :func:`install_preemption_handler` handlers are live."""
+    return bool(_handlers)
+
+
+def preempt_enabled() -> bool:
+    """True when graceful preemption is armed — a handler is installed,
+    the ``QUEST_PREEMPT=1`` env knob is set (auto-installs at the next
+    ``Circuit.run``), or a preemption is already requested.  An armed
+    supervisor routes ``Circuit.run`` onto the observed per-item path:
+    the drain needs item boundaries, which the whole-program jit
+    cannot provide."""
+    return (bool(_handlers) or _preempt["flag"]
+            or os.environ.get("QUEST_PREEMPT") == "1")
+
+
+def maybe_autoinstall() -> None:
+    """The ``QUEST_PREEMPT=1`` path for unmodified drivers: install the
+    handler lazily at ``Circuit.run`` entry.  Off the main thread
+    (where CPython refuses signal.signal) the flag-based machinery
+    still works — a drill or another thread's handler can still
+    request the drain — so the refusal degrades silently."""
+    if os.environ.get("QUEST_PREEMPT") != "1" or _handlers:
+        return
+    with contextlib.suppress(ValueError):
+        install_preemption_handler()
+
+
+# ---------------------------------------------------------------------------
+# Run deadlines
+# ---------------------------------------------------------------------------
+
+
+def deadline_env_s() -> float | None:
+    """The ``QUEST_DEADLINE_S`` wall-clock budget (None when unset or
+    unparseable/non-positive)."""
+    try:
+        v = float(os.environ["QUEST_DEADLINE_S"])
+    except (KeyError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
+def _deadlines() -> list:
+    s = getattr(_tls, "deadlines", None)
+    if s is None:
+        s = _tls.deadlines = []
+    return s
+
+
+@contextlib.contextmanager
+def deadline_scope(seconds: float):
+    """Arm a wall-clock budget for the scope (per thread, innermost
+    wins): ``Circuit.run(deadline_s=...)`` wraps its body in one.  The
+    clock is ``metrics.clock`` — the same timebase the ledger and the
+    watchdog walls read."""
+    seconds = float(seconds)
+    if seconds <= 0:
+        raise QuESTValidationError(
+            f"deadline_s must be a positive wall-clock budget, got "
+            f"{seconds!r}")
+    s = _deadlines()
+    s.append((metrics.clock() + seconds, seconds))
+    try:
+        yield
+    finally:
+        s.pop()
+
+
+def deadline_remaining() -> float | None:
+    """Seconds left in this thread's innermost armed deadline (may be
+    negative once expired), or None with no deadline armed."""
+    s = _deadlines()
+    if not s:
+        return None
+    return s[-1][0] - metrics.clock()
+
+
+def deadline_total() -> float | None:
+    """The innermost armed deadline's total budget (message context)."""
+    s = _deadlines()
+    return s[-1][1] if s else None
+
+
+# ---------------------------------------------------------------------------
+# Item-boundary preflight: the ONE place drains and refusals happen
+# ---------------------------------------------------------------------------
+
+
+def _drain(probe, amps, meta: dict, *, why: str, detail: str = ""):
+    """Drain one observed run at an item boundary: emergency
+    checkpoint (when the run is checkpointed and the state passes the
+    drain health check), flight dump, typed raise.  ``why`` is
+    ``"preempt"`` or ``"deadline"``."""
+    snapped, ck_detail = (probe.emergency_snapshot(amps)
+                          if probe is not None
+                          else (None, "no probe on this run"))
+    dump = metrics.flight_dump(
+        f"supervised drain ({why}) before plan item "
+        f"{meta.get('index')}",
+        offending={"item": dict(meta), "drain": why,
+                   "snapshot": snapped, "detail": detail or None})
+    resume_hint = (
+        f"; resume with resilience.resume_run (last-good snapshot: "
+        f"{snapped})" if snapped else f"; {ck_detail}")
+    flight_note = (f"; flight recorder dumped to {dump}" if dump else
+                   " (flight-recorder dump failed; see "
+                   "metrics.sink_errors)")
+    at = (f"plan item {meta.get('index')} ({meta.get('kind')})")
+    if why == "preempt":
+        metrics.counter_inc("supervisor.preemptions")
+        raise QuESTPreemptedError(
+            f"run preempted before {at}: cooperative drain "
+            f"(requested by {_preempt['source']})"
+            + resume_hint + flight_note)
+    metrics.counter_inc("supervisor.deadline_expired")
+    raise QuESTTimeoutError(
+        f"run deadline: {detail} — refusing {at} before launch"
+        + resume_hint + flight_note)
+
+
+def preflight_item(probe, amps, meta: dict, exchange_bytes: int = 0,
+                   ndev: int = 1) -> None:
+    """Item-boundary lifecycle check, called by
+    ``mesh_exec.observe_item`` BEFORE an item is counted, recorded, or
+    launched (via ``circuit._HealthProbe.preflight``) — so a refused
+    item leaves no cursor advance, no flight entry, and no timeline
+    event.
+
+    Two checks: a requested preemption drains the run here (see
+    :func:`_drain`), and an armed deadline refuses an item whose
+    priced cost — ``resilience.watchdog_budget_s`` over the item's own
+    exchange bytes, the exact figure the watchdog would wall it with —
+    exceeds the remaining budget.  Both checkpoint-then-raise, so the
+    caller resumes from this exact boundary."""
+    if _preempt["flag"]:
+        _drain(probe, amps, meta, why="preempt")
+    rem = deadline_remaining()
+    if rem is None:
+        return
+    from . import resilience  # deferred: resilience imports metrics
+
+    cost = resilience.watchdog_budget_s(int(exchange_bytes), int(ndev))
+    if rem <= 0:
+        _drain(probe, amps, meta, why="deadline",
+               detail=f"wall budget {deadline_total():.3f}s already "
+                      f"exhausted ({-rem:.3f}s over)")
+    if cost > rem:
+        _drain(probe, amps, meta, why="deadline",
+               detail=f"remaining budget {rem:.3f}s cannot cover the "
+                      f"item's priced cost {cost:.3f}s "
+                      f"(exchange_bytes={int(exchange_bytes)}, "
+                      f"{int(ndev)} device(s); cost = the watchdog "
+                      "budget formula, QUEST_WATCHDOG_* in "
+                      "docs/ROBUSTNESS.md)")
+
+
+def maybe_drain_eager(qureg) -> None:
+    """The eager/C flush path's symmetric drain, called after every
+    flushed gate run (``register._run_gates``): when a preemption is
+    requested, force one off-cadence flush checkpoint (when the
+    process checkpoint policy is armed — ``setCheckpointEvery`` /
+    ``QUEST_CKPT_DIR``+``_EVERY``), dump the flight ring, and raise
+    :class:`QuESTPreemptedError`.  Flush boundaries are always
+    canonical layout, so the snapshot restores as a plain final state
+    (``resilience.resume_state`` / C ``resumeRun``)."""
+    if not _preempt["flag"]:
+        return
+    from . import resilience  # deferred: resilience imports metrics
+
+    snapped, detail = resilience.eager_emergency_checkpoint(qureg)
+    dump = metrics.flight_dump(
+        "supervised drain (preempt) at flush boundary",
+        offending={"item": {"kind": "flush"}, "drain": "preempt",
+                   "snapshot": snapped})
+    metrics.counter_inc("supervisor.preemptions")
+    raise QuESTPreemptedError(
+        "flush preempted: cooperative drain (requested by "
+        f"{_preempt['source']})"
+        + (f"; resume with resilience.resume_state (snapshot: "
+           f"{snapped})" if snapped else f"; {detail}")
+        + (f"; flight recorder dumped to {dump}" if dump else
+           " (flight-recorder dump failed; see metrics.sink_errors)"))
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+
+def configure_gate(enabled: bool = True, *,
+                   max_inflight: int | None = None,
+                   slo_p99_s: float | None = None,
+                   retry_after_s: float | None = None,
+                   slo_label: str | None = None) -> None:
+    """Programmatically arm (or disarm) the admission gate and its
+    bounds.  ``None`` keeps the current override; a NON-POSITIVE value
+    CLEARS the override back to the env/default (the ``set_watchdog``
+    contract).  Env knobs for unmodified drivers: ``QUEST_ADMISSION=1``
+    arms it, with ``QUEST_MAX_INFLIGHT`` / ``QUEST_SLO_P99_S`` /
+    ``QUEST_RETRY_AFTER_S`` as the bounds."""
+    _gate["on"] = bool(enabled)
+
+    def _norm(v, cast):
+        if v is None:
+            return "keep"
+        v = cast(v)
+        return v if v > 0 else None
+
+    for key, v, cast in (("max_inflight", max_inflight, int),
+                         ("slo_p99_s", slo_p99_s, float),
+                         ("retry_after_s", retry_after_s, float)):
+        nv = _norm(v, cast)
+        if nv != "keep":
+            _gate[key] = nv
+    if slo_label is not None:
+        _gate["slo_label"] = slo_label or None
+
+
+def gate_enabled() -> bool:
+    """True when the admission gate is armed (programmatic
+    :func:`configure_gate` or ``QUEST_ADMISSION=1``)."""
+    return _gate["on"] or os.environ.get("QUEST_ADMISSION") == "1"
+
+
+def _gate_param(key: str, env: str, cast, default):
+    v = _gate[key]
+    if v is not None:
+        return v
+    try:
+        v = cast(os.environ[env])
+    except (KeyError, ValueError):
+        return default
+    return v if v > 0 else default
+
+
+def max_inflight() -> int | None:
+    """The in-flight concurrency cap (None = uncapped)."""
+    return _gate_param("max_inflight", "QUEST_MAX_INFLIGHT", int, None)
+
+
+def slo_p99_s() -> float | None:
+    """The run-wall p99 SLO bound in seconds (None = no SLO check)."""
+    return _gate_param("slo_p99_s", "QUEST_SLO_P99_S", float, None)
+
+
+def retry_after_s() -> float:
+    """The backoff hint shed runs carry (``QuESTOverloadError
+    .retry_after_s`` and the ``/readyz`` body)."""
+    return _gate_param("retry_after_s", "QUEST_RETRY_AFTER_S", float,
+                       RETRY_AFTER_S_DEFAULT)
+
+
+def slo_label() -> str:
+    """Ledger label whose ``run.wall_s.<label>`` histogram the SLO
+    check reads (``Circuit.run`` records under ``circuit_run``)."""
+    return _gate["slo_label"] or os.environ.get("QUEST_SLO_LABEL") \
+        or SLO_LABEL_DEFAULT
+
+
+def inflight() -> int:
+    """Outermost runs currently executing in this process."""
+    with _lock:
+        return _inflight[0]
+
+
+def _evaluate_gate(reserve: bool = False):
+    """The admission decision, shared by :func:`admit` and
+    :func:`readiness`: returns ``(ok, reason, shed_kind)`` where
+    ``shed_kind`` is the counter suffix (``shed_unhealthy`` /
+    ``shed_overload``) of a refusal.  Checks in severity order —
+    unhealthy mesh first (retrying locally cannot help), then the
+    concurrency cap, then the live p99-vs-SLO comparison from the SLO
+    histograms (PR 8's ``run.wall_s.<label>``).
+
+    ``reserve`` (the :func:`admit` path) takes the in-flight slot
+    ATOMICALLY with the cap check — check-then-increment under one
+    lock acquisition, released again if a later check sheds — so
+    concurrent admits can never overshoot ``max_inflight``;
+    :func:`run_scope` then consumes the reservation instead of
+    incrementing a second time."""
+    from . import resilience  # deferred: resilience imports metrics
+
+    degraded = resilience.mesh_health()["degraded"]
+    if degraded:
+        return (False, f"mesh unhealthy: device(s) {degraded} are "
+                       "marked DEGRADED by the circuit breaker",
+                "shed_unhealthy")
+    reserved = False
+    cap = max_inflight()
+    with _lock:
+        n = _inflight[0]
+        if cap is not None and n >= cap:
+            return (False, f"concurrency cap saturated ({n} in flight "
+                           f">= cap {cap})", "shed_overload")
+        if reserve:
+            _inflight[0] += 1
+            reserved = True
+    slo = slo_p99_s()
+    if slo is not None:
+        h = metrics.histograms().get(f"run.wall_s.{slo_label()}")
+        if h and h["count"] and h["p99"] is not None and h["p99"] > slo:
+            if reserved:
+                with _lock:
+                    _inflight[0] -= 1
+            return (False, f"run.wall_s.{slo_label()} p99 "
+                           f"{h['p99']:g}s breaches the configured "
+                           f"SLO {slo:g}s", "shed_overload")
+    if reserved:
+        _tls.admit_reserved = True
+    return True, None, None
+
+
+def admit(label: str = "circuit_run") -> None:
+    """Admission decision for one incoming run (``Circuit.run`` entry,
+    outermost non-resume runs only).  A no-op while the gate is
+    disarmed and no drain is in progress; otherwise every decision is
+    counted (``supervisor.admitted`` / ``shed_overload`` /
+    ``shed_unhealthy``) and refusals raise
+    :class:`QuESTOverloadError` with the ``retry_after_s`` hint.  A
+    draining process sheds every new run — the same verdict
+    ``/readyz`` serves as 503."""
+    if _preempt["flag"]:
+        metrics.counter_inc("supervisor.shed_overload")
+        raise QuESTOverloadError(
+            "run shed: process is draining (preemption requested by "
+            f"{_preempt['source']}); retry against another replica "
+            f"(retry_after_s={retry_after_s():g})",
+            retry_after_s=retry_after_s())
+    if not gate_enabled():
+        return
+    ok, reason, shed_kind = _evaluate_gate(reserve=True)
+    if ok:
+        metrics.counter_inc("supervisor.admitted")
+        metrics.trace(f"admission: admitted {label!r}")
+        return
+    metrics.counter_inc(f"supervisor.{shed_kind}")
+    ra = retry_after_s()
+    metrics.trace(f"admission: {shed_kind} {label!r}: {reason}")
+    raise QuESTOverloadError(
+        f"run shed ({shed_kind}): {reason} (retry_after_s={ra:g})",
+        retry_after_s=ra)
+
+
+def readiness():
+    """The ``/readyz`` verdict (never counts a decision): ``(ready,
+    reason, retry_after_s)`` — ready iff the process is not draining
+    AND the admission gate would admit a run right now."""
+    if _preempt["flag"]:
+        return (False, "draining (preemption requested by "
+                       f"{_preempt['source']})", retry_after_s())
+    if not gate_enabled():
+        return True, None, 0.0
+    ok, reason, _kind = _evaluate_gate()
+    return ok, reason, (0.0 if ok else retry_after_s())
+
+
+@contextlib.contextmanager
+def run_scope(deadline_s: float | None = None, *,
+              outermost: bool = True):
+    """Per-run lifecycle scope entered by ``Circuit.run``: arms the
+    deadline (when given) and holds one in-flight slot (outermost runs
+    only — nested resumes/rollbacks share the outer run's slot).  A
+    slot already reserved by :func:`admit`'s atomic
+    check-and-increment is CONSUMED here, not taken twice."""
+    reserved = getattr(_tls, "admit_reserved", False)
+    if reserved:
+        _tls.admit_reserved = False
+    track = outermost and not reserved
+    if track:
+        with _lock:
+            _inflight[0] += 1
+    try:
+        if deadline_s is not None:
+            with deadline_scope(deadline_s):
+                yield
+        else:
+            yield
+    finally:
+        if track or reserved:
+            with _lock:
+                _inflight[0] -= 1
+
+
+@contextlib.contextmanager
+def recovery_scope():
+    """Marks recovery work (``resilience.resume_run`` and the healing
+    rollbacks): admission is bypassed inside — shedding a resume would
+    turn a survivable preemption into a lost run."""
+    prev = getattr(_tls, "recovering", False)
+    _tls.recovering = True
+    try:
+        yield
+    finally:
+        _tls.recovering = prev
+
+
+def in_recovery() -> bool:
+    """True inside a :func:`recovery_scope` (this thread)."""
+    return getattr(_tls, "recovering", False)
+
+
+# ---------------------------------------------------------------------------
+# Bounded-concurrency in-process run queue
+# ---------------------------------------------------------------------------
+
+
+def serve(requests, *, workers: int = 2, label: str = "serve") -> list:
+    """Run ``requests`` (zero-argument callables) through a bounded
+    worker pool — the in-process run queue of the serving front end.
+    At most ``workers`` requests execute concurrently (queueing is the
+    backpressure; the admission gate still applies inside each
+    request's own ``Circuit.run``, so an unhealthy mesh sheds queued
+    work with typed errors instead of running it).
+
+    Returns one ``{"ok", "value" | "error"}`` dict per request, in
+    request order.  The submit-time trace scope propagates to the
+    worker threads, so queued work joins the caller's trace chain."""
+    import queue as _queue
+
+    jobs = list(requests)
+    if workers < 1:
+        raise QuESTValidationError(
+            f"serve: workers must be >= 1, got {workers}")
+    results: list = [None] * len(jobs)
+    q: _queue.Queue = _queue.Queue()
+    submit_tid = telemetry.current_trace_id()
+    for i, fn in enumerate(jobs):
+        q.put((i, fn))
+
+    def worker():
+        while True:
+            try:
+                i, fn = q.get_nowait()
+            except _queue.Empty:
+                return
+            scope = (telemetry.trace_scope(submit_tid) if submit_tid
+                     else contextlib.nullcontext())
+            try:
+                with scope:
+                    results[i] = {"ok": True, "value": fn()}
+                metrics.counter_inc("supervisor.serve_completed")
+            except Exception as e:  # typed errors are data here: a
+                # shed/drained request must not kill its worker (or
+                # the queue behind it)
+                results[i] = {"ok": False, "error": e}
+                metrics.counter_inc("supervisor.serve_failed")
+            finally:
+                q.task_done()
+
+    threads = [threading.Thread(target=worker,
+                                name=f"quest-serve-{label}-{k}")
+               for k in range(min(workers, max(len(jobs), 1)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Supervised-script helpers (the tools/supervise.py contract)
+# ---------------------------------------------------------------------------
+
+
+def resumable(directory: str) -> bool:
+    """True when ``directory`` holds a restorable mid-run rotation
+    slot with a ``run_position`` sidecar — the :func:`run_or_resume`
+    decision, peeked from the sidecars without touching any register."""
+    from . import resilience  # deferred: resilience imports metrics
+
+    for slot in resilience.SLOTS:
+        pos = resilience._read_position(os.path.join(directory, slot))
+        if pos:
+            return True
+    return False
+
+
+def run_or_resume(circuit, qureg, directory: str, *,
+                  pallas: str = "auto", checkpoint_every: int = 1,
+                  key=None, deadline_s: float | None = None):
+    """The supervised run script's ONE entry point: resume from
+    ``directory`` when an interrupted run left a restorable rotation
+    there, else start fresh with checkpointing armed into it.  Under
+    ``tools/supervise.py`` this makes the restart loop automatic —
+    kill → resume chains need no operator, and the trace_id threads
+    through the sidecar so the chain stays one queryable incident."""
+    from . import resilience  # deferred: resilience imports metrics
+
+    if resumable(directory):
+        return resilience.resume_run(circuit, qureg, directory,
+                                     pallas=pallas,
+                                     deadline_s=deadline_s)
+    return circuit.run(qureg, pallas=pallas, key=key,
+                       checkpoint_dir=directory,
+                       checkpoint_every=checkpoint_every,
+                       deadline_s=deadline_s)
+
+
+def supervised_main(fn) -> None:
+    """Run ``fn()`` and map the RESUMABLE lifecycle failures —
+    preemption (code 6) and deadline expiry (code 3) — to process exit
+    codes, the contract ``tools/supervise.py`` keys its automatic
+    restart on.  Any other exception propagates normally (a crash the
+    supervisor must NOT blindly restart)."""
+    try:
+        fn()
+    except (QuESTPreemptedError, QuESTTimeoutError) as e:
+        sys.exit(int(e.code))
+
+
+def state_snapshot() -> dict:
+    """JSON-serialisable view of the lifecycle state (the ``/readyz``
+    body and test hook): preempt flag/source, handler signals, armed
+    deadline remaining, gate config, in-flight count."""
+    ready, reason, ra = readiness()
+    return {
+        "draining": _preempt["flag"],
+        "preempt_source": _preempt["source"],
+        "handler_signals": sorted(_handlers),
+        "deadline_remaining_s": deadline_remaining(),
+        "gate_enabled": gate_enabled(),
+        "max_inflight": max_inflight(),
+        "slo_p99_s": slo_p99_s(),
+        "inflight": inflight(),
+        "ready": ready,
+        "reason": reason,
+        "retry_after_s": ra,
+    }
+
+
+def reset() -> None:
+    """Clear the preempt flag, uninstall any handlers, disarm the gate,
+    drop this thread's deadline stack, and zero the in-flight count
+    (test hook; the conftest autouse fixture calls this so a leaked
+    handler or tripped gate can never bleed into an unrelated test)."""
+    clear_preemption()
+    uninstall_preemption_handler()
+    _gate.update(on=False, max_inflight=None, slo_p99_s=None,
+                 retry_after_s=None, slo_label=None)
+    with _lock:
+        _inflight[0] = 0
+    _tls.deadlines = []
+    _tls.recovering = False
+    _tls.admit_reserved = False

@@ -111,6 +111,19 @@ def effective_trace_id() -> str | None:
     return current_trace_id() or _last["trace_id"]
 
 
+def supervise_attempt() -> int | None:
+    """The supervised-restart attempt ordinal (``tools/supervise.py``
+    exports ``QUEST_SUPERVISE_ATTEMPT=n`` into each relaunch), or None
+    outside a supervised chain.  ``Circuit.run`` annotates it onto the
+    ledger record, so a kill → resume chain's records carry both the
+    shared ``trace_id`` AND each process's position in the chain."""
+    try:
+        n = int(os.environ["QUEST_SUPERVISE_ATTEMPT"])
+    except (KeyError, ValueError):
+        return None
+    return n if n >= 1 else None
+
+
 # ---------------------------------------------------------------------------
 # Deterministic trace sampling (QUEST_TRACE_SAMPLE=N)
 # ---------------------------------------------------------------------------

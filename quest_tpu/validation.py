@@ -74,6 +74,35 @@ class QuESTTopologyError(QuESTError):
     code = 5
 
 
+class QuESTPreemptedError(QuESTError):
+    """The run was cooperatively drained after a preemption request
+    (SIGTERM/SIGINT via ``supervisor.install_preemption_handler`` /
+    ``QUEST_PREEMPT=1`` / C ``setPreemptionHandler``, or a scripted
+    ``preempt`` fault): the state was checkpointed into the run's
+    two-slot rotation (when one is armed) and the flight ring dumped
+    before this was raised, so ``resilience.resume_run`` — or the
+    ``tools/supervise.py`` restart loop keying on this code — continues
+    the run bit-identically under the same trace_id."""
+
+    code = 6
+
+
+class QuESTOverloadError(QuESTError):
+    """The admission gate shed this run instead of admitting it: the
+    mesh-health breaker reports DEGRADED devices, the in-flight
+    concurrency cap is saturated, or the live run-wall p99 breaches
+    the configured SLO (``supervisor.configure_gate``).  Carries a
+    ``retry_after_s`` hint — the caller should back off and retry, or
+    route to another replica (``/readyz`` reports 503 for the same
+    decision)."""
+
+    code = 7
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 def _fail(msg: str, func: str | None = None):
     raise QuESTValidationError(msg if func is None else f"{func}: {msg}")
 

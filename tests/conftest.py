@@ -152,11 +152,17 @@ def _reset_strike_and_fault_state():
     is equally process-global, and a test that degraded a sink would
     otherwise silently swallow the FIRST warning an unrelated later
     test asserts on (masking repeat warnings is exactly the registry's
-    production job — in the suite it is cross-test leakage)."""
+    production job — in the suite it is cross-test leakage).
+
+    The supervisor lifecycle state resets too (same pattern): a leaked
+    preemption handler would intercept the test runner's own SIGINT, a
+    leftover preempt flag would drain — and a tripped admission gate
+    would shed — every subsequent observed run in the session."""
     yield
     qt.resilience.clear_fault_plan()
     qt.resilience.clear_mesh_health()
     qt.metrics.clear_warn_once()
+    qt.supervisor.reset()
 
 
 def random_statevector(n, seed):

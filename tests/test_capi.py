@@ -151,6 +151,7 @@ def lib():
                                         ct.c_double, ct.c_double]
     L.setIntegrityChecks.argtypes = [QuESTEnv, ct.c_int, ct.c_int,
                                      ct.c_int]
+    L.setPreemptionHandler.argtypes = [QuESTEnv, ct.c_int]
     return L
 
 
@@ -384,6 +385,24 @@ def test_checkpoint_resume_c_api(lib, cenv, tmp_path):
     assert metrics.counters().get("resilience.resumes", 0) >= 1
     lib.destroyQureg(q, cenv)
     lib.destroyQureg(q2, cenv)
+
+
+def test_set_preemption_handler_c_api(lib, cenv):
+    """setPreemptionHandler over the REAL ABI: the shim shares this
+    process's interpreter, so installing from C must arm the same
+    cooperative-drain machinery the Python API uses (and uninstall
+    must restore the previous handlers)."""
+    import signal as _signal
+
+    from quest_tpu import supervisor
+
+    prev = _signal.getsignal(_signal.SIGTERM)
+    lib.setPreemptionHandler(cenv, 1)
+    assert supervisor.handler_installed()
+    assert supervisor.preempt_enabled()
+    lib.setPreemptionHandler(cenv, 0)
+    assert not supervisor.handler_installed()
+    assert _signal.getsignal(_signal.SIGTERM) is prev
 
 
 def test_error_taxonomy_c_api(lib, cenv, tmp_path):

@@ -484,7 +484,9 @@ def test_error_taxonomy_discipline():
     # the codes are ABI (capi/include/QuEST.h QuESTErrorCode): pinned
     assert (v.QuESTError.code, v.QuESTValidationError.code,
             v.QuESTTimeoutError.code, v.QuESTCorruptionError.code,
-            v.QuESTTopologyError.code) == (1, 2, 3, 4, 5)
+            v.QuESTTopologyError.code, v.QuESTPreemptedError.code,
+            v.QuESTOverloadError.code) == (1, 2, 3, 4, 5, 6, 7)
     for sub in (v.QuESTValidationError, v.QuESTTimeoutError,
-                v.QuESTCorruptionError, v.QuESTTopologyError):
+                v.QuESTCorruptionError, v.QuESTTopologyError,
+                v.QuESTPreemptedError, v.QuESTOverloadError):
         assert issubclass(sub, v.QuESTError)

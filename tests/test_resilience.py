@@ -849,3 +849,86 @@ def test_snapshot_rotation_alternates_slots(env1, tmp_path):
     # the sidecar of the latest slot carries the newest position
     pos = resilience.load_snapshot(qt.create_qureg(4, env1), d)
     assert pos["item_index"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Resume ergonomics: a never-checkpointed / stripped directory must
+# NAME what is missing (ISSUE-11 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_resume_run_names_directory_and_both_slots_when_empty(
+        env1, tmp_path):
+    """resume_run on a directory that was never checkpointed into (it
+    exists but holds neither rotation slot nor a flat snapshot) must
+    raise a QuESTError naming the directory AND both expected slot
+    paths — mirroring the both-slots-corrupt message, so 'wrong
+    directory' reads instantly from the error."""
+    d = str(tmp_path / "never-written")
+    os.makedirs(d)
+    q = qt.create_qureg(4, env1)
+    with pytest.raises(qt.QuESTError) as ei:
+        resilience.resume_run(models.qft(4), q, d)
+    msg = str(ei.value)
+    assert d in msg
+    for slot in resilience.SLOTS:
+        assert os.path.join(d, slot) in msg, msg
+
+
+def test_resume_run_missing_sidecars_names_both_slot_paths(
+        env1, tmp_path):
+    """Slots whose run_position sidecars were deleted (present arrays,
+    missing sidecar — damage, not corruption) are treated as corrupt,
+    and the every-slot-failed error names the directory and BOTH full
+    slot paths."""
+    d = str(tmp_path / "stripped")
+    circ = models.qft(6)
+    q = qt.create_qureg(6, env1)
+    resilience.set_fault_plan([("run_item", 4, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=1)
+    resilience.clear_fault_plan()
+    removed = 0
+    for slot in resilience.SLOTS:
+        p = os.path.join(d, slot, "run_position.json")
+        if os.path.exists(p):
+            os.remove(p)
+            removed += 1
+    assert removed == 2  # both slots had rotated in by item 4
+    with pytest.raises(qt.QuESTCorruptionError) as ei:
+        resilience.resume_run(circ, qt.create_qureg(6, env1), d)
+    msg = str(ei.value)
+    assert f"no restorable checkpoint under {d}" in msg
+    for slot in resilience.SLOTS:
+        assert os.path.join(d, slot) in msg, msg
+    assert "run_position" in msg
+
+
+# ---------------------------------------------------------------------------
+# Retry-policy doc table: generated, pinned doc <-> code (ISSUE-11
+# satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_doc_matches_code():
+    """docs/ROBUSTNESS.md embeds the RETRY_POLICY table between
+    generated markers; the file content must equal
+    resilience.retry_policy_table_md() exactly, so the published
+    policy can never rot away from the one that runs."""
+    path = os.path.join(REPO, "docs", "ROBUSTNESS.md")
+    with open(path) as f:
+        text = f.read()
+    begin = "<!-- BEGIN GENERATED: RETRY_POLICY"
+    end = "<!-- END GENERATED: RETRY_POLICY -->"
+    assert begin in text and end in text, (
+        "docs/ROBUSTNESS.md lost its RETRY_POLICY generated markers")
+    body = text.split(begin, 1)[1].split("-->", 1)[1]
+    body = body.split(end, 1)[0].strip()
+    want = resilience.retry_policy_table_md().strip()
+    assert body == want, (
+        "docs/ROBUSTNESS.md's retry table does not match "
+        "resilience.retry_policy_table_md() — regenerate the doc "
+        "block from the live table:\n" + want)
+    # every seam in the policy appears in the rendered table
+    for seam in resilience.RETRY_POLICY:
+        assert f"`{seam}`" in want

@@ -1,0 +1,686 @@
+"""Supervised-execution lifecycle tests (quest_tpu.supervisor):
+graceful preemption drain, run deadlines, admission control, the
+bounded run queue, and the tools/supervise.py restart contract.
+
+Everything here is deterministic: preemptions are scripted via the
+``preempt`` fault kind (a flag flip at an exact plan item — the same
+flag a real SIGTERM flips, which is tested separately with a real
+signal), deadlines price items through the watchdog formula with
+configured floors, and shedding decisions are pure reads of registry /
+counter state.  The acceptance drills (ISSUE-11) are pinned here and
+as ``CHAOS_r10.json`` rows:
+
+* SIGTERM drill — a checkpointed run killed mid-plan exits with the
+  preempted code having written a VALID checkpoint (``ckpt_fsck``
+  passes), and resumes bit-identically under ONE trace_id;
+* deadline drill — an item whose priced cost exceeds the remaining
+  budget is refused with ``QuESTTimeoutError`` BEFORE launch (no
+  timeline event for the refused item), then resumes bit-identically
+  with a fresh budget;
+* overload drill — a tripped breaker / saturated cap sheds with
+  ``QuESTOverloadError`` carrying ``retry_after_s``, ``/readyz``
+  reports 503, counters move, admitted runs are unaffected.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics, models, resilience, supervisor
+from quest_tpu.validation import (QuESTOverloadError,
+                                  QuESTPreemptedError,
+                                  QuESTTimeoutError,
+                                  QuESTValidationError)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+N = 8
+
+
+def _qft_ref(env, pallas=False):
+    q = qt.create_qureg(N, env)
+    models.qft(N).run(q, pallas=pallas)
+    return qt.get_state_vector(q)
+
+
+def _trace_of_last_run():
+    return (metrics.get_run_ledger() or {}).get("meta", {}).get("trace_id")
+
+
+# ---------------------------------------------------------------------------
+# Graceful preemption
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_drain_checkpoint_resume_bit_identical(env1, tmp_path):
+    """The SIGTERM drill, deterministic form: a scripted ``preempt``
+    fault flips the flag while item 3 executes; the checkpointed run
+    drains at the next boundary with ABI code 6 having written a
+    checkpoint that passes the offline fsck, and ``resume_run``
+    completes it bit-identically under the same trace_id."""
+    ref = _qft_ref(env1)
+    d = str(tmp_path / "ckpt")
+    circ = models.qft(N)
+    q = qt.create_qureg(N, env1)
+    before = metrics.counters()
+    resilience.set_fault_plan([("run_item", 3, "preempt")])
+    with pytest.raises(QuESTPreemptedError) as ei:
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    assert ei.value.code == 6
+    msg = str(ei.value)
+    assert "cooperative drain" in msg
+    assert "resume with resilience.resume_run" in msg
+    # the emergency checkpoint is REAL: offline fsck verifies it
+    rep = resilience.verify_checkpoint(d)
+    assert rep["ok"], rep
+    after = metrics.counters()
+    assert after.get("supervisor.preemptions", 0) \
+        - before.get("supervisor.preemptions", 0) == 1
+    assert after.get("supervisor.preempt_ckpt_failures", 0) \
+        == before.get("supervisor.preempt_ckpt_failures", 0)
+    drained_tid = _trace_of_last_run()
+    assert drained_tid
+    # same-process resume: stop draining first (a fresh supervised
+    # process never sees the flag)
+    supervisor.clear_preemption()
+    resilience.resume_run(circ, q, d, pallas=False)
+    assert _trace_of_last_run() == drained_tid
+    assert np.array_equal(qt.get_state_vector(q), ref)
+
+
+def test_preempt_drain_without_checkpoint_names_the_gap(env1):
+    """A preempted run with NO checkpoint armed still drains with the
+    typed error (naming the un-resumable gap) and leaves the register
+    unbricked — the observed path never donates."""
+    supervisor.install_preemption_handler()
+    circ = models.qft(N)
+    q = qt.create_qureg(N, env1)
+    resilience.set_fault_plan([("run_item", 2, "preempt")])
+    with pytest.raises(QuESTPreemptedError) as ei:
+        circ.run(q, pallas=False)
+    resilience.clear_fault_plan()
+    assert "no checkpoint directory armed" in str(ei.value)
+    assert abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+
+
+def test_real_signal_flips_flag_and_uninstall_restores():
+    """The actual signal path: an installed handler turns a real
+    SIGTERM into a flag flip (no exception, no death), and uninstall
+    restores the previous handler exactly."""
+    prev = signal.getsignal(signal.SIGTERM)
+    supervisor.install_preemption_handler()
+    assert supervisor.preempt_enabled()
+    signal.raise_signal(signal.SIGTERM)
+    assert supervisor.preempt_requested()
+    supervisor.uninstall_preemption_handler()
+    assert signal.getsignal(signal.SIGTERM) is prev
+    supervisor.clear_preemption()
+    assert not supervisor.preempt_requested()
+
+
+def test_eager_flush_path_drains_symmetrically(env1, tmp_path):
+    """The eager/C path's drain: a requested preemption forces one
+    off-cadence flush snapshot under the armed process policy and
+    raises at the flush boundary; the snapshot restores as a plain
+    final state (resume_state), bit-identical to the flushed work."""
+    d = str(tmp_path / "eager")
+    resilience.set_checkpoint_policy(d, 1000)  # armed, cadence never due
+    try:
+        q = qt.create_qureg(N, env1)
+        qt.hadamard(q, 0)
+        qt.controlled_not(q, 0, 1)
+        _ = qt.get_state_vector(q)  # clean flush, no drain
+        supervisor.request_preemption("test")
+        qt.pauli_x(q, 2)
+        with pytest.raises(QuESTPreemptedError) as ei:
+            qt.get_state_vector(q)  # forces the flush -> drain
+        assert "flush preempted" in str(ei.value)
+        assert "resume_state" in str(ei.value)
+        supervisor.clear_preemption()
+        fresh = qt.create_qureg(N, env1)
+        pos = resilience.resume_state(fresh, d)
+        assert pos.get("kind") == "flush"
+        assert pos.get("preempted") is True
+        # the drained flush HAD applied the X before checkpointing
+        want = qt.create_qureg(N, env1)
+        qt.hadamard(want, 0)
+        qt.controlled_not(want, 0, 1)
+        qt.pauli_x(want, 2)
+        assert np.array_equal(qt.get_state_vector(fresh),
+                              qt.get_state_vector(want))
+    finally:
+        resilience.set_checkpoint_policy(None, 0)
+
+
+def test_eager_drain_captures_whole_pending_stream(env1, tmp_path):
+    """The drain fires at the END of a flush — after the gate runs AND
+    the non-gate channel chain have been applied — so ops queued
+    behind the gate prefix are in the emergency snapshot, never lost."""
+    d = str(tmp_path / "eager-chain")
+    resilience.set_checkpoint_policy(d, 1000)
+    try:
+        dq = qt.create_density_qureg(3, env1)
+        qt.pauli_x(dq, 0)
+        qt.apply_one_qubit_damping_error(dq, 0, 0.25)  # non-gate chain
+        supervisor.request_preemption("test")
+        with pytest.raises(QuESTPreemptedError):
+            qt.calc_purity(dq)  # forces the flush -> drain at its END
+        supervisor.clear_preemption()
+        fresh = qt.create_density_qureg(3, env1)
+        resilience.resume_state(fresh, d)
+        want = qt.create_density_qureg(3, env1)
+        qt.pauli_x(want, 0)
+        qt.apply_one_qubit_damping_error(want, 0, 0.25)
+        assert np.array_equal(qt.get_density_matrix(fresh),
+                              qt.get_density_matrix(want))
+    finally:
+        resilience.set_checkpoint_policy(None, 0)
+
+
+def test_camel_alias_flag_semantics():
+    """qt.setPreemptionHandler keeps the C signature's flag shape:
+    truthy installs, zero uninstalls (a bare alias of install_ would
+    crash on the int)."""
+    prev = signal.getsignal(signal.SIGTERM)
+    qt.setPreemptionHandler(1)
+    assert supervisor.handler_installed()
+    qt.setPreemptionHandler(0)
+    assert not supervisor.handler_installed()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_admit_reserves_inflight_slot_atomically():
+    """admit() takes the in-flight slot under the same lock as the cap
+    check, so concurrent admits can never overshoot max_inflight; the
+    run_scope that follows consumes the reservation instead of
+    double-counting, and a later SLO shed releases it."""
+    supervisor.configure_gate(True, max_inflight=1)
+    supervisor.admit("t")          # reserves the only slot
+    assert supervisor.inflight() == 1
+    with pytest.raises(QuESTOverloadError):
+        supervisor.admit("t")      # cap saturated by the reservation
+    with supervisor.run_scope(None):   # consumes the reservation
+        assert supervisor.inflight() == 1
+    assert supervisor.inflight() == 0
+    # a reservation taken at the cap step is RELEASED when the SLO
+    # check sheds afterwards
+    metrics.hist_record("run.wall_s.circuit_run", 1.0)
+    supervisor.configure_gate(True, slo_p99_s=1e-12)
+    with pytest.raises(QuESTOverloadError):
+        supervisor.admit("t")
+    assert supervisor.inflight() == 0
+    supervisor.configure_gate(False, max_inflight=-1, slo_p99_s=-1.0)
+
+
+def test_preempt_fault_kind_validation():
+    """``preempt`` is valid only on the observed per-item seams."""
+    resilience.set_fault_plan([("run_item", 0, "preempt")])
+    resilience.set_fault_plan([("mesh_exchange", 1, "preempt")])
+    with pytest.raises(QuESTValidationError):
+        resilience.set_fault_plan([("ckpt_save", 0, "preempt")])
+    resilience.clear_fault_plan()
+
+
+# ---------------------------------------------------------------------------
+# Run deadlines
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_refuses_before_launch_then_resumes(env1, tmp_path):
+    """The deadline drill: a budget smaller than the first item's
+    priced cost (the watchdog floor) refuses that item BEFORE launch —
+    the timeline carries NO event for it — after checkpointing, and
+    the resume completes bit-identically under a fresh budget."""
+    ref = _qft_ref(env1)
+    d = str(tmp_path / "dl")
+    circ = models.qft(N)
+    q = qt.create_qureg(N, env1)
+    before = metrics.counters()
+    metrics.start_timeline()
+    try:
+        # 5 s budget vs the 30 s default per-item floor: the FIRST
+        # item's priced cost already exceeds the whole budget, so the
+        # refusal is immediate and deterministic (no waiting)
+        with pytest.raises(QuESTTimeoutError) as ei:
+            circ.run(q, pallas=False, checkpoint_dir=d,
+                     checkpoint_every=2, deadline_s=5.0)
+    finally:
+        doc = metrics.stop_timeline()
+    msg = str(ei.value)
+    assert "run deadline" in msg
+    assert "priced cost" in msg
+    assert "before launch" in msg
+    # the refused item launched nothing: zero walled plan items
+    assert doc["traceEvents"] == []
+    after = metrics.counters()
+    assert after.get("supervisor.deadline_expired", 0) \
+        - before.get("supervisor.deadline_expired", 0) == 1
+    # fresh budget (here: none) -> bit-identical completion
+    resilience.resume_run(circ, q, d, pallas=False)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+
+
+def test_deadline_mid_run_refusal_keeps_progress(env1, tmp_path):
+    """With a per-item floor far below the budget, the run makes real
+    progress before a scripted straggler drains the budget; the next
+    item is refused and the emergency checkpoint carries the applied
+    prefix (resume replays only the tail, bit-identical)."""
+    ref = _qft_ref(env1)
+    d = str(tmp_path / "dl2")
+    circ = models.qft(N)
+    # prewarm the observed per-item programs so compile time does not
+    # eat the budget (the chaos drill's _warm_observed pattern)
+    resilience.set_watchdog(True, min_s=300.0)
+    circ.run(qt.create_qureg(N, env1), pallas=False)
+    resilience.set_watchdog(False, min_s=-1.0)
+    resilience.set_watchdog(False, min_s=0.4, slack=4.0)
+    resilience.set_fault_plan([("run_item", 4, "delay:1600")])
+    q = qt.create_qureg(N, env1)
+    try:
+        with pytest.raises(QuESTTimeoutError) as ei:
+            circ.run(q, pallas=False, checkpoint_dir=d,
+                     checkpoint_every=2, deadline_s=2.0)
+    finally:
+        resilience.clear_fault_plan()
+        resilience.set_watchdog(False, min_s=-1.0, slack=-1.0)
+    assert "run deadline" in str(ei.value)
+    pos = resilience._read_position(
+        os.path.join(d, open(os.path.join(d, "latest")).read().strip()),
+        required=True)
+    assert pos["item_index"] >= 5  # items 0..4 (incl. the slow one) ran
+    resilience.resume_run(circ, q, d, pallas=False)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+
+
+def test_deadline_and_watchdog_share_one_pricing():
+    """The deadline preflight and the watchdog wall price an item with
+    the SAME function over the same inputs — which is exactly why an
+    armed wall always fires before the run's deadline: preflight only
+    launches an item whose priced cost fits the remaining budget, and
+    the wall it gets IS that cost."""
+    resilience.set_watchdog(True, min_s=0.7, gbps=10.0, slack=2.0)
+    try:
+        cost = resilience.watchdog_budget_s(8 << 20, 4)
+        wall = resilience.watchdog_begin({"index": 0}, 8 << 20, 4)
+        assert wall.budget == pytest.approx(cost)
+        wall.cancel()
+        # the formula itself: min_s + bytes/device / (gbps*1e9) * slack
+        assert cost == pytest.approx(
+            0.7 + ((8 << 20) / 4) / (10.0 * 1e9) * 2.0)
+    finally:
+        resilience.set_watchdog(False, min_s=-1.0, gbps=-1.0,
+                                slack=-1.0)
+
+
+def test_deadline_validation(env1):
+    q = qt.create_qureg(N, env1)
+    with pytest.raises(QuESTValidationError):
+        models.qft(N).run(q, deadline_s=0)
+    with pytest.raises(QuESTValidationError):
+        models.qft(N).run(q, deadline_s=-3)
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_matrix_counters_and_retry_after(env1):
+    """The overload drill, in-process: unhealthy mesh sheds
+    shed_unhealthy, a saturated cap sheds shed_overload with the
+    configured retry_after_s, an SLO p99 breach sheds, and admitted
+    runs complete unaffected with the decision annotated on their
+    ledger record."""
+    circ = models.qft(N)
+    before = metrics.counters()
+    supervisor.configure_gate(True, max_inflight=2, retry_after_s=4.5)
+    # admitted + annotated
+    q = qt.create_qureg(N, env1)
+    circ.run(q)
+    rec = metrics.get_run_ledger()
+    assert rec["meta"].get("admission") == "admitted"
+    assert abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+    # unhealthy mesh -> shed_unhealthy
+    resilience.set_watchdog(False, strikes=1)
+    resilience.suspect_devices([0], reason="admission test")
+    with pytest.raises(QuESTOverloadError) as ei:
+        circ.run(qt.create_qureg(N, env1))
+    assert ei.value.code == 7
+    assert "shed_unhealthy" in str(ei.value)
+    assert ei.value.retry_after_s == 4.5
+    resilience.clear_mesh_health()
+    resilience.set_watchdog(False, strikes=-1)
+    # saturated cap -> shed_overload (two outermost slots held open)
+    with supervisor.run_scope(None), supervisor.run_scope(None):
+        with pytest.raises(QuESTOverloadError) as ei:
+            circ.run(qt.create_qureg(N, env1))
+        assert "concurrency cap saturated" in str(ei.value)
+    # SLO p99 breach -> shed_overload (the histogram already has the
+    # admitted run's sample, and any positive wall beats 1e-9)
+    supervisor.configure_gate(True, slo_p99_s=1e-9)
+    with pytest.raises(QuESTOverloadError) as ei:
+        circ.run(qt.create_qureg(N, env1))
+    assert "breaches the configured SLO" in str(ei.value)
+    supervisor.configure_gate(False, max_inflight=-1, slo_p99_s=-1.0,
+                              retry_after_s=-1.0)
+    # admitted again once disarmed
+    q2 = qt.create_qureg(N, env1)
+    circ.run(q2)
+    assert abs(qt.calc_total_prob(q2) - 1.0) < 1e-6
+    after = metrics.counters()
+
+    def delta(k):
+        return after.get(k, 0) - before.get(k, 0)
+
+    assert delta("supervisor.admitted") == 1
+    assert delta("supervisor.shed_unhealthy") == 1
+    assert delta("supervisor.shed_overload") == 2
+    assert delta("supervisor.preemptions") == 0
+
+
+def test_resume_bypasses_admission(env1, tmp_path):
+    """Recovery work is never shed: a resume_run under a gate that
+    would refuse every new run still completes."""
+    ref = _qft_ref(env1)
+    d = str(tmp_path / "rec")
+    circ = models.qft(N)
+    q = qt.create_qureg(N, env1)
+    resilience.set_fault_plan([("run_item", 3, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    supervisor.configure_gate(True, max_inflight=1)
+    try:
+        with supervisor.run_scope(None):  # cap saturated for NEW runs
+            with pytest.raises(QuESTOverloadError):
+                circ.run(qt.create_qureg(N, env1))
+            resilience.resume_run(circ, q, d, pallas=False)
+    finally:
+        supervisor.configure_gate(False, max_inflight=-1)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+
+
+def test_draining_process_sheds_new_runs(env1):
+    supervisor.request_preemption("test")
+    with pytest.raises(QuESTOverloadError) as ei:
+        models.qft(N).run(qt.create_qureg(N, env1))
+    assert "draining" in str(ei.value)
+    supervisor.clear_preemption()
+
+
+def test_readyz_endpoint_tracks_gate_and_drain(env1):
+    """/readyz: 200 by default, 503 while draining, 503 with the gate
+    armed over a degraded mesh — with reason and retry_after_s in the
+    body — and back to 200 once cleared."""
+    import metrics_serve
+
+    server, port = metrics_serve.start_in_thread(0)
+
+    def readyz():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=30) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    try:
+        code, body = readyz()
+        assert code == 200 and body["ready"]
+        supervisor.request_preemption("test")
+        code, body = readyz()
+        assert code == 503 and body["draining"]
+        assert "draining" in body["reason"]
+        supervisor.clear_preemption()
+        supervisor.configure_gate(True, retry_after_s=2.5)
+        resilience.set_watchdog(False, strikes=1)
+        resilience.suspect_devices([0], reason="readyz test")
+        code, body = readyz()
+        assert code == 503 and not body["ready"]
+        assert "DEGRADED" in body["reason"]
+        assert body["retry_after_s"] == 2.5
+        resilience.clear_mesh_health()
+        resilience.set_watchdog(False, strikes=-1)
+        code, body = readyz()
+        assert code == 200 and body["ready"]
+        # the Prometheus export carries the lifecycle gauges
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            samples = metrics_serve.parse_text(r.read().decode())
+        assert samples.get("quest_supervisor_draining") == 0.0
+        assert "quest_supervisor_inflight" in samples
+    finally:
+        server.shutdown()
+        supervisor.configure_gate(False, retry_after_s=-1.0)
+
+
+def test_serve_bounded_queue_runs_everything_in_order():
+    """supervisor.serve: every request runs, results keep request
+    order, concurrency never exceeds the worker bound, and a typed
+    failure becomes that request's result instead of killing the
+    queue."""
+    lock = threading.Lock()
+    active = [0]
+    peak = [0]
+
+    def job(i):
+        def run():
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            try:
+                if i == 3:
+                    raise QuESTOverloadError("shed", retry_after_s=9.0)
+                return i * i
+            finally:
+                with lock:
+                    active[0] -= 1
+        return run
+
+    results = supervisor.serve([job(i) for i in range(6)], workers=2)
+    assert peak[0] <= 2
+    assert [r["ok"] for r in results] == [True, True, True, False,
+                                          True, True]
+    assert [r.get("value") for r in results[:3]] == [0, 1, 4]
+    assert isinstance(results[3]["error"], QuESTOverloadError)
+    assert results[3]["error"].retry_after_s == 9.0
+
+
+# ---------------------------------------------------------------------------
+# tools/supervise.py restart loop
+# ---------------------------------------------------------------------------
+
+
+def test_supervise_constants_pinned_to_retry_tables():
+    """The stdlib-only wrapper mirrors the resilience retry table; the
+    mirrors must never drift from the live values (they ARE the
+    'deterministic bounded backoff from the retry tables')."""
+    import supervise
+
+    assert supervise.RETRY_BASE_DELAY == resilience.RETRY_BASE_DELAY
+    assert supervise.MAX_RESTARTS_DEFAULT \
+        == resilience.RETRY_POLICY["ckpt_save"]
+    assert supervise.RESUMABLE_CODES == (QuESTPreemptedError.code,
+                                         QuESTTimeoutError.code)
+
+
+def test_supervise_restart_loop_contract(tmp_path):
+    """The loop itself, with a jax-free child: a resumable exit code
+    relaunches (attempt ordinal exported), completion ends the loop
+    with 0, and a non-resumable code is final with no relaunch."""
+    import supervise
+
+    marker = tmp_path / "attempts"
+    child = tmp_path / "child.py"
+    child.write_text(
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "att = os.environ.get('QUEST_SUPERVISE_ATTEMPT')\n"
+        "assert att == str(n + 1), (att, n)\n"
+        "sys.exit(6 if n == 0 else 0)\n")
+    rc = supervise.supervise([sys.executable, str(child)],
+                             max_restarts=3)
+    assert rc == 0
+    assert marker.read_text() == "2"
+    # non-resumable exit code: final, no restart
+    marker.unlink()
+    child.write_text(
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "sys.exit(5)\n")
+    rc = supervise.supervise([sys.executable, str(child)],
+                             max_restarts=3)
+    assert rc == 5
+    assert marker.read_text() == "1"
+    # restart budget exhausts: the resumable code is returned
+    marker.unlink()
+    child.write_text("import sys; sys.exit(6)\n")
+    rc = supervise.supervise([sys.executable, str(child)],
+                             max_restarts=1)
+    assert rc == 6
+
+
+def test_run_or_resume_roundtrip(env1, tmp_path):
+    """run_or_resume: fresh directory starts a checkpointed run;
+    after a drain the SAME call resumes it — the supervised script's
+    whole contract in two calls."""
+    ref = _qft_ref(env1)
+    d = str(tmp_path / "ror")
+    circ = models.qft(N)
+    q = qt.create_qureg(N, env1)
+    assert not supervisor.resumable(d)
+    resilience.set_fault_plan([("run_item", 3, "preempt")])
+    with pytest.raises(QuESTPreemptedError):
+        supervisor.run_or_resume(circ, q, d, pallas=False,
+                                 checkpoint_every=2)
+    resilience.clear_fault_plan()
+    supervisor.clear_preemption()
+    assert supervisor.resumable(d)
+    supervisor.run_or_resume(circ, q, d, pallas=False)
+    assert np.array_equal(qt.get_state_vector(q), ref)
+
+
+def test_env_handler_installs_on_resumed_runs(env1, tmp_path,
+                                              monkeypatch):
+    """QUEST_PREEMPT=1 must arm the handler on EVERY run entry —
+    resumes included: a supervised relaunch enters through resume_run,
+    and the SECOND preemption of a chain must drain as gracefully as
+    the first."""
+    d = str(tmp_path / "re")
+    circ = models.qft(N)
+    q = qt.create_qureg(N, env1)
+    resilience.set_fault_plan([("run_item", 3, "runtime")])
+    with pytest.raises(RuntimeError):
+        circ.run(q, pallas=False, checkpoint_dir=d, checkpoint_every=2)
+    resilience.clear_fault_plan()
+    monkeypatch.setenv("QUEST_PREEMPT", "1")
+    resilience.resume_run(circ, q, d, pallas=False)
+    assert supervisor.handler_installed()
+
+
+def test_supervise_main_keeps_child_args_after_separator(tmp_path):
+    """Wrapper options are parsed only before `--`: the child's own
+    flags (even ones spelled like the wrapper's) pass through
+    verbatim."""
+    import supervise
+
+    marker = tmp_path / "argv"
+    child = tmp_path / "child.py"
+    child.write_text(
+        "import sys\n"
+        f"open({str(marker)!r}, 'w').write(' '.join(sys.argv[1:]))\n")
+    rc = supervise.main(["--max-restarts", "2", "--", str(child),
+                         "--max-restarts", "9",
+                         "--no-resume-on-signal"])
+    assert rc == 0
+    assert marker.read_text() == "--max-restarts 9 --no-resume-on-signal"
+
+
+def test_supervise_attempt_annotated_on_ledger(env1, monkeypatch):
+    monkeypatch.setenv("QUEST_SUPERVISE_ATTEMPT", "2")
+    models.qft(N).run(qt.create_qureg(N, env1))
+    assert (metrics.get_run_ledger() or {})["meta"].get(
+        "supervise_attempt") == 2
+
+
+# ---------------------------------------------------------------------------
+# ledger_diff lifecycle rules
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_diff_lifecycle_rules_fire_both_directions():
+    """The strictly-regressive rules actually fire: shed_unhealthy
+    growth (false-positive shedding) and ANY appearance of
+    preemption-checkpoint failures are violations; equal values pass."""
+    import ledger_diff
+
+    old = {"counters": {"supervisor.shed_unhealthy": 1,
+                        "supervisor.preempt_ckpt_failures": 0}}
+    ok = {"counters": {"supervisor.shed_unhealthy": 1,
+                       "supervisor.preempt_ckpt_failures": 0}}
+    v, _c, _s = ledger_diff.gate(old, ok)
+    assert not [x for x in v if "supervisor" in x["key"]]
+    grew = {"counters": {"supervisor.shed_unhealthy": 2,
+                         "supervisor.preempt_ckpt_failures": 0}}
+    v, _c, _s = ledger_diff.gate(old, grew)
+    assert any(x["key"] == "counters.supervisor.shed_unhealthy"
+               for x in v)
+    failed = {"counters": {"supervisor.shed_unhealthy": 1,
+                           "supervisor.preempt_ckpt_failures": 1}}
+    v, _c, _s = ledger_diff.gate(old, failed)
+    assert any(x["key"] == "counters.supervisor.preempt_ckpt_failures"
+               for x in v)
+
+
+# ---------------------------------------------------------------------------
+# C bridge contract
+# ---------------------------------------------------------------------------
+
+
+def test_set_preemption_handler_bridge_contract():
+    """The C bridge's setPreemptionHandler installs/uninstalls the
+    same handler machinery the Python API uses."""
+    from quest_tpu import capi_bridge
+
+    prev = signal.getsignal(signal.SIGTERM)
+    assert capi_bridge.setPreemptionHandler(1) == 0
+    assert supervisor.handler_installed()
+    assert capi_bridge.setPreemptionHandler(0) == 0
+    assert not supervisor.handler_installed()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_preempt_drain_on_mesh_path(env8, tmp_path):
+    """The drain works on the sharded fused-plan path too (relayout
+    items between segments): preempt mid-plan, resume bit-identically
+    on the same mesh."""
+    d = str(tmp_path / "mesh")
+    circ = models.qft(N)
+    ref = qt.create_qureg(N, env8)
+    circ.run(ref, pallas="auto")
+    refv = qt.get_state_vector(ref)
+    q = qt.create_qureg(N, env8)
+    resilience.set_fault_plan([("run_item", 2, "preempt")])
+    with pytest.raises(QuESTPreemptedError):
+        circ.run(q, pallas="auto", checkpoint_dir=d,
+                 checkpoint_every=1)
+    resilience.clear_fault_plan()
+    supervisor.clear_preemption()
+    resilience.resume_run(circ, q, d, pallas="auto")
+    assert np.array_equal(qt.get_state_vector(q), refv)

@@ -51,6 +51,23 @@ each scenario's recovery contract:
   roll back to the last good slot AUTOMATICALLY and complete, with
   final amplitudes BIT-IDENTICAL to an uninjected run and the
   ``sdc_detected``/``sdc_recovered``/``rollbacks`` counters recorded.
+* ``preempt_drain``    — a scripted ``preempt`` fault (a deterministic
+  SIGTERM) flips the cooperative flag mid-checkpointed-run: the run
+  must drain at the next item boundary with a typed
+  ``QuESTPreemptedError`` (ABI code 6) having written a VALID
+  emergency checkpoint (``resilience.verify_checkpoint`` passes), and
+  ``resume_run`` must complete it bit-identically under ONE trace_id.
+* ``deadline_budget``  — a run under ``deadline_s`` whose remaining
+  budget (drained by a scripted ``delay`` straggler) cannot cover the
+  next item's priced cost must refuse that item BEFORE launch with a
+  typed ``QuESTTimeoutError`` naming the budget arithmetic, then
+  resume bit-identically with a fresh budget.
+* ``overload_shed``    — with the admission gate armed: a tripped
+  mesh-health breaker sheds with ``QuESTOverloadError``
+  (``shed_unhealthy``) and ``/readyz`` reports 503; a saturated
+  concurrency cap sheds (``shed_overload``) carrying the configured
+  ``retry_after_s`` hint; admitted runs before and after are
+  unaffected — all with zero randomness.
 
 Every scenario must end in either a clean recovery (with the
 resilience counters recorded) or a ``QuESTError`` naming the seam —
@@ -86,7 +103,7 @@ if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
 import numpy as np  # noqa: E402
 
 import quest_tpu as qt  # noqa: E402
-from quest_tpu import metrics, models, resilience  # noqa: E402
+from quest_tpu import metrics, models, resilience, supervisor  # noqa: E402
 from quest_tpu.reporting import stopwatch  # noqa: E402
 
 N_QUBITS = int(os.environ.get("QUEST_CHAOS_QUBITS", "10"))
@@ -573,6 +590,166 @@ def drill_sdc_rollback(circ, env, ndev, pallas, ref):
     resilience.clear_mesh_health()
 
 
+def drill_preempt_drain(circ, env, pallas, ref):
+    # a deterministic SIGTERM: the scripted 'preempt' fault flips the
+    # cooperative flag while item KILL_AT executes; the run drains at
+    # the next boundary with an emergency checkpoint and code 6
+    d = tempfile.mkdtemp(prefix="chaos-preempt-")
+    before = metrics.counters()
+    q = qt.create_qureg(N_QUBITS, env)
+    resilience.set_fault_plan([("run_item", KILL_AT, "preempt")])
+    drained = code_ok = named_resume = False
+    try:
+        circ.run(q, pallas=pallas, checkpoint_dir=d,
+                 checkpoint_every=CKPT_EVERY)
+    except qt.QuESTPreemptedError as e:
+        drained = "cooperative drain" in str(e)
+        code_ok = e.code == 6
+        named_resume = "resume with resilience.resume_run" in str(e)
+    finally:
+        resilience.clear_fault_plan()
+    fsck_ok = resilience.verify_checkpoint(d)["ok"]
+    drained_tid = (metrics.get_run_ledger() or {}).get(
+        "meta", {}).get("trace_id")
+    supervisor.clear_preemption()  # same-process resume: stop draining
+    resilience.resume_run(circ, q, d, pallas=pallas)
+    resumed_tid = (metrics.get_run_ledger() or {}).get(
+        "meta", {}).get("trace_id")
+    got = qt.get_state_vector(q)
+    delta = counters_delta(before, ("supervisor.preemptions",
+                                    "supervisor.preempt_ckpt_failures",
+                                    "resilience.resumes"))
+    chain_intact = bool(drained_tid) and drained_tid == resumed_tid
+    bit_identical = bool(np.array_equal(got, ref))
+    ok = (drained and code_ok and named_resume and fsck_ok
+          and bit_identical and chain_intact
+          and delta["supervisor.preemptions"] >= 1
+          and delta["supervisor.preempt_ckpt_failures"] == 0)
+    record("preempt_drain", ok, drained=drained, abi_code_6=code_ok,
+           named_resume=named_resume, checkpoint_fsck_ok=fsck_ok,
+           bit_identical=bit_identical, trace_id=resumed_tid,
+           trace_chain_intact=chain_intact, **delta)
+    shutil.rmtree(d, ignore_errors=True)
+
+
+#: Deadline drill budget: per-item priced floor (s), injected delay
+#: (ms) and the run's wall budget (s).  The delay spends the budget at
+#: item KILL_AT, so the NEXT item's priced cost exceeds the remainder
+#: with wide margins on a loaded host.
+DL_MIN_S = 0.5
+DL_DELAY_MS = 1800
+DL_BUDGET_S = 2.2
+
+
+def drill_deadline_budget(circ, env, pallas, ref):
+    d = tempfile.mkdtemp(prefix="chaos-deadline-")
+    _warm_observed(circ, env, pallas)
+    before = metrics.counters()
+    # cost floor priced via the watchdog formula WITHOUT arming the
+    # watchdog: the deadline repricing reads the same knobs
+    resilience.set_watchdog(False, min_s=DL_MIN_S, slack=4.0)
+    resilience.set_fault_plan([("run_item", KILL_AT,
+                                f"delay:{DL_DELAY_MS}")])
+    q = qt.create_qureg(N_QUBITS, env)
+    refused = named_budget = named_prelaunch = False
+    try:
+        circ.run(q, pallas=pallas, checkpoint_dir=d,
+                 checkpoint_every=CKPT_EVERY, deadline_s=DL_BUDGET_S)
+    except qt.QuESTTimeoutError as e:
+        msg = str(e)
+        refused = "run deadline" in msg
+        named_budget = "priced cost" in msg or "exhausted" in msg
+        named_prelaunch = "before launch" in msg
+    finally:
+        resilience.clear_fault_plan()
+        resilience.set_watchdog(False, min_s=-1.0, slack=-1.0)
+    # resume with a FRESH budget (here: none) to completion
+    resilience.resume_run(circ, q, d, pallas=pallas)
+    got = qt.get_state_vector(q)
+    delta = counters_delta(before, ("supervisor.deadline_expired",
+                                    "resilience.resumes"))
+    bit_identical = bool(np.array_equal(got, ref))
+    ok = (refused and named_budget and named_prelaunch
+          and bit_identical and delta["supervisor.deadline_expired"] >= 1)
+    record("deadline_budget", ok, refused=refused,
+           named_budget=named_budget, named_prelaunch=named_prelaunch,
+           bit_identical=bit_identical, budget_s=DL_BUDGET_S,
+           injected_delay_ms=DL_DELAY_MS, item_floor_s=DL_MIN_S,
+           **delta)
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def drill_overload_shed(circ, env, ndev, pallas):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_serve
+
+    before = metrics.counters()
+    supervisor.configure_gate(True, max_inflight=2, retry_after_s=7.5)
+    server, port = metrics_serve.start_in_thread(0)
+
+    def readyz():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=30) as r:
+                return r.status, _json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read().decode())
+
+    try:
+        # healthy, under cap: admitted and unaffected
+        q = qt.create_qureg(N_QUBITS, env)
+        circ.run(q, pallas=pallas)
+        admitted_clean = abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+        ready0 = readyz()[0] == 200
+        # breaker tripped -> shed_unhealthy + /readyz 503
+        resilience.set_watchdog(False, strikes=1)
+        resilience.suspect_devices([0], reason="chaos overload drill")
+        shed_unhealthy = retry_hint = False
+        try:
+            circ.run(qt.create_qureg(N_QUBITS, env), pallas=pallas)
+        except qt.QuESTOverloadError as e:
+            shed_unhealthy = "shed_unhealthy" in str(e) \
+                and e.code == 7
+            retry_hint = e.retry_after_s == 7.5
+        code503, body = readyz()
+        readyz_unhealthy = code503 == 503 and not body["ready"]
+        resilience.clear_mesh_health()
+        # concurrency cap saturated -> shed_overload
+        shed_overload = False
+        with supervisor.run_scope(None), supervisor.run_scope(None):
+            try:
+                circ.run(qt.create_qureg(N_QUBITS, env), pallas=pallas)
+            except qt.QuESTOverloadError as e:
+                shed_overload = "concurrency cap saturated" in str(e)
+        # gate recovered: admitted again, run unaffected
+        q2 = qt.create_qureg(N_QUBITS, env)
+        circ.run(q2, pallas=pallas)
+        admitted_after = abs(qt.calc_total_prob(q2) - 1.0) < 1e-6
+    finally:
+        server.shutdown()
+        supervisor.configure_gate(False, max_inflight=-1,
+                                  retry_after_s=-1.0)
+        resilience.set_watchdog(False, strikes=-1)
+        resilience.clear_mesh_health()
+    delta = counters_delta(before, ("supervisor.admitted",
+                                    "supervisor.shed_unhealthy",
+                                    "supervisor.shed_overload"))
+    ok = (admitted_clean and ready0 and shed_unhealthy and retry_hint
+          and readyz_unhealthy and shed_overload and admitted_after
+          and delta["supervisor.admitted"] >= 2
+          and delta["supervisor.shed_unhealthy"] == 1
+          and delta["supervisor.shed_overload"] == 1)
+    record("overload_shed", ok, admitted_clean=admitted_clean,
+           shed_unhealthy=shed_unhealthy, retry_after_hint=retry_hint,
+           readyz_503_when_unhealthy=readyz_unhealthy,
+           shed_overload=shed_overload, admitted_after=admitted_after,
+           **delta)
+
+
 def main():
     rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 6
     sw = stopwatch()
@@ -603,6 +780,9 @@ def main():
     drill_sdc_on_wire(circ, env, ndev, pallas)
     drill_sdc_drift(circ, env, pallas)
     drill_sdc_rollback(circ, env, ndev, pallas, ref)
+    drill_preempt_drain(circ, env, pallas, ref)
+    drill_deadline_budget(circ, env, pallas, ref)
+    drill_overload_shed(circ, env, ndev, pallas)
 
     n_fail = sum(1 for r in results if not r["ok"])
     doc = {
@@ -628,11 +808,17 @@ def main():
             "drift_op_factor": resilience.DRIFT_OP_FACTOR_DEFAULT,
             "drift_dev_factor": resilience.DRIFT_DEV_FACTOR_DEFAULT,
         },
+        "lifecycle": {
+            "deadline_budget_s": DL_BUDGET_S,
+            "deadline_delay_ms": DL_DELAY_MS,
+            "deadline_item_floor_s": DL_MIN_S,
+            "gate_retry_after_s": 7.5,
+        },
         "scenarios": results,
         "failures": n_fail,
         "seconds": round(sw.seconds, 2),
         "counters": {k: v for k, v in metrics.counters().items()
-                     if k.startswith("resilience.")
+                     if k.startswith(("resilience.", "supervisor."))
                      or k == "metrics.sink_errors"},
     }
     out = os.path.join(REPO, f"CHAOS_r{rnd:02d}.json")

@@ -57,6 +57,16 @@ DEFAULT_RULES = [
     # negative, same -0.0 caveat as above)
     ("counters.resilience.sdc_detected", +0.0, False),
     ("counters.resilience.sdc_recovered", -0.001, False),
+    # lifecycle-layer health, strictly regressive: the drill's
+    # overload scenario sheds a FIXED number of runs for an unhealthy
+    # mesh, so MORE shed_unhealthy than baseline = the admission gate
+    # grew false positives and is refusing healthy traffic (+0 cost
+    # rule); ANY preemption-drain checkpoint failure (the emergency
+    # snapshot skipped or failed during a drain) is a regression of
+    # the preempt-safety contract — the baseline is 0, so the +0 rule
+    # fires on any appearance
+    ("counters.supervisor.shed_unhealthy", +0.0, False),
+    ("counters.supervisor.preempt_ckpt_failures", +0.0, False),
     # structural / communication metrics: tight, config-independent
     ("mesh_exchange_bytes_qft30", +0.01, False),
     ("counters.exec.exchange_bytes", +0.01, False),

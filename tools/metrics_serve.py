@@ -9,8 +9,19 @@ A stdlib-only HTTP server over the always-on telemetry layer
   ``getMetricsText``).
 * ``GET /healthz``  — JSON verdict wired to the mesh-health registry
   (``resilience.mesh_health``): HTTP 200 while no device is marked
-  DEGRADED, 503 once the circuit breaker has tripped — the liveness/
-  readiness shape a serving stack points its prober at.
+  DEGRADED, 503 once the circuit breaker has tripped — the liveness
+  shape a serving stack points its prober at.
+* ``GET /readyz``   — the ADMISSION verdict (``quest_tpu.supervisor``):
+  HTTP 200 only when the gate would admit a run right now; 503 while
+  the process is draining after a preemption request, the mesh-health
+  breaker is tripped, the in-flight cap is saturated, or the run-wall
+  p99 breaches the configured SLO.  The body carries the reason and a
+  ``retry_after_s`` hint, so a load balancer stops routing here
+  BEFORE runs start getting shed with ``QuESTOverloadError``.
+
+The CLI process handles SIGTERM/SIGINT by shutting the serving thread
+down cleanly (exit 0), so the endpoint itself survives a preemption
+drill instead of dying with a traceback mid-scrape.
 
 Two deployment shapes:
 
@@ -77,9 +88,20 @@ class MetricsHandler(BaseHTTPRequestHandler):
                    "strikes_to_degrade": health["strikes_to_degrade"]}
             self._send(200 if ok else 503, json.dumps(doc) + "\n",
                        "application/json")
+        elif path == "/readyz":
+            from quest_tpu import supervisor
+
+            ready, reason, retry_after = supervisor.readiness()
+            doc = {"ready": ready, "reason": reason,
+                   "retry_after_s": retry_after,
+                   "draining": supervisor.preempt_requested(),
+                   "inflight": supervisor.inflight(),
+                   "gate_enabled": supervisor.gate_enabled()}
+            self._send(200 if ready else 503, json.dumps(doc) + "\n",
+                       "application/json")
         elif path == "/":
             self._send(200, "quest-tpu metrics endpoint: "
-                            "/metrics /healthz\n", "text/plain")
+                            "/metrics /healthz /readyz\n", "text/plain")
         else:
             self._send(404, "not found\n", "text/plain")
 
@@ -168,9 +190,22 @@ def main(argv) -> int:
         _demo_run()
     server, bound = start_in_thread(port)
     print(f"metrics-serve: listening on http://127.0.0.1:{bound} "
-          "(/metrics /healthz)", flush=True)
+          "(/metrics /healthz /readyz)", flush=True)
+    # clean SIGTERM shutdown: a preempted serving process must drain
+    # the endpoint thread and exit 0, not die mid-scrape with a
+    # traceback — the same cooperative-drain discipline the simulator
+    # runs use (quest_tpu.supervisor), minus the checkpoint
+    import signal
+
+    stop = threading.Event()
+
+    def _on_term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
     try:
-        threading.Event().wait()
+        stop.wait()
+        print("metrics-serve: SIGTERM received, draining", flush=True)
     except KeyboardInterrupt:
         pass
     finally:

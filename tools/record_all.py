@@ -15,6 +15,7 @@ alone.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import subprocess
 import sys
@@ -183,6 +184,144 @@ def metrics_serve_smoke(summary) -> None:
         print(detail)
 
 
+#: The supervised child: a checkpointed QFT run under QUEST_PREEMPT
+#: with a deterministic straggler holding the plan open long enough
+#: for the drill's SIGTERM to land mid-run.  On relaunch (a restorable
+#: rotation exists) it resumes instead — supervisor.run_or_resume —
+#: and prints the final state hash + the chain's trace_id.
+_SUPERVISE_CHILD = """\
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
+jax.config.update("jax_enable_x64", True)
+import hashlib
+import numpy as np
+import quest_tpu as qt
+from quest_tpu import metrics, models, resilience, supervisor
+
+CKPT = {ckpt!r}
+N = 10
+
+def main():
+    env = qt.create_env(num_devices=1)
+    q = qt.create_qureg(N, env)
+    circ = models.qft(N)
+    delay_ms = int(os.environ.get("QUEST_SMOKE_DELAY_MS", "0"))
+    if delay_ms and not supervisor.resumable(CKPT):
+        # first attempt only: hold the plan open for the SIGTERM
+        resilience.set_fault_plan([("run_item", 4, f"delay:{{delay_ms}}")])
+    supervisor.run_or_resume(circ, q, CKPT, pallas=False,
+                             checkpoint_every=1)
+    rec = metrics.get_run_ledger() or {{}}
+    sv = np.ascontiguousarray(qt.get_state_vector(q))
+    print("TRACE=" + str(rec.get("meta", {{}}).get("trace_id")),
+          flush=True)
+    print("STATE=" + hashlib.sha256(sv.tobytes()).hexdigest(),
+          flush=True)
+
+try:
+    main()
+except (qt.QuESTPreemptedError, qt.QuESTTimeoutError) as e:
+    rec = metrics.get_run_ledger() or {{}}
+    print("TRACE=" + str(rec.get("meta", {{}}).get("trace_id")),
+          flush=True)
+    print("DRAINED code=%d" % e.code, flush=True)
+    sys.exit(int(e.code))
+"""
+
+
+def supervise_smoke(summary) -> None:
+    """Tier-2 smoke: the full out-of-process preemption chain.  Runs
+    tools/supervise.py wrapping a checkpointed run script, SIGTERMs
+    the SUPERVISOR once the first checkpoint exists (the wrapper
+    forwards it; the child drains with the preempted code 6 having
+    checkpointed), and asserts the automatic resume completes with a
+    state hash BIT-IDENTICAL to an uninterrupted run under ONE
+    trace_id across the chain.  A broken drain, a lost checkpoint, or
+    a restart loop that stops resuming fails the recording round here
+    instead of in the next real preemption."""
+    import signal as _signal
+    import tempfile
+
+    t0 = time.time()
+    ok, detail = False, ""
+    with tempfile.TemporaryDirectory() as td:
+        child = os.path.join(td, "child.py")
+        env = {k: v for k, v in os.environ.items()
+               if k != "QUEST_PREEMPT"}
+
+        def run_reference() -> str:
+            ref_ckpt = os.path.join(td, "ckpt-ref")
+            with open(child, "w") as f:
+                f.write(_SUPERVISE_CHILD.format(repo=REPO,
+                                                ckpt=ref_ckpt))
+            r = subprocess.run([sys.executable, child],
+                               capture_output=True, text=True,
+                               env=env, timeout=600)
+            for line in r.stdout.splitlines():
+                if line.startswith("STATE="):
+                    return line.split("=", 1)[1]
+            raise RuntimeError(f"reference child failed: "
+                               f"{r.stdout[-300:]} {r.stderr[-300:]}")
+
+        try:
+            ref_state = run_reference()
+            ckpt = os.path.join(td, "ckpt")
+            with open(child, "w") as f:
+                f.write(_SUPERVISE_CHILD.format(repo=REPO, ckpt=ckpt))
+            env["QUEST_PREEMPT"] = "1"
+            env["QUEST_SMOKE_DELAY_MS"] = "8000"
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "supervise.py"), child],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, cwd=REPO, env=env)
+            # SIGTERM the SUPERVISOR once the child's first checkpoint
+            # exists (the scripted delay then holds the run open, so
+            # the forwarded signal deterministically lands mid-plan)
+            latest = os.path.join(ckpt, "latest")
+            deadline = time.time() + 300
+            while not os.path.isfile(latest):
+                if time.time() > deadline:
+                    raise TimeoutError("no checkpoint appeared")
+                if proc.poll() is not None:
+                    raise RuntimeError("supervisor exited early")
+                time.sleep(0.2)
+            proc.send_signal(_signal.SIGTERM)
+            out, err = proc.communicate(timeout=600)
+            traces = [ln.split("=", 1)[1] for ln in out.splitlines()
+                      if ln.startswith("TRACE=")]
+            states = [ln.split("=", 1)[1] for ln in out.splitlines()
+                      if ln.startswith("STATE=")]
+            drained = "DRAINED code=6" in out
+            resumed = "resuming in" in out
+            one_trace = (len(traces) >= 2 and traces[0] not in
+                         ("None", "") and len(set(traces)) == 1)
+            ok = (proc.returncode == 0 and drained and resumed
+                  and one_trace and states == [ref_state])
+            if not ok:
+                detail = (f"rc={proc.returncode} drained={drained} "
+                          f"resumed={resumed} traces={traces} "
+                          f"state_match={states == [ref_state]} "
+                          f"out={out[-400:]} err={err[-300:]}")
+        except Exception as e:
+            detail = f"{type(e).__name__}: {e}"
+            with contextlib.suppress(Exception):
+                proc.kill()
+    secs = time.time() - t0
+    summary.append(("supervise", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'supervise':22s} {secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 def main():
     rnd = sys.argv[1] if len(sys.argv) > 1 else "2"
     summary = []
@@ -212,6 +351,7 @@ def main():
     bench_gate_smoke(summary)
     roofline_attr_smoke(summary)
     metrics_serve_smoke(summary)
+    supervise_smoke(summary)
     chaos_drill_smoke(summary, rnd)
     n_fail = sum(1 for _, ok, _ in summary if not ok)
     print(f"{len(summary)} recorders, {n_fail} failed")

@@ -1,0 +1,200 @@
+"""Supervised restart loop: relaunch a run script across preemptions.
+
+The in-process half of the lifecycle layer (``quest_tpu.supervisor``)
+turns a SIGTERM into a checkpointed, typed, resumable failure; this
+wrapper is the out-of-process half that makes the kill → resume chain
+AUTOMATIC.  It launches a run script as a child process, watches its
+exit code, and relaunches it whenever the code names a RESUMABLE
+lifecycle failure:
+
+* ``6``  — ``QUEST_ERROR_PREEMPTED``: a cooperative preemption drain
+  (the child checkpointed into its rotation before exiting);
+* ``3``  — ``QUEST_ERROR_TIMEOUT``: a run-deadline drain (same
+  contract; the relaunch continues under a fresh budget).  NOTE code
+  3 also covers collective-watchdog hang breaches, which do NOT write
+  a checkpoint — a relaunched attempt then starts fresh
+  (``run_or_resume`` finds no rotation).  That is deliberate: a hung
+  collective is often a transient link/host condition a restart
+  clears, and the bounded ``--max-restarts`` budget guarantees a
+  persistent hang still surfaces as a final nonzero exit instead of
+  looping forever.
+
+Scripts opt into the contract with ``supervisor.supervised_main`` (map
+the two lifecycle errors to exit codes) and ``supervisor.run_or_resume``
+(resume from the checkpoint directory when a restorable rotation is
+there, else start fresh) — the relaunched attempt then completes
+bit-identically under the SAME trace_id, which rides the checkpoint
+sidecar across the process boundary.  Any other exit code is final: a
+crash must surface, not be blindly restarted.
+
+A SIGTERM/SIGINT delivered to THIS wrapper is forwarded to the child —
+so preempting the supervisor preempts the run gracefully, the child
+drains with code 6, and the wrapper immediately resumes it (the
+whole point: the pod scheduler kills process trees, not processes).
+Pass ``--no-resume-on-signal`` to make a forwarded signal final
+instead (drain, then stop).
+
+Restarts are bounded and deterministically backed off: at most
+``--max-restarts N`` (default 3 — ``resilience.RETRY_POLICY``'s
+``ckpt_save`` budget, the try-hardest row of the retry table) with the
+same jitter-free exponential backoff the in-process retries use
+(``resilience.RETRY_BASE_DELAY * 2^(i-1)``); a doc-pin test asserts
+these constants agree with the live table.  Each attempt exports
+``QUEST_SUPERVISE_ATTEMPT=n`` so the child's ledger records carry
+their position in the chain next to the shared trace_id.
+
+Stdlib-only on purpose: the wrapper must survive anything the
+simulator process can do to itself, so it never imports jax or
+quest_tpu.
+
+Usage::
+
+    python tools/supervise.py [--max-restarts N]
+                              [--no-resume-on-signal] [--]
+                              script.py [args...]
+
+Exit status: the final child attempt's exit code (0 on a completed
+chain), or 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+#: Child exit codes that mean "checkpointed and resumable" — the
+#: QuESTErrorCode values QUEST_ERROR_PREEMPTED and QUEST_ERROR_TIMEOUT
+#: (capi/include/QuEST.h; quest_tpu.validation pins them as ABI).
+RESUMABLE_CODES = (6, 3)
+
+#: Restart budget and backoff base — MIRRORS of
+#: ``resilience.RETRY_POLICY["ckpt_save"]`` and
+#: ``resilience.RETRY_BASE_DELAY`` (this wrapper is stdlib-only, so it
+#: cannot import them; ``tests/test_supervisor.py`` pins the values
+#: against the live table so they cannot drift).
+MAX_RESTARTS_DEFAULT = 3
+RETRY_BASE_DELAY = 0.02
+
+
+def _launch(cmd, attempt: int):
+    env = dict(os.environ)
+    env["QUEST_SUPERVISE_ATTEMPT"] = str(attempt)
+    return subprocess.Popen(cmd, env=env)
+
+
+def supervise(cmd, max_restarts: int = MAX_RESTARTS_DEFAULT,
+              resume_on_signal: bool = True) -> int:
+    """Run ``cmd`` (argv list) under the restart loop; returns the
+    final exit code.  See the module docstring for the contract."""
+    # Signal bookkeeping is PER ATTEMPT: each preemption event (which
+    # may arrive minutes after a previous chain link was resumed) gets
+    # its own graceful SIGTERM before any escalation to SIGKILL.  A
+    # signal landing while no child is alive (during backoff, or
+    # between wait() and the next launch) is remembered and delivered
+    # to the next child at launch — a preemption request must never be
+    # silently dropped.
+    state = {"during": 0, "pending": False, "any": False}
+    child = {"proc": None}
+
+    def _forward(signum, frame):
+        state["any"] = True
+        p = child["proc"]
+        if p is not None and p.poll() is None:
+            state["during"] += 1
+            # first signal to THIS child: graceful — it drains and
+            # exits resumable; repeats escalate to SIGKILL
+            p.send_signal(signal.SIGTERM if state["during"] == 1
+                          else signal.SIGKILL)
+        else:
+            state["pending"] = True
+
+    prev = {s: signal.signal(s, _forward)
+            for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        attempt = 1
+        restarts = 0
+        while True:
+            print(f"supervise: attempt {attempt}: {' '.join(cmd)}",
+                  flush=True)
+            state["during"] = 0
+            child["proc"] = _launch(cmd, attempt)
+            if state["pending"]:
+                # a preemption arrived while no child was alive:
+                # honour it now — the fresh child drains immediately
+                state["pending"] = False
+                state["during"] = 1
+                child["proc"].send_signal(signal.SIGTERM)
+            code = child["proc"].wait()
+            if code == 0:
+                print(f"supervise: attempt {attempt} completed",
+                      flush=True)
+                return 0
+            if code not in RESUMABLE_CODES:
+                print(f"supervise: attempt {attempt} exited {code} "
+                      "(not a resumable lifecycle code) — giving up",
+                      flush=True)
+                return code
+            if state["any"] and not resume_on_signal:
+                print(f"supervise: attempt {attempt} drained with "
+                      f"code {code} after a forwarded signal — "
+                      "stopping (--no-resume-on-signal)", flush=True)
+                return code
+            if restarts >= max_restarts:
+                print(f"supervise: attempt {attempt} exited {code} "
+                      f"but the {max_restarts}-restart budget is "
+                      "exhausted — giving up", flush=True)
+                return code
+            restarts += 1
+            delay = RETRY_BASE_DELAY * (1 << (restarts - 1))
+            print(f"supervise: attempt {attempt} exited {code} "
+                  f"({'preempted' if code == 6 else 'deadline'}); "
+                  f"resuming in {delay:g}s "
+                  f"(restart {restarts}/{max_restarts})", flush=True)
+            time.sleep(delay)
+            attempt += 1
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+
+def main(argv) -> int:
+    args = list(argv)
+    max_restarts = MAX_RESTARTS_DEFAULT
+    resume_on_signal = True
+    # wrapper options are parsed only BEFORE the `--` separator or the
+    # first non-option token — everything after belongs to the child
+    # script verbatim (its own --max-restarts must reach it untouched)
+    while args:
+        a = args[0]
+        if a == "--":
+            args.pop(0)
+            break
+        if a == "--max-restarts":
+            try:
+                max_restarts = int(args[1])
+            except (IndexError, ValueError):
+                print(__doc__)
+                return 2
+            del args[:2]
+            continue
+        if a == "--no-resume-on-signal":
+            resume_on_signal = False
+            args.pop(0)
+            continue
+        if a.startswith("-"):
+            print(__doc__)
+            return 2
+        break
+    if not args:
+        print(__doc__)
+        return 2
+    cmd = [sys.executable] + args if args[0].endswith(".py") else args
+    return supervise(cmd, max_restarts=max_restarts,
+                     resume_on_signal=resume_on_signal)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
