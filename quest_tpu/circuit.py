@@ -664,8 +664,14 @@ class Circuit:
         Keyed on the op-stream CONTENT (ops are hashable tuples, and
         hashing them is microseconds against a compile), so any mutation
         — recorded or direct ``ops`` manipulation — recompiles."""
+        from .parallel.mesh_exec import comm_config_token
+
         use_pallas = pallas is True or pallas == "auto"
-        key = (mesh, donate, use_pallas, tuple(self.ops))
+        # the comm config token keys the collective shape the trace
+        # bakes in (sub-block pipelining, f32-on-wire): flipping either
+        # knob mid-process must recompile, not reuse
+        key = (mesh, donate, use_pallas, comm_config_token(),
+               tuple(self.ops))
         fn = self._compiled.get(key)
         if fn is None:
             metrics.counter_inc("circuit.compile_cache_misses")
@@ -894,8 +900,10 @@ class Circuit:
         # compiles comm items as CHECKED (amps, fault) programs, which
         # a later unarmed run must not reuse (and vice versa)
         integ = resilience.integrity_enabled()
+        from .parallel.mesh_exec import comm_config_token
+
         memo_key = ("observed", qureg.mesh, use_pallas, integ,
-                    tuple(self.ops))
+                    comm_config_token(), tuple(self.ops))
         ent = self._compiled.get(memo_key)
         if ent is None:
             probe = _HealthProbe(self, qureg.mesh)
@@ -1049,6 +1057,12 @@ class Circuit:
                 metrics.start_timeline()
                 metrics.annotate_run("trace_sampled", True)
                 own_capture = True
+            # bookmark for an env-knob/programmatic capture that
+            # outlives this run: the comm_hidden_frac annotation below
+            # must measure THIS run's events only
+            tl_mark = (metrics.timeline_event_count()
+                       if metrics.timeline_active() and not own_capture
+                       else None)
             observed = (metrics.timeline_active()
                         or metrics.health_every() > 0
                         or ckpt is not None or _resume is not None
@@ -1117,6 +1131,7 @@ class Circuit:
                     return resilience.self_heal(
                         self, qureg, ckpt["directory"], pallas, e)
             finally:
+                run_events = None
                 if own_capture:
                     # close the sampled capture even when the run
                     # raised: the timeline document (optionally dumped
@@ -1126,6 +1141,20 @@ class Circuit:
                         _tm.trace_sample_path(run_id))
                     metrics.annotate_run("timeline_events",
                                          len(doc["traceEvents"]))
+                    run_events = doc["traceEvents"]
+                elif tl_mark is not None:
+                    run_events = metrics.timeline_events(start=tl_mark)
+                if run_events:
+                    # comm_hidden_frac: MEASURED interval overlap of
+                    # this run's comm spans with its compute spans —
+                    # 0.0 under serial exchanges, driven up by the
+                    # pipelined collectives, gated by the config-bound
+                    # ledger_diff rule via the bench annotation
+                    ov = metrics.timeline_comm_overlap(run_events)
+                    if ov["comm_us"] > 0:
+                        metrics.annotate_run(
+                            "comm_hidden_frac",
+                            round(ov["frac"], 4))
                 metrics.annotate_run("resilience",
                                      resilience.run_counters())
 
@@ -1231,6 +1260,7 @@ class _HealthProbe:
     def reset(self) -> None:
         self._count = 0
         self._ops_since = 0
+        self._wire_since = 0      # f32-on-wire comm items since then
         self._ref = None          # norm/trace at the last healthy probe
         self._last_healthy = None
         self._ops_done = None     # op-aligned prefix at the last item
@@ -1356,6 +1386,14 @@ class _HealthProbe:
             self._ops_done = meta.get("ops_done")
             self._layout = meta.get("layout")
         self._ops_since += int(meta.get("ops", 1))
+        if meta.get("comm_class") in ("half", "full", "relayout"):
+            from .parallel.mesh_exec import wire_dtype
+
+            if wire_dtype(amps.dtype) != amps.dtype:
+                # this item's payloads travelled f32-compressed: the
+                # drift budget's wire term prices the deliberate
+                # demotion error so it never reads as corruption
+                self._wire_since += 1
         # the integrity layer probes EVERY item: the drift budget's
         # whole point is per-item attribution of a suspected SDC
         probe_due = (bool(k) and self._count % k == 0) or integ
@@ -1373,8 +1411,9 @@ class _HealthProbe:
         if integ and structural:
             ndev = (1 if self._mesh is None
                     else int(self._mesh.devices.size))
-            budget = resilience.drift_budget(self._ops_since,
-                                             amps.dtype, ndev)
+            budget = resilience.drift_budget(
+                self._ops_since, amps.dtype, ndev,
+                wire_items=self._wire_since)
         # under timeline capture the probe itself is a walled item
         # (kind "probe", tagged by trigger), so sampled/observed
         # timelines show what the observability layer COSTS next to
@@ -1402,6 +1441,7 @@ class _HealthProbe:
             if structural:
                 self._ref = val if val is not None else self._ref
                 self._ops_since = 0
+                self._wire_since = 0
             self._last_healthy = {"index": meta.get("index"),
                                   "kind": meta.get("kind")}
             if ckpt_due:

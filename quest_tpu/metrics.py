@@ -601,10 +601,81 @@ def timeline_span(name: str, args: dict | None = None, tid: int = 0):
         timeline_event(name, t0, _now() - t0, args=args, tid=tid)
 
 
-def timeline_events() -> list[dict]:
-    """Snapshot of the captured events (a copy)."""
+def timeline_events(start: int = 0) -> list[dict]:
+    """Snapshot of the captured events (a copy).  ``start`` slices
+    BEFORE copying — a run reading its own tail of a long-lived
+    capture (via the :func:`timeline_event_count` bookmark) must not
+    pay an O(full-buffer) copy of everything before it."""
     with _lock:
-        return json.loads(json.dumps(_timeline["events"]))
+        ev = _timeline["events"][start:] if start else \
+            _timeline["events"]
+        return json.loads(json.dumps(ev))
+
+
+def timeline_event_count() -> int:
+    """Number of events currently in the capture buffer — a cheap
+    bookmark (no copy) so a run can slice out ITS OWN events from a
+    long-lived env-knob capture when annotating ``comm_hidden_frac``."""
+    with _lock:
+        return len(_timeline["events"])
+
+
+#: Timeline kinds that move amplitudes over the interconnect — the
+#: whole-item comm spans of the serial executor plus the per-sub-block
+#: send spans of the pipelined one.  ``tools/trace_view.py`` carries
+#: the same sets (it must stay stdlib-only for offline trace files);
+#: a test pins the two copies equal.
+TIMELINE_COMM_KINDS = frozenset({
+    "bitswap", "relayout", "bitswap-send", "relayout-send"})
+
+#: Timeline kinds that stream the state through the compute units,
+#: including the pipelined exchange's gather/merge legs — the compute
+#: that HIDES the wire.
+TIMELINE_COMPUTE_KINDS = frozenset({
+    "pallas-pass", "xla-segment", "stream", "xla-stream",
+    "bitswap-gather", "bitswap-merge",
+    "relayout-gather", "relayout-merge"})
+
+
+def timeline_comm_overlap(events=None) -> dict:
+    """MEASURED comm/compute overlap of a timeline capture:
+    ``{"comm_us", "hidden_us", "frac"}`` where ``hidden_us`` is the
+    portion of the comm spans' wall windows overlapped by a compute
+    span's wall window (merged intervals, so stacked compute never
+    double-counts) and ``frac = hidden/comm`` is ``comm_hidden_frac``
+    — the run-ledger annotation the pipelined-collective gate rule
+    watches.  Interval overlap of honest walls, not a model: 0.0 under
+    the serial executor, and exactly what ``tools/trace_view.py``
+    reports for the same capture."""
+    if events is None:
+        events = timeline_events()
+    compute = []
+    for e in events:
+        if e.get("name") in TIMELINE_COMPUTE_KINDS:
+            t0 = float(e.get("ts", 0.0))
+            compute.append((t0, t0 + float(e.get("dur", 0.0))))
+    compute.sort()
+    merged: list = []
+    for a, b in compute:
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    comm = hidden = 0.0
+    for e in events:
+        if e.get("name") not in TIMELINE_COMM_KINDS:
+            continue
+        a = float(e.get("ts", 0.0))
+        b = a + float(e.get("dur", 0.0))
+        comm += b - a
+        for ca, cb in merged:
+            if cb <= a:
+                continue
+            if ca >= b:
+                break
+            hidden += min(b, cb) - max(a, ca)
+    return {"comm_us": comm, "hidden_us": hidden,
+            "frac": (hidden / comm) if comm else 0.0}
 
 
 def timeline_trace() -> dict:

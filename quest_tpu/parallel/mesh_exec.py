@@ -38,6 +38,7 @@ at all.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +48,150 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .. import metrics
 from ..ops.lattice import Lattice, shard_map_compat, state_shape, _ilog2
 from ..ops.pallas_kernels import apply_fused_segment
+
+
+# ---------------------------------------------------------------------------
+# Sub-block pipelined collectives (ISSUE 12: hide the wire)
+# ---------------------------------------------------------------------------
+#
+# Every collective payload — half swaps, full-chunk exchanges, relayout
+# coset sub-blocks — can split into S leading-axis sub-blocks
+# (``QUEST_COMM_SUBBLOCKS``, power of two, default auto from the payload
+# size) and exchange as S independent ppermutes instead of one.  Inside
+# a jitted program the S (ppermute -> merge) chains carry no mutual
+# dependencies, so XLA's latency-hiding scheduler can overlap round
+# k+1's wire transfer with round k's merge; on the OBSERVED per-item
+# path the same decomposition is driven from the host as a software
+# double-buffered pipeline (:class:`_PipelinedFn`) whose gather / send /
+# merge legs are each walled as their own timeline sub-span — which is
+# what makes ``comm_hidden_frac`` a MEASURED interval-overlap figure
+# rather than a model.  Sub-blocking never changes WHAT moves: the
+# exchange-element accounting (``plan_exchange_elems`` /
+# ``relayout_comm_elems``) is S-invariant by construction, so every
+# historical exchange-byte pin holds exactly.
+
+#: Smallest payload a sub-block may shrink to under the auto policy
+#: (storage elements, per device).  Below this the per-collective fixed
+#: cost dominates and splitting only adds launches.  Sized for the
+#: relayout coset rounds, whose per-round payload is chunk/2^q — the
+#: dominant wire traffic of real plans.
+COMM_SUBBLOCK_MIN_ELEMS = 1 << 11
+
+#: Auto policy's sub-block ceiling; an explicit QUEST_COMM_SUBBLOCKS
+#: may exceed it (it is still clamped to divide the payload).
+COMM_SUBBLOCKS_MAX_AUTO = 8
+
+#: Default send-lookahead of the host-driven pipeline: how many
+#: sub-block ppermutes are kept in flight while earlier sub-blocks
+#: gather/merge (QUEST_COMM_PIPELINE_DEPTH overrides; min 1 = no
+#: lookahead, i.e. serial).  2 is classic double buffering; 3 — one
+#: extra leg of lookahead — measured ~2x more hidden wire on the
+#: virtual-mesh QFT sweeps, because a collective's fixed rendezvous
+#: cost spans more than one gather/merge leg.
+COMM_PIPELINE_DEPTH_DEFAULT = 3
+
+
+def comm_pipeline_depth() -> int:
+    """Send-lookahead window of :func:`_drive_pipeline`."""
+    try:
+        return max(1, int(os.environ.get(
+            "QUEST_COMM_PIPELINE_DEPTH",
+            str(COMM_PIPELINE_DEPTH_DEFAULT))))
+    except ValueError:
+        return COMM_PIPELINE_DEPTH_DEFAULT
+
+
+def comm_subblocks(payload_elems: int) -> int:
+    """Sub-block count S for one collective payload of
+    ``payload_elems`` storage elements (per device).
+
+    ``QUEST_COMM_SUBBLOCKS`` pins S explicitly (must be a power of
+    two; validated loudly — a silently-ignored knob is how tuning
+    sweeps lie); unset, S doubles while each sub-block stays at least
+    :data:`COMM_SUBBLOCK_MIN_ELEMS`, capped at
+    :data:`COMM_SUBBLOCKS_MAX_AUTO`.  Always clamped so S divides the
+    payload (payloads are powers of two, so the clamp only ever
+    halves)."""
+    raw = os.environ.get("QUEST_COMM_SUBBLOCKS")
+    if raw:
+        from .. import validation as _v
+
+        try:
+            s = int(raw)
+        except ValueError:
+            raise _v.QuESTValidationError(
+                f"QUEST_COMM_SUBBLOCKS={raw!r} is not an integer")
+        if s < 1 or (s & (s - 1)):
+            raise _v.QuESTValidationError(
+                f"QUEST_COMM_SUBBLOCKS={raw!r}: sub-block count must "
+                "be a power of two >= 1 (payloads are power-of-two "
+                "sized and split on the leading axis)")
+    else:
+        s = 1
+        while (s < COMM_SUBBLOCKS_MAX_AUTO
+               and payload_elems // (2 * s) >= COMM_SUBBLOCK_MIN_ELEMS):
+            s *= 2
+    s = min(s, max(int(payload_elems), 1))
+    while s > 1 and payload_elems % s:
+        s //= 2
+    return max(s, 1)
+
+
+def item_subblocks(item, num_vec_bits: int, dev_bits: int) -> int:
+    """S for one plan item: the sub-block count of its per-device
+    collective payload (1 for comm-free items).  The ONE resolution
+    point shared by the executors, the checked-collective sender maps,
+    the timeline metas and the watchdog repricing, so none of them can
+    disagree about an item's pipeline shape."""
+    chunk_bits = num_vec_bits - dev_bits
+    cls = _swap_comm_class(item, chunk_bits)
+    if cls in (None, "local"):
+        return 1
+    s_chunk = 1 << (chunk_bits + 1)      # interleaved storage chunk
+    if cls == "half":
+        payload = s_chunk // 2
+    elif cls == "full":
+        payload = s_chunk
+    else:
+        q, dst_rounds = _relayout_dev_maps(item[1], num_vec_bits,
+                                           dev_bits)
+        if not dst_rounds:
+            return 1
+        payload = s_chunk >> q
+    return comm_subblocks(payload)
+
+
+def comm_config_token() -> tuple:
+    """Hashable identity of the env-driven collective configuration a
+    compiled mesh program bakes in — sub-block pipelining
+    (``QUEST_COMM_SUBBLOCKS``) and f32-on-wire (``QUEST_WIRE_F32``).
+    Part of every compile/observed memo key (``Circuit.compile`` /
+    ``Circuit._observed_fn``): a knob flipped mid-process must never
+    reuse a program traced under the other configuration."""
+    return (os.environ.get("QUEST_COMM_SUBBLOCKS") or "",
+            "1" if wire_f32_enabled() else "")
+
+
+def wire_f32_enabled() -> bool:
+    """The opt-in f32-on-wire compression knob (``QUEST_WIRE_F32=1``):
+    f64 collective payloads demote to f32 before the ppermute and
+    promote on receive — half the wire bytes for a bounded, PRICED
+    precision cost (the ``resilience.drift_budget`` wire term keeps
+    the integrity probes armed without false positives).  f32 states
+    are already at the wire precision and never demote."""
+    return os.environ.get("QUEST_WIRE_F32") == "1"
+
+
+def wire_dtype(dtype):
+    """The dtype one collective payload actually travels the wire in:
+    the state dtype, or f32 when :func:`wire_f32_enabled` and the
+    state is f64.  Checksums (:func:`_fold_token`) fold over THIS
+    dtype — the verification must cover the bits that moved, not the
+    bits that were reconstructed after the move."""
+    dt = jnp.dtype(dtype)
+    if wire_f32_enabled() and dt.itemsize == 8:
+        return jnp.dtype(jnp.float32)
+    return dt
 
 
 def _lift_bit(b: int, lane_bits: int) -> int:
@@ -131,26 +276,64 @@ def _corrupt_payload(payload, fault, active):
     return jnp.where(active, out, payload)
 
 
-def _checked_ppermute(payload, axis, pairs, dev, fault, armed):
-    """One verified collective round: fold the send-side token, apply
-    any scripted in-flight corruption (``armed`` = this round is the
-    scripted one; the drill corrupts sender device 0), route payload
-    and token through the SAME pairs, and flag a receive-side refold
-    mismatch.  Returns ``(received, flag)`` with ``flag`` shape (1,)
-    int32."""
-    tok = _fold_token(payload)
-    if armed:
-        payload = _corrupt_payload(payload, fault,
+def _exchange(payload, axis, pairs, subblocks: int = 1,
+              wire_ok: bool = True):
+    """One UNCHECKED collective exchange, sub-block pipelined: split
+    the payload into ``subblocks`` leading-axis sub-blocks and route
+    each through its own ppermute.  The sub-block (ppermute -> merge)
+    chains are mutually independent in the traced graph, so XLA can
+    overlap sub-block j+1's wire transfer with sub-block j's merge —
+    the in-program half of the pipelining; re-stacking is the merge.
+    ``wire_ok`` additionally allows the opt-in f32-on-wire demotion
+    (:func:`wire_dtype`); callers with an exactness contract — the
+    degraded-resume canonicalisation — pass False."""
+    wd = wire_dtype(payload.dtype) if wire_ok else payload.dtype
+    demote = wd != payload.dtype
+    if subblocks <= 1 and not demote:
+        return lax.ppermute(payload, axis, pairs)
+    flat = payload.reshape(max(subblocks, 1), -1)
+    recvs = []
+    for j in range(flat.shape[0]):
+        blk = flat[j].astype(wd) if demote else flat[j]
+        r = lax.ppermute(blk, axis, pairs)
+        recvs.append(r.astype(payload.dtype) if demote else r)
+    return jnp.stack(recvs).reshape(payload.shape)
+
+
+def _checked_ppermute(payload, axis, pairs, dev, fault, armed,
+                      subblocks: int = 1, wire_ok: bool = True):
+    """One verified collective exchange over ``subblocks`` sub-block
+    rounds: PER SUB-BLOCK, fold the send-side token over the ON-WIRE
+    dtype, apply any scripted in-flight corruption (``armed`` = this
+    exchange is the scripted one; the drill corrupts sender device 0's
+    FIRST sub-block), route payload and token through the SAME pairs,
+    and flag a receive-side refold mismatch.  Returns
+    ``(received, flags)`` with ``flags`` shape (subblocks,) int32 in
+    sub-block order — one verification verdict per wire leg, so a
+    corrupted sub-block attributes to its exact
+    (round, sub-block, sender -> receiver) coordinates."""
+    S = max(int(subblocks), 1)
+    wd = wire_dtype(payload.dtype) if wire_ok else payload.dtype
+    demote = wd != payload.dtype
+    flat = payload.reshape(S, -1)
+    recvs, flags = [], []
+    for j in range(S):
+        blk = flat[j].astype(wd) if demote else flat[j]
+        tok = _fold_token(blk)
+        if armed and j == 0:
+            blk = _corrupt_payload(blk, fault,
                                    (fault[0] > 0) & (dev == 0))
-    recv = lax.ppermute(payload, axis, pairs)
-    tok_recv = lax.ppermute(tok, axis, pairs)
-    flag = (_fold_token(recv) != tok_recv).astype(jnp.int32)
-    return recv, flag
+        recv = lax.ppermute(blk, axis, pairs)
+        tok_recv = lax.ppermute(tok, axis, pairs)
+        flags.append((_fold_token(recv) != tok_recv).astype(jnp.int32))
+        recvs.append(recv.astype(payload.dtype) if demote else recv)
+    return (jnp.stack(recvs).reshape(payload.shape),
+            jnp.concatenate(flags))
 
 
 def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
                  chunk_bits: int, lane_bits: int, check: bool = False,
-                 fault=None):
+                 fault=None, subblocks: int = 1):
     """Return the interleaved chunk after globally swapping amplitude
     index bits ``a``/``b``: new[i] = old[i with bits a, b swapped].
 
@@ -168,8 +351,13 @@ def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
     ``check=True`` (the integrity layer, quest_tpu.resilience ISSUE-9)
     verifies the exchange with a folded payload checksum riding the
     same route (:func:`_checked_ppermute`) and returns
-    ``(amps, flags)`` with ``flags`` a per-device (1, 1) int32
-    mismatch indicator; ``fault`` is the traced SDC injection vector.
+    ``(amps, flags)`` with ``flags`` a per-device (1, subblocks) int32
+    mismatch indicator — one verdict per sub-block wire leg; ``fault``
+    is the traced SDC injection vector.  ``subblocks`` splits the
+    exchanged payload into S independently-permuted sub-blocks (the
+    pipelined-collective decomposition; see :func:`comm_subblocks`) —
+    pure data movement either way, so S never changes a bit of the
+    result or an element of the exchange accounting.
     """
     if a > b:
         a, b = b, a
@@ -192,10 +380,10 @@ def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
             for p in range(ndev)
         ]
         if not check:
-            return lax.ppermute(amps, axis, pairs)
+            return _exchange(amps, axis, pairs, subblocks)
         recv, flag = _checked_ppermute(amps, axis, pairs, dev, fault,
-                                       armed=True)
-        return recv, flag.reshape(1, 1)
+                                       armed=True, subblocks=subblocks)
+        return recv, flag.reshape(1, -1)
     # device <-> local: half-chunk exchange, re+im in one payload
     off = b - chunk_bits
     stride = 1 << off
@@ -207,13 +395,13 @@ def bitswap_amps(amps, a: int, b: int, dev, axis: str, ndev: int,
     pairs = [(p, p ^ stride) for p in range(ndev)]
     if check:
         recv, flag = _checked_ppermute(send, axis, pairs, dev, fault,
-                                       armed=True)
+                                       armed=True, subblocks=subblocks)
     else:
-        recv = lax.ppermute(send, axis, pairs)
+        recv = _exchange(send, axis, pairs, subblocks)
     new0 = jnp.where(w == 0, h0, recv)
     new1 = jnp.where(w == 0, recv, h1)
     out = jnp.stack([new0, new1], axis=ax2).reshape(amps.shape)
-    return (out, flag.reshape(1, 1)) if check else out
+    return (out, flag.reshape(1, -1)) if check else out
 
 
 # ---------------------------------------------------------------------------
@@ -359,19 +547,26 @@ def _merge_blocks(nb, A, chunk_bits: int, shape):
 
 def apply_relayout(amps, perm, dev, axis: str, ndev: int,
                    chunk_bits: int, lane_bits: int, check: bool = False,
-                   fault=None):
+                   fault=None, subblocks: int = 1,
+                   wire_ok: bool = True):
     """Execute a fused multi-bit relayout over the sharded interleaved
     array: ``new[i] = old[j]`` with bit b of j = bit ``perm[b]`` of i
     (amplitude-index bits).
 
     ``check=True`` verifies every ppermute round with a folded payload
     checksum (:func:`_checked_ppermute` — the integrity layer) and
-    returns ``(amps, flags)``, ``flags`` a per-device (1, R) int32
-    array over the R communicating rounds in ascending-``w`` order —
-    the SAME order :func:`exchange_round_senders` reports its static
-    sender maps in, so a flagged (device, round) pair attributes to an
-    exact sender.  A scripted in-flight fault corrupts sender device
-    0's payload in the first communicating round.
+    returns ``(amps, flags)``, ``flags`` a per-device
+    (1, R * subblocks) int32 array over the R communicating rounds in
+    ascending-``w`` order, ``subblocks`` sub-block verdicts per round —
+    the SAME column order :func:`exchange_round_senders` reports its
+    static sender maps in, so a flagged (device, column) pair
+    attributes to an exact (round, sub-block, sender).  A scripted
+    in-flight fault corrupts sender device 0's payload in the first
+    communicating round's first sub-block.  ``subblocks`` pipelines
+    each round's coset exchange (:func:`comm_subblocks`); ``wire_ok``
+    gates the opt-in f32-on-wire demotion — the degraded-resume
+    canonicalisation (:func:`apply_layout_perm`) passes False to keep
+    its exactness contract.
 
     Statically lifts ``perm`` to the storage index (component bit a
     fixed point), decomposes ``perm = R . E`` (``relayout_decompose``)
@@ -407,10 +602,13 @@ def apply_relayout(amps, perm, dev, axis: str, ndev: int,
             if check:
                 z, flag = _checked_ppermute(z, axis,
                                             list(enumerate(dsts)), dev,
-                                            fault, armed=True)
-                flags = flag.reshape(1, 1)
+                                            fault, armed=True,
+                                            subblocks=subblocks,
+                                            wire_ok=wire_ok)
+                flags = flag.reshape(1, -1)
             else:
-                z = lax.ppermute(z, axis, list(enumerate(dsts)))
+                z = _exchange(z, axis, list(enumerate(dsts)),
+                              subblocks, wire_ok=wire_ok)
         out = _permute_local_bits(z, lperm, cb_s)
         return (out, flags) if check else out
 
@@ -436,14 +634,18 @@ def apply_relayout(amps, perm, dev, axis: str, ndev: int,
         if check:
             # only the FIRST communicating round is armed for a
             # scripted in-flight corruption (one deterministic hit per
-            # item); every round is verified
+            # item, landing in its first sub-block); every round's
+            # every sub-block is verified
             r, flag = _checked_ppermute(sent, axis,
                                         list(enumerate(dsts)), dev,
-                                        fault, armed=not flag_list)
+                                        fault, armed=not flag_list,
+                                        subblocks=subblocks,
+                                        wire_ok=wire_ok)
             recv.append(r)
             flag_list.append(flag)
         else:
-            recv.append(lax.ppermute(sent, axis, list(enumerate(dsts))))
+            recv.append(_exchange(sent, axis, list(enumerate(dsts)),
+                                  subblocks, wire_ok=wire_ok))
     rb = jnp.stack(recv)
     nb = jnp.stack([
         lax.dynamic_index_in_dim(rb, u ^ dD, axis=0, keepdims=False)
@@ -482,8 +684,11 @@ def apply_layout_perm(amps, perm, mesh):
 
     def body(a):
         dev = lax.axis_index(axis)
+        # wire_ok=False: canonicalisation is EXACT by contract (the
+        # degraded-mesh resume's bit-identity pins rest on it), so the
+        # opt-in f32-on-wire demotion never applies here
         return apply_relayout(a, tuple(perm), dev, axis, ndev,
-                              chunk_bits, lane_bits)
+                              chunk_bits, lane_bits, wire_ok=False)
 
     fn = shard_map_compat(body, mesh=mesh,
                           in_specs=(P(axis),),
@@ -502,7 +707,11 @@ def exchange_round_senders(item, num_vec_bits: int, dev_bits: int):
     a half/full bitswap, ascending-``w`` over ``_relayout_dev_maps``'s
     communicating rounds for a fused relayout — so a verification flag
     at (device, round) attributes to one exact sender/receiver pair
-    (``resilience.wire_corruption``)."""
+    (``resilience.wire_corruption``).  Under sub-block pipelining each
+    round fans out into ``subblocks`` flag COLUMNS sharing the round's
+    map; :func:`sender_columns` expands these maps into the per-column
+    (senders, labels) the checked programs' flags are verified
+    against."""
     chunk_bits = num_vec_bits - dev_bits
     ndev = 1 << dev_bits
     cls = _swap_comm_class(item, chunk_bits)
@@ -527,20 +736,42 @@ def exchange_round_senders(item, num_vec_bits: int, dev_bits: int):
     return senders
 
 
+def sender_columns(senders, subblocks: int):
+    """Expand per-ROUND sender maps into per-COLUMN ``(maps, labels)``
+    matching a checked program's flag layout under sub-block
+    pipelining: each round contributes ``subblocks`` columns sharing
+    its map, labelled ``"<round>.<sub-block>"`` (plain round ints at
+    subblocks=1, keeping the serial attribution spelling byte-stable).
+    The labels are what ``resilience.wire_corruption`` names a caught
+    corruption with — item / round / sub-block / sender -> receiver."""
+    S = max(int(subblocks), 1)
+    if S == 1:
+        return list(senders), list(range(len(senders)))
+    cols, labels = [], []
+    for w, smap in enumerate(senders):
+        for j in range(S):
+            cols.append(smap)
+            labels.append(f"{w}.{j}")
+    return cols, labels
+
+
 class _CheckedFn:
     """One integrity-checked per-item program (the checksummed-
     collectives half of quest_tpu.resilience's integrity layer): wraps
     the jitted ``(amps, fault) -> (amps, flags)`` shard_map program
-    together with its STATIC per-round sender maps
-    (:func:`exchange_round_senders`), so ``observe_item`` can verify
-    the flags host-side and attribute any mismatch to the exact
-    sender/receiver pair."""
+    together with its STATIC per-column sender maps and labels
+    (:func:`exchange_round_senders` expanded by
+    :func:`sender_columns`), so ``observe_item`` can verify the flags
+    host-side and attribute any mismatch to the exact
+    (round, sub-block, sender -> receiver) coordinates."""
 
-    __slots__ = ("fn", "senders")
+    __slots__ = ("fn", "senders", "labels")
 
-    def __init__(self, fn, senders):
+    def __init__(self, fn, senders, labels=None):
         self.fn = fn
         self.senders = senders
+        self.labels = (list(range(len(senders))) if labels is None
+                       else labels)
 
     def __call__(self, amps):
         # plain-call fallback (e.g. a traced execution where host-side
@@ -549,6 +780,333 @@ class _CheckedFn:
         # on the observed path (observe_item), which calls .fn directly
         out, _flags = self.fn(amps, jnp.zeros((2,), jnp.int32))
         return out
+
+
+class _PipelinedFn:
+    """One sub-block pipelined comm item (S > 1): the whole-item jitted
+    program ``fn`` (in-program sub-blocked — the unobserved/per-item
+    fast form, checked ``(amps, fault) -> (amps, flags)`` when
+    ``senders`` is non-empty, plain ``amps -> amps`` otherwise) PLUS
+    the staged ``prep`` / ``send`` / ``merge`` / ``init`` / ``finish``
+    shard_map programs the OBSERVED path drives as a host-side
+    double-buffered pipeline (:func:`_drive_pipeline`): while sub-block
+    j's ppermute is in flight, sub-block j+1's payload is gathered and
+    sub-block j's predecessor merged, each leg its own walled timeline
+    sub-span — ``<kind>-send`` (comm, carrying the stage's exact
+    exchange-byte share) and ``<kind>-gather`` / ``<kind>-merge``
+    (compute).  ``comm_hidden_frac`` is then the measured interval
+    overlap of those sub-spans, not a model.
+
+    ``stage_desc`` is ``[(send_idx, w, j, elems), ...]`` in execution
+    order — ``send[send_idx]`` is the round's jitted ppermute program
+    (one per round: routing pairs are static), ``w``/``j`` the traced
+    round/sub-block selectors ``prep``/``merge`` take, ``elems`` the
+    stage's exchange-element share (the per-stage split of the SAME
+    ``plan_exchange_elems`` accounting, so summed timeline bytes still
+    equal the ledger's).  ``senders``/``labels`` are per flag COLUMN
+    (:func:`sender_columns`), shared by the whole checked program and
+    the staged flags alike."""
+
+    __slots__ = ("fn", "senders", "labels", "kind", "subblocks",
+                 "prep", "send", "merge", "init", "finish",
+                 "stage_desc")
+
+    def __init__(self, fn, senders, labels, kind, subblocks, stages):
+        self.fn = fn
+        self.senders = senders
+        self.labels = labels
+        self.kind = kind
+        self.subblocks = subblocks
+        self.prep = stages["prep"]
+        self.send = stages["send"]
+        self.merge = stages["merge"]
+        self.init = stages["init"]
+        self.finish = stages["finish"]
+        self.stage_desc = stages["stage_desc"]
+
+    def __call__(self, amps):
+        # unobserved path / traced contexts: the whole-item program
+        # (still in-program sub-blocked, so XLA's scheduler keeps the
+        # overlap opportunity) — the staged host pipeline exists for
+        # the observed path only, where its legs are walled
+        if self.senders:
+            out, _flags = self.fn(amps, jnp.zeros((2,), jnp.int32))
+            return out
+        return self.fn(amps)
+
+
+def _build_pipeline_stages(item, num_vec_bits: int, dev_bits: int,
+                           lane_bits: int, mesh, axis: str, ndev: int,
+                           S: int, checked: bool):
+    """Staged shard_map programs for ONE comm plan item under
+    sub-block pipelining (see :class:`_PipelinedFn`).  Returns the
+    stage dict, or None for items that move nothing.
+
+    Program count is kept compile-friendly by TRACING the round and
+    sub-block selectors: one ``prep``/``merge``/``init``/``finish``
+    program per item plus one ``send`` program per communicating round
+    (ppermute routing pairs must be static), regardless of S."""
+    chunk_bits = num_vec_bits - dev_bits
+    cls = _swap_comm_class(item, chunk_bits)
+    if cls in (None, "local") or S <= 1:
+        return None
+    s_chunk = 1 << (chunk_bits + 1)
+    cb_s = chunk_bits + 1
+
+    if cls == "relayout":
+        perm = item[1]
+        perm_s = _lift_perm(perm, lane_bits)
+        A, _B, R = relayout_decompose(perm_s, cb_s)
+        q = len(A)
+        lperm = R[:cb_s]
+        D_s = [b - cb_s for b in _B]
+        _q, dst_rounds = _relayout_dev_maps(perm, num_vec_bits,
+                                            dev_bits)
+        if not dst_rounds:
+            return None
+        block = s_chunk >> q
+        m = block // S
+
+        def _sel(dev):
+            eD = jnp.zeros((), jnp.int32)
+            dD = jnp.zeros((), jnp.int32)
+            for i in range(q):
+                eD = eD | (((dev >> D_s[i]) & 1) << i)
+                dD = dD | (((dev >> (R[cb_s + D_s[i]] - cb_s)) & 1)
+                           << i)
+            return eD, dD
+
+        def payload(a, dev, w):
+            blocks = _split_blocks(a, A, cb_s)
+            eD, _ = _sel(dev)
+            return lax.dynamic_index_in_dim(blocks, eD ^ w, axis=0,
+                                            keepdims=False)
+
+        def acc_init(a, dev):
+            acc = jnp.zeros((1 << q, block), a.dtype)
+            kept = [w for w in range(1 << q) if w not in dst_rounds]
+            if kept:
+                blocks = _split_blocks(a, A, cb_s)
+                eD, dD = _sel(dev)
+                for w in kept:  # w == 0 under an identity relabel
+                    sent = lax.dynamic_index_in_dim(blocks, eD ^ w,
+                                                    axis=0,
+                                                    keepdims=False)
+                    acc = lax.dynamic_update_slice(
+                        acc, sent.reshape(1, block), (w ^ dD, 0))
+            return acc
+
+        def acc_place(acc, r, dev, w, j):
+            _, dD = _sel(dev)
+            return lax.dynamic_update_slice(
+                acc, r.astype(acc.dtype).reshape(1, m),
+                (w ^ dD, j * m))
+
+        def finish_body(a, acc):
+            z = _merge_blocks(acc, A, cb_s, a.shape)
+            return _permute_local_bits(z, lperm, cb_s)
+
+        rounds = [(w, list(enumerate(dst_rounds[w])),
+                   block * sum(1 for e, d in enumerate(dst_rounds[w])
+                               if d != e))
+                  for w in sorted(dst_rounds)]
+    else:
+        a_bit, b_bit = sorted(item[1:])
+        if cls == "half":
+            sa = _lift_bit(a_bit, lane_bits)
+            off = b_bit - chunk_bits
+            stride = 1 << off
+            pairs = [(p, p ^ stride) for p in range(ndev)]
+            half = s_chunk // 2
+            m = half // S
+
+            def payload(a, dev, w):
+                v, ax2 = _isolate_bit(a, sa, lane_bits + 1)
+                h0 = lax.index_in_dim(v, 0, ax2, keepdims=False)
+                h1 = lax.index_in_dim(v, 1, ax2, keepdims=False)
+                wd = (dev >> off) & 1
+                return jnp.where(wd == 0, h1, h0).reshape(-1)
+
+            def acc_init(a, dev):
+                return jnp.zeros((half,), a.dtype)
+
+            def finish_body(a, acc):
+                v, ax2 = _isolate_bit(a, sa, lane_bits + 1)
+                h0 = lax.index_in_dim(v, 0, ax2, keepdims=False)
+                h1 = lax.index_in_dim(v, 1, ax2, keepdims=False)
+                wd = (lax.axis_index(axis) >> off) & 1
+                recv = acc.reshape(h0.shape)
+                new0 = jnp.where(wd == 0, h0, recv)
+                new1 = jnp.where(wd == 0, recv, h1)
+                return jnp.stack([new0, new1],
+                                 axis=ax2).reshape(a.shape)
+
+            rounds = [(0, pairs, ndev * half)]
+        else:  # full: device<->device, movers only
+            o1, o2 = (x - chunk_bits for x in (a_bit, b_bit))
+            stride = (1 << o1) | (1 << o2)
+            pairs = [(p, p ^ stride)
+                     if ((p >> o1) & 1) != ((p >> o2) & 1) else (p, p)
+                     for p in range(ndev)]
+            m = s_chunk // S
+
+            def payload(a, dev, w):
+                return a.reshape(-1)
+
+            def acc_init(a, dev):
+                return jnp.zeros((s_chunk,), a.dtype)
+
+            def finish_body(a, acc):
+                return acc.reshape(a.shape)
+
+            rounds = [(0, pairs, (ndev // 2) * s_chunk)]
+
+        def acc_place(acc, r, dev, w, j):
+            return lax.dynamic_update_slice(acc, r.astype(acc.dtype),
+                                            (j * m,))
+
+    def shm(body, in_specs, out_specs):
+        return shard_map_compat(body, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs)
+
+    def prep_body(a, w, j):
+        dev = lax.axis_index(axis)
+        p = payload(a, dev, w).reshape(S, -1)
+        p = lax.dynamic_index_in_dim(p, j, axis=0, keepdims=False)
+        return p.astype(wire_dtype(p.dtype))
+
+    prep = jax.jit(shm(prep_body, (P(axis), P(), P()), P(axis)))
+
+    send_fns = []
+    for _w, rpairs, _elems in rounds:
+        if checked:
+            def send_body(p, fault, arm, _pairs=rpairs):
+                dev = lax.axis_index(axis)
+                tok = _fold_token(p)
+                p = _corrupt_payload(
+                    p, fault,
+                    (arm > 0) & (fault[0] > 0) & (dev == 0))
+                recv = lax.ppermute(p, axis, _pairs)
+                tok_recv = lax.ppermute(tok, axis, _pairs)
+                flag = (_fold_token(recv) != tok_recv).astype(jnp.int32)
+                return recv, flag
+
+            send_fns.append(jax.jit(shm(send_body,
+                                        (P(axis), P(), P()),
+                                        (P(axis), P(axis)))))
+        else:
+            def send_body(p, _pairs=rpairs):
+                return lax.ppermute(p, axis, _pairs)
+
+            send_fns.append(jax.jit(shm(send_body, (P(axis),),
+                                        P(axis))))
+
+    def merge_body(acc, r, w, j):
+        dev = lax.axis_index(axis)
+        return acc_place(acc, r, dev, w, j)
+
+    merge = jax.jit(shm(merge_body, (P(axis), P(axis), P(), P()),
+                        P(axis)), donate_argnums=(0,))
+
+    def init_body(a):
+        return acc_init(a, lax.axis_index(axis))
+
+    init = jax.jit(shm(init_body, (P(axis),), P(axis)))
+    finish = jax.jit(shm(finish_body, (P(axis), P(axis)), P(axis)))
+
+    stage_desc = [(ri, w, j, elems // S)
+                  for ri, (w, _pairs, elems) in enumerate(rounds)
+                  for j in range(S)]
+    return {"prep": prep, "send": send_fns, "merge": merge,
+            "init": init, "finish": finish, "stage_desc": stage_desc}
+
+
+def _drive_pipeline(pipe: "_PipelinedFn", amps, fvec, args: dict):
+    """Execute one comm item as the host-driven double-buffered
+    pipeline: the NEXT sub-block's payload gather and ppermute dispatch
+    happen while the current sub-block's transfer is still in flight,
+    and each received sub-block merges while its successor travels.
+    Every leg is its own timeline sub-span; a send span runs from
+    DISPATCH to completion-sync — the issue-to-sync accounting async
+    collectives get on a real timeline — so the compute walled inside
+    that window is measured overlap, not inference.
+
+    Returns ``(amps_out, flags | None)`` with ``flags`` a host
+    (ndev, columns) matrix in :func:`sender_columns` order when the
+    item is checked."""
+    import numpy as np
+
+    checked = bool(pipe.senders)
+    base = {k: args[k] for k in ("index", "comm_class", "subblocks")
+            if k in args}
+    kind = pipe.kind
+    itemsize = jnp.dtype(amps.dtype).itemsize
+    wire_isz = wire_dtype(amps.dtype).itemsize
+    K = len(pipe.stage_desc)
+    t_disp = [0.0] * K
+    inflight = [None] * K
+
+    def sel(k):
+        _ri, w, j, _elems = pipe.stage_desc[k]
+        return (jnp.asarray(w, jnp.int32), jnp.asarray(j, jnp.int32))
+
+    def gather(k):
+        _ri, w, j, _elems = pipe.stage_desc[k]
+        with metrics.timeline_span(f"{kind}-gather",
+                                   args=dict(base, round=w, sub=j)):
+            p = pipe.prep(amps, *sel(k))
+            jax.block_until_ready(p)
+        return p
+
+    def dispatch(k, p):
+        ri, _w, _j, _elems = pipe.stage_desc[k]
+        t_disp[k] = metrics.clock()
+        if checked:
+            arm = jnp.asarray(1 if k == 0 else 0, jnp.int32)
+            inflight[k] = pipe.send[ri](p, fvec, arm)
+        else:
+            inflight[k] = pipe.send[ri](p)
+
+    depth = comm_pipeline_depth()
+    dispatch(0, gather(0))
+    with metrics.timeline_span(f"{kind}-merge",
+                               args=dict(base, stage="init")):
+        acc = pipe.init(amps)
+        jax.block_until_ready(acc)
+    flag_cols = []
+    next_disp = 1
+    for k in range(K):
+        while next_disp < min(K, k + depth):
+            # software double-buffering (lookahead `depth`): the next
+            # sub-blocks' gathers + wire dispatches ride under
+            # sub-block k's in-flight transfer, and their transfers in
+            # turn ride under the merges below
+            dispatch(next_disp, gather(next_disp))
+            next_disp += 1
+        out = inflight[k]
+        inflight[k] = None
+        recv, flag = out if checked else (out, None)
+        jax.block_until_ready(out)
+        _ri, w, j, elems = pipe.stage_desc[k]
+        ev_args = dict(base, round=w, sub=j,
+                       exchange_bytes=elems * itemsize)
+        if wire_isz != itemsize:
+            ev_args["wire_bytes"] = elems * wire_isz
+        metrics.timeline_event(f"{kind}-send", t_disp[k],
+                               metrics.clock() - t_disp[k],
+                               args=ev_args)
+        if checked:
+            flag_cols.append(np.asarray(jax.device_get(flag)).reshape(-1))
+        with metrics.timeline_span(f"{kind}-merge",
+                                   args=dict(base, round=w, sub=j)):
+            acc = pipe.merge(acc, recv, *sel(k))
+            jax.block_until_ready(acc)
+    with metrics.timeline_span(f"{kind}-merge",
+                               args=dict(base, stage="finish")):
+        out = pipe.finish(amps, acc)
+        jax.block_until_ready(out)
+    flags = np.stack(flag_cols, axis=1) if checked else None
+    return out, flags
 
 
 def _poison_state(amps, code: int, param: int):
@@ -601,7 +1159,11 @@ def item_timeline_meta(item, num_vec_bits: int, dev_bits: int,
         targets = sorted(item[1:])
     return {"kind": "relayout" if item[0] == "relayout" else "bitswap",
             "targets": targets, "comm_class": cls,
-            "exchange_elems": elems}
+            "exchange_elems": elems,
+            # the pipeline shape rides the meta so the timeline tags,
+            # the flight ring, the watchdog repricing and the
+            # supervisor preflight all read the SAME resolved S
+            "subblocks": item_subblocks(item, num_vec_bits, dev_bits)}
 
 
 def observe_item(f, amps, meta: dict, hook=None):
@@ -682,7 +1244,9 @@ def observe_item(f, amps, meta: dict, hook=None):
     if cur is not None:
         cur.take()
     wall = resilience.watchdog_begin(wd_meta, exchange_bytes, ndev)
-    chk = f if isinstance(f, _CheckedFn) else None
+    chk = (f if isinstance(f, (_CheckedFn, _PipelinedFn)) and f.senders
+           else None)
+    pipe = f if isinstance(f, _PipelinedFn) else None
     # everything after the wall is armed runs under the cancel guard: a
     # raising fault seam must not leak a live timer that would later
     # fire and overwrite the real failure's flight dump
@@ -708,39 +1272,51 @@ def observe_item(f, amps, meta: dict, hook=None):
             # a simulated hung collective: blocks until the armed
             # deadline, then raises the breach (never returns)
             resilience.watchdog_stall(wall, wd_meta)
+        fvec = (jnp.asarray(wire_sdc or (0, 0), jnp.int32)
+                if chk is not None else None)
         if chk is not None:
-            fvec = jnp.asarray(wire_sdc or (0, 0), jnp.int32)
+            # checked whole program with the run's fault vector — used
+            # whenever the staged pipeline below does not take over
             run = lambda a: chk.fn(a, fvec)  # noqa: E731
         else:
             run = f
         flags = None
-        if metrics.timeline_active():
+        if pipe is not None and metrics.timeline_active():
+            # sub-block pipelined comm item under capture: the staged
+            # host pipeline replaces the single enclosing item span
+            # with per-leg sub-spans (<kind>-send / -gather / -merge)
+            # whose exchange-byte shares sum to the item's — the
+            # timeline==ledger equality pin holds, and the send spans'
+            # measured overlap with the compute legs IS
+            # comm_hidden_frac
+            amps, flags = _drive_pipeline(pipe, amps, fvec, args)
+        elif metrics.timeline_active():
             with metrics.timeline_span(kind, args=args):
                 out = run(amps)
                 jax.block_until_ready(out)
+            amps, flags = out if chk is not None else (out, None)
         elif wall is not None:
             out = run(amps)
             jax.block_until_ready(out)
+            amps, flags = out if chk is not None else (out, None)
         else:
             out = run(amps)
-        if chk is not None:
-            amps, flags = out
-        else:
-            amps = out
+            amps, flags = out if chk is not None else (out, None)
     except BaseException:
         if wall is not None:
             wall.cancel()
         raise
     resilience.watchdog_end(wall)
     if flags is not None:
-        # receive-side verification: flags[d, r] = device d's round-r
-        # payload failed its checksum refold; attribute via the static
-        # sender maps and raise (strikes both devices)
+        # receive-side verification: flags[d, c] = device d's column-c
+        # payload (round r, sub-block j under pipelining) failed its
+        # checksum refold; attribute via the static per-column sender
+        # maps and labels and raise (strikes both devices)
         fl = jax.device_get(flags)
-        bad = [(r, chk.senders[r][d], d)
+        bad = [(chk.labels[c], chk.senders[c][d], d)
                for d in range(fl.shape[0])
-               for r in range(min(fl.shape[1], len(chk.senders)))
-               if fl[d, r]]
+               for c in range(min(fl.shape[1], len(chk.senders)))
+               if fl[d, c]]
         if bad:
             resilience.wire_corruption(wd_meta, bad)
     elif wire_sdc is not None:
@@ -973,12 +1549,13 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
             return apply_fused_segment(amps, seg_ops, high,
                                        interpret=interpret,
                                        dev_flags=flags)
+        S = item_subblocks(item, num_vec_bits, dev_bits)
         if item[0] == "relayout":
             return apply_relayout(amps, item[1], dev, axis, ndev,
-                                  chunk_bits, lane_bits)
+                                  chunk_bits, lane_bits, subblocks=S)
         _, a, b = item
         return bitswap_amps(amps, a, b, dev, axis, ndev,
-                            chunk_bits, lane_bits)
+                            chunk_bits, lane_bits, subblocks=S)
 
     def shmap(body):
         # replication checks disabled (see shard_map_compat): pallas_call's
@@ -1015,14 +1592,15 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
 
         def checked_item_body(item, amps, fault):
             dev = lax.axis_index(axis)
+            S = item_subblocks(item, num_vec_bits, dev_bits)
             if item[0] == "relayout":
                 return apply_relayout(amps, item[1], dev, axis, ndev,
                                       chunk_bits, lane_bits, check=True,
-                                      fault=fault)
+                                      fault=fault, subblocks=S)
             _, a, b = item
             return bitswap_amps(amps, a, b, dev, axis, ndev,
                                 chunk_bits, lane_bits, check=True,
-                                fault=fault)
+                                fault=fault, subblocks=S)
 
         def shmap_checked(body):
             return shard_map_compat(
@@ -1040,16 +1618,29 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
             key = (_item_key(item), bool(senders))
             f = unique.get(key)
             if f is None:
+                S = item_subblocks(item, num_vec_bits, dev_bits)
+                cols, labels = sender_columns(senders, S)
                 if senders:
                     jf = jax.jit(
                         shmap_checked(functools.partial(
                             checked_item_body, item)),
                         donate_argnums=(0,) if donate else ())
-                    f = _CheckedFn(jf, senders)
                 else:
-                    f = jax.jit(
+                    jf = jax.jit(
                         shmap(functools.partial(item_body, item)),
                         donate_argnums=(0,) if donate else ())
+                stages = _build_pipeline_stages(
+                    item, num_vec_bits, dev_bits, lane_bits, mesh,
+                    axis, ndev, S, bool(senders)) if S > 1 else None
+                if stages is not None:
+                    f = _PipelinedFn(
+                        jf, cols, labels,
+                        "relayout" if item[0] == "relayout"
+                        else "bitswap", S, stages)
+                elif senders:
+                    f = _CheckedFn(jf, cols, labels)
+                else:
+                    f = jf
                 unique[key] = f
             item_fns.append(f)
         layouts = plan_layouts(plan, num_vec_bits)

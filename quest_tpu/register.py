@@ -306,8 +306,20 @@ class Qureg:
         budget = None
         if integ:
             ndev = 1 if self.mesh is None else int(self.mesh.devices.size)
+            wire_items = 0
+            if ndev > 1:
+                from .parallel.mesh_exec import wire_dtype
+
+                if wire_dtype(self._amps.dtype) != self._amps.dtype:
+                    # flush-granularity upper bound on compressed
+                    # exchanges (at most one relayout per streamed op):
+                    # the observed path counts exact comm items, the
+                    # eager seam prices the ceiling — generous, never a
+                    # false positive under opt-in f32-on-wire
+                    wire_items = n_ops
             budget = resilience.drift_budget(n_ops, self._amps.dtype,
-                                             ndev)
+                                             ndev,
+                                             wire_items=wire_items)
         reason, _after = check_state_health(
             self._amps, is_density=self.is_density,
             num_qubits=self.num_qubits, mesh=self.mesh,
@@ -577,7 +589,15 @@ def _stream_fn(ops: tuple, num_vec_qubits: int, mesh, dtype=jnp.float32):
                 _trace("stream compiled+saved")
         return fn
 
-    key = (ops, num_vec_qubits, mesh, dtype)
+    from .parallel.mesh_exec import comm_config_token
+
+    # the comm config token keys the collective shape a mesh program
+    # bakes in (sub-block pipelining, f32-on-wire): a knob flipped
+    # mid-process must rebuild, not reuse — same contract as
+    # Circuit.compile's memo (single-device programs have no
+    # collectives, but one uniform key is cheaper than a stale
+    # program is expensive)
+    key = (ops, num_vec_qubits, mesh, dtype, comm_config_token())
     if key in _STREAM_CACHE:
         metrics.counter_inc("stream.cache_hits")
     return lru_get(_STREAM_CACHE, key, _STREAM_CACHE_MAX, build)

@@ -604,7 +604,8 @@ def watchdog_strikes() -> int:
         return WATCHDOG_STRIKES_DEFAULT
 
 
-def watchdog_budget_s(exchange_bytes: int, ndev: int) -> float:
+def watchdog_budget_s(exchange_bytes: int, ndev: int,
+                      subblocks: int = 1) -> float:
     """Deadline for one observed plan item, in seconds.
 
     ``exchange_bytes`` is the item's interconnect volume summed over
@@ -612,14 +613,27 @@ def watchdog_budget_s(exchange_bytes: int, ndev: int) -> float:
     ``plan_exchange_elems`` figure the ledger records, so the watchdog
     and the ledger can never disagree about an item's cost.  Per-device
     wire time prices against the configured link bandwidth with a slack
-    factor; the floor covers compute-only items (exchange_bytes 0)."""
+    factor; the floor covers compute-only items (exchange_bytes 0).
+
+    ``subblocks`` reprices a sub-block PIPELINED item (S > 1): the
+    wire still carries every byte — overlap hides time, it never
+    removes traffic — so the serial wire term stays, and ONE extra
+    sub-block leg (``wire / S``) prices the pipeline fill: the first
+    sub-block's un-overlapped gather/merge tail that the serial
+    schedule did not have.  The factor is ``1 + 1/S`` — bounded by
+    1.5x at S=2 and shrinking toward the serial budget as S grows, so
+    a pipelined item can neither breach spuriously (the budget covers
+    the overlapped schedule's worst case) nor inflate the deadline
+    into uselessness (no slack explosion)."""
     gbps = _wd_param("gbps", "QUEST_WATCHDOG_GBPS", WATCHDOG_GBPS_DEFAULT)
     slack = _wd_param("slack", "QUEST_WATCHDOG_SLACK",
                       WATCHDOG_SLACK_DEFAULT)
     min_s = _wd_param("min_s", "QUEST_WATCHDOG_MIN_S",
                       WATCHDOG_MIN_S_DEFAULT)
     per_dev = exchange_bytes / max(int(ndev), 1)
-    return min_s + (per_dev / (gbps * 1e9)) * slack
+    S = max(int(subblocks), 1)
+    fill = (1.0 / S) if S > 1 else 0.0
+    return min_s + (per_dev / (gbps * 1e9)) * slack * (1.0 + fill)
 
 
 class _WatchdogWall:
@@ -663,7 +677,9 @@ def watchdog_begin(meta: dict, exchange_bytes: int,
     armed wall always fires before the run's deadline would."""
     if not watchdog_enabled():
         return None
-    return _WatchdogWall(meta, watchdog_budget_s(exchange_bytes, ndev))
+    return _WatchdogWall(meta, watchdog_budget_s(
+        exchange_bytes, ndev,
+        subblocks=int(meta.get("subblocks") or 1)))
 
 
 def watchdog_end(wall: "_WatchdogWall | None") -> None:
@@ -851,6 +867,14 @@ INTEGRITY_ROLLBACKS_DEFAULT = 2
 #: overrides QUEST_DRIFT_OP_FACTOR / QUEST_DRIFT_DEV_FACTOR.
 DRIFT_OP_FACTOR_DEFAULT = 64.0
 DRIFT_DEV_FACTOR_DEFAULT = 16.0
+#: Per-compressed-exchange drift allowance of the opt-in f32-on-wire
+#: payload demotion (QUEST_WIRE_F32=1, mesh_exec.wire_dtype): each
+#: demoted collective rounds every travelled amplitude to f32, adding
+#: up to ~eps32/2 relative error per exchange — priced at f32 eps
+#: times this factor PER WIRE-COMPRESSED COMM ITEM, exactly as the
+#: per-op term prices kernel roundoff, so the integrity probes stay
+#: armed under compression without false positives.
+DRIFT_WIRE_FACTOR_DEFAULT = 8.0
 
 _integrity = {"on": False, "heal": None, "rollbacks": None}
 
@@ -915,28 +939,45 @@ def _drift_factor(env: str, default: float) -> float:
         return default
 
 
-def drift_budget(n_ops: int, dtype, ndev: int) -> float:
+def drift_budget(n_ops: int, dtype, ndev: int,
+                 wire_items: int = 0) -> float:
     """Relative norm (sv) / trace (dm) drift budget for ``n_ops``
     applied ops on an ``ndev``-device mesh at ``dtype`` — the fp-model
     error allowance the integrity layer prices invariants against,
     exactly as the watchdog prices time from bytes:
 
-    ``budget = eps * (op_factor * n_ops + dev_factor * (ndev - 1))``
+    ``budget = eps * (op_factor * n_ops + dev_factor * (ndev - 1))
+    + eps32 * wire_factor * wire_items``
 
     The per-op term is the same generous roundoff-growth model the
     health probes use (only kernel bugs or injected garbage should
     trip); the per-device term covers the reduction-order spread of
-    sharded norm/trace sums.  A measured drift past this budget is
-    *suspected silent data corruption*: far above accumulated roundoff
-    yet possibly far below anything a NaN scan would ever see."""
+    sharded norm/trace sums.  ``wire_items`` prices the opt-in
+    f32-on-wire compression (``QUEST_WIRE_F32=1``): the count of
+    comm items whose payloads travelled demoted since the last healthy
+    probe, each allowed ``eps32 * QUEST_DRIFT_WIRE_FACTOR`` of
+    invariant drift — the introduced error is deliberate and bounded,
+    and must not read as corruption (0 when compression is off, so
+    the serial formula is byte-stable).  A measured drift past this
+    budget is *suspected silent data corruption*: far above priced
+    roundoff yet possibly far below anything a NaN scan would ever
+    see."""
+    import numpy as _np
+
     from . import precision as _prec
 
     eps = _prec.real_eps(dtype)
     op_f = _drift_factor("QUEST_DRIFT_OP_FACTOR", DRIFT_OP_FACTOR_DEFAULT)
     dev_f = _drift_factor("QUEST_DRIFT_DEV_FACTOR",
                           DRIFT_DEV_FACTOR_DEFAULT)
-    return eps * (op_f * max(int(n_ops), 1)
-                  + dev_f * max(int(ndev) - 1, 0))
+    budget = eps * (op_f * max(int(n_ops), 1)
+                    + dev_f * max(int(ndev) - 1, 0))
+    if wire_items:
+        wire_f = _drift_factor("QUEST_DRIFT_WIRE_FACTOR",
+                               DRIFT_WIRE_FACTOR_DEFAULT)
+        budget += _prec.real_eps(_np.float32) * wire_f \
+            * max(int(wire_items), 0)
+    return budget
 
 
 def sdc_suspected(reason: str, meta: dict | None = None) -> str:

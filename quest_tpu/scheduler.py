@@ -558,6 +558,59 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     return plan
 
 
+def plan_comm_cost(plan, num_vec_bits: int, dev_bits: int,
+                   subblocks: int | None = None) -> dict:
+    """Overlap-aware comm-class costing of a mesh plan — the
+    scheduler-side MODEL of what the pipelined collectives buy (the
+    measured figure is the timeline's ``comm_hidden_frac``; this is
+    the planning-time estimate tools cost schedules with before
+    touching a chip).
+
+    Per comm item, the total exchange volume is the exact
+    ``plan_exchange_elems`` accounting (S-invariant: sub-blocking
+    never changes what moves), while the EXPOSED volume models the
+    double-buffered schedule's un-hidden wire: with S sub-blocks in
+    flight against the gather/merge legs, only the pipeline-fill leg
+    (``1/S`` of the item's volume) cannot overlap — the same fill
+    term ``resilience.watchdog_budget_s`` prices deadlines with.
+    ``subblocks=None`` resolves S per item exactly as the executors
+    do (``mesh_exec.item_subblocks``: env override or payload-size
+    auto); an explicit value models a tuning sweep.
+
+    Returns ``{"per_class": {cls: {"items", "exchange_elems",
+    "exposed_elems"}}, "exchange_elems", "exposed_elems",
+    "hidden_frac_model"}``."""
+    from .parallel.mesh_exec import (_swap_comm_class, item_subblocks,
+                                     plan_exchange_elems)
+
+    chunk_bits = num_vec_bits - dev_bits
+    per_class: dict = {}
+    total = exposed = 0.0
+    for item in plan:
+        cls = _swap_comm_class(item, chunk_bits)
+        if cls in (None, "local"):
+            continue
+        _, elems = plan_exchange_elems([item], num_vec_bits, dev_bits)
+        if not elems:
+            continue
+        S = (item_subblocks(item, num_vec_bits, dev_bits)
+             if subblocks is None else max(int(subblocks), 1))
+        exp = elems / S if S > 1 else float(elems)
+        row = per_class.setdefault(cls, {"items": 0,
+                                         "exchange_elems": 0,
+                                         "exposed_elems": 0.0})
+        row["items"] += 1
+        row["exchange_elems"] += elems
+        row["exposed_elems"] += exp
+        total += elems
+        exposed += exp
+    return {"per_class": per_class,
+            "exchange_elems": int(total),
+            "exposed_elems": exposed,
+            "hidden_frac_model": (1.0 - exposed / total) if total
+            else 0.0}
+
+
 def compose_swap_perm(run, num_vec_bits: int, perm=None):
     """Composed bit-permutation of a swap run, in execution order.
 

@@ -323,7 +323,12 @@ def preflight_item(probe, amps, meta: dict, exchange_bytes: int = 0,
         return
     from . import resilience  # deferred: resilience imports metrics
 
-    cost = resilience.watchdog_budget_s(int(exchange_bytes), int(ndev))
+    # identical pricing to the watchdog wall this item would be armed
+    # with — including the pipelined-item fill repricing keyed by the
+    # meta's resolved sub-block count (the pricing-identity contract)
+    cost = resilience.watchdog_budget_s(
+        int(exchange_bytes), int(ndev),
+        subblocks=int(meta.get("subblocks") or 1))
     if rem <= 0:
         _drain(probe, amps, meta, why="deadline",
                detail=f"wall budget {deadline_total():.3f}s already "

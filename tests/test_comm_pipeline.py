@@ -1,0 +1,568 @@
+"""Sub-block pipelined collectives (ISSUE 12): hide the wire.
+
+Covers: (a) the sub-block decomposition policy (``QUEST_COMM_SUBBLOCKS``
+validation, payload-size auto, divisibility clamp); (b) pipelined-vs-
+serial BIT-IDENTITY — at the primitive level (``bitswap_amps`` /
+``apply_relayout`` with ``subblocks`` > 1 across 2/4/8-device meshes
+and every comm class) and end-to-end through an observed Circuit.run
+whose comm items execute as the staged host pipeline; (c) the
+timeline==ledger exchange-byte EQUALITY pin under pipelining (per-sub-
+block send spans carry exact byte shares) and the measured
+``comm_hidden_frac`` run annotation; (d) per-sub-block checksummed
+collectives — an injected wire bitflip/scale is caught with
+round.sub-block attribution and participant strikes, and lands
+SILENTLY when the layer is disarmed; (e) f32-on-wire compression —
+bounded error, checksums folded over the wire dtype, the drift
+budget's wire term keeping integrity armed without false positives;
+(f) the repriced watchdog/deadline budgets (pricing identity incl. the
+pipeline-fill factor); (g) the scheduler's overlap-aware comm costing
+model; (h) the config-bound ``comm_hidden_frac`` ledger_diff rule
+firing in both directions, and trace_view's pipelined kinds + per-item
+hidden column staying in lockstep with ``quest_tpu.metrics``.
+"""
+
+import os
+import re
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics, models, resilience
+from quest_tpu.circuit import Circuit
+from quest_tpu.ops.lattice import state_shape, _ilog2, shard_map_compat
+from quest_tpu.parallel import mesh_exec
+from quest_tpu.parallel.mesh_exec import (
+    apply_relayout,
+    bitswap_amps,
+    comm_subblocks,
+    item_subblocks,
+    plan_exchange_elems,
+    sender_columns,
+)
+from quest_tpu.scheduler import (compose_swap_perm, plan_comm_cost,
+                                 schedule_mesh)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import ledger_diff  # noqa: E402
+import trace_view  # noqa: E402
+
+AXIS = "amp"
+
+
+@pytest.fixture(autouse=True)
+def _clean_comm_env(monkeypatch):
+    """No pipelining/wire knob may leak between tests (compiled
+    programs are keyed by the comm config token, but a leaked env var
+    would silently re-route every later mesh test)."""
+    monkeypatch.delenv("QUEST_COMM_SUBBLOCKS", raising=False)
+    monkeypatch.delenv("QUEST_COMM_PIPELINE_DEPTH", raising=False)
+    monkeypatch.delenv("QUEST_WIRE_F32", raising=False)
+    yield
+    metrics.stop_timeline()
+
+
+# ---------------------------------------------------------------------------
+# (a) decomposition policy
+# ---------------------------------------------------------------------------
+
+
+def test_comm_subblocks_env_validation(monkeypatch):
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "3")
+    with pytest.raises(qt.QuESTValidationError, match="power of two"):
+        comm_subblocks(1 << 16)
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "x")
+    with pytest.raises(qt.QuESTValidationError, match="not an integer"):
+        comm_subblocks(1 << 16)
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "0")
+    with pytest.raises(qt.QuESTValidationError):
+        comm_subblocks(1 << 16)
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "4")
+    assert comm_subblocks(1 << 16) == 4
+    # clamp: S never exceeds (or fails to divide) the payload
+    assert comm_subblocks(2) == 2
+    assert comm_subblocks(1) == 1
+
+
+def test_comm_subblocks_auto_policy():
+    lo = mesh_exec.COMM_SUBBLOCK_MIN_ELEMS
+    assert comm_subblocks(lo) == 1          # splitting would go below
+    assert comm_subblocks(2 * lo) == 2
+    assert comm_subblocks(lo // 2) == 1     # tiny payloads stay serial
+    big = lo * mesh_exec.COMM_SUBBLOCKS_MAX_AUTO * 4
+    assert comm_subblocks(big) == mesh_exec.COMM_SUBBLOCKS_MAX_AUTO
+
+
+def test_item_subblocks_accounting_invariance(monkeypatch):
+    """S never changes WHAT moves: per-item exchange elements are
+    identical under any sub-block count (the historical-pin
+    guarantee), and the meta carries the resolved S."""
+    n, dev_bits = 12, 3
+    lane_bits = _ilog2(state_shape(1 << n, 1 << dev_bits)[1])
+    plan = schedule_mesh(list(models.qft(n).ops), n, dev_bits,
+                         lane_bits)
+    base = [plan_exchange_elems([it], n, dev_bits)[1] for it in plan]
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "4")
+    forced = [plan_exchange_elems([it], n, dev_bits)[1] for it in plan]
+    assert base == forced
+    metas = [mesh_exec.item_timeline_meta(it, n, dev_bits)
+             for it in plan if it[0] in ("swap", "relayout")]
+    moving = [m for m in metas if m.get("exchange_elems")]
+    assert moving
+    assert all(m["subblocks"] == 4 for m in moving)
+
+
+# ---------------------------------------------------------------------------
+# (b) pipelined-vs-serial bit identity
+# ---------------------------------------------------------------------------
+
+
+def _exchange_both(item, ndev, n, S):
+    """(serial, pipelined) results of one comm item over a random
+    interleaved state on an ndev mesh."""
+    dev_bits = _ilog2(ndev)
+    cb = n - dev_bits
+    shape = state_shape(1 << n, ndev)
+    lanes = shape[1]
+    lane_bits = _ilog2(lanes)
+    rng = np.random.RandomState(hash((ndev, n, S, str(item))) % (2**31))
+    host = np.concatenate([rng.randn(1 << n).reshape(shape),
+                           rng.randn(1 << n).reshape(shape)], axis=1)
+    mesh = Mesh(np.array(jax.devices()[:ndev]), (AXIS,))
+    amps = jax.device_put(jnp.asarray(host),
+                          NamedSharding(mesh, P(AXIS)))
+
+    def run(subblocks):
+        def body(a):
+            dev = lax.axis_index(AXIS)
+            if item[0] == "relayout":
+                return apply_relayout(a, item[1], dev, AXIS, ndev, cb,
+                                      lane_bits, subblocks=subblocks)
+            _, x, y = item
+            return bitswap_amps(a, x, y, dev, AXIS, ndev, cb,
+                                lane_bits, subblocks=subblocks)
+
+        fn = shard_map_compat(body, mesh=mesh, in_specs=(P(AXIS),),
+                              out_specs=P(AXIS))
+        return np.asarray(jax.jit(fn)(amps))
+
+    return run(1), run(S)
+
+
+@pytest.mark.parametrize("ndev", [2, 4, 8])
+def test_pipelined_primitives_bit_identical(ndev):
+    """Property: every comm class (half / full / relayout incl.
+    device<->device residuals) is bit-identical under sub-blocking at
+    several S, on 2/4/8-device meshes."""
+    dev_bits = _ilog2(ndev)
+    n = dev_bits + 5
+    cb = n - dev_bits
+    items = [("swap", 0, cb)]                       # half
+    if dev_bits >= 2:
+        items.append(("swap", cb, cb + 1))          # full
+    chain = [("swap", i, cb + i)
+             for i in range(min(dev_bits, 3))]
+    items.append(("relayout",
+                  tuple(compose_swap_perm(chain, n))))   # fused coset
+    if dev_bits >= 2:  # device<->device residual in R
+        items.append(("relayout", tuple(compose_swap_perm(
+            [("swap", 0, cb), ("swap", 0, cb + 1)], n))))
+    for item in items:
+        for S in (2, 4):
+            serial, piped = _exchange_both(item, ndev, n, S)
+            np.testing.assert_array_equal(serial, piped,
+                                          err_msg=f"{item} S={S}")
+
+
+def test_pipelined_observed_run_bit_identical(env8, monkeypatch):
+    """End to end: an observed run whose comm items execute as the
+    staged host pipeline (timeline on, S forced) produces amplitudes
+    BIT-IDENTICAL to the serial fast path."""
+    n = 12
+    circ = models.qft(n)
+    q = qt.create_qureg(n, env8)
+    circ.run(q)
+    ref = qt.get_state_vector(q)
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "4")
+    q2 = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    circ.run(q2)
+    ev = metrics.timeline_events()
+    metrics.stop_timeline()
+    assert np.array_equal(qt.get_state_vector(q2), ref)
+    # the comm items really ran staged: per-sub-block send spans exist
+    assert any(e["name"].endswith("-send") for e in ev)
+
+
+# ---------------------------------------------------------------------------
+# (c) timeline==ledger pins + measured comm_hidden_frac
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_ledger_byte_equality_under_pipelining(env8,
+                                                        monkeypatch):
+    """The per-sub-block send spans carry exact exchange-byte SHARES:
+    summed timeline bytes still EQUAL the ledger's accounting, and the
+    run annotates a measured (>0) comm_hidden_frac."""
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "4")
+    n = 12
+    circ = models.qft(n)
+    q = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    circ.run(q)
+    ev = metrics.timeline_events()
+    led = metrics.get_run_ledger()
+    metrics.stop_timeline()
+    tl_bytes = sum(e["args"].get("exchange_bytes", 0) for e in ev)
+    assert tl_bytes > 0
+    assert tl_bytes == led["counters"]["exec.exchange_bytes"]
+    # a pipelined item emits NO enclosing comm span (its sub-spans
+    # replace it) — double counting would break the equality above
+    piped = {e["args"]["index"] for e in ev
+             if e["name"].endswith("-send")}
+    whole = {e["args"].get("index") for e in ev
+             if e["name"] in ("bitswap", "relayout")}
+    assert piped and not (piped & whole)
+    frac = led["meta"].get("comm_hidden_frac")
+    assert frac is not None and frac > 0.0
+    ov = metrics.timeline_comm_overlap(ev)
+    assert round(ov["frac"], 4) == frac
+    # trace_view (the offline tool) computes the same aggregate from
+    # the same events
+    total, hidden = trace_view.comm_hidden_us(ev)
+    assert total == pytest.approx(ov["comm_us"])
+    assert hidden == pytest.approx(ov["hidden_us"])
+
+
+def test_trace_view_kind_sets_match_metrics():
+    """The stdlib-only tool's classification sets are a COPY of the
+    metrics module's; they must never drift apart."""
+    assert set(trace_view.COMM_KINDS) == \
+        set(metrics.TIMELINE_COMM_KINDS)
+    assert set(trace_view.COMPUTE_KINDS) == \
+        set(metrics.TIMELINE_COMPUTE_KINDS)
+
+
+def test_trace_view_per_item_hidden_column(env8, monkeypatch):
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "4")
+    n = 12
+    circ = models.qft(n)
+    q = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    circ.run(q)
+    ev = metrics.timeline_events()
+    metrics.stop_timeline()
+    out = trace_view.comm_compute_summary(ev)
+    assert "comm_hidden_frac:" in out
+    assert "hidden ms" in out          # per-item column present
+    rows = trace_view.per_item_hidden(ev)
+    assert rows
+    for _idx, kind, tot, hid, frac in rows:
+        assert kind in ("bitswap", "relayout")
+        assert 0.0 <= frac <= 1.0 and hid <= tot + 1e-9
+    # serial captures keep the old summary (no pipelined sub-spans)
+    serial_ev = [e for e in ev if not e["name"].endswith(
+        ("-send", "-gather", "-merge"))]
+    assert "hidden ms" not in trace_view.comm_compute_summary(serial_ev)
+
+
+# ---------------------------------------------------------------------------
+# (d) per-sub-block checksummed collectives
+# ---------------------------------------------------------------------------
+
+
+def test_sender_columns_labels():
+    senders = [[1, 0, 3, 2], [2, 3, 0, 1]]
+    cols, labels = sender_columns(senders, 1)
+    assert cols == senders and labels == [0, 1]
+    cols, labels = sender_columns(senders, 2)
+    assert cols == [senders[0], senders[0], senders[1], senders[1]]
+    assert labels == ["0.0", "0.1", "1.0", "1.1"]
+
+
+@pytest.mark.parametrize("kind", ["bitflip:12", "scale:1000"])
+def test_pipelined_wire_sdc_detected_with_subblock_attribution(
+        env8, monkeypatch, kind):
+    """An in-flight corruption under S=4 pipelining is caught by the
+    per-sub-block checksum, named as round.sub-block with the exact
+    sender -> receiver pair, and strikes exactly the participants —
+    on the STAGED path (timeline on)."""
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "4")
+    n = 10
+    circ = models.qft(n)
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 0, kind)])
+    q = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    try:
+        with pytest.raises(qt.QuESTCorruptionError) as ei:
+            circ.run(q, pallas="auto")
+    finally:
+        metrics.stop_timeline()
+        resilience.set_integrity(False)
+    msg = str(ei.value)
+    assert "failed its checksum" in msg
+    assert re.search(r"round \d+\.\d+", msg), msg
+    pairs = re.findall(r"device (\d+) -> device (\d+)", msg)
+    assert pairs, msg
+    participants = {int(d) for pair in pairs for d in pair}
+    health = resilience.mesh_health()
+    assert set(health["strikes"]) == participants
+    # the register survives (observed runs never donate)
+    assert abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+
+
+def test_pipelined_wire_sdc_silent_when_disarmed(env8, monkeypatch,
+                                                 tmp_path):
+    """The same injection with the integrity layer DISARMED lands in
+    the state silently under pipelining too — the baseline failure
+    mode the per-sub-block checksums close."""
+    monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", "4")
+    n = 10
+    circ = models.qft(n)
+    q0 = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    circ.run(q0)
+    metrics.stop_timeline()
+    ref = qt.get_state_vector(q0)
+    before = metrics.counters().get("resilience.sdc_detected", 0)
+    resilience.set_fault_plan([("mesh_exchange", 1, "bitflip:12")])
+    q = qt.create_qureg(n, env8)
+    metrics.start_timeline()
+    circ.run(q)
+    metrics.stop_timeline()
+    got = qt.get_state_vector(q)
+    assert not np.array_equal(got, ref)          # silently corrupted
+    assert np.abs(got - ref).max() < 1e-3        # ...and subtly so
+    assert metrics.counters().get("resilience.sdc_detected", 0) \
+        == before
+
+
+# ---------------------------------------------------------------------------
+# (e) f32-on-wire compression
+# ---------------------------------------------------------------------------
+
+
+def test_wire_f32_bounded_error_and_no_false_positive(env8,
+                                                      monkeypatch):
+    """QUEST_WIRE_F32=1 on an f64 state: demoted payloads introduce a
+    small bounded error (nonzero — the wire really compressed), the
+    checksums fold over the ON-WIRE dtype (clean checked run passes),
+    and the drift budget's wire term absorbs the priced demotion error
+    — no false-positive SDC."""
+    n = 10
+    circ = models.qft(n)
+    q = qt.create_qureg(n, env8)
+    circ.run(q)
+    ref = qt.get_state_vector(q)
+    monkeypatch.setenv("QUEST_WIRE_F32", "1")
+    q1 = qt.create_qureg(n, env8)
+    circ.run(q1)
+    err = np.abs(qt.get_state_vector(q1) - ref).max()
+    assert 0.0 < err < 1e-5
+    before = metrics.counters().get("resilience.sdc_detected", 0)
+    resilience.set_integrity(True)
+    try:
+        q2 = qt.create_qureg(n, env8)
+        circ.run(q2, pallas="auto")   # drift-budget breach would raise
+    finally:
+        resilience.set_integrity(False)
+    assert metrics.counters().get("resilience.sdc_detected", 0) \
+        == before
+    # detection is still armed under compression: a REAL corruption on
+    # the compressed wire is caught
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 1, "bitflip:8")])
+    try:
+        q3 = qt.create_qureg(n, env8)
+        with pytest.raises(qt.QuESTCorruptionError,
+                           match="failed its checksum"):
+            circ.run(q3, pallas="auto")
+    finally:
+        resilience.set_integrity(False)
+
+
+def test_wire_f32_exactness_paths_keep_contract(env8, monkeypatch):
+    """f32 states never demote (already at wire precision), and the
+    degraded-resume canonicalisation (apply_layout_perm) stays EXACT
+    under the knob — its wire_ok=False contract."""
+    monkeypatch.setenv("QUEST_WIRE_F32", "1")
+    assert mesh_exec.wire_dtype(jnp.float32) == jnp.dtype(jnp.float32)
+    assert mesh_exec.wire_dtype(jnp.float64) == jnp.dtype(jnp.float32)
+    n, ndev = 9, 8
+    shape = state_shape(1 << n, ndev)
+    rng = np.random.RandomState(3)
+    host = np.concatenate([rng.randn(1 << n).reshape(shape),
+                           rng.randn(1 << n).reshape(shape)], axis=1)
+    mesh = Mesh(np.array(jax.devices()[:ndev]), (AXIS,))
+    amps = jax.device_put(jnp.asarray(host),
+                          NamedSharding(mesh, P(AXIS)))
+    perm = list(compose_swap_perm([("swap", 0, 6), ("swap", 1, 7)], n))
+    out = np.asarray(mesh_exec.apply_layout_perm(amps, perm, mesh))
+    # exact data movement: every element equals the host oracle bit
+    # for bit even while the wire knob is set
+    lanes = shape[1]
+    flat_re = host[:, :lanes].reshape(-1)
+    idx = np.arange(1 << n)
+    j = np.zeros_like(idx)
+    for b in range(n):
+        j |= ((idx >> perm[b]) & 1) << b
+    np.testing.assert_array_equal(out[:, :lanes].reshape(-1),
+                                  flat_re[j])
+
+
+def test_drift_budget_wire_term(monkeypatch):
+    from quest_tpu import precision
+
+    eps32 = precision.real_eps(np.float32)
+    base = resilience.drift_budget(10, np.float64, 8)
+    priced = resilience.drift_budget(10, np.float64, 8, wire_items=3)
+    assert priced == pytest.approx(
+        base + eps32 * resilience.DRIFT_WIRE_FACTOR_DEFAULT * 3)
+    monkeypatch.setenv("QUEST_DRIFT_WIRE_FACTOR", "2")
+    assert resilience.drift_budget(10, np.float64, 8, wire_items=5) \
+        == pytest.approx(base + eps32 * 2.0 * 5)
+    # off-path byte-stability: no wire items -> the serial formula
+    assert resilience.drift_budget(10, np.float64, 8, wire_items=0) \
+        == base
+
+
+# ---------------------------------------------------------------------------
+# (f) repriced budgets (pricing identity)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_budget_pipeline_fill_pricing(monkeypatch):
+    """budget(S) = min_s + wire * slack * (1 + 1/S) for S>1 — the
+    fill-leg repricing; S=1 keeps the serial formula bit-stable, and
+    the factor shrinks monotonically toward serial (no slack
+    explosion) while never pricing BELOW the serial wire (no spurious
+    breach)."""
+    monkeypatch.setenv("QUEST_WATCHDOG_GBPS", "10")
+    monkeypatch.setenv("QUEST_WATCHDOG_SLACK", "2")
+    monkeypatch.setenv("QUEST_WATCHDOG_MIN_S", "1")
+    b = 8 << 30
+    ndev = 8
+    wire = (b / ndev) / (10 * 1e9) * 2
+    assert resilience.watchdog_budget_s(b, ndev) == \
+        pytest.approx(1 + wire)
+    assert resilience.watchdog_budget_s(b, ndev, subblocks=2) == \
+        pytest.approx(1 + wire * 1.5)
+    assert resilience.watchdog_budget_s(b, ndev, subblocks=8) == \
+        pytest.approx(1 + wire * 1.125)
+    prev = float("inf")
+    for S in (2, 4, 8, 16):
+        cur = resilience.watchdog_budget_s(b, ndev, subblocks=S)
+        assert 1 + wire < cur < prev
+        prev = cur
+
+
+def test_watchdog_wall_and_preflight_share_subblock_pricing(
+        monkeypatch):
+    """The armed wall and the supervisor preflight price a pipelined
+    item from the SAME meta subblocks — the deadline guarantee (an
+    armed wall always fires before the run deadline) needs the two
+    identical."""
+    from quest_tpu import supervisor
+
+    monkeypatch.setenv("QUEST_WATCHDOG_MIN_S", "0.001")
+    resilience.set_watchdog(True)
+    try:
+        meta = {"index": 0, "kind": "relayout", "comm_class":
+                "relayout", "subblocks": 4, "ndev": 8}
+        wall = resilience.watchdog_begin(meta, 8 << 20, 8)
+        wall.cancel()
+        want = resilience.watchdog_budget_s(8 << 20, 8, subblocks=4)
+        assert wall.budget == pytest.approx(want)
+        assert wall.budget > resilience.watchdog_budget_s(8 << 20, 8)
+    finally:
+        resilience.set_watchdog(False)
+    # the preflight reads the same meta key: its refusal names the
+    # SAME repriced cost the wall would be armed with
+    monkeypatch.setenv("QUEST_WATCHDOG_MIN_S", "100")
+    want = resilience.watchdog_budget_s(8 << 20, 8, subblocks=4)
+    probe = type("P", (), {"emergency_snapshot":
+                           lambda self, a: (None, "no ckpt")})()
+    with supervisor.deadline_scope(5.0):
+        with pytest.raises(qt.QuESTTimeoutError,
+                           match="priced cost") as ei:
+            supervisor.preflight_item(probe, jnp.zeros((2, 2)),
+                                      {"index": 0, "subblocks": 4},
+                                      exchange_bytes=8 << 20, ndev=8)
+    assert f"{want:.3f}" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# (g) scheduler costing model
+# ---------------------------------------------------------------------------
+
+
+def test_plan_comm_cost_model(monkeypatch):
+    n, dev_bits = 16, 3
+    lane_bits = _ilog2(state_shape(1 << n, 1 << dev_bits)[1])
+    plan = schedule_mesh(list(models.qft(n).ops), n, dev_bits,
+                         lane_bits)
+    _, total = plan_exchange_elems(plan, n, dev_bits)
+    cost = plan_comm_cost(plan, n, dev_bits)
+    assert cost["exchange_elems"] == total
+    # serial model: nothing hidden
+    serial = plan_comm_cost(plan, n, dev_bits, subblocks=1)
+    assert serial["exposed_elems"] == pytest.approx(total)
+    assert serial["hidden_frac_model"] == 0.0
+    # forced S: exposed is exactly the fill legs (1/S per item)
+    forced = plan_comm_cost(plan, n, dev_bits, subblocks=4)
+    assert forced["exposed_elems"] == pytest.approx(total / 4)
+    assert forced["hidden_frac_model"] == pytest.approx(0.75)
+    # auto resolution matches the executors' per-item S
+    want = sum(
+        plan_exchange_elems([it], n, dev_bits)[1]
+        / item_subblocks(it, n, dev_bits)
+        for it in plan if it[0] in ("swap", "relayout")
+        if plan_exchange_elems([it], n, dev_bits)[1])
+    assert cost["exposed_elems"] == pytest.approx(want)
+    assert set(cost["per_class"]) <= {"half", "full", "relayout"}
+
+
+# ---------------------------------------------------------------------------
+# (h) the gate rule, both directions
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_diff_comm_hidden_rule_both_directions():
+    old = {"metric": "gate_ops_per_sec_30q", "comm_hidden_frac": 0.75}
+    ok_new = dict(old, comm_hidden_frac=0.71)      # -5.3%: inside
+    bad_new = dict(old, comm_hidden_frac=0.60)     # -20%: regression
+    v, _c, _s = ledger_diff.gate(old, ok_new)
+    assert not [x for x in v if x["key"] == "comm_hidden_frac"]
+    v, _c, _s = ledger_diff.gate(old, bad_new)
+    assert [x for x in v if x["key"] == "comm_hidden_frac"], v
+    # an IMPROVEMENT never fires the strictly-regressive rule
+    v, _c, _s = ledger_diff.gate(old, dict(old, comm_hidden_frac=0.9))
+    assert not [x for x in v if x["key"] == "comm_hidden_frac"]
+    # config-bound: a different workload config skips, never lies
+    v, c, skipped = ledger_diff.gate(
+        dict(old, metric="gate_ops_per_sec_20q"), bad_new)
+    assert ("comm_hidden_frac", "config mismatch") in skipped
+    # the rule ALSO binds on the probe's own config string: same bench
+    # metric, different probe workload/schedule -> skip, never a
+    # cross-config verdict
+    v, c, skipped = ledger_diff.gate(
+        dict(old, comm_overlap_metric="comm_overlap_qft20_8dev_s1x8_d3"),
+        dict(bad_new,
+             comm_overlap_metric="comm_overlap_qft14_8dev_s1_d3"))
+    assert not [x for x in v if x["key"] == "comm_hidden_frac"]
+    assert ("comm_hidden_frac", "config mismatch") in skipped
+    # matching probe config on both sides still gates
+    both = "comm_overlap_qft20_8dev_s1x8_d3"
+    v, _c, _s = ledger_diff.gate(
+        dict(old, comm_overlap_metric=both),
+        dict(bad_new, comm_overlap_metric=both))
+    assert [x for x in v if x["key"] == "comm_hidden_frac"]

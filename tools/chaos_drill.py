@@ -83,6 +83,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -528,6 +529,50 @@ def drill_sdc_on_wire(circ, env, ndev, pallas):
     resilience.clear_mesh_health()
 
 
+def drill_pipelined_wire_sdc(circ, env, ndev, pallas):
+    """Wire SDC under sub-block PIPELINED collectives (ISSUE 12): with
+    QUEST_COMM_SUBBLOCKS forcing S=4 and a timeline capture routing
+    the comm items through the staged host pipeline, an injected
+    in-flight bitflip must still be caught by the PER-SUB-BLOCK
+    checksum with the corrupted leg named as round.sub-block and the
+    exact sender -> receiver pair attributed — the integrity contract
+    survives the overlap optimisation."""
+    if ndev < 2:
+        record("pipelined_wire_sdc", True,
+               skipped="needs a multi-device mesh")
+        return
+    resilience.clear_mesh_health()
+    before = metrics.counters()
+    os.environ["QUEST_COMM_SUBBLOCKS"] = "4"
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 0, "bitflip:12")])
+    q = qt.create_qureg(N_QUBITS, env)
+    caught = named_pair = named_subblock = False
+    metrics.start_timeline()
+    try:
+        circ.run(q, pallas=pallas)
+    except qt.QuESTCorruptionError as e:
+        msg = str(e)
+        caught = "failed its checksum" in msg
+        named_pair = "-> device" in msg
+        named_subblock = bool(re.search(r"round \d+\.\d+", msg))
+    finally:
+        metrics.stop_timeline()
+        resilience.set_integrity(False)
+        resilience.clear_fault_plan()
+        os.environ.pop("QUEST_COMM_SUBBLOCKS", None)
+    struck = sorted(resilience.mesh_health()["strikes"])
+    delta = counters_delta(before, ("resilience.sdc_detected",))
+    unbricked = abs(qt.calc_total_prob(q) - 1.0) < 1e-6
+    ok = caught and named_pair and named_subblock and bool(struck) \
+        and delta["resilience.sdc_detected"] >= 1 and unbricked
+    record("pipelined_wire_sdc", ok, caught=caught,
+           named_pair=named_pair, named_subblock=named_subblock,
+           struck_devices=struck, register_unbricked=unbricked,
+           **delta)
+    resilience.clear_mesh_health()
+
+
 def drill_sdc_drift(circ, env, pallas):
     before = metrics.counters()
     resilience.set_integrity(True)
@@ -778,6 +823,7 @@ def main():
     drill_degraded_resume(circ, env, ndev, pallas)
     drill_breaker_trip(circ, env, ndev, pallas)
     drill_sdc_on_wire(circ, env, ndev, pallas)
+    drill_pipelined_wire_sdc(circ, env, ndev, pallas)
     drill_sdc_drift(circ, env, pallas)
     drill_sdc_rollback(circ, env, ndev, pallas, ref)
     drill_preempt_drain(circ, env, pallas, ref)

@@ -37,7 +37,11 @@ import sys
 
 
 #: (key, signed limit fraction, config_bound) — config_bound rules only
-#: apply when both records describe the same workload config.
+#: apply when both records describe the same workload config (the
+#: top-level ``metric`` field).  A STRING config_bound names an
+#: additional record field that must also match — for metrics whose
+#: configuration lives outside ``metric`` (e.g. the overlap probe's
+#: ``comm_overlap_metric``).
 DEFAULT_RULES = [
     # recovery-path health: a chaos drill artifact (CHAOS_r*.json) with
     # ANY failed scenario, or fewer scenarios than the baseline, is a
@@ -47,16 +51,23 @@ DEFAULT_RULES = [
     # rate-style: FEWER breaches than baseline = the drill's watchdog
     # scenarios stopped firing (a shrunken fault matrix).  NOTE the
     # limit must be strictly negative — -0.0 compares >= 0 and would
-    # invert the rule into increase-is-bad
-    ("counters.resilience.watchdog_breaches", -0.001, False),
-    # SDC detector health, strictly regressive in both directions: the
-    # drill's fault matrix injects a FIXED number of corruptions, so
-    # MORE detections than baseline = the integrity layer grew false
-    # positives (+0 cost rule), while FEWER recoveries = a detector or
-    # the rollback path stopped firing under injection (strictly
-    # negative, same -0.0 caveat as above)
-    ("counters.resilience.sdc_detected", +0.0, False),
-    ("counters.resilience.sdc_recovered", -0.001, False),
+    # invert the rule into increase-is-bad.  CONFIG-BOUND (as are the
+    # detector-health rules below): these counters scale with the
+    # drill's scenario matrix, and the chaos artifact's `metric` field
+    # (chaos-qN-sK) encodes exactly that — a GROWN matrix detecting
+    # more injections is progress, not a false-positive regression,
+    # so cross-matrix comparisons skip while same-matrix ones (and
+    # plain run-ledger records, which carry no `metric` field on
+    # either side) still gate
+    ("counters.resilience.watchdog_breaches", -0.001, True),
+    # SDC detector health, strictly regressive in both directions: at
+    # a fixed fault matrix the drill injects a FIXED number of
+    # corruptions, so MORE detections than baseline = the integrity
+    # layer grew false positives (+0 cost rule), while FEWER
+    # recoveries = a detector or the rollback path stopped firing
+    # under injection (strictly negative, same -0.0 caveat as above)
+    ("counters.resilience.sdc_detected", +0.0, True),
+    ("counters.resilience.sdc_recovered", -0.001, True),
     # lifecycle-layer health, strictly regressive: the drill's
     # overload scenario sheds a FIXED number of runs for an unhealthy
     # mesh, so MORE shed_unhealthy than baseline = the admission gate
@@ -64,8 +75,8 @@ DEFAULT_RULES = [
     # rule); ANY preemption-drain checkpoint failure (the emergency
     # snapshot skipped or failed during a drain) is a regression of
     # the preempt-safety contract — the baseline is 0, so the +0 rule
-    # fires on any appearance
-    ("counters.supervisor.shed_unhealthy", +0.0, False),
+    # fires on any appearance regardless of config
+    ("counters.supervisor.shed_unhealthy", +0.0, True),
     ("counters.supervisor.preempt_ckpt_failures", +0.0, False),
     # structural / communication metrics: tight, config-independent
     ("mesh_exchange_bytes_qft30", +0.01, False),
@@ -96,6 +107,21 @@ DEFAULT_RULES = [
     # (two correlated sweeps again) roughly HALVES this, far past the
     # noise allowance — bench.py --gate then fails
     ("roofline_frac", -0.2, True),
+    # pipelined-collective overlap: MEASURED fraction of exchange wall
+    # time hidden behind compute (tools/overlap_probe.py timeline
+    # capture, annotated by bench.py).  Config-bound and strictly
+    # regressive at -10% relative: a change that re-serialises the
+    # exchanges (sub-blocking off, a barrier between send and merge,
+    # a lost lookahead) drops this from ~0.75 toward 0.0 — far past
+    # the allowance — while honest scheduling noise stays inside it.
+    # The bench's top-level `metric` does not encode the PROBE's
+    # config (workload size, resolved sub-blocks, lookahead), so this
+    # rule additionally binds on `comm_overlap_metric` — the probe's
+    # own config-encoding metric string bench.py copies onto the
+    # record — and skips when the two probes measured different
+    # things (e.g. a leftover QUEST_OVERLAP_QUBITS from a tuning
+    # sweep)
+    ("comm_hidden_frac", -0.10, "comm_overlap_metric"),
 ]
 
 
@@ -156,6 +182,12 @@ def gate(old: dict, new: dict, rules=None):
             skipped.append((key, "missing"))
             continue
         if config_bound and not same_config:
+            skipped.append((key, "config mismatch"))
+            continue
+        if isinstance(config_bound, str) \
+                and old.get(config_bound) != new.get(config_bound):
+            # rule-specific config field disagrees: the two records
+            # measured different things for THIS metric
             skipped.append((key, "config mismatch"))
             continue
         ov, nv = fo[key], fn_[key]

@@ -1,0 +1,133 @@
+"""Measure ``comm_hidden_frac`` on a virtual-mesh distributed QFT.
+
+Runs a QFT-30-class plan (relayout-fused mesh schedule; size via
+``QUEST_OVERLAP_QUBITS``, default 20) over an 8-virtual-device CPU mesh
+on the OBSERVED per-item path with timeline capture, so the pipelined
+collectives' send/gather/merge sub-spans are walled for real — then
+reports the measured interval-overlap figures:
+
+- ``comm_hidden_frac``: fraction of exchange wall time overlapped by
+  compute spans (``metrics.timeline_comm_overlap`` — the same numbers
+  ``tools/trace_view.py`` prints for the dumped capture);
+- ``exchange_bytes`` summed off the timeline events, pinned equal to
+  the run ledger's ``exec.exchange_bytes`` (the accounting identity
+  sub-blocking must preserve);
+- ``wire_bytes``: what those exchanges put ON the wire (equal to
+  exchange bytes except under ``QUEST_WIRE_F32=1`` on f64 states).
+
+The capture is the WARM run: the first application compiles each
+per-item stage program, and a span that contains a compile is a
+compile measurement, not a wire measurement.  ``bench.py`` invokes
+this tool as a subprocess to annotate its bench_measure ledger record
+(the ``comm_hidden_frac`` ledger_diff rule gates the printed BENCH
+record), and ``tools/record_all.py`` runs it as the overlap tier-2
+smoke (asserting overlap > 0).
+
+Prints ONE JSON line.  Exit 0 on success, 1 when the mesh cannot be
+built (fewer than 2 devices and no virtual-device support).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir)))
+
+# virtual 8-device CPU mesh, exactly as the test suite and
+# tools/qft_dist.py force it (must precede the jax import)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+
+
+def main() -> int:
+    import quest_tpu as qt
+    from quest_tpu import metrics, models
+    from quest_tpu.reporting import stopwatch
+
+    n = int(os.environ.get("QUEST_OVERLAP_QUBITS", "20"))
+    ndev = 8 if len(jax.devices()) >= 8 else 1
+    if ndev < 2:
+        print(json.dumps({"error": "no multi-device mesh available"}))
+        return 1
+    env = qt.create_env(num_devices=ndev)
+    circ = models.qft(n)
+
+    # warm-up application UNDER CAPTURE (capture is what routes the
+    # run onto the observed per-item path): compiles every per-item
+    # stage program, so the retained capture below measures the
+    # schedule, not the compiler
+    q = qt.create_qureg(n, env)
+    metrics.start_timeline()
+    warm = stopwatch()
+    circ.run(q)
+    warm_s = warm.seconds
+
+    q = qt.create_qureg(n, env)
+    metrics.start_timeline()   # clears the warm-up events
+    sw = stopwatch()
+    circ.run(q)
+    wall_s = sw.seconds
+    events = metrics.timeline_events()
+    led = metrics.get_run_ledger() or {}
+    metrics.stop_timeline()
+
+    ov = metrics.timeline_comm_overlap(events)
+    tl_bytes = sum(e["args"].get("exchange_bytes", 0) for e in events)
+    led_bytes = int(led.get("counters", {}).get("exec.exchange_bytes",
+                                                0))
+    wire_bytes = sum(e["args"].get("wire_bytes",
+                                   e["args"].get("exchange_bytes", 0))
+                     for e in events)
+    from quest_tpu.parallel.mesh_exec import comm_pipeline_depth
+
+    subblocks = sorted({e["args"]["subblocks"] for e in events
+                        if "subblocks" in e.get("args", {})})
+    depth = comm_pipeline_depth()
+    # the metric string encodes the probe's RESOLVED config (workload,
+    # mesh, sub-block counts, lookahead): ledger_diff's
+    # comm_hidden_frac rule binds on it (via bench.py's
+    # comm_overlap_metric copy), so two probes that measured different
+    # schedules are never gated against each other
+    cfg = "s" + "x".join(str(s) for s in subblocks) + f"_d{depth}"
+    record = {
+        "metric": f"comm_overlap_qft{n}_{ndev}dev_{cfg}",
+        "comm_hidden_frac": round(ov["frac"], 4),
+        "comm_s": round(ov["comm_us"] / 1e6, 4),
+        "hidden_s": round(ov["hidden_us"] / 1e6, 4),
+        "exchange_bytes": tl_bytes,
+        "ledger_exchange_bytes": led_bytes,
+        "wire_bytes": int(wire_bytes),
+        "subblocks": subblocks,
+        "pipeline_depth": depth,
+        "events": len(events),
+        "wall_s": round(wall_s, 3),
+        "warm_wall_s": round(warm_s, 3),
+        "ledger_comm_hidden_frac": (led.get("meta", {})
+                                    .get("comm_hidden_frac")),
+    }
+    print(json.dumps(record))
+    # the accounting identity is the tool's own acceptance check: a
+    # sub-blocking bug that drops or double-counts a stage's bytes
+    # must fail HERE, not in a downstream artifact diff
+    if tl_bytes != led_bytes:
+        print(f"overlap-probe: timeline bytes {tl_bytes} != ledger "
+              f"bytes {led_bytes}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

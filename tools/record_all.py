@@ -125,6 +125,44 @@ def roofline_attr_smoke(summary) -> None:
         print(err[-1500:])
 
 
+def overlap_smoke(summary) -> None:
+    """Tier-2 smoke: tools/overlap_probe.py — a warm observed QFT over
+    the 8-virtual-device mesh, asserting (a) the pipelined collectives
+    actually hide wire time (measured ``comm_hidden_frac`` > 0 from
+    real timeline-interval overlap — a regression that re-serialises
+    the exchanges reads exactly 0.0 here) and (b) the sub-blocked
+    timeline's summed exchange bytes still EQUAL the run ledger's
+    (the probe exits nonzero itself when that identity breaks)."""
+    import json as _json
+
+    env = dict(os.environ)
+    env.setdefault("QUEST_OVERLAP_QUBITS", "18")
+    t0 = time.time()
+    ok, detail = False, ""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "overlap_probe.py")],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=900)
+        rec = _json.loads(r.stdout.strip().splitlines()[-1]) \
+            if r.stdout.strip() else {}
+        ok = (r.returncode == 0
+              and rec.get("comm_hidden_frac", 0) > 0
+              and rec.get("exchange_bytes", 0)
+              == rec.get("ledger_exchange_bytes", -1))
+        if not ok:
+            detail = (f"rc={r.returncode} rec={rec} "
+                      f"err={r.stderr[-400:]}")
+    except Exception as e:
+        detail = f"{type(e).__name__}: {e}"
+    secs = time.time() - t0
+    summary.append(("overlap_probe", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'overlap_probe':22s} {secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 def metrics_serve_smoke(summary) -> None:
     """Tier-2 smoke: start tools/metrics_serve.py (--demo populates the
     telemetry with one small run), scrape /metrics and /healthz over
@@ -350,6 +388,7 @@ def main():
             print(err[-1500:])
     bench_gate_smoke(summary)
     roofline_attr_smoke(summary)
+    overlap_smoke(summary)
     metrics_serve_smoke(summary)
     supervise_smoke(summary)
     chaos_drill_smoke(summary, rnd)

@@ -112,3 +112,22 @@ print(f"mesh plan (dev_bits={DEV_BITS}): "
       + json.dumps(mesh_report, sort_keys=True))
 print(f"relayout fusion saves {saved:.1%} exchange volume "
       f"({u['exchange_elems']} -> {f['exchange_elems']} elems)")
+
+# Overlap-aware costing (scheduler.plan_comm_cost): the model-side
+# estimate of the pipelined collectives' exposed (un-hidden) wire for
+# the fused plan, per comm class and per sub-block count — the
+# MEASURED counterpart is the timeline's comm_hidden_frac.
+from quest_tpu.scheduler import plan_comm_cost  # noqa: E402
+
+with metrics.suppressed():
+    plan = schedule_mesh(list(circ.ops), N, DEV_BITS, lane_bits)
+    for S in (None, 2, 8):
+        cost = plan_comm_cost(plan, N, DEV_BITS, subblocks=S)
+        tag = "auto" if S is None else f"S={S}"
+        print(f"pipelined comm cost ({tag}): "
+              f"exposed {cost['exposed_elems']:.0f} of "
+              f"{cost['exchange_elems']} elems "
+              f"(hidden_frac_model {cost['hidden_frac_model']:.3f}) "
+              + json.dumps({k: v['items']
+                            for k, v in cost['per_class'].items()},
+                           sort_keys=True))

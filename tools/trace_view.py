@@ -12,10 +12,21 @@ individual items with their tags — the "which plan item is slow on
 device" answer without opening Perfetto.
 
 Item kinds: ``pallas-pass``/``xla-segment`` (compute sweeps),
-``bitswap``/``relayout`` (collective exchange), ``stream``/
-``xla-stream`` (eager flush dispatch), and ``probe`` (health/
-integrity/checkpoint probes — the observability layer's own walled
-cost, tagged with its trigger).
+``bitswap``/``relayout`` (collective exchange — whole-item spans of
+the SERIAL executor), ``bitswap-send``/``relayout-send`` (per-sub-
+block wire legs of the PIPELINED executor, dispatch-to-sync walls
+carrying each stage's exchange-byte share), ``bitswap-gather``/
+``-merge`` / ``relayout-gather``/``-merge`` (the pipeline's payload
+gather and received-sub-block merge legs — the compute that hides the
+wire), ``stream``/``xla-stream`` (eager flush dispatch), and ``probe``
+(health/integrity/checkpoint probes — the observability layer's own
+walled cost, tagged with its trigger).
+
+The comm-vs-compute summary includes a PER-ITEM hidden-fraction table
+when pipelined sub-spans are present: each comm item's total exchange
+wall, how much of it a compute span overlapped, and the resulting
+per-item ``comm_hidden_frac`` — which plan item still exposes wire
+time, not just whether the aggregate is healthy.
 
 Usage: python tools/trace_view.py timeline.json [-k N] [--by-kind]
 """
@@ -26,10 +37,17 @@ import json
 import sys
 from collections import defaultdict
 
-#: Items that move amplitudes over the interconnect.
-COMM_KINDS = {"bitswap", "relayout"}
-#: Items that stream the state through the compute units.
-COMPUTE_KINDS = {"pallas-pass", "xla-segment", "stream", "xla-stream"}
+#: Items that move amplitudes over the interconnect (whole-item spans
+#: plus the pipelined executor's per-sub-block send legs).  MUST stay
+#: equal to quest_tpu.metrics.TIMELINE_COMM_KINDS (this tool is
+#: stdlib-only by design; a test pins the copies).
+COMM_KINDS = {"bitswap", "relayout", "bitswap-send", "relayout-send"}
+#: Items that stream the state through the compute units, including
+#: the pipelined exchange's gather/merge legs.  Mirror of
+#: quest_tpu.metrics.TIMELINE_COMPUTE_KINDS.
+COMPUTE_KINDS = {"pallas-pass", "xla-segment", "stream", "xla-stream",
+                 "bitswap-gather", "bitswap-merge",
+                 "relayout-gather", "relayout-merge"}
 #: The observability layer's own walled items (health / integrity /
 #: checkpoint probes — kind "probe", tagged with a ``trigger`` arg).
 PROBE_KINDS = {"probe"}
@@ -124,9 +142,42 @@ def by_kind_table(events: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def per_item_hidden(events: list[dict]) -> list[tuple]:
+    """Per-ITEM overlap attribution: ``[(index, kind, comm_us,
+    hidden_us, frac), ...]`` over every plan item with comm spans
+    (grouped by the ``index`` tag the executors stamp on every item
+    and sub-span event), hidden measured against the capture's GLOBAL
+    merged compute intervals — which plan item still exposes wire
+    time."""
+    compute = _merged_intervals([e for e in events
+                                 if classify(e) == "compute"])
+    items: dict = {}
+    for e in events:
+        if classify(e) != "comm":
+            continue
+        idx = e.get("args", {}).get("index")
+        kind = e.get("name", "?").split("-")[0]
+        a = e.get("ts", 0.0)
+        b = a + e.get("dur", 0.0)
+        hid = 0.0
+        for ca, cb in compute:
+            if cb <= a:
+                continue
+            if ca >= b:
+                break
+            hid += min(b, cb) - max(a, ca)
+        tot, h, _ = items.get(idx, (0.0, 0.0, kind))
+        items[idx] = (tot + (b - a), h + hid, kind)
+    return [(idx, kind, tot, hid, (hid / tot if tot else 0.0))
+            for idx, (tot, hid, kind) in sorted(
+                items.items(), key=lambda kv: (kv[0] is None, kv[0]))]
+
+
 def comm_compute_summary(events: list[dict]) -> str:
     """Comm-vs-compute wall split + the aggregate ``comm_hidden_frac``
-    (exchange time overlapped by compute / total exchange time)."""
+    (exchange time overlapped by compute / total exchange time), with
+    a per-item hidden-fraction table when a pipelined capture carries
+    per-sub-block spans."""
     cls_us: dict = defaultdict(float)
     for e in events:
         cls_us[classify(e)] += e.get("dur", 0.0)
@@ -139,6 +190,13 @@ def comm_compute_summary(events: list[dict]) -> str:
     lines.append(f"comm_hidden_frac: {frac:.3f} "
                  f"({hidden / 1e3:.2f} of {total_comm / 1e3:.2f} ms of "
                  "exchange overlapped by compute)")
+    rows = per_item_hidden(events)
+    if rows and any("-send" in e.get("name", "") for e in events):
+        lines.append(f"{'item':>6}{'kind':>10}{'comm ms':>10}"
+                     f"{'hidden ms':>11}{'hidden':>8}")
+        for idx, kind, tot, hid, f in rows:
+            lines.append(f"{str(idx):>6}{kind:>10}{tot / 1e3:>10.2f}"
+                         f"{hid / 1e3:>11.2f}{f:>8.1%}")
     return "\n".join(lines)
 
 
