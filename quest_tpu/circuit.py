@@ -1039,6 +1039,19 @@ class Circuit:
             metrics.annotate_run(
                 "num_devices",
                 1 if qureg.mesh is None else int(qureg.mesh.devices.size))
+            if qureg.mesh is not None:
+                from . import env as _env
+
+                _ns = _env.num_slices(
+                    int(qureg.mesh.devices.size),
+                    qureg.mesh.devices.reshape(-1).tolist())
+                if _ns > 1:
+                    # failure-domain topology on the record: a ledger
+                    # reader can tell a multi-slice run's DCN-priced
+                    # budgets and slice annotations apart from a flat
+                    # mesh's without reconstructing the env (absent on
+                    # single-slice runs, keeping records byte-stable)
+                    metrics.annotate_run("num_slices", _ns)
             if outermost and _resume is None \
                     and not supervisor.in_recovery() \
                     and supervisor.gate_enabled():

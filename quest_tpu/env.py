@@ -93,6 +93,157 @@ def create_env(num_devices: int | None = None, devices=None) -> QuESTEnv:
     return QuESTEnv(mesh=Mesh(np.array(devices), (AMP_AXIS,)))
 
 
+# ---------------------------------------------------------------------------
+# Failure-domain topology: the slice map
+# ---------------------------------------------------------------------------
+#
+# On a multi-slice TPU deployment the 1-D amplitude mesh spans SLICES:
+# within a slice the devices exchange over ICI, across slices over DCN
+# — a different fabric with ~an order of magnitude less bandwidth and a
+# different failure domain (a whole slice preempts or dies together).
+# The slice map is the one topology fact every layer above keys on: the
+# scheduler prices ICI-vs-DCN legs and biases `localise` to keep hot
+# qubits off the cross-slice axis, the watchdog/preflight budgets price
+# each fabric at its own GB/s, and the mesh-health registry rolls chip
+# strikes up into slice health so losing a whole slice degrades to the
+# survivors instead of aborting (quest_tpu.resilience).
+#
+# Two derivations, in priority order:
+#
+# * ``QUEST_SLICE_SHAPE=<slices>x<devices_per_slice>`` — a VIRTUAL
+#   multi-slice topology (both factors powers of two).  Mesh position
+#   ``d`` belongs to slice ``d // devices_per_slice``: the slice index
+#   occupies the TOP log2(slices) device bits, so the cross-slice axis
+#   is the mesh's outermost qubits — exactly how a real multi-slice
+#   ``jax.distributed`` mesh lays out (slices enumerate contiguously in
+#   ``jax.devices()`` order).  This makes every failure-domain
+#   mechanism testable on a CPU host with virtual devices.
+# * real device ``slice_index`` attributes (Cloud TPU multi-slice
+#   runtimes annotate them) when present on the mesh's devices.
+#
+# Unset and unannotated, everything is ONE slice and every layer above
+# reduces to its historical single-fabric behaviour byte-for-byte.
+
+
+def slice_spec() -> tuple[int, int] | None:
+    """The virtual slice topology ``(num_slices, devices_per_slice)``
+    declared by ``QUEST_SLICE_SHAPE=<S>x<D>``, or None when unset.
+    Both factors must be powers of two (device/slice index bits are
+    qubit bits); a malformed value fails loudly — a silently-ignored
+    topology knob would un-price every DCN leg."""
+    raw = os.environ.get("QUEST_SLICE_SHAPE")
+    if not raw:
+        return None
+    from .validation import QuESTValidationError
+
+    parts = raw.lower().split("x")
+    try:
+        s, d = (int(p) for p in parts)
+    except ValueError:
+        s, d = 0, 0
+    if len(parts) != 2 or s < 1 or d < 1 or (s & (s - 1)) \
+            or (d & (d - 1)):
+        raise QuESTValidationError(
+            f"QUEST_SLICE_SHAPE={raw!r}: want <slices>x<devices_per_"
+            "slice> with both powers of two (e.g. 2x4 — the slice "
+            "index bits are qubit bits)")
+    return s, d
+
+
+def device_slice_map(ndev: int, devices=None) -> list[int]:
+    """Slice id of each mesh position ``0..ndev-1``.
+
+    ``QUEST_SLICE_SHAPE`` wins (position ``d`` -> ``d // devices_per_
+    slice``; a mesh SMALLER than the declared topology — a degraded
+    resume's surviving sub-mesh — maps its positions the same way, so
+    survivors confined to one slice all read as that slice); else real
+    ``slice_index`` device attributes when ``devices`` carry them; else
+    one slice.  A mesh LARGER than the declared virtual topology is
+    refused — it would silently alias two slices onto one."""
+    spec = slice_spec()
+    if spec is not None:
+        s, d = spec
+        if ndev > s * d:
+            from .validation import QuESTValidationError
+
+            raise QuESTValidationError(
+                f"QUEST_SLICE_SHAPE declares {s}x{d} = {s * d} "
+                f"device(s) but the mesh has {ndev} — the slice map "
+                "would alias distinct slices")
+        return [p // d for p in range(ndev)]
+    if devices is None:
+        # callers without a device list (fabric pricing, the strike
+        # rollup) still honour real multi-slice hardware: the mesh is
+        # built from jax.devices() order, so its first ndev entries ARE
+        # the mesh positions.  Guarded — never called at import time,
+        # but a backend that cannot initialise must degrade to one
+        # slice, not raise out of an accounting path
+        try:
+            devices = jax.devices()[:ndev]
+        except Exception:
+            devices = None
+    if devices is not None:
+        ids = [getattr(dv, "slice_index", None) for dv in devices]
+        if all(i is not None for i in ids) and len(set(ids)) > 1:
+            order = sorted(set(ids))
+            return [order.index(i) for i in ids]
+    return [0] * ndev
+
+
+def num_slices(ndev: int, devices=None) -> int:
+    """Distinct slices spanned by an ``ndev``-position mesh (1 = single
+    failure domain; everything above then keeps its historical
+    single-fabric behaviour)."""
+    return len(set(device_slice_map(ndev, devices)))
+
+
+def slice_of_device(d: int) -> int:
+    """Slice id of mesh position ``d`` under the declared topology —
+    or real ``slice_index`` attributes when no virtual shape is set —
+    else 0.  The registry-facing form: the mesh-health strike rollup
+    keys on positions without holding a device list."""
+    spec = slice_spec()
+    if spec is not None:
+        return int(d) // spec[1]
+    try:
+        devs = jax.devices()
+        smap = device_slice_map(len(devs), devs)
+        return smap[int(d)] if int(d) < len(smap) else 0
+    except Exception:
+        return 0
+
+
+def slice_devices(s: int, ndev: int) -> list[int]:
+    """Mesh positions belonging to slice ``s`` (empty when the slice is
+    outside the declared topology or the mesh)."""
+    return [d for d, sid in enumerate(device_slice_map(ndev))
+            if sid == int(s)]
+
+
+def topology_num_slices() -> int:
+    """Slices of the AMBIENT topology — the declared virtual shape,
+    else real ``slice_index`` attributes of ``jax.devices()``, else 1.
+    The registry-facing gate for the chip->slice health rollup, which
+    must stay inert on single-slice hosts."""
+    spec = slice_spec()
+    if spec is not None:
+        return spec[0]
+    try:
+        return num_slices(len(jax.devices()))
+    except Exception:
+        return 1
+
+
+def cross_slice_dev_bits(dev_bits: int, ndev: int | None = None) -> int:
+    """How many of the mesh's TOP device bits index the slice — the
+    qubits whose relayouts cross DCN.  0 on a single-slice mesh (no
+    cross-slice axis; the scheduler bias and fabric pricing are then
+    inert)."""
+    n = 1 << dev_bits if ndev is None else int(ndev)
+    k = num_slices(n)
+    return (k - 1).bit_length() if k > 1 else 0
+
+
 def destroy_env(env: QuESTEnv) -> None:
     """Tear down the environment (reference: destroyQuESTEnv).
 

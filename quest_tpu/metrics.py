@@ -518,6 +518,14 @@ def export_text() -> str:
         "up": 1,
         "mesh.degraded_devices": len(health["degraded"]),
         "mesh.strikes_total": sum(health["strikes"].values()),
+        # hierarchical failure-domain view (quest_slice_*): how many
+        # slices the declared topology has, how many are DEGRADED
+        # whole domains, and the chip threshold that demotes one —
+        # what a pager needs to tell "one flaky chip" from "we lost a
+        # slice" without parsing /healthz
+        "slice.count": len(health.get("slices") or {}) or 1,
+        "slice.degraded": len(health.get("degraded_slices") or ()),
+        "slice.degrade_chips": health.get("chips_to_degrade_slice", 0),
         "timeline.active": 1 if timeline_active() else 0,
         "trace.sample_every": telemetry.trace_sample_every(),
         # lifecycle gauges (quest_tpu.supervisor): what an autoscaler

@@ -164,12 +164,15 @@ def item_subblocks(item, num_vec_bits: int, dev_bits: int) -> int:
 def comm_config_token() -> tuple:
     """Hashable identity of the env-driven collective configuration a
     compiled mesh program bakes in — sub-block pipelining
-    (``QUEST_COMM_SUBBLOCKS``) and f32-on-wire (``QUEST_WIRE_F32``).
-    Part of every compile/observed memo key (``Circuit.compile`` /
+    (``QUEST_COMM_SUBBLOCKS``), f32-on-wire (``QUEST_WIRE_F32``) and
+    the declared slice topology (``QUEST_SLICE_SHAPE``: it steers the
+    scheduler's cross-slice bias and the per-item fabric metas).  Part
+    of every compile/observed memo key (``Circuit.compile`` /
     ``Circuit._observed_fn``): a knob flipped mid-process must never
-    reuse a program traced under the other configuration."""
+    reuse a program planned under the other configuration."""
     return (os.environ.get("QUEST_COMM_SUBBLOCKS") or "",
-            "1" if wire_f32_enabled() else "")
+            "1" if wire_f32_enabled() else "",
+            os.environ.get("QUEST_SLICE_SHAPE") or "")
 
 
 def wire_f32_enabled() -> bool:
@@ -1157,13 +1160,23 @@ def item_timeline_meta(item, num_vec_bits: int, dev_bits: int,
         targets = sorted(b for b, p in enumerate(item[1]) if p != b)
     else:
         targets = sorted(item[1:])
-    return {"kind": "relayout" if item[0] == "relayout" else "bitswap",
+    meta = {"kind": "relayout" if item[0] == "relayout" else "bitswap",
             "targets": targets, "comm_class": cls,
             "exchange_elems": elems,
             # the pipeline shape rides the meta so the timeline tags,
             # the flight ring, the watchdog repricing and the
             # supervisor preflight all read the SAME resolved S
             "subblocks": item_subblocks(item, num_vec_bits, dev_bits)}
+    # failure-domain pricing: the item's DCN share rides the meta so
+    # the watchdog wall, the preflight refusal and the timeline tags
+    # all price the SAME fabric split (the pricing-identity contract).
+    # Key present only when a leg actually crosses slices — the
+    # single-slice default metas stay byte-stable
+    _ici, dcn = item_fabric_elems(item, num_vec_bits, dev_bits,
+                                  elems=elems)
+    if dcn:
+        meta["dcn_elems"] = dcn
+    return meta
 
 
 def observe_item(f, amps, meta: dict, hook=None):
@@ -1221,6 +1234,7 @@ def observe_item(f, amps, meta: dict, hook=None):
     args = dict(meta)
     kind = args.pop("kind")
     elems = args.pop("exchange_elems", 0)
+    dcn_elems = args.pop("dcn_elems", 0)
     stream_elems = args.pop("stream_elems", 0)
     ndev = args.pop("ndev", 1)
     args.pop("ops_done", None)   # resume bookkeeping, not a trace tag
@@ -1228,6 +1242,11 @@ def observe_item(f, amps, meta: dict, hook=None):
     exchange_bytes = elems * itemsize
     if elems or meta.get("comm_class") is not None:
         args["exchange_bytes"] = exchange_bytes
+    if dcn_elems:
+        # the cross-slice share of exchange_bytes (never an addition to
+        # it): fabric-priced budgets and the DCN-leg attribution in
+        # refusal messages key on this tag
+        args["dcn_bytes"] = dcn_elems * itemsize
     if stream_elems:
         # per-item achieved-GB/s attribution (tools/roofline_attr.py):
         # the same one-sweep figure the ledger's exec.stream_bytes uses
@@ -1255,12 +1274,16 @@ def observe_item(f, amps, meta: dict, hook=None):
         stalled = False
         wire_sdc = None
         state_sdc = None
+        lost_slice = None
+        flap_ms = None
         if resilience.fault_active():
             fired = []
             if meta.get("comm_class") in ("half", "full", "relayout"):
                 fx = resilience.fault_point("mesh_exchange")
                 fired.append(fx)
                 wire_sdc = resilience.sdc_params(fx)
+                lost_slice = resilience.slice_loss_param(fx)
+                flap_ms = resilience.dcn_flap_ms(fx)
             fr = resilience.fault_point("run_item")
             fired.append(fr)
             state_sdc = resilience.sdc_params(fr)
@@ -1272,6 +1295,18 @@ def observe_item(f, amps, meta: dict, hook=None):
             # a simulated hung collective: blocks until the armed
             # deadline, then raises the breach (never returns)
             resilience.watchdog_stall(wall, wd_meta)
+        if lost_slice is not None:
+            # a scripted whole-slice loss: every chip of the slice is
+            # marked DEGRADED and the exchange fails with a typed
+            # topology error naming the failure domain (never returns)
+            resilience.slice_lost(lost_slice, wd_meta)
+        if flap_ms is not None:
+            # a deterministic DCN brown-out: the straggle lands ONLY on
+            # items with a cross-slice leg, so the breach it provokes is
+            # priced against the DCN budget and an ICI-only item can
+            # never false-positive from the same scripted plan
+            resilience.dcn_flap(flap_ms, int(args.get("dcn_bytes", 0)),
+                                wd_meta)
         fvec = (jnp.asarray(wire_sdc or (0, 0), jnp.int32)
                 if chk is not None else None)
         if chk is not None:
@@ -1443,6 +1478,77 @@ def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int):
         else:
             elems += ndev * (s_chunk // 2)   # half chunk, every device
     return relayouts, elems
+
+
+def item_fabric_elems(item, num_vec_bits: int, dev_bits: int,
+                      slice_map=None, elems: int | None = None):
+    """Per-FABRIC split of one plan item's exchange volume:
+    ``(ici_elems, dcn_elems)`` storage elements, summed over every
+    device.  A (sender -> receiver) leg is DCN when the two mesh
+    positions sit in different slices (``env.device_slice_map`` — the
+    declared ``QUEST_SLICE_SHAPE`` virtual topology or real
+    ``slice_index`` attributes), else ICI.
+
+    Derived from the SAME static sender maps the checked collectives
+    verify against (:func:`exchange_round_senders`) and the same
+    per-round payload sizes ``apply_relayout``/``bitswap_amps`` move,
+    so ``ici + dcn == plan_exchange_elems`` exactly — the fabric split
+    refines the ledger accounting, it never disagrees with it (pinned
+    in tests/test_failure_domains.py).  Single-slice meshes return
+    ``(elems, 0)``: every historical byte pin is the ICI column.
+    ``elems`` lets a caller that already computed the item's
+    ``plan_exchange_elems`` total pass it in instead of re-deriving
+    it (relayout decompositions are not free at plan-build time)."""
+    from .. import env as _env
+
+    if elems is None:
+        _, elems = plan_exchange_elems([item], num_vec_bits, dev_bits)
+    if not elems:
+        return 0, 0
+    ndev = 1 << dev_bits
+    if slice_map is None:
+        slice_map = _env.device_slice_map(ndev)
+    if len(set(slice_map)) <= 1:
+        return elems, 0
+    chunk_bits = num_vec_bits - dev_bits
+    s_chunk = 1 << (chunk_bits + 1)
+    cls = _swap_comm_class(item, chunk_bits)
+    if cls == "half":
+        payload = s_chunk // 2
+    elif cls == "full":
+        payload = s_chunk
+    else:
+        q, _dst = _relayout_dev_maps(item[1], num_vec_bits, dev_bits)
+        payload = s_chunk >> q
+    ici = dcn = 0
+    for smap in exchange_round_senders(item, num_vec_bits, dev_bits):
+        for d, s in enumerate(smap):
+            if s == d:
+                continue  # the round routes this block back in place
+            if slice_map[s] != slice_map[d]:
+                dcn += payload
+            else:
+                ici += payload
+    assert ici + dcn == elems, (ici, dcn, elems)
+    return ici, dcn
+
+
+def plan_fabric_elems(plan, num_vec_bits: int, dev_bits: int,
+                      slice_map=None):
+    """Whole-plan per-fabric exchange split: ``(ici_elems,
+    dcn_elems)``, summed over every comm item and device.  The sum
+    equals ``plan_exchange_elems``'s total by construction."""
+    from .. import env as _env
+
+    if slice_map is None:
+        slice_map = _env.device_slice_map(1 << dev_bits)
+    ici = dcn = 0
+    for item in plan:
+        i, d = item_fabric_elems(item, num_vec_bits, dev_bits,
+                                 slice_map)
+        ici += i
+        dcn += d
+    return ici, dcn
 
 
 def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,

@@ -177,6 +177,15 @@ SDC_SEAMS = ("mesh_exchange", "run_item")
 #: modelling a SIGTERM that arrived while that item executed).
 PREEMPT_SEAMS = ("mesh_exchange", "run_item")
 
+#: The seams that model FAILURE-DOMAIN faults (``slice_loss:<s>`` — a
+#: whole slice dies: every chip of slice ``s`` is marked DEGRADED and
+#: the in-flight exchange fails with a typed topology error — and
+#: ``dcn_flap:<ms>`` — a deterministic DCN brown-out: the straggle
+#: lands only on items with a cross-slice leg, so a breach prices
+#: against the DCN budget and ICI-only items can never false-positive).
+#: Both are collective-fabric faults, so only the exchange seam.
+SLICE_SEAMS = ("mesh_exchange",)
+
 #: Per-seam bounded retry budget (attempts AFTER the first).  Sinks are
 #: best-effort (they already degrade), so one retry; checkpoint I/O is
 #: the recovery path itself, so it tries hardest.  This table IS the
@@ -281,6 +290,30 @@ def sdc_params(kind) -> tuple[int, int] | None:
     return (2, v) if v != 0 else None
 
 
+def slice_loss_param(kind) -> int | None:
+    """The slice index of a ``"slice_loss:<s>"`` fault kind (a scripted
+    whole-slice failure), else None."""
+    if not isinstance(kind, str) or not kind.startswith("slice_loss:"):
+        return None
+    try:
+        s = int(kind.split(":", 1)[1])
+    except ValueError:
+        return None
+    return s if s >= 0 else None
+
+
+def dcn_flap_ms(kind) -> int | None:
+    """The millisecond straggle of a ``"dcn_flap:<ms>"`` fault kind (a
+    deterministic cross-slice-fabric brown-out), else None."""
+    if not isinstance(kind, str) or not kind.startswith("dcn_flap:"):
+        return None
+    try:
+        ms = int(kind.split(":", 1)[1])
+    except ValueError:
+        return None
+    return ms if ms >= 0 else None
+
+
 def _parse_plan(spec) -> list[tuple[str, int, str]]:
     """Normalise a fault plan: a ``"seam:hit:kind[,...]"`` string (the
     ``QUEST_FAULT_PLAN`` format; ``;`` also separates entries; the
@@ -297,13 +330,15 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
                 continue
             bits = part.split(":")
             if len(bits) == 4 and bits[2] in ("delay", "bitflip",
-                                              "scale"):
+                                              "scale", "slice_loss",
+                                              "dcn_flap"):
                 bits = [bits[0], bits[1], f"{bits[2]}:{bits[3]}"]
             if len(bits) != 3:
                 raise QuESTValidationError(
                     f"bad fault-plan entry {part!r}: want seam:hit:kind "
                     "(or seam:hit:delay:<ms> / seam:hit:bitflip:<bit> / "
-                    "seam:hit:scale:<ppm>)")
+                    "seam:hit:scale:<ppm> / seam:hit:slice_loss:<s> / "
+                    "seam:hit:dcn_flap:<ms>)")
             entries.append((bits[0], bits[1], bits[2]))
     else:
         for e in spec:
@@ -317,11 +352,13 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
             raise QuESTValidationError(
                 f"unknown fault seam {seam!r}; seams: {sorted(SEAMS)}")
         if kind not in KINDS and _delay_ms(kind) is None \
-                and sdc_params(kind) is None:
+                and sdc_params(kind) is None \
+                and slice_loss_param(kind) is None \
+                and dcn_flap_ms(kind) is None:
             raise QuESTValidationError(
                 f"unknown fault kind {kind!r}; kinds: {list(KINDS)}, "
-                "delay:<ms>, bitflip:<bit> (0..63) or scale:<ppm> "
-                "(nonzero)")
+                "delay:<ms>, bitflip:<bit> (0..63), scale:<ppm> "
+                "(nonzero), slice_loss:<s> or dcn_flap:<ms>")
         if (kind == "stall" or _delay_ms(kind) is not None) \
                 and seam not in STRAGGLER_SEAMS:
             raise QuESTValidationError(
@@ -338,6 +375,13 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
                 f"fault kind 'preempt' models a mid-run SIGTERM and "
                 f"is valid only on the {sorted(PREEMPT_SEAMS)} seams, "
                 f"not {seam!r}")
+        if (slice_loss_param(kind) is not None
+                or dcn_flap_ms(kind) is not None) \
+                and seam not in SLICE_SEAMS:
+            raise QuESTValidationError(
+                f"fault kind {kind!r} models a failure-domain fault on "
+                f"the collective fabric and is valid only on the "
+                f"{sorted(SLICE_SEAMS)} seam, not {seam!r}")
         try:
             hit = int(hit)
         except (TypeError, ValueError):
@@ -472,6 +516,11 @@ def fault_point(name: str) -> str | None:
         return "preempt"
     if sdc_params(fired) is not None:
         return fired
+    if slice_loss_param(fired) is not None or dcn_flap_ms(fired) is not None:
+        # failure-domain kinds return the spec itself — the caller
+        # (mesh_exec.observe_item) owns the item context (which slice
+        # map, whether the item has a DCN leg) the fault acts on
+        return fired
     if fired == "io":
         raise OSError(f"scripted fault at seam {name!r} (hit {idx})")
     raise RuntimeError(f"scripted fault at seam {name!r} (hit {idx})")
@@ -541,18 +590,39 @@ WATCHDOG_GBPS_DEFAULT = 45.0
 WATCHDOG_SLACK_DEFAULT = 8.0
 WATCHDOG_MIN_S_DEFAULT = 30.0
 WATCHDOG_STRIKES_DEFAULT = 3
+#: Per-device DCN bandwidth (QUEST_DCN_GBPS): the cross-slice legs of
+#: a multi-slice mesh ride the data-center network, roughly an order
+#: of magnitude slower than ICI — 5 GB/s is a conservative per-device
+#: share.  Items with a DCN leg price that share of their bytes at
+#: this figure instead of the ICI one (watchdog_budget_s), so a
+#: DCN-crossing relayout gets a proportionally larger deadline: no
+#: spurious breach on the slow fabric, no slack explosion on ICI-only
+#: items.
+WATCHDOG_DCN_GBPS_DEFAULT = 5.0
+
+#: Chips-per-slice threshold of the hierarchical health rollup
+#: (QUEST_SLICE_DEGRADE_CHIPS): a slice with at least this many
+#: DEGRADED chips becomes a DEGRADED SLICE — one whole failure domain
+#: — which quarantine, the admission gate and /healthz then operate
+#: on.  2 keeps one flaky chip from condemning its healthy neighbours
+#: while a genuine slice-wide event (power, DCN partition, preemption)
+#: trips immediately.
+SLICE_DEGRADE_CHIPS_DEFAULT = 2
 
 _watchdog = {"on": False, "gbps": None, "slack": None, "min_s": None,
-             "strikes": None}
+             "strikes": None, "dcn_gbps": None}
 
-#: Per-device suspect counters and the degraded set, keyed by device
-#: index on the executing mesh.
-_mesh_health = {"strikes": {}, "degraded": []}
+#: Per-device suspect counters, the degraded set (keyed by device
+#: index on the executing mesh), and the chip->slice rollup: slices
+#: (env.slice_of_device under the declared QUEST_SLICE_SHAPE topology)
+#: whose degraded-chip count reached the rollup threshold.
+_mesh_health = {"strikes": {}, "degraded": [], "degraded_slices": []}
 
 
 def set_watchdog(enabled: bool = True, *, gbps: float | None = None,
                  slack: float | None = None, min_s: float | None = None,
-                 strikes: int | None = None) -> None:
+                 strikes: int | None = None,
+                 dcn_gbps: float | None = None) -> None:
     """Programmatically arm (or disarm) the collective watchdog and
     override its budget parameters.  ``None`` keeps the current
     override; a NON-POSITIVE value CLEARS the override back to the
@@ -569,7 +639,8 @@ def set_watchdog(enabled: bool = True, *, gbps: float | None = None,
 
     for key, v, cast in (("gbps", gbps, float), ("slack", slack, float),
                          ("min_s", min_s, float),
-                         ("strikes", strikes, int)):
+                         ("strikes", strikes, int),
+                         ("dcn_gbps", dcn_gbps, float)):
         nv = _norm(v, cast)
         if nv != "keep":
             _watchdog[key] = nv
@@ -605,7 +676,8 @@ def watchdog_strikes() -> int:
 
 
 def watchdog_budget_s(exchange_bytes: int, ndev: int,
-                      subblocks: int = 1) -> float:
+                      subblocks: int = 1,
+                      dcn_bytes: int = 0) -> float:
     """Deadline for one observed plan item, in seconds.
 
     ``exchange_bytes`` is the item's interconnect volume summed over
@@ -624,16 +696,50 @@ def watchdog_budget_s(exchange_bytes: int, ndev: int,
     1.5x at S=2 and shrinking toward the serial budget as S grows, so
     a pipelined item can neither breach spuriously (the budget covers
     the overlapped schedule's worst case) nor inflate the deadline
-    into uselessness (no slack explosion)."""
+    into uselessness (no slack explosion).
+
+    ``dcn_bytes`` is the CROSS-SLICE share of ``exchange_bytes`` (the
+    exact ``mesh_exec.item_fabric_elems`` figure the item's meta
+    carries on a multi-slice mesh — never an addition to the total):
+    that share prices against the DCN bandwidth (``QUEST_DCN_GBPS``)
+    instead of the ICI one, so a DCN-crossing relayout's deadline
+    grows in proportion to its slow-fabric traffic while ICI-only
+    items keep the exact historical budget (``dcn_bytes=0`` reduces
+    to the single-fabric formula term for term)."""
     gbps = _wd_param("gbps", "QUEST_WATCHDOG_GBPS", WATCHDOG_GBPS_DEFAULT)
     slack = _wd_param("slack", "QUEST_WATCHDOG_SLACK",
                       WATCHDOG_SLACK_DEFAULT)
     min_s = _wd_param("min_s", "QUEST_WATCHDOG_MIN_S",
                       WATCHDOG_MIN_S_DEFAULT)
-    per_dev = exchange_bytes / max(int(ndev), 1)
+    nd = max(int(ndev), 1)
+    dcn = min(max(int(dcn_bytes), 0), int(exchange_bytes))
+    wire = (exchange_bytes - dcn) / nd / (gbps * 1e9)
+    if dcn:
+        dcn_gbps = _wd_param("dcn_gbps", "QUEST_DCN_GBPS",
+                             WATCHDOG_DCN_GBPS_DEFAULT)
+        wire += dcn / nd / (dcn_gbps * 1e9)
     S = max(int(subblocks), 1)
     fill = (1.0 / S) if S > 1 else 0.0
-    return min_s + (per_dev / (gbps * 1e9)) * slack * (1.0 + fill)
+    return min_s + wire * slack * (1.0 + fill)
+
+
+def fabric_pricing_str(exchange_bytes: int, dcn_bytes: int = 0) -> str:
+    """The per-fabric byte split and bandwidths one priced budget used,
+    for refusal/breach messages: a DCN-induced refusal must be
+    diagnosable from the message alone (which fabric, how many bytes
+    on each leg, at what GB/s) — watchdog breaches, preflight deadline
+    refusals and the docs all render THIS string, so the three can
+    never describe the same price differently (the pricing-identity
+    contract, pinned in tests/test_failure_domains.py)."""
+    gbps = _wd_param("gbps", "QUEST_WATCHDOG_GBPS", WATCHDOG_GBPS_DEFAULT)
+    dcn = min(max(int(dcn_bytes), 0), int(exchange_bytes))
+    s = (f"exchange_bytes={int(exchange_bytes)}: "
+         f"ICI {int(exchange_bytes) - dcn} B @ {gbps:g} GB/s")
+    if dcn:
+        dcn_gbps = _wd_param("dcn_gbps", "QUEST_DCN_GBPS",
+                             WATCHDOG_DCN_GBPS_DEFAULT)
+        s += f" + DCN {dcn} B @ {dcn_gbps:g} GB/s"
+    return s
 
 
 class _WatchdogWall:
@@ -679,7 +785,8 @@ def watchdog_begin(meta: dict, exchange_bytes: int,
         return None
     return _WatchdogWall(meta, watchdog_budget_s(
         exchange_bytes, ndev,
-        subblocks=int(meta.get("subblocks") or 1)))
+        subblocks=int(meta.get("subblocks") or 1),
+        dcn_bytes=int(meta.get("dcn_bytes") or 0)))
 
 
 def watchdog_end(wall: "_WatchdogWall | None") -> None:
@@ -740,10 +847,12 @@ def _watchdog_breach(meta: dict, elapsed: float, budget: float,
         + (f", comm class {cc}" if cc else "")
         + (", STALLED in flight" if stalled else "")
         + f"): elapsed {elapsed:.3f}s exceeds the expected budget "
-        f"{budget:.3f}s (exchange_bytes="
-        f"{meta.get('exchange_bytes', 0)}, {ndev} device(s); budget = "
-        "min_s + bytes/device / link_GBps x slack — see "
-        "QUEST_WATCHDOG_* in docs/ROBUSTNESS.md)"
+        f"{budget:.3f}s ("
+        + fabric_pricing_str(int(meta.get("exchange_bytes", 0) or 0),
+                             int(meta.get("dcn_bytes", 0) or 0))
+        + f"; {ndev} device(s); budget = "
+        "min_s + sum(fabric bytes/device / fabric_GBps) x slack — see "
+        "QUEST_WATCHDOG_* / QUEST_DCN_GBPS in docs/ROBUSTNESS.md)"
         + (f"; flight recorder dumped to {path}" if path else
            " (flight-recorder dump failed; see metrics.sink_errors)")
         + (f"; devices newly degraded: {newly}" if newly else "")
@@ -751,12 +860,68 @@ def _watchdog_breach(meta: dict, elapsed: float, budget: float,
     raise QuESTTimeoutError(msg)
 
 
+def slice_degrade_chips() -> int:
+    """Degraded chips needed before a slice becomes a DEGRADED SLICE
+    (``QUEST_SLICE_DEGRADE_CHIPS``, min 1)."""
+    try:
+        return max(1, int(os.environ["QUEST_SLICE_DEGRADE_CHIPS"]))
+    except (KeyError, ValueError):
+        return SLICE_DEGRADE_CHIPS_DEFAULT
+
+
+def _rollup_slices_locked() -> list[int]:
+    """Chip -> slice strike rollup (caller holds ``_lock``): under a
+    multi-slice topology (the declared ``QUEST_SLICE_SHAPE``, or real
+    ``slice_index`` device attributes), any slice whose DEGRADED-chip
+    count reached :func:`slice_degrade_chips` joins the degraded-slice
+    set.  Returns the NEWLY degraded slices; a single-slice host
+    returns [] and never rolls up, keeping the flat registry's
+    historical behaviour byte-for-byte."""
+    from . import env as _env
+
+    if _env.topology_num_slices() <= 1:
+        return []
+    per_slice: dict[int, int] = {}
+    for d in _mesh_health["degraded"]:
+        s = _env.slice_of_device(d)
+        per_slice[s] = per_slice.get(s, 0) + 1
+    k = slice_degrade_chips()
+    newly = []
+    for s, n in sorted(per_slice.items()):
+        if n >= k and s not in _mesh_health["degraded_slices"]:
+            _mesh_health["degraded_slices"].append(s)
+            newly.append(s)
+    return newly
+
+
+def _note_degraded_slices(newly: list, reason: str = "") -> None:
+    """Counter/trace/ledger bookkeeping for newly DEGRADED slices
+    (outside the lock).  ``resilience.slice_degraded`` is watched by a
+    strictly-regressive +0 ``ledger_diff`` rule: at a fixed drill
+    matrix, MORE slice demotions than baseline = the rollup grew false
+    positives and is condemning healthy failure domains."""
+    if not newly:
+        return
+    metrics.counter_inc("resilience.slice_degraded", len(newly))
+    metrics.trace(
+        f"mesh health: slice(s) {newly} marked DEGRADED "
+        f"(>= {slice_degrade_chips()} degraded chip(s) each)"
+        + (f" ({reason})" if reason else ""))
+    with _lock:
+        degraded_slices = sorted(_mesh_health["degraded_slices"])
+    metrics.annotate_run("degraded_slices", degraded_slices)
+
+
 def suspect_devices(devices, reason: str = "") -> list[int]:
     """Strike each device in ``devices`` in the mesh-health registry;
     devices reaching the circuit-breaker threshold
     (:func:`watchdog_strikes`) are marked DEGRADED — returned, counted
     (``resilience.devices_degraded``), annotated onto the active run
-    ledger record, and surfaced by :func:`health_suffix`."""
+    ledger record, and surfaced by :func:`health_suffix`.  Under a
+    declared multi-slice topology the strikes ROLL UP: a slice
+    accumulating :func:`slice_degrade_chips` degraded chips becomes a
+    DEGRADED SLICE (one whole failure domain), which quarantine, the
+    admission gate and ``/healthz`` operate on."""
     k = watchdog_strikes()
     newly = []
     with _lock:
@@ -768,6 +933,7 @@ def suspect_devices(devices, reason: str = "") -> list[int]:
                 _mesh_health["degraded"].append(d)
                 newly.append(d)
         degraded = sorted(_mesh_health["degraded"])
+        new_slices = _rollup_slices_locked() if newly else []
     if newly:
         metrics.counter_inc("resilience.devices_degraded", len(newly))
         metrics.trace(f"mesh health: device(s) {newly} marked degraded "
@@ -775,38 +941,157 @@ def suspect_devices(devices, reason: str = "") -> list[int]:
                       (f" ({reason})" if reason else ""))
     if degraded:
         metrics.annotate_run("degraded_devices", degraded)
+    _note_degraded_slices(new_slices, reason)
     return newly
 
 
-def mesh_health() -> dict:
-    """Snapshot of the mesh-health registry: per-device suspect-strike
-    counters, the degraded set, and the breaker threshold."""
+def slice_lost(s: int, meta: dict | None = None):
+    """A whole slice died (the scripted ``slice_loss:<s>`` fault kind
+    — on real hardware, the multi-slice runtime reporting a slice
+    unreachable): mark EVERY chip of slice ``s`` DEGRADED at the full
+    strike threshold, mark the slice itself a DEGRADED SLICE, dump the
+    flight ring, and raise a typed :class:`QuESTTopologyError` naming
+    the failure domain and the recovery route (``heal_run`` /
+    ``resume_run(allow_topology_change=True)`` onto the surviving
+    slices)."""
+    from . import env as _env
+
+    ndev = int((meta or {}).get("ndev", 0) or 0)
+    if not ndev:
+        spec = _env.slice_spec()
+        ndev = spec[0] * spec[1] if spec else 1
+    chips = _env.slice_devices(int(s), ndev)
+    if not chips:
+        raise QuESTValidationError(
+            f"slice_loss:{s}: slice {s} holds no device of the "
+            f"{ndev}-device mesh under the declared topology "
+            "(QUEST_SLICE_SHAPE)")
+    k = watchdog_strikes()
+    newly_chips = []
     with _lock:
-        return {"strikes": dict(_mesh_health["strikes"]),
-                "degraded": sorted(_mesh_health["degraded"]),
-                "strikes_to_degrade": watchdog_strikes()}
+        for d in chips:
+            _mesh_health["strikes"][d] = max(
+                _mesh_health["strikes"].get(d, 0), k)
+            if d not in _mesh_health["degraded"]:
+                _mesh_health["degraded"].append(d)
+                newly_chips.append(d)
+        if int(s) not in _mesh_health["degraded_slices"]:
+            _mesh_health["degraded_slices"].append(int(s))
+            new_slice = [int(s)]
+        else:
+            new_slice = []
+        degraded = sorted(_mesh_health["degraded"])
+    if newly_chips:
+        # count only chips NEWLY demoted — one already struck out by an
+        # earlier breach must not inflate the devices_degraded telemetry
+        metrics.counter_inc("resilience.devices_degraded",
+                            len(newly_chips))
+    metrics.annotate_run("degraded_devices", degraded)
+    _note_degraded_slices(new_slice, reason=f"slice {s} LOST")
+    path = metrics.flight_dump(
+        f"whole-slice loss: slice {s} unreachable",
+        offending={"item": dict(meta or {}), "slice": int(s),
+                   "chips": chips})
+    raise QuESTTopologyError(
+        f"slice {s} LOST"
+        + (f" during plan item {meta.get('index')} "
+           f"({meta.get('kind')}, comm class {meta.get('comm_class')})"
+           if meta else "")
+        + f": device(s) {chips} are unreachable and marked DEGRADED "
+        "(whole failure domain) — resume onto the surviving slices "
+        "with resilience.heal_run(circuit, qureg, directory) or "
+        "resilience.resume_run(..., allow_topology_change=True)"
+        + (f"; flight recorder dumped to {path}" if path else
+           " (flight-recorder dump failed; see metrics.sink_errors)")
+        + health_suffix())
+
+
+def dcn_flap(ms: int, dcn_bytes: int, meta: dict | None = None) -> None:
+    """A deterministic cross-slice-fabric brown-out (the scripted
+    ``dcn_flap:<ms>`` fault kind): sleep ``ms`` milliseconds — under
+    the armed watchdog wall, so the straggle breaches the item's
+    DCN-priced budget — but ONLY when the item actually has a DCN leg
+    (``dcn_bytes > 0``).  An ICI-only item ignores the flap entirely
+    (traced, not slept): a DCN event must never false-positive a
+    breach against an ICI budget."""
+    if dcn_bytes <= 0:
+        metrics.trace(
+            f"dcn_flap:{ms} ignored: item"
+            + (f" {meta.get('index')}" if meta else "")
+            + " has no cross-slice leg (ICI-only — a DCN brown-out "
+            "cannot touch it)")
+        return
+    metrics.trace(
+        f"dcn_flap: stalling the DCN leg ({dcn_bytes} B) of item"
+        + (f" {meta.get('index')}" if meta else "") + f" by {ms} ms")
+    time.sleep(ms / 1000.0)
+
+
+def mesh_health() -> dict:
+    """Snapshot of the mesh-health registry — the TWO-LEVEL view:
+    per-device suspect-strike counters, the degraded chip set and the
+    breaker threshold (the flat registry, unchanged), plus
+    ``degraded_slices`` / ``chips_to_degrade_slice`` and — under a
+    declared multi-slice topology — a per-slice ``slices`` breakdown
+    (devices, degraded chips, summed strikes, status) that
+    ``/healthz`` and the sidecar snapshot render."""
+    from . import env as _env
+
+    with _lock:
+        out = {"strikes": dict(_mesh_health["strikes"]),
+               "degraded": sorted(_mesh_health["degraded"]),
+               "strikes_to_degrade": watchdog_strikes(),
+               "degraded_slices": sorted(_mesh_health["degraded_slices"]),
+               "chips_to_degrade_slice": slice_degrade_chips()}
+    spec = _env.slice_spec()
+    if spec is not None:
+        n_slices, per = spec
+        slices = {}
+        for s in range(n_slices):
+            chips = list(range(s * per, (s + 1) * per))
+            bad = [d for d in chips if d in out["degraded"]]
+            slices[str(s)] = {
+                "devices": chips,
+                "degraded_chips": bad,
+                "strikes": sum(out["strikes"].get(d, 0) for d in chips),
+                "status": ("DEGRADED" if s in out["degraded_slices"]
+                           else "ok"),
+            }
+        out["slices"] = slices
+    return out
 
 
 def clear_mesh_health() -> None:
-    """Zero the strike counters and the degraded set (a repaired mesh,
-    or a test hook)."""
+    """Zero the strike counters, the degraded set and the slice rollup
+    (a repaired mesh, or a test hook)."""
     with _lock:
         _mesh_health["strikes"].clear()
         del _mesh_health["degraded"][:]
+        del _mesh_health["degraded_slices"][:]
 
 
 def health_suffix() -> str:
     """Degraded-device summary appended to health-probe and watchdog
     messages ('' while the mesh is healthy) — the probe-facing face of
-    the mesh-health registry."""
+    the mesh-health registry.  Degraded SLICES are named as whole
+    failure domains, steering the operator to whole-slice quarantine
+    instead of chip-by-chip surgery."""
     with _lock:
         degraded = sorted(_mesh_health["degraded"])
+        slices = sorted(_mesh_health["degraded_slices"])
     if not degraded:
         return ""
     return (f"; mesh health: device(s) {degraded} are marked DEGRADED "
-            f"({watchdog_strikes()}-strike circuit breaker) — consider "
-            "a degraded-mesh resume onto the surviving devices "
-            "(resilience.resume_run(..., allow_topology_change=True))")
+            f"({watchdog_strikes()}-strike circuit breaker)"
+            + (f"; slice(s) {slices} are DEGRADED SLICES — whole "
+               "failure domains (>= "
+               f"{slice_degrade_chips()} degraded chip(s) each)"
+               if slices else "")
+            + " — consider "
+            "a degraded-mesh resume onto the surviving "
+            + ("slices" if slices else "devices")
+            + " (resilience.resume_run(..., allow_topology_change="
+              "True))")
 
 
 def mesh_health_snapshot() -> dict | None:
@@ -842,9 +1127,18 @@ def restore_mesh_health(snapshot: dict | None) -> None:
             if d not in _mesh_health["degraded"]:
                 _mesh_health["degraded"].append(d)
                 restored.append(d)
+        # re-derive the slice rollup from the merged chip view: the
+        # sidecar persists only chip-level facts (the rollup is a pure
+        # function of them plus the declared topology), so a restored
+        # registry reaches the same two-level verdict it would have
+        # learned live — without double-counting slice_degraded
+        new_slices = _rollup_slices_locked()
     if restored:
         metrics.trace(f"mesh health restored from checkpoint sidecar: "
                       f"device(s) {restored} inherit DEGRADED status")
+    if new_slices:
+        metrics.trace(f"mesh health restored from checkpoint sidecar: "
+                      f"slice(s) {new_slices} roll up to DEGRADED")
 
 
 # ---------------------------------------------------------------------------
@@ -1097,16 +1391,30 @@ def heal_run(circuit, qureg, directory: str, pallas: str = "auto"):
     degraded-resume contract); same-mesh rollbacks work anywhere.
     Bounded by :func:`integrity_rollbacks`, counted like
     :func:`self_heal`."""
+    from . import env as _env
+
     ndev = 1 if qureg.mesh is None else int(qureg.mesh.devices.size)
-    degraded = [d for d in mesh_health()["degraded"] if d < ndev]
+    health = mesh_health()
+    degraded = {d for d in health["degraded"] if d < ndev}
+    # quarantine whole FAILURE DOMAINS: every chip of a DEGRADED SLICE
+    # is excluded — its not-yet-struck members share the slice's fate
+    # (power, DCN partition, preemption land slice-wide), so the
+    # surviving topology is confined to healthy slices by construction
+    lost_slices = sorted(health["degraded_slices"])
+    for s in lost_slices:
+        degraded.update(d for d in _env.slice_devices(s, ndev))
+    degraded = sorted(degraded)
     if not degraded:
         return _rollback_retry(circuit, qureg, directory, pallas, None,
                                "heal_run"), qureg
     if ndev - len(degraded) < 1:
         raise QuESTCorruptionError(
             f"heal_run: every device of the {ndev}-device mesh is "
-            "marked degraded — no surviving topology to quarantine "
-            "onto (clear_mesh_health() after repair)")
+            "marked degraded"
+            + (f" (slice(s) {lost_slices} are whole degraded domains)"
+               if lost_slices else "")
+            + " — no surviving topology to quarantine onto "
+            "(clear_mesh_health() after repair)")
     from .env import create_env
     from .register import create_density_qureg, create_qureg
 
@@ -1120,7 +1428,10 @@ def heal_run(circuit, qureg, directory: str, pallas: str = "auto"):
                if i not in degraded]
     surviving = 1 << (len(healthy).bit_length() - 1)
     metrics.trace(f"heal_run: quarantining degraded device(s) "
-                  f"{degraded}; degraded-mesh resume {ndev} -> "
+                  f"{degraded}"
+                  + (f" (whole slice(s) {lost_slices})" if lost_slices
+                     else "")
+                  + f"; degraded-mesh resume {ndev} -> "
                   f"{surviving} device(s)")
     new_env = create_env(devices=healthy[:surviving])
     make = create_density_qureg if qureg.is_density else create_qureg
@@ -1816,6 +2127,7 @@ def _resume_degraded(circuit, qureg, pos: dict, pallas, named: str,
     # sidecars would carry the TAIL's fingerprint and positions, which
     # the original circuit could no longer resume — re-arm
     # checkpointing explicitly for very long tails.
+    lost_slices = mesh_health()["degraded_slices"]
     if tail.num_measurements and preseed:
         # remaining draws must fold in at index len(preseed): the
         # preseeded cursor needs the observed path (the ONLY reason to
@@ -1824,20 +2136,30 @@ def _resume_degraded(circuit, qureg, pos: dict, pallas, named: str,
         # program)
         resume = {"item_index": 0, "outcomes": [], "key": pos.get("key"),
                   "preseed": preseed, "slot": pos.get("slot")}
-        return tail.run(qureg, pallas=pallas, deadline_s=deadline_s,
-                        _resume=resume)
-    if tail.num_measurements:
+        out = tail.run(qureg, pallas=pallas, deadline_s=deadline_s,
+                       _resume=resume)
+    elif tail.num_measurements:
         # no prior draws: a plain clean run with the stored key is
         # exactly the uninterrupted smaller-mesh run of the tail
-        return tail.run(qureg, pallas=pallas, deadline_s=deadline_s,
-                        key=decode_prng_key(pos.get("key")))
-    out = tail.run(qureg, pallas=pallas, deadline_s=deadline_s)
-    if preseed:
-        # every recorded draw happened before the cut: the outcomes
-        # vector is exactly the replayed prefix
-        import jax.numpy as jnp
+        out = tail.run(qureg, pallas=pallas, deadline_s=deadline_s,
+                       key=decode_prng_key(pos.get("key")))
+    else:
+        out = tail.run(qureg, pallas=pallas, deadline_s=deadline_s)
+        if preseed:
+            # every recorded draw happened before the cut: the outcomes
+            # vector is exactly the replayed prefix
+            import jax.numpy as jnp
 
-        return jnp.asarray(preseed, jnp.int32)
+            out = jnp.asarray(preseed, jnp.int32)
+    if lost_slices:
+        # the tail completed on a mesh that excludes whole degraded
+        # slices: a recovered slice loss (the -0.001 strictly
+        # regressive ledger_diff rule watches this — FEWER recoveries
+        # at a fixed drill matrix = the slice-loss path stopped firing)
+        metrics.counter_inc("resilience.slice_loss_recovered")
+        metrics.trace(f"degraded-mesh resume completed with slice(s) "
+                      f"{lost_slices} quarantined: slice loss "
+                      "recovered on the surviving slices")
     return out
 
 

@@ -362,7 +362,8 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
                   row_budget: int | None = None,
                   max_high: int | None = None,
                   fuse_relayouts: bool = True,
-                  with_meta: bool = False):
+                  with_meta: bool = False,
+                  dcn_dev_bits: int | None = None):
     """Mesh scheduling with qubit relabeling.
 
     Returns a plan: a list of
@@ -393,6 +394,20 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     layout, so the produced state is bit-compatible with every other
     kernel and with amplitude access.
 
+    ``dcn_dev_bits`` (default: derived from the declared slice
+    topology, ``env.cross_slice_dev_bits``) marks the mesh's TOP device
+    bits as the cross-slice DCN axis.  When nonzero, ``localise``
+    biases its eviction pairing to keep hot qubits OFF that axis: a
+    fused localisation run pairs the coldest eviction victims with the
+    DCN bits it vacates (the members resident on DCN bits claim their
+    victims first), so the qubit parked across the slow fabric is the
+    one that mixes farthest in the future — the next DCN crossing is
+    pushed as late as possible, often past the end of the circuit.
+    Which bits participate in a fused relayout is unchanged (the item's
+    own cost is permutation-determined), only the victim->bit pairing
+    moves; with ``dcn_dev_bits == 0`` (any single-slice mesh) the plan
+    is byte-identical to the unbiased schedule.
+
     ``with_meta=True`` additionally returns a parallel ``aligned`` list:
     ``aligned[i]`` is the count of ORIGINAL ops fully covered by plan
     items ``0..i`` when that boundary is op-aligned, else None.  The
@@ -406,6 +421,12 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
     """
     ops = normalize_diag(ops)
     chunk_bits = num_vec_bits - dev_bits
+    if dcn_dev_bits is None:
+        from . import env as _env
+
+        dcn_dev_bits = _env.cross_slice_dev_bits(dev_bits)
+    dcn_lo = num_vec_bits - min(max(int(dcn_dev_bits), 0), dev_bits)
+    dcn_active = dcn_lo < num_vec_bits
     if max_high is None:
         max_high = default_max_high(chunk_bits)
     if row_budget is None:
@@ -479,6 +500,16 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
                 (inv[p] for p in range(chunk_bits, num_vec_bits)
                  if inv[p] != q and next_mix_use(inv[p], i) < len(ops)),
                 key=lambda qq: next_mix_use(qq, i))
+        if dcn_active and len(batch) > 1:
+            # failure-domain bias: members resident on the cross-slice
+            # (DCN) device bits claim their eviction victims FIRST, so
+            # the coldest victims — the qubits that mix farthest in the
+            # future — are the ones parked across the slow fabric.
+            # Pure pairing: the fused relayout's own volume is fixed by
+            # its composed permutation, so this only defers the NEXT
+            # DCN crossing (inert when dcn_dev_bits == 0)
+            batch.sort(key=lambda qq: (pos[qq] < dcn_lo,
+                                       next_mix_use(qq, i)))
         noevict = set(keep) | set(batch)
         for qq in batch:
             if pos[qq] < chunk_bits:
@@ -577,15 +608,27 @@ def plan_comm_cost(plan, num_vec_bits: int, dev_bits: int,
     do (``mesh_exec.item_subblocks``: env override or payload-size
     auto); an explicit value models a tuning sweep.
 
+    Each per-class row — and the top level — additionally splits the
+    exchange volume by FABRIC: ``dcn_elems`` is the share whose
+    (sender -> receiver) legs cross slices (``env.device_slice_map``;
+    the ICI share is ``exchange_elems - dcn_elems``), so a schedule
+    can be costed against the two fabrics' different bandwidths before
+    touching a chip (``tools/sched_stats.py`` renders the split).  On
+    a single-slice mesh every ``dcn_elems`` is 0.
+
     Returns ``{"per_class": {cls: {"items", "exchange_elems",
-    "exposed_elems"}}, "exchange_elems", "exposed_elems",
-    "hidden_frac_model"}``."""
-    from .parallel.mesh_exec import (_swap_comm_class, item_subblocks,
+    "dcn_elems", "exposed_elems"}}, "exchange_elems", "dcn_elems",
+    "exposed_elems", "hidden_frac_model"}``."""
+    from . import env as _env
+    from .parallel.mesh_exec import (_swap_comm_class,
+                                     item_fabric_elems, item_subblocks,
                                      plan_exchange_elems)
 
     chunk_bits = num_vec_bits - dev_bits
+    slice_map = _env.device_slice_map(1 << dev_bits)
     per_class: dict = {}
     total = exposed = 0.0
+    dcn_total = 0
     for item in plan:
         cls = _swap_comm_class(item, chunk_bits)
         if cls in (None, "local"):
@@ -593,19 +636,25 @@ def plan_comm_cost(plan, num_vec_bits: int, dev_bits: int,
         _, elems = plan_exchange_elems([item], num_vec_bits, dev_bits)
         if not elems:
             continue
+        _ici, dcn = item_fabric_elems(item, num_vec_bits, dev_bits,
+                                      slice_map, elems=elems)
         S = (item_subblocks(item, num_vec_bits, dev_bits)
              if subblocks is None else max(int(subblocks), 1))
         exp = elems / S if S > 1 else float(elems)
         row = per_class.setdefault(cls, {"items": 0,
                                          "exchange_elems": 0,
+                                         "dcn_elems": 0,
                                          "exposed_elems": 0.0})
         row["items"] += 1
         row["exchange_elems"] += elems
+        row["dcn_elems"] += dcn
         row["exposed_elems"] += exp
         total += elems
+        dcn_total += dcn
         exposed += exp
     return {"per_class": per_class,
             "exchange_elems": int(total),
+            "dcn_elems": int(dcn_total),
             "exposed_elems": exposed,
             "hidden_frac_model": (1.0 - exposed / total) if total
             else 0.0}

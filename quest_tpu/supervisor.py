@@ -325,10 +325,14 @@ def preflight_item(probe, amps, meta: dict, exchange_bytes: int = 0,
 
     # identical pricing to the watchdog wall this item would be armed
     # with — including the pipelined-item fill repricing keyed by the
-    # meta's resolved sub-block count (the pricing-identity contract)
+    # meta's resolved sub-block count AND the per-fabric ICI/DCN byte
+    # split the meta carries (the pricing-identity contract: watchdog,
+    # preflight and the refusal message below all read the same split)
+    dcn_bytes = int(meta.get("dcn_bytes") or 0)
     cost = resilience.watchdog_budget_s(
         int(exchange_bytes), int(ndev),
-        subblocks=int(meta.get("subblocks") or 1))
+        subblocks=int(meta.get("subblocks") or 1),
+        dcn_bytes=dcn_bytes)
     if rem <= 0:
         _drain(probe, amps, meta, why="deadline",
                detail=f"wall budget {deadline_total():.3f}s already "
@@ -336,11 +340,12 @@ def preflight_item(probe, amps, meta: dict, exchange_bytes: int = 0,
     if cost > rem:
         _drain(probe, amps, meta, why="deadline",
                detail=f"remaining budget {rem:.3f}s cannot cover the "
-                      f"item's priced cost {cost:.3f}s "
-                      f"(exchange_bytes={int(exchange_bytes)}, "
-                      f"{int(ndev)} device(s); cost = the watchdog "
-                      "budget formula, QUEST_WATCHDOG_* in "
-                      "docs/ROBUSTNESS.md)")
+                      f"item's priced cost {cost:.3f}s ("
+                      + resilience.fabric_pricing_str(
+                          int(exchange_bytes), dcn_bytes)
+                      + f"; {int(ndev)} device(s); cost = the watchdog "
+                      "budget formula, QUEST_WATCHDOG_* / "
+                      "QUEST_DCN_GBPS in docs/ROBUSTNESS.md)")
 
 
 def maybe_drain_eager(qureg) -> None:
@@ -469,10 +474,14 @@ def _evaluate_gate(reserve: bool = False):
     incrementing a second time."""
     from . import resilience  # deferred: resilience imports metrics
 
-    degraded = resilience.mesh_health()["degraded"]
+    health = resilience.mesh_health()
+    degraded = health["degraded"]
     if degraded:
+        slices = health.get("degraded_slices") or []
         return (False, f"mesh unhealthy: device(s) {degraded} are "
-                       "marked DEGRADED by the circuit breaker",
+                       "marked DEGRADED by the circuit breaker"
+                       + (f" (whole failure domain(s): slice(s) "
+                          f"{slices} DEGRADED)" if slices else ""),
                 "shed_unhealthy")
     reserved = False
     cap = max_inflight()

@@ -1,0 +1,609 @@
+"""Failure-domain-aware meshes (ISSUE 13 acceptance).
+
+Hierarchical slice health, DCN-priced budgets, and whole-slice-loss
+degraded resume: (a) the SLICE TOPOLOGY model — ``QUEST_SLICE_SHAPE``
+parsing/validation and the derived maps; (b) FABRIC ACCOUNTING — the
+per-item ICI/DCN split refines ``plan_exchange_elems`` exactly (their
+sum is the historical total, so every byte pin keeps holding), the
+default single-slice metas/plans stay byte-stable, and the ``localise``
+bias measurably keeps hot qubits off the cross-slice axis; (c) FABRIC-
+PRICED BUDGETS — ``watchdog_budget_s`` reduces term-for-term to the
+historical formula at ``dcn_bytes=0`` and prices the DCN share at
+``QUEST_DCN_GBPS``, with the watchdog-breach and preflight-refusal
+messages NAMING the priced fabric and per-leg byte split (the
+pricing-identity contract); (d) HIERARCHICAL MESH HEALTH — chip
+strikes roll up chip -> slice at the ``QUEST_SLICE_DEGRADE_CHIPS``
+threshold, ``slice_loss:<s>``/``dcn_flap:<ms>`` validate on the
+exchange seam only, whole-slice loss marks every chip and the slice,
+and the rollup survives the checkpoint sidecar round trip; (e) the
+PROPERTY that strike rollup, slice quarantine and sender attribution
+stay EXACT under virtual 2- and 4-slice meshes at S in {1, 4}
+sub-blocks — a checksummed-collective corruption on a DCN leg still
+names item/round(.sub)/sender -> receiver and strikes only that
+pair's devices; (f) SLICE-LOSS DEGRADED RESUME — an 8-device 2-slice
+virtual mesh that loses a whole slice resumes BIT-IDENTICALLY on
+exactly the surviving slice's devices; (g) the observability faces:
+``quest_slice_*`` gauges, the hierarchical ``/healthz`` body, and the
+``ledger_diff`` slice rules firing in both directions.
+"""
+
+import json
+import os
+import re
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import env as qenv
+from quest_tpu import metrics, models, resilience, supervisor
+from quest_tpu.parallel.mesh_exec import (_item_key, item_fabric_elems,
+                                          item_timeline_meta,
+                                          plan_exchange_elems,
+                                          plan_fabric_elems)
+from quest_tpu.scheduler import plan_comm_cost, schedule_mesh
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+N = 8  # enough qubits for multi-item mesh plans at 8 devices
+
+
+@pytest.fixture(autouse=True)
+def _clean_domains(monkeypatch):
+    for var in ("QUEST_SLICE_SHAPE", "QUEST_SLICE_DEGRADE_CHIPS",
+                "QUEST_DCN_GBPS", "QUEST_FAULT_PLAN", "QUEST_INTEGRITY",
+                "QUEST_COMM_SUBBLOCKS", "QUEST_WATCHDOG",
+                "QUEST_CKPT_DIR", "QUEST_CKPT_EVERY"):
+        monkeypatch.delenv(var, raising=False)
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+# ---------------------------------------------------------------------------
+# (a) slice topology model
+# ---------------------------------------------------------------------------
+
+
+def test_slice_spec_parsing(monkeypatch):
+    assert qenv.slice_spec() is None
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    assert qenv.slice_spec() == (2, 4)
+    for bad in ("2", "3x4", "2x3", "x4", "2x", "2x4x2", "ab"):
+        monkeypatch.setenv("QUEST_SLICE_SHAPE", bad)
+        with pytest.raises(qt.QuESTValidationError):
+            qenv.slice_spec()
+
+
+def test_device_slice_map_and_bits(monkeypatch):
+    assert qenv.device_slice_map(8) == [0] * 8
+    assert qenv.num_slices(8) == 1
+    assert qenv.cross_slice_dev_bits(3) == 0
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    assert qenv.device_slice_map(8) == [0, 0, 0, 0, 1, 1, 1, 1]
+    assert qenv.num_slices(8) == 2
+    assert qenv.cross_slice_dev_bits(3) == 1
+    assert qenv.slice_of_device(5) == 1
+    assert qenv.slice_devices(1, 8) == [4, 5, 6, 7]
+    # a SMALLER surviving sub-mesh maps positions the same way —
+    # survivors confined to slice 0 all read as slice 0
+    assert qenv.device_slice_map(4) == [0, 0, 0, 0]
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "4x2")
+    assert qenv.device_slice_map(8) == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert qenv.cross_slice_dev_bits(3) == 2
+    # a mesh LARGER than the declared topology would alias slices
+    with pytest.raises(qt.QuESTValidationError):
+        qenv.device_slice_map(16)
+
+
+# ---------------------------------------------------------------------------
+# (b) fabric accounting + plan byte-stability + localise bias
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", ["2x4", "4x2"])
+def test_fabric_split_refines_exchange_elems(monkeypatch, shape):
+    ops = list(models.qft(10).ops)
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", shape)
+    plan = schedule_mesh(ops, 10, 3, 2)
+    _, total = plan_exchange_elems(plan, 10, 3)
+    ici, dcn = plan_fabric_elems(plan, 10, 3)
+    assert ici + dcn == total  # the split REFINES the ledger total
+    assert dcn > 0             # a QFT relabels the top (cross-slice) bit
+    for item in plan:
+        i, d = item_fabric_elems(item, 10, 3)
+        _, e = plan_exchange_elems([item], 10, 3)
+        assert i + d == e
+    cost = plan_comm_cost(plan, 10, 3)
+    assert cost["dcn_elems"] == dcn
+    assert sum(r["dcn_elems"] for r in cost["per_class"].values()) == dcn
+
+
+def test_single_slice_fabric_is_all_ici():
+    ops = list(models.qft(10).ops)
+    plan = schedule_mesh(ops, 10, 3, 2)
+    _, total = plan_exchange_elems(plan, 10, 3)
+    assert plan_fabric_elems(plan, 10, 3) == (total, 0)
+    assert plan_comm_cost(plan, 10, 3)["dcn_elems"] == 0
+
+
+def test_default_plan_and_meta_byte_stable(monkeypatch):
+    """The single-slice default path is untouched: the plan is
+    byte-identical with the topology model inert, and comm-item metas
+    carry no dcn key (historical metas byte-stable)."""
+    ops = list(models.qft(10).ops)
+    base = schedule_mesh(ops, 10, 3, 2)
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    unbiased = schedule_mesh(ops, 10, 3, 2, dcn_dev_bits=0)
+    assert _item_key(base) == _item_key(unbiased)
+    monkeypatch.delenv("QUEST_SLICE_SHAPE")
+    for item in base:
+        if item[0] in ("swap", "relayout"):
+            assert "dcn_elems" not in item_timeline_meta(item, 10, 3)
+
+
+def test_meta_carries_dcn_share(monkeypatch):
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    plan = schedule_mesh(list(models.qft(10).ops), 10, 3, 2)
+    seen = 0
+    for item in plan:
+        if item[0] not in ("swap", "relayout"):
+            continue
+        meta = item_timeline_meta(item, 10, 3)
+        _i, d = item_fabric_elems(item, 10, 3)
+        assert meta.get("dcn_elems", 0) == d
+        seen += d > 0
+    assert seen  # at least one DCN-crossing item exercised the tag
+
+
+def _x_on(t):
+    return ("apply_2x2", (t, 0),
+            ((0.0, 0.0), (1.0, 0.0), (1.0, 0.0), (0.0, 0.0)))
+
+
+def test_localise_bias_keeps_hot_qubits_off_dcn(monkeypatch):
+    """Witness circuit: the biased schedule parks the coldest eviction
+    victim on the cross-slice bit, so the later retrieval crosses ICI
+    instead of DCN — strictly less cross-slice volume at equal total.
+    Plus the aggregate guard: over a seeded random corpus the bias
+    never increases total cross-slice volume."""
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    sm = qenv.device_slice_map(8)
+    witness = [_x_on(t) for t in (0, 2, 5, 0, 4, 2, 1, 1)]
+    b = plan_fabric_elems(schedule_mesh(witness, 6, 3, 1), 6, 3, sm)
+    u = plan_fabric_elems(
+        schedule_mesh(witness, 6, 3, 1, dcn_dev_bits=0), 6, 3, sm)
+    assert b[1] < u[1], (b, u)
+    import random
+
+    rng = random.Random(1)
+    tot_b = tot_u = 0
+    for _ in range(150):
+        seq = [rng.randrange(6) for _ in range(rng.randint(3, 12))]
+        ops = [_x_on(t) for t in seq]
+        tot_b += plan_fabric_elems(
+            schedule_mesh(ops, 6, 3, 1), 6, 3, sm)[1]
+        tot_u += plan_fabric_elems(
+            schedule_mesh(ops, 6, 3, 1, dcn_dev_bits=0), 6, 3, sm)[1]
+    assert tot_b < tot_u, (tot_b, tot_u)
+
+
+# ---------------------------------------------------------------------------
+# (c) fabric-priced budgets + message pins
+# ---------------------------------------------------------------------------
+
+
+def test_budget_dcn_pricing(monkeypatch):
+    monkeypatch.setenv("QUEST_WATCHDOG_GBPS", "10")
+    monkeypatch.setenv("QUEST_WATCHDOG_SLACK", "2")
+    monkeypatch.setenv("QUEST_WATCHDOG_MIN_S", "1")
+    monkeypatch.setenv("QUEST_DCN_GBPS", "5")
+    # dcn_bytes=0 reduces to the historical single-fabric formula
+    assert resilience.watchdog_budget_s(8 * 10_000_000_000, 8) \
+        == pytest.approx(1.0 + 2.0)
+    # half the bytes on DCN at 5 GB/s: 1 + (0.5 + 1.0) * 2 = 4
+    assert resilience.watchdog_budget_s(
+        8 * 10_000_000_000, 8, dcn_bytes=4 * 10_000_000_000) \
+        == pytest.approx(4.0)
+    # the DCN share can never exceed the total (defensive clamp)
+    assert resilience.watchdog_budget_s(100, 1, dcn_bytes=10 ** 9) \
+        == resilience.watchdog_budget_s(100, 1, dcn_bytes=100)
+    # pipelined fill factor composes with the fabric split
+    b1 = resilience.watchdog_budget_s(1 << 30, 8,
+                                      dcn_bytes=1 << 29)
+    b2 = resilience.watchdog_budget_s(1 << 30, 8, subblocks=2,
+                                      dcn_bytes=1 << 29)
+    assert b2 == pytest.approx(1.0 + (b1 - 1.0) * 1.5)
+
+
+def test_fabric_pricing_str_names_both_legs(monkeypatch):
+    monkeypatch.setenv("QUEST_WATCHDOG_GBPS", "10")
+    monkeypatch.setenv("QUEST_DCN_GBPS", "5")
+    s = resilience.fabric_pricing_str(100, 40)
+    assert "ICI 60 B @ 10 GB/s" in s
+    assert "DCN 40 B @ 5 GB/s" in s
+    # ICI-only items name their one fabric, no DCN clause
+    s0 = resilience.fabric_pricing_str(100, 0)
+    assert "ICI 100 B @ 10 GB/s" in s0 and "DCN" not in s0
+
+
+def test_watchdog_breach_message_names_fabric_split():
+    """Satellite bugfix pin: a breach names the priced fabric and the
+    per-leg byte split, so a DCN-induced refusal is diagnosable from
+    the message alone."""
+    meta = {"index": 3, "kind": "relayout", "comm_class": "relayout",
+            "ndev": 8, "exchange_bytes": 7168, "dcn_bytes": 4096}
+    with pytest.raises(qt.QuESTTimeoutError) as ei:
+        resilience._watchdog_breach(meta, elapsed=9.0, budget=1.0)
+    msg = str(ei.value)
+    assert "exceeds the expected budget" in msg
+    assert "ICI 3072 B @" in msg and "DCN 4096 B @" in msg
+    assert "QUEST_DCN_GBPS" in msg
+
+
+def test_preflight_refusal_names_fabric_split(monkeypatch):
+    """The deadline refusal prices with the SAME formula and names the
+    SAME fabric split (pricing-identity contract)."""
+    monkeypatch.setenv("QUEST_WATCHDOG_MIN_S", "0.001")
+    monkeypatch.setenv("QUEST_WATCHDOG_GBPS", "1")
+    monkeypatch.setenv("QUEST_DCN_GBPS", "1")
+    meta = {"index": 1, "kind": "bitswap", "comm_class": "half",
+            "subblocks": 1, "dcn_bytes": 4 << 30}
+    with supervisor.deadline_scope(1.0):
+        with pytest.raises(qt.QuESTTimeoutError) as ei:
+            supervisor.preflight_item(None, None, meta,
+                                      exchange_bytes=8 << 30, ndev=2)
+    msg = str(ei.value)
+    assert "priced cost" in msg and "before launch" in msg
+    assert f"ICI {4 << 30} B @" in msg and f"DCN {4 << 30} B @" in msg
+    want = resilience.watchdog_budget_s(8 << 30, 2,
+                                        dcn_bytes=4 << 30)
+    assert f"{want:.3f}s" in msg  # the watchdog's own price, verbatim
+
+
+# ---------------------------------------------------------------------------
+# (d) hierarchical mesh health + fault kinds
+# ---------------------------------------------------------------------------
+
+
+def test_slice_fault_kind_parsing():
+    assert resilience.slice_loss_param("slice_loss:1") == 1
+    assert resilience.slice_loss_param("slice_loss:-1") is None
+    assert resilience.slice_loss_param("slice_loss:x") is None
+    assert resilience.slice_loss_param("dcn_flap:5") is None
+    assert resilience.dcn_flap_ms("dcn_flap:250") == 250
+    assert resilience.dcn_flap_ms("dcn_flap:-1") is None
+    assert resilience.dcn_flap_ms(None) is None
+    # env 4-field spelling parses; exchange seam only
+    resilience.set_fault_plan(
+        "mesh_exchange:0:slice_loss:1;mesh_exchange:2:dcn_flap:500")
+    resilience.clear_fault_plan()
+    for bad in ("run_item:0:slice_loss:1", "run_item:0:dcn_flap:5",
+                "ckpt_save:0:slice_loss:0"):
+        with pytest.raises(qt.QuESTValidationError):
+            resilience.set_fault_plan(bad)
+    with pytest.raises(qt.QuESTValidationError):
+        resilience.set_fault_plan("mesh_exchange:0:slice_loss:x")
+
+
+def test_strike_rollup_state_machine(monkeypatch):
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    resilience.set_watchdog(False, strikes=1)
+    try:
+        resilience.suspect_devices([4], reason="t")
+        h = resilience.mesh_health()
+        assert h["degraded"] == [4]
+        assert h["degraded_slices"] == []          # 1 chip < threshold 2
+        assert h["slices"]["1"]["degraded_chips"] == [4]
+        assert h["slices"]["1"]["status"] == "ok"
+        resilience.suspect_devices([6], reason="t")
+        h = resilience.mesh_health()
+        assert h["degraded_slices"] == [1]         # 2 chips -> DEGRADED
+        assert h["slices"]["1"]["status"] == "DEGRADED"
+        assert h["slices"]["0"]["status"] == "ok"  # no overreach
+        assert "DEGRADED SLICES" in resilience.health_suffix()
+        # counted once, not re-counted on further strikes
+        base = metrics.counters().get("resilience.slice_degraded", 0)
+        resilience.suspect_devices([5], reason="t")
+        assert metrics.counters().get("resilience.slice_degraded",
+                                      0) == base
+    finally:
+        resilience.set_watchdog(False, strikes=-1)
+
+
+def test_rollup_inert_without_topology():
+    """Single-slice meshes keep the flat registry: no slices view, no
+    rollup, byte-stable health_suffix."""
+    resilience.set_watchdog(False, strikes=1)
+    try:
+        resilience.suspect_devices([0, 1, 2], reason="t")
+        h = resilience.mesh_health()
+        assert h["degraded_slices"] == [] and "slices" not in h
+        assert "DEGRADED SLICES" not in resilience.health_suffix()
+        assert "surviving devices" in resilience.health_suffix()
+    finally:
+        resilience.set_watchdog(False, strikes=-1)
+
+
+def test_slice_lost_marks_whole_domain(monkeypatch):
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    with pytest.raises(qt.QuESTTopologyError) as ei:
+        resilience.slice_lost(1, {"ndev": 8, "index": 2,
+                                  "kind": "relayout",
+                                  "comm_class": "relayout"})
+    msg = str(ei.value)
+    assert "slice 1 LOST" in msg and "[4, 5, 6, 7]" in msg
+    assert "allow_topology_change=True" in msg
+    h = resilience.mesh_health()
+    assert h["degraded"] == [4, 5, 6, 7]
+    assert h["degraded_slices"] == [1]
+    with pytest.raises(qt.QuESTValidationError):
+        resilience.slice_lost(7, {"ndev": 8})   # outside the topology
+
+
+def test_rollup_survives_sidecar_round_trip(monkeypatch):
+    """The sidecar persists chip-level facts only; the slice verdict is
+    re-derived on restore — same two-level conclusion, no
+    double-counted slice_degraded."""
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    with pytest.raises(qt.QuESTTopologyError):
+        resilience.slice_lost(0, {"ndev": 8})
+    snap = resilience.mesh_health_snapshot()
+    assert "degraded_slices" not in (snap or {})   # chip-level only
+    base = metrics.counters().get("resilience.slice_degraded", 0)
+    resilience.clear_mesh_health()
+    assert resilience.mesh_health()["degraded_slices"] == []
+    resilience.restore_mesh_health(snap)
+    h = resilience.mesh_health()
+    assert h["degraded"] == [0, 1, 2, 3]
+    assert h["degraded_slices"] == [0]
+    assert metrics.counters().get("resilience.slice_degraded",
+                                  0) == base
+
+
+def test_admission_gate_names_failure_domain(monkeypatch):
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    with pytest.raises(qt.QuESTTopologyError):
+        resilience.slice_lost(1, {"ndev": 8})
+    supervisor.configure_gate(True)
+    try:
+        with pytest.raises(qt.QuESTOverloadError) as ei:
+            supervisor.admit("t")
+        assert "slice(s) [1] DEGRADED" in str(ei.value)
+        ready, reason, _ra = supervisor.readiness()
+        assert not ready and "slice(s) [1]" in reason
+    finally:
+        supervisor.configure_gate(False)
+
+
+# ---------------------------------------------------------------------------
+# (e) property: rollup + quarantine + sender attribution exact under
+#     2-/4-slice meshes at S in {1, 4}
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", ["2x4", "4x2"])
+@pytest.mark.parametrize("subblocks", [1, 4])
+def test_dcn_leg_corruption_attribution_exact(env8, monkeypatch, shape,
+                                              subblocks):
+    """A checksummed-collective corruption on a DCN leg still names
+    item / round(.sub) / sender -> receiver, and strikes EXACTLY that
+    pair — attribution and rollup never smear across the slice
+    boundary, under either virtual topology and with or without
+    sub-block pipelining."""
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", shape)
+    if subblocks > 1:
+        monkeypatch.setenv("QUEST_COMM_SUBBLOCKS", str(subblocks))
+    # one gate on the TOP qubit: the plan's first comm item swaps the
+    # top device bit — a cross-slice (DCN) leg under both topologies
+    from quest_tpu.circuit import Circuit
+
+    circ = Circuit(N)
+    circ.hadamard(N - 1)
+    resilience.set_integrity(True)
+    resilience.set_fault_plan([("mesh_exchange", 0, "bitflip:12")])
+    q = qt.create_qureg(N, env8)
+    try:
+        with pytest.raises(qt.QuESTCorruptionError) as ei:
+            circ.run(q, pallas="auto")
+    finally:
+        resilience.set_integrity(False)
+        resilience.clear_fault_plan()
+    msg = str(ei.value)
+    label = r"\d+\.\d+" if subblocks > 1 else r"\d+"
+    m = re.search(rf"device (\d+) -> device (\d+) \(round ({label})\)",
+                  msg)
+    assert m, msg
+    snd, rcv = int(m.group(1)), int(m.group(2))
+    # the drill corrupts sender device 0's first armed leg; the
+    # receiver is across the slice boundary (it IS a DCN leg)
+    sm = qenv.device_slice_map(8)
+    assert snd == 0 and sm[snd] != sm[rcv], (snd, rcv, sm)
+    h = resilience.mesh_health()
+    assert sorted(h["strikes"]) == sorted({snd, rcv})  # EXACTLY the pair
+    # one strike per chip: far below both the chip breaker and the
+    # slice threshold — no device degraded, no slice demoted
+    assert h["degraded"] == [] and h["degraded_slices"] == []
+    # with a 1-chip slice threshold the SAME evidence demotes exactly
+    # the two slices the pair touches
+    monkeypatch.setenv("QUEST_SLICE_DEGRADE_CHIPS", "1")
+    resilience.set_watchdog(False, strikes=1)
+    try:
+        resilience.suspect_devices([snd, rcv], reason="prop")
+        h2 = resilience.mesh_health()
+        assert h2["degraded_slices"] == sorted({sm[snd], sm[rcv]})
+    finally:
+        resilience.set_watchdog(False, strikes=-1)
+
+
+# ---------------------------------------------------------------------------
+# (f) slice-loss degraded resume: bit-identical on the survivors
+# ---------------------------------------------------------------------------
+
+
+def test_slice_loss_resumes_bit_identical_on_survivors(
+        env8, monkeypatch, tmp_path):
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    d = str(tmp_path / "ckpt")
+    circ = models.qft(N)
+    q = qt.create_qureg(N, env8)
+    resilience.set_fault_plan([("mesh_exchange", 2, "slice_loss:1")])
+    try:
+        with pytest.raises(qt.QuESTTopologyError) as ei:
+            circ.run(q, pallas="auto", checkpoint_dir=d,
+                     checkpoint_every=2)
+    finally:
+        resilience.clear_fault_plan()
+    assert "slice 1 LOST" in str(ei.value)
+    with open(os.path.join(d, "latest")) as f:
+        latest = f.read().strip()
+    pos = resilience._read_position(os.path.join(d, latest),
+                                    required=True)
+    assert pos.get("ops_applied") is not None
+    before = metrics.counters().get("resilience.slice_loss_recovered", 0)
+    _out, q2 = resilience.heal_run(circ, q, d, pallas="auto")
+    all_dev = q.mesh.devices.reshape(-1).tolist()
+    # quarantine confined the survivors to the HEALTHY slice — the
+    # whole domain went, including its never-struck chips
+    assert q2.mesh.devices.reshape(-1).tolist() == all_dev[:4]
+    got = qt.get_state_vector(q2)
+    # reference: restore the snapshot into a fresh slice-0 register,
+    # canonicalise the recorded layout on the host (exact), run the
+    # remaining ops there uninterrupted
+    env_half = qt.create_env(devices=all_dev[:4])
+    probe = qt.create_qureg(N, env_half)
+    resilience.load_snapshot(probe, d)
+    raw = qt.get_state_vector(probe)
+    perm = pos.get("layout") or list(range(N))
+    idx = np.zeros(1 << N, dtype=np.int64)
+    ar = np.arange(1 << N)
+    for b, p in enumerate(perm):
+        idx |= ((ar >> p) & 1) << b
+    fresh = qt.create_qureg(N, env_half)
+    canon = raw[idx]
+    qt.init_state_from_amps(fresh, canon.real.copy(), canon.imag.copy())
+    from quest_tpu.circuit import Circuit
+
+    tail = Circuit(N, False, ops=list(circ.ops)[int(pos["ops_applied"]):])
+    tail.run(fresh, pallas="auto")
+    assert np.array_equal(got, qt.get_state_vector(fresh))
+    assert metrics.counters().get("resilience.slice_loss_recovered",
+                                  0) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# (g) observability faces: gauges, /healthz, ledger_diff rules
+# ---------------------------------------------------------------------------
+
+
+def test_export_text_slice_gauges(monkeypatch):
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    with pytest.raises(qt.QuESTTopologyError):
+        resilience.slice_lost(1, {"ndev": 8})
+    text = metrics.export_text()
+    samples = {}
+    for line in text.splitlines():
+        if line.startswith("quest_slice_"):
+            name, val = line.split()
+            samples[name] = float(val)
+    assert samples["quest_slice_count"] == 2.0
+    assert samples["quest_slice_degraded"] == 1.0
+    assert samples["quest_slice_degrade_chips"] == \
+        resilience.slice_degrade_chips()
+
+
+def test_healthz_hierarchical_view(monkeypatch):
+    import metrics_serve
+
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    server, port = metrics_serve.start_in_thread(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            ok_body = json.loads(r.read().decode())
+            assert r.status == 200
+        assert ok_body["ok"] and ok_body["degraded_slices"] == []
+        assert ok_body["slices"]["0"]["status"] == "ok"
+        with pytest.raises(qt.QuESTTopologyError):
+            resilience.slice_lost(1, {"ndev": 8})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read().decode())
+        assert body["degraded_slices"] == [1]
+        assert body["slices"]["1"]["status"] == "DEGRADED"
+        assert body["slices"]["1"]["degraded_chips"] == [4, 5, 6, 7]
+        assert body["slices"]["0"]["status"] == "ok"
+    finally:
+        server.shutdown()
+
+
+def test_ledger_diff_slice_rules_fire_both_directions():
+    """slice_degraded (+0: more demotions = rollup false positives)
+    and slice_loss_recovered (-0.001: fewer recoveries = the
+    quarantine path stopped firing) — each fires in its bad direction
+    and stays quiet in the good one."""
+    import ledger_diff
+
+    def chaos(degraded, recovered):
+        return {"metric": "chaos-q10-s18",
+                "counters": {"resilience": {
+                    "slice_degraded": degraded,
+                    "slice_loss_recovered": recovered}}}
+
+    def keys(violations):
+        return {v["key"] for v in violations}
+
+    v, _c, _s = ledger_diff.gate(chaos(2, 1), chaos(3, 1))
+    assert "counters.resilience.slice_degraded" in keys(v)
+    v, _c, _s = ledger_diff.gate(chaos(2, 1), chaos(1, 1))
+    assert "counters.resilience.slice_degraded" not in keys(v)
+    v, _c, _s = ledger_diff.gate(chaos(2, 2), chaos(2, 1))
+    assert "counters.resilience.slice_loss_recovered" in keys(v)
+    v, _c, _s = ledger_diff.gate(chaos(2, 1), chaos(2, 2))
+    assert "counters.resilience.slice_loss_recovered" not in keys(v)
+    # config-bound: a different drill matrix skips both rules
+    other = chaos(9, 0)
+    other["metric"] = "chaos-q10-s99"
+    v, _c, skipped = ledger_diff.gate(chaos(2, 2), other)
+    assert not {k for k in keys(v) if "slice" in k}
+    assert any("slice" in k for k, _why in skipped)
+
+
+def test_chaos_scenario_timeout_records_timed_out_verdict(monkeypatch):
+    """One hung drill row becomes a distinct ``timed_out`` verdict on
+    that row instead of stalling the whole matrix: the per-scenario
+    subprocess wall fires and the matrix moves on."""
+    import chaos_drill
+
+    monkeypatch.setattr(chaos_drill, "SCENARIO_TIMEOUT_S", 1)
+    # kill_resume's cold subprocess takes far longer than 1 s to even
+    # build its environment — a deterministic "hang" for the wall
+    monkeypatch.setattr(chaos_drill, "SCENARIOS",
+                        [chaos_drill.SCENARIOS[0]])
+    del chaos_drill.results[:]
+    try:
+        chaos_drill._run_matrix(0, in_process=False)
+        assert len(chaos_drill.results) == 1
+        row = chaos_drill.results[0]
+        assert row["timed_out"] and not row["ok"]
+        assert row["timeout_s"] == 1
+    finally:
+        del chaos_drill.results[:]
+
+
+def test_run_ledger_annotates_num_slices(env8, monkeypatch):
+    monkeypatch.setenv("QUEST_SLICE_SHAPE", "2x4")
+    q = qt.create_qureg(N, env8)
+    models.qft(N).run(q, pallas="auto")
+    rec = metrics.get_run_ledger()
+    assert rec["meta"]["num_slices"] == 2
+    monkeypatch.delenv("QUEST_SLICE_SHAPE")
+    q2 = qt.create_qureg(N, env8)
+    models.qft(N).run(q2, pallas="auto")
+    assert "num_slices" not in metrics.get_run_ledger()["meta"]

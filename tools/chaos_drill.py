@@ -69,13 +69,41 @@ each scenario's recovery contract:
   ``retry_after_s`` hint; admitted runs before and after are
   unaffected — all with zero randomness.
 
+* ``slice_loss_resume``  — a scripted ``slice_loss:<s>`` kills a whole
+  slice of the 2-slice virtual mesh (``QUEST_SLICE_SHAPE=2x4``)
+  mid-checkpointed-run: the exchange must fail with a typed error
+  naming the slice, all its chips (and the slice) roll up DEGRADED,
+  and ``heal_run`` must quarantine the whole failure domain — resuming
+  BIT-IDENTICALLY on exactly the surviving slice's devices under one
+  trace_id (``slice_loss_recovered`` counted).
+* ``dcn_straggler``      — a scripted ``dcn_flap:<ms>`` at a
+  DCN-crossing item must breach that item's DCN-PRICED budget with the
+  message naming both fabrics and the per-leg byte split; the same
+  flap at an ICI-only item is ignored (no false positive); and once
+  the breach strikes out the participants, ``/healthz`` flips to 503
+  naming the degraded slices.
+* ``slice_quarantine_shed`` — with a slice LOST and the admission gate
+  armed, incoming runs shed with ``QuESTOverloadError`` naming the
+  degraded failure domain, ``/readyz`` serves 503 with the same
+  reason, and a repaired mesh admits again.
+
 Every scenario must end in either a clean recovery (with the
 resilience counters recorded) or a ``QuESTError`` naming the seam —
 never a silent wrong state.  Prints one PASS/FAIL line per scenario and
 writes ``CHAOS_r{N}.json``.  Wired into ``tools/record_all.py`` as a
 tier-2 smoke.
 
-Usage: python tools/chaos_drill.py [round]
+Isolation: by default every scenario runs in its OWN subprocess under
+its own ``QUEST_CHAOS_SCENARIO_TIMEOUT_S`` wall (420 s default), so one
+hung drill row records a distinct ``timed_out`` verdict on that row and
+the matrix moves on — it can no longer stall the whole run — and
+process-global state (fault plans, strike registries, env knobs) can
+never leak between rows.  ``--in-process`` keeps the old shared-process
+mode for debugging; ``--scenario NAME --out FILE`` is the child
+protocol.
+
+Usage: python tools/chaos_drill.py [round] [--in-process]
+                                   [--scenario NAME --out FILE]
 """
 
 from __future__ import annotations
@@ -795,42 +823,476 @@ def drill_overload_shed(circ, env, ndev, pallas):
            **delta)
 
 
-def main():
-    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 6
-    sw = stopwatch()
-    resilience.reset()
-    # watchdog breaches and tripped probes dump the flight ring; keep
-    # the drill's dumps out of the repo working directory
-    os.environ.setdefault(
-        "QUEST_FLIGHT_FILE",
-        os.path.join(tempfile.gettempdir(),
-                     f"chaos-flight-{os.getpid()}.json"))
+#: Virtual failure-domain topology of the slice scenarios: 2 slices x
+#: 4 chips over the 8-device virtual mesh (QUEST_SLICE_SHAPE).
+SLICE_SHAPE = "2x4"
+
+
+def _comm_hits_by_fabric(circ, ndev):
+    """(first DCN-crossing, first ICI-only) mesh_exchange hit indices
+    of the observed plan under the active slice topology — so the
+    fabric drills can script their faults at exact, plan-derived hits
+    instead of guessed constants."""
+    from quest_tpu.ops.lattice import _ilog2, state_shape
+    from quest_tpu.parallel.mesh_exec import (_swap_comm_class,
+                                              item_fabric_elems)
+    from quest_tpu.scheduler import schedule_mesh
+
+    dev_bits = _ilog2(ndev)
+    lanes = state_shape(1 << N_QUBITS, ndev)[1]
+    plan = schedule_mesh(list(circ.ops), N_QUBITS, dev_bits,
+                         _ilog2(lanes))
+    cb = N_QUBITS - dev_bits
+    dcn = ici = None
+    h = 0
+    for it in plan:
+        if _swap_comm_class(it, cb) not in ("half", "full", "relayout"):
+            continue
+        _i, d = item_fabric_elems(it, N_QUBITS, dev_bits)
+        if d and dcn is None:
+            dcn = h
+        if not d and ici is None:
+            ici = h
+        h += 1
+    return dcn, ici
+
+
+def drill_slice_loss_resume(circ, env, ndev, pallas):
+    """Whole-slice loss mid-checkpointed-run on the 2-slice virtual
+    mesh: the scripted ``slice_loss:1`` must fail the exchange with a
+    typed error naming the slice and mark all 4 of its chips (and the
+    slice) DEGRADED; ``heal_run`` must then quarantine the WHOLE
+    failure domain — the surviving mesh is exactly slice 0's devices —
+    and resume BIT-IDENTICALLY to a clean run of the remaining ops on
+    those survivors, under ONE trace_id, counting
+    ``slice_loss_recovered``."""
+    if ndev < 8:
+        record("slice_loss_resume", True,
+               skipped="needs the 8-device virtual mesh (2 slices x "
+                       "4 chips)")
+        return
+    os.environ["QUEST_SLICE_SHAPE"] = SLICE_SHAPE
+    d = tempfile.mkdtemp(prefix="chaos-slice-loss-")
+    before = metrics.counters()
+    try:
+        q = qt.create_qureg(N_QUBITS, env)
+        resilience.set_fault_plan([("mesh_exchange", 2, "slice_loss:1")])
+        named_slice = False
+        try:
+            circ.run(q, pallas=pallas, checkpoint_dir=d,
+                     checkpoint_every=CKPT_EVERY)
+        except qt.QuESTTopologyError as e:
+            named_slice = "slice 1 LOST" in str(e)
+        finally:
+            resilience.clear_fault_plan()
+        lost_tid = (metrics.get_run_ledger() or {}).get(
+            "meta", {}).get("trace_id")
+        health = resilience.mesh_health()
+        rolled_up = (health["degraded_slices"] == [1]
+                     and health["degraded"] == [4, 5, 6, 7])
+        with open(os.path.join(d, "latest")) as f:
+            latest = f.read().strip()
+        pos = resilience._read_position(os.path.join(d, latest),
+                                        required=True)
+        if pos.get("ops_applied") is None:
+            record("slice_loss_resume", False,
+                   detail=f"checkpoint at item {pos.get('item_index')} "
+                          "not op-aligned — adjust the slice_loss hit")
+            return
+        _out, q2 = resilience.heal_run(circ, q, d, pallas=pallas)
+        resumed_tid = (metrics.get_run_ledger() or {}).get(
+            "meta", {}).get("trace_id")
+        got = qt.get_state_vector(q2)
+        all_dev = q.mesh.devices.reshape(-1).tolist()
+        confined = (q2.mesh.devices.reshape(-1).tolist()
+                    == all_dev[:ndev // 2])
+        # reference: restore the snapshot into a fresh surviving-slice
+        # register, canonicalise the recorded layout on the host
+        # (exact), run the remaining ops there uninterrupted
+        env_half = qt.create_env(devices=all_dev[:ndev // 2])
+        probe = qt.create_qureg(N_QUBITS, env_half)
+        resilience.load_snapshot(probe, d)
+        raw = qt.get_state_vector(probe)
+        perm = pos.get("layout") or list(range(N_QUBITS))
+        idx = np.zeros(1 << N_QUBITS, dtype=np.int64)
+        ar = np.arange(1 << N_QUBITS)
+        for b, p in enumerate(perm):
+            idx |= ((ar >> p) & 1) << b
+        canon = raw[idx]
+        fresh = qt.create_qureg(N_QUBITS, env_half)
+        qt.init_state_from_amps(fresh, canon.real.copy(),
+                                canon.imag.copy())
+        from quest_tpu.circuit import Circuit
+
+        tail = Circuit(N_QUBITS, False,
+                       ops=list(circ.ops)[int(pos["ops_applied"]):])
+        tail.run(fresh, pallas=pallas)
+        ref = qt.get_state_vector(fresh)
+        delta = counters_delta(before,
+                               ("resilience.slice_degraded",
+                                "resilience.slice_loss_recovered",
+                                "resilience.degraded_resumes"))
+        bit_identical = bool(np.array_equal(got, ref))
+        chain_intact = bool(lost_tid) and lost_tid == resumed_tid
+        ok = (named_slice and rolled_up and confined and bit_identical
+              and chain_intact
+              and delta["resilience.slice_degraded"] >= 1
+              and delta["resilience.slice_loss_recovered"] >= 1)
+        record("slice_loss_resume", ok, named_slice=named_slice,
+               rolled_up=rolled_up, confined_to_slice0=confined,
+               bit_identical=bit_identical, trace_id=resumed_tid,
+               trace_chain_intact=chain_intact,
+               from_devices=ndev, to_devices=ndev // 2,
+               ops_applied=pos["ops_applied"], **delta)
+    finally:
+        os.environ.pop("QUEST_SLICE_SHAPE", None)
+        resilience.clear_mesh_health()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def drill_dcn_straggler(circ, env, ndev, pallas):
+    """Deterministic DCN brown-out on the 2-slice virtual mesh: a
+    scripted ``dcn_flap:<ms>`` at a DCN-crossing item must breach that
+    item's DCN-PRICED budget with the refusal naming both fabrics and
+    the per-leg byte split; the SAME flap scripted at an ICI-only item
+    must be ignored entirely (no false positive — a DCN event cannot
+    touch an ICI budget); and once the breach strikes out the
+    participants, ``/healthz`` must flip to 503 NAMING the degraded
+    slices in its hierarchical body."""
+    if ndev < 8:
+        record("dcn_straggler", True,
+               skipped="needs the 8-device virtual mesh (2 slices x "
+                       "4 chips)")
+        return
+    os.environ["QUEST_SLICE_SHAPE"] = SLICE_SHAPE
+    before = metrics.counters()
+    try:
+        dcn_hit, ici_hit = _comm_hits_by_fabric(circ, ndev)
+        _warm_observed(circ, env, pallas)
+        # (a) flap at the DCN item: budget breach, fabric-split message
+        resilience.set_watchdog(True, min_s=WD_MIN_S, slack=4.0,
+                                strikes=1)
+        resilience.set_fault_plan(
+            [("mesh_exchange", dcn_hit, f"dcn_flap:{WD_DELAY_MS}")])
+        q = qt.create_qureg(N_QUBITS, env)
+        caught = named_fabric = False
+        try:
+            circ.run(q, pallas=pallas)
+        except qt.QuESTTimeoutError as e:
+            msg = str(e)
+            caught = "exceeds the expected budget" in msg
+            named_fabric = ("DCN" in msg and "ICI" in msg
+                            and "GB/s" in msg)
+        finally:
+            resilience.clear_fault_plan()
+        # the breach struck out every participant (strikes=1): the
+        # chip->slice rollup must mark the slices and /healthz must
+        # serve 503 naming them
+        health = resilience.mesh_health()
+        rolled_up = bool(health["degraded_slices"])
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import metrics_serve
+
+        server, port = metrics_serve.start_in_thread(0)
+        try:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=30) as r:
+                    code, body = r.status, _json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                code, body = e.code, _json.loads(e.read().decode())
+        finally:
+            server.shutdown()
+        healthz_flipped = (code == 503
+                           and body.get("degraded_slices")
+                           == health["degraded_slices"]
+                           and any(row.get("status") == "DEGRADED"
+                                   for row in (body.get("slices")
+                                               or {}).values()))
+        resilience.clear_mesh_health()
+        # (b) the same flap at an ICI-only item: ignored, run clean
+        no_false_positive = ici_hit is not None
+        if ici_hit is not None:
+            resilience.set_fault_plan(
+                [("mesh_exchange", ici_hit, f"dcn_flap:{WD_DELAY_MS}")])
+            q2 = qt.create_qureg(N_QUBITS, env)
+            try:
+                circ.run(q2, pallas=pallas)
+            except qt.QuESTTimeoutError:
+                no_false_positive = False
+            finally:
+                resilience.clear_fault_plan()
+    finally:
+        resilience.clear_fault_plan()
+        resilience.set_watchdog(False, strikes=-1)
+        resilience.clear_mesh_health()
+        os.environ.pop("QUEST_SLICE_SHAPE", None)
+    delta = counters_delta(before, ("resilience.watchdog_breaches",
+                                    "resilience.slice_degraded"))
+    ok = (caught and named_fabric and rolled_up and healthz_flipped
+          and no_false_positive
+          and delta["resilience.watchdog_breaches"] == 1
+          and delta["resilience.slice_degraded"] >= 1)
+    record("dcn_straggler", ok, caught=caught,
+           named_fabric_split=named_fabric, rolled_up=rolled_up,
+           healthz_503_named_slice=healthz_flipped,
+           ici_no_false_positive=no_false_positive,
+           dcn_hit=dcn_hit, ici_hit=ici_hit, **delta)
+
+
+def drill_slice_quarantine_shed(circ, env, ndev, pallas):
+    """The admission gate operates on whole failure domains: with
+    slice 1 LOST (every chip degraded, the slice rolled up) and the
+    gate armed, an incoming run must shed with a typed
+    ``QuESTOverloadError`` whose reason NAMES the degraded slice,
+    ``/readyz`` must serve the same verdict as 503 — and once the
+    domain is repaired (``clear_mesh_health``), runs are admitted
+    again, unaffected."""
+    if ndev < 8:
+        record("slice_quarantine_shed", True,
+               skipped="needs the 8-device virtual mesh (2 slices x "
+                       "4 chips)")
+        return
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_serve
+
+    os.environ["QUEST_SLICE_SHAPE"] = SLICE_SHAPE
+    before = metrics.counters()
+    supervisor.configure_gate(True, retry_after_s=3.5)
+    server, port = metrics_serve.start_in_thread(0)
+    try:
+        # lose the whole slice (the registry half of slice_loss:<s> —
+        # the typed raise is the exchange's job, not the gate's)
+        try:
+            resilience.slice_lost(1, {"ndev": ndev})
+        except qt.QuESTTopologyError:
+            pass
+        shed = named_domain = retry_hint = False
+        try:
+            circ.run(qt.create_qureg(N_QUBITS, env), pallas=pallas)
+        except qt.QuESTOverloadError as e:
+            shed = "shed_unhealthy" in str(e) and e.code == 7
+            named_domain = "slice(s) [1] DEGRADED" in str(e)
+            retry_hint = e.retry_after_s == 3.5
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=30) as r:
+                code, body = r.status, _json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            code, body = e.code, _json.loads(e.read().decode())
+        readyz_503 = code == 503 and not body["ready"] \
+            and "slice(s) [1]" in (body.get("reason") or "")
+        # domain repaired: admitted again, run unaffected
+        resilience.clear_mesh_health()
+        q2 = qt.create_qureg(N_QUBITS, env)
+        circ.run(q2, pallas=pallas)
+        admitted_after = abs(qt.calc_total_prob(q2) - 1.0) < 1e-6
+    finally:
+        server.shutdown()
+        supervisor.configure_gate(False, retry_after_s=-1.0)
+        resilience.clear_mesh_health()
+        os.environ.pop("QUEST_SLICE_SHAPE", None)
+    delta = counters_delta(before, ("supervisor.shed_unhealthy",
+                                    "resilience.slice_degraded"))
+    ok = (shed and named_domain and retry_hint and readyz_503
+          and admitted_after
+          and delta["supervisor.shed_unhealthy"] == 1
+          and delta["resilience.slice_degraded"] >= 1)
+    record("slice_quarantine_shed", ok, shed=shed,
+           named_failure_domain=named_domain,
+           retry_after_hint=retry_hint, readyz_503=readyz_503,
+           admitted_after_repair=admitted_after, **delta)
+
+
+#: The scenario matrix, in execution order: (name, needs_ref, runner).
+#: ``needs_ref`` tells the per-scenario subprocess whether to pay for
+#: the 8-device reference run (the bit-identity oracle) — scenarios
+#: that derive their own reference skip it.
+SCENARIOS = [
+    ("kill_resume", True,
+     lambda c, e, n, p, r: shutil.rmtree(
+         drill_kill_resume(c, e, p, r), ignore_errors=True)),
+    ("corrupt_slot", True,
+     lambda c, e, n, p, r: drill_corrupt_slot(c, e, p, r)),
+    ("transient_aot", False,
+     lambda c, e, n, p, r: drill_transient_aot()),
+    ("sink_failure", False,
+     lambda c, e, n, p, r: drill_sink_failure(c, e, p)),
+    ("injected_nan", False,
+     lambda c, e, n, p, r: drill_injected_nan(c, e, p)),
+    ("straggler_watchdog", False,
+     lambda c, e, n, p, r: drill_straggler_watchdog(c, e, n, p)),
+    ("degraded_resume", False,
+     lambda c, e, n, p, r: drill_degraded_resume(c, e, n, p)),
+    ("breaker_trip", False,
+     lambda c, e, n, p, r: drill_breaker_trip(c, e, n, p)),
+    ("sdc_on_wire", False,
+     lambda c, e, n, p, r: drill_sdc_on_wire(c, e, n, p)),
+    ("pipelined_wire_sdc", False,
+     lambda c, e, n, p, r: drill_pipelined_wire_sdc(c, e, n, p)),
+    ("sdc_drift", False,
+     lambda c, e, n, p, r: drill_sdc_drift(c, e, p)),
+    ("sdc_rollback", True,
+     lambda c, e, n, p, r: drill_sdc_rollback(c, e, n, p, r)),
+    ("preempt_drain", True,
+     lambda c, e, n, p, r: drill_preempt_drain(c, e, p, r)),
+    ("deadline_budget", True,
+     lambda c, e, n, p, r: drill_deadline_budget(c, e, p, r)),
+    ("overload_shed", False,
+     lambda c, e, n, p, r: drill_overload_shed(c, e, n, p)),
+    ("slice_loss_resume", False,
+     lambda c, e, n, p, r: drill_slice_loss_resume(c, e, n, p)),
+    ("dcn_straggler", False,
+     lambda c, e, n, p, r: drill_dcn_straggler(c, e, n, p)),
+    ("slice_quarantine_shed", False,
+     lambda c, e, n, p, r: drill_slice_quarantine_shed(c, e, n, p)),
+]
+
+#: Per-SCENARIO subprocess wall budget (QUEST_CHAOS_SCENARIO_TIMEOUT_S):
+#: one hung drill row — a deadlocked collective, a wedged subprocess, a
+#: watchdog that failed to fire — becomes a distinct ``timed_out``
+#: verdict on that row instead of stalling the whole matrix (the old
+#: single-process drill's failure mode).  Sized ~3x the slowest healthy
+#: row's cold-start time on the 1-core CI host.
+SCENARIO_TIMEOUT_S = int(os.environ.get(
+    "QUEST_CHAOS_SCENARIO_TIMEOUT_S", "420"))
+
+
+def _counters_doc() -> dict:
+    return {k: v for k, v in metrics.counters().items()
+            if k.startswith(("resilience.", "supervisor."))
+            or k == "metrics.sink_errors"}
+
+
+def _run_scenario(name: str, needs_ref: bool, runner) -> None:
     env, ndev = make_env()
     # a mesh plan has relayout exchanges between segments; a 1-device
     # fused plan can collapse to one item, so the single-device drill
     # uses the per-gate path for fine-grained kill points
     pallas = "auto" if ndev > 1 else False
     circ = models.qft(N_QUBITS)
-    ref = reference_state(circ, env, pallas)
+    ref = reference_state(circ, env, pallas) if needs_ref else None
+    runner(circ, env, ndev, pallas, ref)
 
-    kill_dir = drill_kill_resume(circ, env, pallas, ref)
-    shutil.rmtree(kill_dir, ignore_errors=True)
-    drill_corrupt_slot(circ, env, pallas, ref)
-    drill_transient_aot()
-    drill_sink_failure(circ, env, pallas)
-    drill_injected_nan(circ, env, pallas)
-    drill_straggler_watchdog(circ, env, ndev, pallas)
-    drill_degraded_resume(circ, env, ndev, pallas)
-    drill_breaker_trip(circ, env, ndev, pallas)
-    drill_sdc_on_wire(circ, env, ndev, pallas)
-    drill_pipelined_wire_sdc(circ, env, ndev, pallas)
-    drill_sdc_drift(circ, env, pallas)
-    drill_sdc_rollback(circ, env, ndev, pallas, ref)
-    drill_preempt_drain(circ, env, pallas, ref)
-    drill_deadline_budget(circ, env, pallas, ref)
-    drill_overload_shed(circ, env, ndev, pallas)
 
+def _child_main(rnd: int, name: str, out_path: str) -> int:
+    """One scenario in THIS process (the ``--scenario`` child mode):
+    run it, write its result rows and counter snapshot to
+    ``out_path``.  Exit 0 whether the row passed or failed — the
+    verdict lives in the rows; a nonzero exit means the scenario
+    CRASHED the harness itself."""
+    resilience.reset()
+    found = [s for s in SCENARIOS if s[0] == name]
+    if not found:
+        print(f"unknown scenario {name!r}; known: "
+              f"{[s[0] for s in SCENARIOS]}")
+        return 2
+    _nm, needs_ref, runner = found[0]
+    try:
+        _run_scenario(name, needs_ref, runner)
+    except Exception as e:  # a crash is a FAIL row, not a lost matrix
+        record(name, False, crashed=f"{type(e).__name__}: {e}")
+    with open(out_path, "w") as f:
+        json.dump({"scenarios": results, "counters": _counters_doc()},
+                  f)
+    return 0
+
+
+def _replay_row(row: dict) -> None:
+    results.append(row)
+    print(f"{'PASS' if row['ok'] else 'FAIL'} {row['scenario']:18s} "
+          + " ".join(f"{k}={v}" for k, v in row.items()
+                     if k not in ("scenario", "ok")))
+
+
+def _run_matrix(rnd: int, in_process: bool) -> dict:
+    """Execute the whole matrix and return the merged counters.
+
+    Default: every scenario is its OWN subprocess with its own
+    ``SCENARIO_TIMEOUT_S`` wall — a hung row records a distinct
+    ``timed_out`` verdict and the matrix moves on — and its own
+    process-global state (fault plans, mesh health, env knobs like
+    QUEST_SLICE_SHAPE can never leak between rows).  ``in_process``
+    keeps the old shared-process mode for debugging a single
+    machine-state interaction."""
+    merged: dict = {}
+    if in_process:
+        resilience.reset()
+        env, ndev = make_env()
+        pallas = "auto" if ndev > 1 else False
+        circ = models.qft(N_QUBITS)
+        ref = reference_state(circ, env, pallas)
+        for name, _needs_ref, runner in SCENARIOS:
+            runner(circ, env, ndev, pallas, ref)
+        return _counters_doc()
+    for name, _needs_ref, _runner in SCENARIOS:
+        with tempfile.TemporaryDirectory() as td:
+            out = os.path.join(td, "rows.json")
+            cmd = [sys.executable, os.path.abspath(__file__), str(rnd),
+                   "--scenario", name, "--out", out]
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   cwd=REPO, timeout=SCENARIO_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                # the one verdict the hung process cannot write itself
+                record(name, False, timed_out=True,
+                       timeout_s=SCENARIO_TIMEOUT_S)
+                continue
+            doc = None
+            if os.path.isfile(out):
+                try:
+                    with open(out) as f:
+                        doc = json.load(f)
+                except ValueError:
+                    doc = None
+            if doc is None:
+                tail = (r.stderr or r.stdout or "")[-300:].strip()
+                record(name, False, crashed=True, rc=r.returncode,
+                       detail=tail)
+                continue
+            for row in doc["scenarios"]:
+                _replay_row(row)
+            for k, v in (doc.get("counters") or {}).items():
+                merged[k] = merged.get(k, 0) + v
+    return merged
+
+
+def main():
+    args = [a for a in sys.argv[1:]]
+    in_process = "--in-process" in args
+    args = [a for a in args if a != "--in-process"]
+    scenario = out_path = None
+    if "--scenario" in args:
+        i = args.index("--scenario")
+        scenario = args[i + 1]
+        del args[i:i + 2]
+    if "--out" in args:
+        i = args.index("--out")
+        out_path = args[i + 1]
+        del args[i:i + 2]
+    rnd = int(args[0]) if args else 6
+    # watchdog breaches and tripped probes dump the flight ring; keep
+    # the drill's dumps out of the repo working directory
+    os.environ.setdefault(
+        "QUEST_FLIGHT_FILE",
+        os.path.join(tempfile.gettempdir(),
+                     f"chaos-flight-{os.getpid()}.json"))
+    if scenario is not None:
+        sys.exit(_child_main(rnd, scenario,
+                             out_path or os.devnull))
+    sw = stopwatch()
+    counters = _run_matrix(rnd, in_process)
     n_fail = sum(1 for r in results if not r["ok"])
+    n_timed_out = sum(1 for r in results if r.get("timed_out"))
     doc = {
         "artifact": "chaos-drill",
         # config tag for ledger_diff's config-bound rules: wall-time
@@ -839,14 +1301,22 @@ def main():
         "metric": f"chaos-q{N_QUBITS}-s{len(results)}",
         "round": rnd,
         "qubits": N_QUBITS,
-        "num_devices": ndev,
+        # the children rebuild this same environment; report what THIS
+        # host actually provides, not an assumed 8 (a <8-device host
+        # runs the mesh scenarios as skips and must say so)
+        "num_devices": make_env()[1],
         "kill_at_item": KILL_AT,
         "checkpoint_every": CKPT_EVERY,
+        "isolation": ("shared-process" if in_process
+                      else "subprocess-per-scenario"),
+        "scenario_timeout_s": SCENARIO_TIMEOUT_S,
+        "slice_shape": SLICE_SHAPE,
         "watchdog": {
             "min_s": WD_MIN_S,
             "injected_delay_ms": WD_DELAY_MS,
             "slack": 4.0,
             "gbps_default": resilience.WATCHDOG_GBPS_DEFAULT,
+            "dcn_gbps_default": resilience.WATCHDOG_DCN_GBPS_DEFAULT,
             "breaker_strikes": 2,
         },
         "integrity": {
@@ -860,18 +1330,21 @@ def main():
             "deadline_item_floor_s": DL_MIN_S,
             "gate_retry_after_s": 7.5,
         },
+        "failure_domains": {
+            "slice_degrade_chips":
+                resilience.SLICE_DEGRADE_CHIPS_DEFAULT,
+        },
         "scenarios": results,
         "failures": n_fail,
+        "timed_out": n_timed_out,
         "seconds": round(sw.seconds, 2),
-        "counters": {k: v for k, v in metrics.counters().items()
-                     if k.startswith(("resilience.", "supervisor."))
-                     or k == "metrics.sink_errors"},
+        "counters": counters,
     }
     out = os.path.join(REPO, f"CHAOS_r{rnd:02d}.json")
     with open(out, "w") as f:
         json.dump(doc, f, indent=1)
-    print(f"{len(results)} scenarios, {n_fail} failed, "
-          f"{doc['seconds']}s -> {out}")
+    print(f"{len(results)} scenarios, {n_fail} failed "
+          f"({n_timed_out} timed out), {doc['seconds']}s -> {out}")
     sys.exit(1 if n_fail else 0)
 
 

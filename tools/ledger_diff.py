@@ -78,6 +78,16 @@ DEFAULT_RULES = [
     # fires on any appearance regardless of config
     ("counters.supervisor.shed_unhealthy", +0.0, True),
     ("counters.supervisor.preempt_ckpt_failures", +0.0, False),
+    # failure-domain health, strictly regressive in both directions
+    # (config-bound like the sibling detector rules): at a fixed drill
+    # matrix the scenarios lose a FIXED number of slices, so MORE
+    # slice demotions than baseline = the chip->slice rollup grew
+    # false positives and is condemning healthy failure domains (+0
+    # cost rule), while FEWER slice-loss recoveries = the whole-slice
+    # quarantine/degraded-resume path stopped firing under injection
+    # (strictly negative — the -0.0 caveat above applies here too)
+    ("counters.resilience.slice_degraded", +0.0, True),
+    ("counters.resilience.slice_loss_recovered", -0.001, True),
     # structural / communication metrics: tight, config-independent
     ("mesh_exchange_bytes_qft30", +0.01, False),
     ("counters.exec.exchange_bytes", +0.01, False),

@@ -10,7 +10,11 @@ A stdlib-only HTTP server over the always-on telemetry layer
 * ``GET /healthz``  — JSON verdict wired to the mesh-health registry
   (``resilience.mesh_health``): HTTP 200 while no device is marked
   DEGRADED, 503 once the circuit breaker has tripped — the liveness
-  shape a serving stack points its prober at.
+  shape a serving stack points its prober at.  The body carries the
+  HIERARCHICAL failure-domain view: per-slice status (under a declared
+  ``QUEST_SLICE_SHAPE`` topology) and the ``degraded_slices`` list, so
+  a whole-slice loss is named — not just detected — from the probe
+  alone.
 * ``GET /readyz``   — the ADMISSION verdict (``quest_tpu.supervisor``):
   HTTP 200 only when the gate would admit a run right now; 503 while
   the process is draining after a preemption request, the mesh-health
@@ -82,10 +86,25 @@ class MetricsHandler(BaseHTTPRequestHandler):
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
             health = resilience.mesh_health()
-            ok = not health["degraded"]
+            degraded_slices = health.get("degraded_slices") or []
+            # 503 on ANY degraded chip (the historical verdict) and a
+            # fortiori on a DEGRADED SLICE — the body carries the
+            # hierarchical view so the prober can tell "one flaky
+            # chip" from "we lost a whole failure domain" and NAME the
+            # slice without a second query
+            ok = not health["degraded"] and not degraded_slices
             doc = {"ok": ok, "degraded": health["degraded"],
                    "strikes": health["strikes"],
-                   "strikes_to_degrade": health["strikes_to_degrade"]}
+                   "strikes_to_degrade": health["strikes_to_degrade"],
+                   "degraded_slices": degraded_slices,
+                   "chips_to_degrade_slice":
+                       health.get("chips_to_degrade_slice")}
+            if health.get("slices") is not None:
+                doc["slices"] = {
+                    s: {"status": row["status"],
+                        "degraded_chips": row["degraded_chips"],
+                        "strikes": row["strikes"]}
+                    for s, row in health["slices"].items()}
             self._send(200 if ok else 503, json.dumps(doc) + "\n",
                        "application/json")
         elif path == "/readyz":

@@ -67,6 +67,49 @@ def chaos_drill_smoke(summary, rnd) -> None:
         print(err[-1500:])
 
 
+def slice_loss_smoke(summary) -> None:
+    """Tier-2 smoke: kill a whole virtual slice mid-run and assert the
+    failure-domain recovery contract end to end — the drill's
+    ``slice_loss_resume`` scenario run through the chaos harness's own
+    per-scenario subprocess protocol: an 8-device 2-slice virtual mesh
+    loses slice 1 mid-checkpointed-run, ``heal_run`` quarantines the
+    whole domain, and the resume completes BIT-IDENTICALLY on exactly
+    the surviving slice's devices under ONE trace_id.  A broken slice
+    rollup, a quarantine that re-includes lost chips, or a resume that
+    drifts fails the recording round here instead of in the next real
+    slice preemption."""
+    import json as _json
+    import tempfile
+
+    t0 = time.time()
+    ok, detail = False, ""
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "rows.json")
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "chaos_drill.py"), "0",
+                 "--scenario", "slice_loss_resume", "--out", out],
+                capture_output=True, text=True, cwd=REPO,
+                timeout=600)
+            with open(out) as f:
+                rows = _json.load(f)["scenarios"]
+            row = rows[0] if rows else {}
+            ok = (r.returncode == 0 and row.get("ok")
+                  and row.get("bit_identical")
+                  and row.get("confined_to_slice0")
+                  and row.get("trace_chain_intact"))
+            if not ok:
+                detail = f"rc={r.returncode} row={row}"
+        except Exception as e:
+            detail = f"{type(e).__name__}: {e}"
+    secs = time.time() - t0
+    summary.append(("slice_loss", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'slice_loss':22s} {secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 def bench_gate_smoke(summary) -> None:
     """Tier-2 smoke: a small, fast bench run gated against the newest
     recorded BENCH_*.json (``bench.py --gate``, tools/ledger_diff.py
@@ -387,6 +430,7 @@ def main():
             print(out[-1500:])
             print(err[-1500:])
     bench_gate_smoke(summary)
+    slice_loss_smoke(summary)
     roofline_attr_smoke(summary)
     overlap_smoke(summary)
     metrics_serve_smoke(summary)

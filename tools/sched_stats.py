@@ -131,3 +131,40 @@ with metrics.suppressed():
               + json.dumps({k: v['items']
                             for k, v in cost['per_class'].items()},
                            sort_keys=True))
+
+# ---------------------------------------------------------------------------
+# Failure-domain fabric split: the same plan costed over a 2-slice mesh
+# ---------------------------------------------------------------------------
+# Per-fabric (ICI vs cross-slice DCN) exchange volumes of the fused
+# plan under a virtual multi-slice topology, unbiased vs with the
+# localise bias that keeps hot qubits off the cross-slice axis — the
+# planning-time view of what QUEST_SLICE_SHAPE buys before touching a
+# multi-slice deployment.  Unset (the default), every byte is ICI and
+# this section reports a single-fabric plan.
+if DEV_BITS < 1:
+    sys.exit(0)  # single-device mesh: no fabric to split
+os.environ.setdefault("MB_SLICE_SHAPE", "2x%d" % (1 << (DEV_BITS - 1)))
+_prev = os.environ.get("QUEST_SLICE_SHAPE")
+os.environ["QUEST_SLICE_SHAPE"] = os.environ["MB_SLICE_SHAPE"]
+try:
+    with metrics.suppressed():
+        fabric = {}
+        for tag, bias in (("unbiased", 0), ("dcn_biased", None)):
+            p = schedule_mesh(list(circ.ops), N, DEV_BITS, lane_bits,
+                              dcn_dev_bits=bias)
+            cost = plan_comm_cost(p, N, DEV_BITS)
+            fabric[tag] = {"exchange_elems": cost["exchange_elems"],
+                           "dcn_elems": cost["dcn_elems"],
+                           "ici_elems": (cost["exchange_elems"]
+                                         - cost["dcn_elems"])}
+    print(f"fabric split ({os.environ['MB_SLICE_SHAPE']} slices): "
+          + json.dumps(fabric, sort_keys=True))
+    u, b = fabric["unbiased"]["dcn_elems"], fabric["dcn_biased"]["dcn_elems"]
+    if u:
+        print(f"localise DCN bias moves cross-slice volume "
+              f"{u} -> {b} elems ({1.0 - b / u:+.1%} saved)")
+finally:
+    if _prev is None:
+        os.environ.pop("QUEST_SLICE_SHAPE", None)
+    else:
+        os.environ["QUEST_SLICE_SHAPE"] = _prev
