@@ -33,7 +33,9 @@ from .env import (
 )
 from .register import (
     Qureg,
+    BatchedQureg,
     create_qureg,
+    create_batched_qureg,
     create_density_qureg,
     destroy_qureg,
     get_num_qubits,

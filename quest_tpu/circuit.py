@@ -649,6 +649,229 @@ class Circuit:
         gate_runs.append(cur)
         return gate_runs, nu_ops
 
+    def as_batched_fn(self, mesh=None):
+        """The BATCHED executor (``run_batched``): a pure function over
+        an (N, rows, 2L) stack of independent same-shape registers —
+        ``jax.vmap`` over the vmap-COMPATIBLE executor path, so all N
+        members run as one compiled program per application.
+
+        Signature mirrors :meth:`as_fn` with every array grown a
+        leading member axis: ``amps -> amps``, or
+        ``(amps, keys) -> (amps, outcomes)`` with ``keys`` a stacked
+        (N, ...) array of per-member PRNG keys and ``outcomes``
+        (N, num_measurements) int32.
+
+        Routing: the fused Pallas kernels' block specs assume an
+        unbatched state, so batching routes through the
+        vmap-compatible kernel path exactly as ``sample(mode="vmap")``
+        does — the gate-at-a-time XLA kernels, per-chunk under
+        shard_map on a mesh, where a sharded-qubit gate's partner
+        fetch is one ppermute whose payload simply grows the member
+        axis.  (The scheduled-plan batched segment executor,
+        ``mesh_exec.as_batched_mesh_fn``, remains available for
+        measurement-free bulk workloads that prefer relayout-fused
+        communication over the exactness contract below.)
+
+        THE PER-MEMBER BIT-IDENTITY CONTRACT: member ``i``'s
+        amplitudes and outcomes equal the SAME inner program run
+        unbatched — and therefore never depend on how many other
+        members shared the launch — bit for bit, at every precision
+        and mesh size (pinned in tests/test_batch.py).  Every kernel
+        is a barrier-pinned region (``lax.optimization_barrier``
+        between ops): XLA's cross-op FMA contraction varies with the
+        shapes it fuses over, so an unbarriered composite's last-ulp
+        rounding would leak the batch size into a member's result.
+        Against the default fused unbatched path, outcomes are
+        identical and amplitudes agree to that same reassociation
+        tolerance."""
+        from .ops.lattice import run_kernel
+        from jax import lax as _lax
+
+        ops = list(self.ops)
+        has_nu = self._has_nonunitary
+
+        def inner(amps, key=None):
+            outcomes = []
+            for op in ops:
+                kind, statics, scalars = op
+                if kind in ("measure", "collapse"):
+                    amps, out, _ = self._nonunitary_step(
+                        amps, key, len(outcomes), op, mesh)
+                    if out is not None:
+                        outcomes.append(out)
+                else:
+                    amps = run_kernel((amps,), scalars, kind=kind,
+                                      statics=statics, mesh=mesh)
+                amps = _lax.optimization_barrier(amps)
+            if has_nu:
+                return amps, (jnp.stack(outcomes) if outcomes
+                              else jnp.zeros((0,), jnp.int32))
+            return amps
+
+        return jax.vmap(inner)
+
+    def run_batched(self, bqureg, key=None, member_keys=None):
+        """Apply to a :class:`~quest_tpu.register.BatchedQureg`: all N
+        members execute as ONE compiled program (mutating facade, like
+        :meth:`run`).  Returns the per-member measurement outcomes as
+        an (N, num_measurements) int32 array for circuits that draw
+        randomness, else the batched register.
+
+        Per-member PRNG: ``key`` (fresh from the entropy pool when
+        omitted) splits into N member keys, so member ``i`` draws
+        exactly what an unbatched run seeded with that member key
+        would; ``member_keys`` (a stacked (N, ...) key array) passes
+        explicit per-member keys instead — the serving front end
+        threads each tenant's own key through the coalesced launch.
+
+        One ledger record per call (label ``circuit_run_batched``,
+        annotated with ``batch_size``), with gates / passes / stream
+        and exchange bytes attributed at N times the per-member
+        schedule figures — the accounting scales by the batch exactly
+        as the collective payloads do.  An armed admission gate prices
+        the launch at its BATCHED cost: N in-flight slots, shed as one
+        unit (``supervisor.admit(batch=N)``).
+
+        The batched path always executes as one whole program: the
+        per-item observability/resilience modes (timeline items,
+        health probes, checkpoints, watchdogs, deadlines) are
+        per-REGISTER machinery and do not apply; an active timeline
+        capture walls the whole launch as a single ``batched-run``
+        event carrying the batch size, which is what
+        ``tools/trace_view.py`` attributes per member."""
+        from . import resilience
+        from . import supervisor
+        from .register import BatchedQureg
+
+        if not isinstance(bqureg, BatchedQureg):
+            raise _v.QuESTValidationError(
+                "Circuit.run_batched needs a BatchedQureg (use "
+                "create_batched_qureg / BatchedQureg.from_quregs); "
+                "plain registers run via Circuit.run")
+        if (bqureg.num_qubits != self.num_qubits
+                or bqureg.is_density != self.is_density):
+            raise _v.QuESTValidationError(
+                f"Circuit.run_batched: circuit over {self.num_qubits} "
+                f"qubits (density={self.is_density}) cannot run on "
+                f"{bqureg!r}")
+        n = bqureg.batch_size
+        supervisor.maybe_autoinstall()
+        outermost = metrics.run_depth() == 0
+        if outermost and not supervisor.in_recovery():
+            # batched admission: one decision, priced at N slots
+            supervisor.admit("circuit_run_batched", batch=n)
+        run_id = _tm.new_run_id()
+        with supervisor.run_scope(None, outermost=outermost, slots=n), \
+                _tm.trace_scope(_tm.current_trace_id() or run_id), \
+                metrics.run_ledger("circuit_run_batched"):
+            resilience.begin_run()
+            metrics.annotate_run("run_id", run_id)
+            metrics.annotate_run("trace_id", _tm.current_trace_id())
+            metrics.annotate_run("batch_size", n)
+            metrics.annotate_run("num_qubits", self.num_qubits)
+            metrics.annotate_run("is_density", self.is_density)
+            metrics.annotate_run(
+                "num_devices",
+                1 if bqureg.mesh is None
+                else int(bqureg.mesh.devices.size))
+            if outermost and not supervisor.in_recovery() \
+                    and supervisor.gate_enabled():
+                metrics.annotate_run("admission", "admitted")
+            try:
+                draws = (self._has_nonunitary
+                         and self.num_measurements > 0)
+                mkeys = None
+                if self._has_nonunitary:
+                    if member_keys is not None:
+                        mkeys = jnp.asarray(member_keys)
+                        if mkeys.shape[0] != n:
+                            raise _v.QuESTValidationError(
+                                f"Circuit.run_batched: member_keys has "
+                                f"{mkeys.shape[0]} keys for a batch of "
+                                f"{n}")
+                    else:
+                        if key is None:
+                            from .env import default_measure_key
+
+                            key = default_measure_key()
+                        mkeys = jax.random.split(key, n)
+                with metrics.span("compile"):
+                    fn = self._batched_compiled(bqureg.mesh)
+                self._record_batched_run_stats(bqureg)
+                wall = (metrics.timeline_span(
+                            "batched-run",
+                            args={"batch": n,
+                                  "gates": self.num_gates,
+                                  "num_qubits": self.num_qubits})
+                        if metrics.timeline_active()
+                        else contextlib.nullcontext())
+                with metrics.span("execute"), wall:
+                    if self._has_nonunitary:
+                        amps, outcomes = fn(bqureg.amps, mkeys)
+                        if metrics.timeline_active():
+                            jax.block_until_ready(amps)
+                        bqureg._set_state(amps)
+                        return outcomes if draws else bqureg
+                    amps = fn(bqureg.amps)
+                    if metrics.timeline_active():
+                        jax.block_until_ready(amps)
+                    bqureg._set_state(amps)
+                    return bqureg
+            finally:
+                metrics.annotate_run("resilience",
+                                     resilience.run_counters())
+
+    def _batched_compiled(self, mesh):
+        """Memoised jitted batched executor (per mesh + comm config +
+        op stream, like :meth:`compile`); batch-size and dtype
+        polymorphic — jit re-specialises per shape, the memo keeps the
+        function identity stable so it CAN cache."""
+        from .parallel.mesh_exec import comm_config_token
+
+        memo_key = ("batched", mesh, comm_config_token(),
+                    tuple(self.ops))
+        fn = self._compiled.get(memo_key)
+        if fn is None:
+            metrics.counter_inc("circuit.compile_cache_misses")
+            with metrics.span("schedule"):
+                fn = jax.jit(self.as_batched_fn(mesh))
+            self._compiled[memo_key] = fn
+        else:
+            metrics.counter_inc("circuit.compile_cache_hits")
+        return fn
+
+    def _record_batched_run_stats(self, bqureg) -> None:
+        """Ledger attribution of one BATCHED application: the
+        per-member schedule figures times the batch — stream and
+        exchange traffic genuinely scale by N (one program, N member
+        payloads), so the accounting says so."""
+        n = bqureg.batch_size
+        metrics.counter_inc("exec.batch_runs")
+        metrics.counter_inc("exec.batch_members", n)
+        metrics.counter_inc("exec.gates", self.num_gates * n)
+        itemsize = jnp.dtype(bqureg.real_dtype).itemsize
+        nvec = self.num_qubits * (2 if self.is_density else 1)
+        # the batched executor dispatches per recorded op: one streamed
+        # pass over every member's state per op, and — on a mesh — the
+        # gate-stream exchange model (stream_exchange_elems mirrors the
+        # kernels' xor_shift partner fetches exactly), scaled by the
+        # batch precisely as the payloads' member axis is
+        passes = len(self.ops)
+        metrics.counter_inc("exec.passes", passes * n)
+        metrics.counter_inc("exec.stream_bytes",
+                            passes * n * (1 << (nvec + 2)) * itemsize)
+        if bqureg.mesh is not None and bqureg.mesh.devices.size > 1:
+            from .ops.lattice import _ilog2
+            from .parallel.mesh_exec import stream_exchange_elems
+
+            dev_bits = _ilog2(int(bqureg.mesh.devices.size))
+            nex, elems = stream_exchange_elems(self.ops, nvec, dev_bits,
+                                               batch=n)
+            if nex:
+                metrics.counter_inc("exec.gate_exchanges", nex * n)
+                metrics.counter_inc("exec.exchange_bytes",
+                                    elems * itemsize)
+
     def compile(self, mesh=None, donate: bool = True, pallas: str = "auto"):
         """One XLA program for the whole circuit.  ``donate`` reuses the
         input amplitude buffers (the reference's in-place update semantics,
@@ -750,20 +973,22 @@ class Circuit:
         return st
 
     #: ``sample(mode="auto")`` picks vmap while the concurrent shot
-    #: states fit this many bytes (shots x one (re, im) pair); beyond
-    #: it, the sequential collapse-replay mode keeps memory at ONE
-    #: state regardless of shot count.
+    #: states fit this many bytes (batch x shots x one (re, im) pair);
+    #: beyond it, the sequential collapse-replay mode keeps memory at
+    #: ONE state regardless of shot count.
     SAMPLE_VMAP_BYTES = 2 << 30
 
-    def sample(self, shots: int, key=None, dtype=None, mode: str = "auto"):
+    def sample(self, shots: int, key=None, dtype=None,
+               mode: str = "auto", batch: int = 1):
         """Run ``shots`` independent executions of the circuit from
         |0...0> and return the measurement outcomes as an int32 array of
         shape (shots, num_measurements).  Memory: ``mode="vmap"`` holds
         shots x 2^n amplitudes concurrently (fastest for small states);
         ``mode="sequential"`` holds ONE state pair at any shot count
         (the state lives in a ``fori_loop`` carry that XLA keeps in
-        place), so it samples at any size a single state fits; ``mode="auto"`` picks vmap only
-        while shots x state fits ``SAMPLE_VMAP_BYTES``.
+        place), so it samples at any size a single state fits;
+        ``mode="auto"`` picks vmap only while batch x shots x state
+        fits ``SAMPLE_VMAP_BYTES``.
 
         Two TPU-native shot-batching strategies the reference cannot
         express (it re-enters the C API per gate per shot with a host
@@ -783,8 +1008,19 @@ class Circuit:
           pair regardless of shot count, so sampling works at any size
           a single state fits (30 qubits f32 on one v5e) — still with
           no host sync inside the loop.
-        * ``mode="auto"`` (default): vmap while shots x state fits
-          ``SAMPLE_VMAP_BYTES``, else sequential.
+        * ``mode="auto"`` (default): vmap while batch x shots x state
+          fits ``SAMPLE_VMAP_BYTES``, else sequential.
+
+        ``batch`` samples ``batch`` independent shot-sets in the same
+        program — the batched-register serving path's sampler (one
+        member axis, one compiled program) — returning shape
+        (batch, shots, num_measurements) when ``batch > 1``.  The
+        ``"auto"`` heuristic is BATCH-AWARE: the vmap sampler holds
+        batch x shots concurrent states, so the memory comparison
+        multiplies the batch in — a batched caller can never be handed
+        a vmap sampler that cannot fit (ISSUE 14's threshold fix: the
+        old comparison priced a single shot-set regardless of any
+        leading batch axis).
 
         Requires at least one recorded ``measure``.
         """
@@ -799,6 +1035,14 @@ class Circuit:
             raise _v.QuESTValidationError("Circuit.sample: shots must be an integer")
         if shots < 1:
             raise _v.QuESTValidationError("Circuit.sample: shots must be >= 1")
+        try:
+            batch = operator.index(batch)
+        except TypeError:
+            raise _v.QuESTValidationError(
+                "Circuit.sample: batch must be an integer")
+        if batch < 1:
+            raise _v.QuESTValidationError(
+                f"Circuit.sample: batch must be >= 1, got {batch}")
         if mode not in ("auto", "vmap", "sequential"):
             raise _v.QuESTValidationError(
                 "Circuit.sample: mode must be 'auto', 'vmap' or "
@@ -810,9 +1054,12 @@ class Circuit:
         dtype = jnp.dtype(dtype or _prec.default_real_dtype())
         nvec = self.num_qubits * (2 if self.is_density else 1)
         shape = amps_shape(1 << nvec)
+        total = batch * shots
         if mode == "auto":
+            # batch-aware: the vmap sampler's concurrent states are
+            # batch x shots deep, and that product is what must fit
             pair_bytes = 2 * (1 << nvec) * dtype.itemsize
-            mode = ("vmap" if shots * pair_bytes <= self.SAMPLE_VMAP_BYTES
+            mode = ("vmap" if total * pair_bytes <= self.SAMPLE_VMAP_BYTES
                     else "sequential")
         # Memoised like compile(): jit caches on function identity, so a
         # fresh closure per call would re-trace and re-compile the whole
@@ -820,7 +1067,7 @@ class Circuit:
         # shots-polymorphic (the batch is an input); the sequential one
         # burns the trip count into its fori_loop.
         memo_key = ("sample", tuple(self.ops), dtype.name, mode,
-                    shots if mode == "sequential" else None)
+                    total if mode == "sequential" else None)
         sampler = self._compiled.get(memo_key)
         if sampler is None:
             if mode == "vmap":
@@ -850,6 +1097,8 @@ class Circuit:
                       else self.as_fn(mesh=None))
                 n_m = self.num_measurements
 
+                n_total = total
+
                 def body(shot, carry):
                     amps, outs, k = carry
                     k, sub = jax.random.split(k)
@@ -859,9 +1108,9 @@ class Circuit:
 
                 def seq(k):
                     amps0 = jnp.zeros(shape, dtype)
-                    outs0 = jnp.zeros((shots, n_m), jnp.int32)
+                    outs0 = jnp.zeros((n_total, n_m), jnp.int32)
                     _, outs, _ = lax.fori_loop(
-                        0, shots, body, (amps0, outs0, k))
+                        0, n_total, body, (amps0, outs0, k))
                     return outs
 
                 jitted = jax.jit(seq)
@@ -871,7 +1120,11 @@ class Circuit:
 
             self._compiled[memo_key] = call
             sampler = call
-        return sampler(key, shots)
+        out = sampler(key, total)
+        # batch > 1: batch-major member axis (batch, shots, n_meas) —
+        # member b's shots are the contiguous slice [b*shots, (b+1)*shots)
+        # of the flat draw order, so batch=1 results are byte-stable
+        return out.reshape(batch, shots, -1) if batch > 1 else out
 
     def _observed_fn(self, qureg, pallas, ckpt=None, resume=None,
                      key=None):

@@ -535,8 +535,23 @@ def export_text() -> str:
         "supervisor.inflight": supervisor.inflight(),
         "supervisor.gate_enabled": 1 if supervisor.gate_enabled() else 0,
     }
-    return telemetry.render_prometheus(counters(), histograms(),
-                                       gauges=gauges)
+    # batched-serving gauges (quest_batch_*): whether the coalescing
+    # front end is actually ENGAGING in production — the member count
+    # of the coalesced launches executing right now, plus the
+    # coalesced-vs-solo launch split and the members those coalesced
+    # launches carried (mirrors of the supervisor.* counters, exported
+    # as gauges so a dashboard can plot occupancy without rate()
+    # math).  ONE counter snapshot feeds both the mirrors and the
+    # rendered counters, so a scrape can never disagree with itself
+    c = counters()
+    gauges.update({
+        "batch.occupancy": supervisor.batch_occupancy(),
+        "batch.coalesced_launches": c.get("supervisor.batch_launches",
+                                          0),
+        "batch.solo_launches": c.get("supervisor.solo_launches", 0),
+        "batch.members": c.get("supervisor.batch_members", 0),
+    })
+    return telemetry.render_prometheus(c, histograms(), gauges=gauges)
 
 
 # ---------------------------------------------------------------------------
@@ -638,11 +653,14 @@ TIMELINE_COMM_KINDS = frozenset({
 
 #: Timeline kinds that stream the state through the compute units,
 #: including the pipelined exchange's gather/merge legs — the compute
-#: that HIDES the wire.
+#: that HIDES the wire — and the whole-launch span of a batched
+#: multi-register execution (``Circuit.run_batched`` walls its one
+#: compiled program as a single ``batched-run`` event carrying the
+#: batch size; ``tools/trace_view.py`` attributes it per member).
 TIMELINE_COMPUTE_KINDS = frozenset({
     "pallas-pass", "xla-segment", "stream", "xla-stream",
     "bitswap-gather", "bitswap-merge",
-    "relayout-gather", "relayout-merge"})
+    "relayout-gather", "relayout-merge", "batched-run"})
 
 
 def timeline_comm_overlap(events=None) -> dict:

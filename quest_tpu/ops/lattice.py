@@ -278,6 +278,36 @@ class Lattice:
         return lax.all_gather(x, self.axis, tiled=True)
 
 
+def _register_barrier_batch_rule() -> None:
+    """Compat shim: give ``lax.optimization_barrier`` the trivial
+    identity batching rule newer jax versions ship natively, so the
+    kernels' miscompile-guard barriers (see ``Lattice.xor_shift``)
+    compose with ``jax.vmap`` — the batched multi-register executor
+    (``Circuit.run_batched``) vmaps the whole kernel path over a
+    leading member axis.  A barrier is semantically the identity per
+    operand, so applying it to the batched operands with the batch
+    dims unchanged is exact; installed only when the running jax has
+    no rule of its own."""
+    try:
+        from jax.interpreters import batching as _batching
+        from jax._src.lax.lax import optimization_barrier_p as _ob_p
+    except ImportError:  # pragma: no cover - future jax relayouts
+        return
+    if _ob_p in _batching.primitive_batchers:
+        return  # native rule present: never shadow it
+
+    def _rule(args, dims):
+        out = _ob_p.bind(*args)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return out, dims
+
+    _batching.primitive_batchers[_ob_p] = _rule
+
+
+_register_barrier_batch_rule()
+
+
 def shard_map_compat(body, mesh, in_specs, out_specs):
     """``jax.shard_map`` across jax versions: the top-level spelling
     (with ``check_vma``) landed after 0.4.x; older versions expose it as
@@ -432,3 +462,16 @@ def amp_sharding(mesh: Mesh | None):
         return None
     (axis,) = mesh.axis_names
     return NamedSharding(mesh, P(axis))
+
+
+def batched_amp_sharding(mesh: Mesh | None):
+    """NamedSharding for batched (N, S, 2L) amplitude stacks on
+    ``mesh``: the member (batch) axis is replicated structure — every
+    device holds ALL members' share of the row axis — and the row axis
+    shards exactly as :func:`amp_sharding` does, so a batched stack is
+    N interleaved chunks per device and every collective payload grows
+    a leading member axis (``quest_tpu.register.BatchedQureg``)."""
+    if mesh is None:
+        return None
+    (axis,) = mesh.axis_names
+    return NamedSharding(mesh, P(None, axis))

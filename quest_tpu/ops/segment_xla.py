@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import numpy as np
 import jax.numpy as jnp
+from jax import lax
 
 from .lattice import Lattice, _ilog2, merge_amps, split_amps
 from .pallas_kernels import _X_MAT
@@ -148,7 +149,7 @@ def _chan(r, i, lat, tag, bits, sc, dtype):
 
 
 def apply_segment_xla(amps, seg_ops: tuple, high_bits: tuple = (),
-                      dev_flags=None):
+                      dev_flags=None, barrier: bool = False):
     """Pure-XLA equivalent of ``apply_fused_segment`` on one chunk.
 
     ``amps`` is the interleaved (rows, 2L) chunk; the (re, im) halves
@@ -157,7 +158,32 @@ def apply_segment_xla(amps, seg_ops: tuple, high_bits: tuple = (),
     before the result leaves the program.  ``high_bits`` only
     determines the 2x2pair axis->bit mapping; the chunk is processed
     whole, so exposure is irrelevant here.
+
+    A LEADING BATCH AXIS is accepted natively: an (N, rows, 2L) stack
+    of independent same-shape chunks applies the segment to every
+    member via ``jax.vmap`` — every op here is elementwise or a
+    member-local contraction, so batching is value-preserving and each
+    member's result is bit-identical to the unbatched application
+    (this is what makes this executor the batched multi-register
+    path's segment backend; the Pallas kernels' block specs assume an
+    unbatched state and cannot batch).
+
+    ``barrier=True`` pins every op's result as a real value
+    (``lax.optimization_barrier`` between ops): XLA's cross-op FMA
+    contraction varies with the array shapes it fuses over, so an
+    UNBARRIERED segment's last-ulp rounding can depend on the batch
+    size riding the leading axis.  The batched executor builds with
+    barriers so a member's amplitudes never depend on how many other
+    members shared its launch (the batch-size-invariance contract,
+    pinned in tests/test_batch.py); the unbatched default path keeps
+    full fusion and is byte-stable.
     """
+    if amps.ndim == 3:
+        import jax
+
+        return jax.vmap(lambda a: apply_segment_xla(
+            a, seg_ops, high_bits, dev_flags=dev_flags,
+            barrier=barrier))(amps)
     re, im = split_amps(amps)
     lat = Lattice.for_array(re, None, 1)
     lanes = re.shape[1]
@@ -279,4 +305,6 @@ def apply_segment_xla(amps, seg_ops: tuple, high_bits: tuple = (),
             re, im = _chan(re, im, lat, tag, bits, sc, dtype)
         else:
             raise ValueError(kind)
+        if barrier:
+            re, im = lax.optimization_barrier((re, im))
     return merge_amps(re, im)

@@ -1439,11 +1439,19 @@ def plan_comm_stats(plan, num_vec_bits: int, dev_bits: int):
     return {"swaps": swaps, "chunk_volume": vol}
 
 
-def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int):
+def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int, *,
+                        batch: int = 1):
     """STORAGE elements (entries of the interleaved amplitude array) a
     plan's relayouts actually move over the interconnect, summed over
     every device (multiply by the dtype itemsize for bytes — the run
-    ledger's ``exec.exchange_bytes``).  Re-derived from the one-array
+    ledger's ``exec.exchange_bytes``).
+
+    ``batch`` scales the accounting for a BATCHED application
+    (``Circuit.run_batched``): every collective payload grows a
+    leading member axis, so a batch of N moves exactly N times the
+    elements of one member — the per-member figure generalises, it
+    never changes, and every historical byte pin (recorded at the
+    default ``batch=1``) holds exactly.  Re-derived from the one-array
     layout: an interleaved chunk is 2^(chunk_bits+1) elements, and
     every payload carries both components natively — the totals equal
     the split layout's "both arrays" accounting, so historical pins
@@ -1477,7 +1485,51 @@ def plan_exchange_elems(plan, num_vec_bits: int, dev_bits: int):
             elems += (ndev // 2) * s_chunk   # full chunk, half the devs
         else:
             elems += ndev * (s_chunk // 2)   # half chunk, every device
-    return relayouts, elems
+    return relayouts, elems * max(int(batch), 1)
+
+
+def stream_exchange_elems(ops, num_vec_bits: int, dev_bits: int, *,
+                          batch: int = 1):
+    """Exchange accounting of ONE gate-at-a-time application over a
+    mesh — the ``Circuit.run_batched`` executor's comm model (the
+    vmap-compatible kernel path dispatches per recorded op; a
+    sharded-qubit gate's partner fetch is ``Lattice.xor_shift``'s
+    device branch: one ppermute of the whole shifted component per
+    device).  Mirrors the kernel bodies exactly: ``apply_2x2`` fetches
+    its target mask, the ``dm_chan`` tags fetch their per-round pair
+    masks (``depol``/``damp`` one, ``depol2`` three), and
+    phases/controls/measure/collapse never move amplitudes.  Each
+    dev-bit fetch moves both components of every device's chunk —
+    ``ndev * 2^(chunk_bits+1)`` storage elements.  ``batch`` scales by
+    the member count exactly as ``plan_exchange_elems(batch=)`` does
+    (the payloads grow a leading member axis, nothing else changes).
+    Returns ``(exchanges, elems)``."""
+    if dev_bits <= 0:
+        return 0, 0
+    ndev = 1 << dev_bits
+    chunk_bits = num_vec_bits - dev_bits
+    s_chunk = 1 << (chunk_bits + 1)
+
+    def fetch_masks(op):
+        kind, statics, _sc = op
+        if kind == "apply_2x2":
+            return [1 << statics[0]]
+        if kind == "dm_chan":
+            tag, bits = statics[0], statics[1:]
+            if tag in ("depol", "damp"):
+                a, aN = bits
+                return [(1 << a) | (1 << aN)]
+            if tag == "depol2":
+                a, aN, b, bN = bits
+                t1 = (1 << a) | (1 << aN)
+                t2 = (1 << b) | (1 << bN)
+                return [t1, t2, t1 | t2]
+        return []
+
+    exchanges = sum(1 for op in ops for m in fetch_masks(op)
+                    if m >> chunk_bits)
+    return exchanges, (exchanges * ndev * s_chunk
+                       * max(int(batch), 1))
 
 
 def item_fabric_elems(item, num_vec_bits: int, dev_bits: int,
@@ -1554,7 +1606,8 @@ def plan_fabric_elems(plan, num_vec_bits: int, dev_bits: int,
 def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
                      interpret: bool = False, backend: str = "pallas",
                      per_item: bool = False, donate: bool = True,
-                     item_hook=None, op_base: int = 0):
+                     item_hook=None, op_base: int = 0,
+                     batch_stable: bool = False):
     """A pure ``amps -> amps`` function running the recorded ops as
     fused segments inside shard_map over ``mesh``, with relayout
     half-exchanges for sharded-qubit gates.  Input and output arrays
@@ -1593,12 +1646,66 @@ def as_mesh_fused_fn(ops, num_vec_bits: int, mesh: Mesh,
     which checkpoint sidecars record for degraded-mesh resume."""
     return _mesh_plan_fn(ops, num_vec_bits, mesh, interpret, backend,
                          per_item=per_item, donate=donate,
-                         item_hook=item_hook, op_base=op_base)
+                         item_hook=item_hook, op_base=op_base,
+                         batch_stable=batch_stable)
+
+
+def as_batched_mesh_fn(ops, num_vec_bits: int, mesh: Mesh,
+                       backend: str = "xla"):
+    """BATCHED mesh executor (``Circuit.run_batched``): a pure
+    ``amps -> amps`` function over an (N, rows, 2L) stack of
+    independent same-shape registers — ``jax.vmap`` over the
+    whole-plan program of :func:`as_mesh_fused_fn`, so all N members
+    run as ONE compiled program per application.
+
+    The vmap lifts every collective payload by a leading member axis
+    (one ppermute still moves one payload — now N sub-payloads deep),
+    and every plan item's exchange volume scales by exactly N
+    (``plan_exchange_elems(..., batch=N)`` — the accounting
+    generalises, it never changes, so the per-member byte pins hold).
+    ``backend`` defaults to ``"xla"`` (``apply_segment_xla``): the
+    vmap-compatible segment executor — the Pallas kernels' block
+    specs assume an unbatched state and cannot batch.  Batching is
+    value-preserving: member ``i`` of the result is bit-identical to
+    the unbatched program applied to member ``i`` alone (pinned in
+    tests/test_batch.py at f32/f64 across mesh sizes).
+
+    Ledger accounting: a concrete (non-traced) call records the
+    batch-scaled mesh counters; under an outer jit trace the caller
+    attributes from ``fn.plan_stats`` (per-member figures) times its
+    batch size instead, exactly as the unbatched path does."""
+    mfn = _mesh_plan_fn(ops, num_vec_bits, mesh, interpret=False,
+                        backend=backend, per_item=False,
+                        batch_stable=True)
+    vfn = jax.vmap(mfn)
+    st = mfn.plan_stats
+
+    def fn(amps):
+        if not isinstance(amps, jax.core.Tracer):
+            n = int(amps.shape[0])
+            metrics.counter_inc("mesh.batch_executions")
+            metrics.counter_inc("mesh.passes", st["passes"] * n)
+            metrics.counter_inc("mesh.relayouts", st["relayouts"] * n)
+            metrics.counter_inc(
+                "mesh.exchange_bytes",
+                st["exchange_elems"] * n * amps.dtype.itemsize)
+        return vfn(amps)
+
+    fn.plan_stats = st  # per-member: scale by the batch at attribution
+    return fn
 
 
 def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
                   backend: str, per_item: bool, donate: bool = True,
-                  item_hook=None, op_base: int = 0):
+                  item_hook=None, op_base: int = 0,
+                  batch_stable: bool = False):
+    """``batch_stable=True`` (the batched executor's build): every
+    plan item's result — and every seg op's, inside the xla segment
+    backend — is pinned with ``lax.optimization_barrier`` so XLA's
+    shape-dependent cross-op FMA contraction cannot make a member's
+    rounding depend on the batch size sharing its program (the
+    batch-size-invariance contract; see ``apply_segment_xla``).  The
+    default build keeps full fusion and stays byte-stable."""
     from ..scheduler import plan_layouts, schedule_mesh
     from ..ops.segment_xla import apply_segment_xla
 
@@ -1651,7 +1758,8 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
                 ).astype(amps.dtype).reshape(1, -1)
             if backend == "xla":
                 return apply_segment_xla(amps, seg_ops, high,
-                                         dev_flags=flags)
+                                         dev_flags=flags,
+                                         barrier=batch_stable)
             return apply_fused_segment(amps, seg_ops, high,
                                        interpret=interpret,
                                        dev_flags=flags)
@@ -1783,6 +1891,8 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
     def body(amps):
         for item in plan:
             amps = item_body(item, amps)
+            if batch_stable:
+                amps = lax.optimization_barrier(amps)
         return amps
 
     def fn(amps):

@@ -1073,6 +1073,198 @@ def destroy_qureg(qureg: Qureg, env: QuESTEnv | None = None) -> None:
     qureg.amps = None
 
 
+# ---------------------------------------------------------------------------
+# Batched multi-register execution (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+class BatchedQureg:
+    """N independent same-shape registers stacked on a LEADING member
+    axis of one interleaved array — storage shape (N, rows, 2L), with
+    the row axis sharded exactly as a single register's
+    (``lattice.batched_amp_sharding``: every device holds all N
+    members' share of its chunk).
+
+    This is the throughput half of the serving stack
+    (``supervisor.serve``'s coalescing mode): N admitted same-circuit
+    requests execute as ONE compiled program per application
+    (``Circuit.run_batched`` — ``jax.vmap`` over the member axis of
+    the vmap-compatible executor path), with per-member PRNG keys and
+    measurement outcomes, and every mesh collective payload carrying
+    the member axis natively.  PR 6's single interleaved ``_amps``
+    layout is what makes the member axis a plain leading dimension: no
+    member is ever copied, split, or re-stacked.
+
+    Unlike :class:`Qureg` there is no deferred eager gate stream —
+    batched registers exist to be driven by compiled circuits, so the
+    API is deliberately small: create (``create_batched_qureg`` /
+    ``BatchedQureg.from_quregs``), run (``Circuit.run_batched``),
+    read members out (:meth:`member` / :meth:`member_amps`)."""
+
+    __slots__ = ("_amps", "batch_size", "num_qubits", "is_density",
+                 "mesh")
+
+    def __init__(self, amps, batch_size: int, num_qubits: int,
+                 is_density: bool, mesh):
+        self._amps = amps
+        self.batch_size = batch_size
+        self.num_qubits = num_qubits
+        self.is_density = is_density
+        self.mesh = mesh
+
+    # -- shape bookkeeping (per MEMBER, mirroring Qureg) ----------------
+    @property
+    def amps(self):
+        """The batched interleaved (N, rows, 2L) state array."""
+        return self._amps
+
+    def _set_state(self, amps) -> None:
+        self._amps = amps
+
+    @property
+    def num_vec_qubits(self) -> int:
+        return self.num_qubits * (2 if self.is_density else 1)
+
+    @property
+    def num_amps(self) -> int:
+        """Amplitudes of ONE member (the batch holds batch_size x this)."""
+        return 1 << self.num_vec_qubits
+
+    @property
+    def real_dtype(self):
+        return self._amps.dtype
+
+    @property
+    def storage_shape(self) -> tuple[int, int, int]:
+        """Stored (N, rows, 2L) shape of the whole batch."""
+        return self._amps.shape
+
+    # -- member access ---------------------------------------------------
+    def _validate_member(self, i: int) -> int:
+        import operator
+
+        try:
+            i = operator.index(i)
+        except TypeError:
+            raise QuESTValidationError(
+                "BatchedQureg: member index must be an integer")
+        if not 0 <= i < self.batch_size:
+            raise QuESTValidationError(
+                f"BatchedQureg: member index {i} out of range for "
+                f"batch of {self.batch_size}")
+        return i
+
+    def member_amps(self, i: int):
+        """Member ``i``'s interleaved (rows, 2L) state — a copy,
+        resharded to the single-register row sharding so it drops into
+        any unbatched code path."""
+        i = self._validate_member(i)
+        sh = amp_sharding(self.mesh)
+        member = self._amps[i]
+        return member if sh is None else jax.device_put(member, sh)
+
+    def member(self, i: int) -> Qureg:
+        """A fresh :class:`Qureg` holding a COPY of member ``i``'s
+        state (the batch itself is not aliased: serving readout must
+        never let one tenant's register mutate another's)."""
+        q = Qureg(self.member_amps(i), self.num_qubits,
+                  self.is_density, self.mesh)
+        qasm.setup(q)
+        return q
+
+    def to_quregs(self) -> list[Qureg]:
+        """Every member as its own register (see :meth:`member`)."""
+        return [self.member(i) for i in range(self.batch_size)]
+
+    @classmethod
+    def from_quregs(cls, quregs) -> "BatchedQureg":
+        """Stack existing same-shape registers into a batch (each
+        member a copy of the corresponding register's current state —
+        deferred gate streams flush via the ``amps`` reads)."""
+        quregs = list(quregs)
+        if not quregs:
+            raise QuESTValidationError(
+                "BatchedQureg.from_quregs: need at least one register")
+        q0 = quregs[0]
+        for q in quregs[1:]:
+            if (q.num_qubits != q0.num_qubits
+                    or q.is_density != q0.is_density
+                    or q.mesh is not q0.mesh
+                    or q.real_dtype != q0.real_dtype):
+                raise QuESTValidationError(
+                    "BatchedQureg.from_quregs: members must share "
+                    "qubit count, kind, dtype and mesh (got "
+                    f"{q!r} vs {q0!r})")
+        from .ops.lattice import batched_amp_sharding
+
+        stacked = jnp.stack([q.amps for q in quregs])
+        sh = batched_amp_sharding(q0.mesh)
+        if sh is not None:
+            stacked = jax.device_put(stacked, sh)
+        return cls(stacked, len(quregs), q0.num_qubits, q0.is_density,
+                   q0.mesh)
+
+    def __repr__(self):
+        kind = "density-matrix" if self.is_density else "state-vector"
+        return (f"BatchedQureg({self.batch_size} x {kind}, "
+                f"{self.num_qubits} qubits, {self._amps.dtype.name}, "
+                f"mesh={None if self.mesh is None else self.mesh.shape})")
+
+
+@lru_cache(maxsize=64)
+def _batched_init_builder(batch: int, shape: tuple[int, int], dtype,
+                          mesh):
+    """Jitted |0...0>^N builder for a fresh batch, cached per config
+    (the serving front end creates one batch per coalesced launch, so
+    repeated configs must not re-trace)."""
+    from .ops.lattice import batched_amp_sharding
+
+    sh = batched_amp_sharding(mesh)
+
+    def build():
+        amps = jnp.zeros((batch, shape[0], 2 * shape[1]), dtype)
+        # storage element (i, 0, 0) is member i's real amplitude 0:
+        # |0...0> for state-vectors and |0><0| for density matrices
+        return amps.at[:, 0, 0].set(1)
+
+    kw = {} if sh is None else {"out_shardings": sh}
+    return jax.jit(build, **kw)
+
+
+def create_batched_qureg(num_qubits: int, env: QuESTEnv, batch: int,
+                         *, is_density: bool = False,
+                         dtype=None) -> BatchedQureg:
+    """Create ``batch`` independent registers in |0...0> stacked on a
+    leading member axis (see :class:`BatchedQureg`).  Sharding and
+    shape validation match :func:`create_qureg` member-for-member —
+    the batch changes per-device MEMORY (N chunks per device), never
+    per-device shape."""
+    import operator
+
+    validate_create_num_qubits(num_qubits)
+    try:
+        batch = operator.index(batch)
+    except TypeError:
+        raise QuESTValidationError(
+            "create_batched_qureg: batch must be an integer")
+    if batch < 1:
+        raise QuESTValidationError(
+            f"create_batched_qureg: batch must be >= 1, got {batch}")
+    dtype = jnp.dtype(dtype or precision.default_real_dtype())
+    nvec = num_qubits * (2 if is_density else 1)
+    ndev = env.num_devices
+    min_bits = num_qubits if is_density else 0
+    if ndev > 1 and (1 << nvec) // ndev < (1 << min_bits):
+        raise QuESTValidationError(
+            f"cannot shard {num_qubits}-qubit batched "
+            f"{'density matrix' if is_density else 'state-vector'} "
+            f"over {ndev} devices: chunks would be smaller than "
+            f"2^{min_bits} amps")
+    shape = state_shape(1 << nvec, ndev)
+    amps = _batched_init_builder(batch, shape, dtype, env.mesh)()
+    return BatchedQureg(amps, batch, num_qubits, is_density, env.mesh)
+
+
 def get_num_qubits(qureg: Qureg) -> int:
     return qureg.num_qubits
 

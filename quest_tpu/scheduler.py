@@ -590,7 +590,8 @@ def schedule_mesh(ops, num_vec_bits: int, dev_bits: int, lane_bits: int,
 
 
 def plan_comm_cost(plan, num_vec_bits: int, dev_bits: int,
-                   subblocks: int | None = None) -> dict:
+                   subblocks: int | None = None,
+                   batch: int = 1) -> dict:
     """Overlap-aware comm-class costing of a mesh plan — the
     scheduler-side MODEL of what the pipelined collectives buy (the
     measured figure is the timeline's ``comm_hidden_frac``; this is
@@ -616,9 +617,16 @@ def plan_comm_cost(plan, num_vec_bits: int, dev_bits: int,
     touching a chip (``tools/sched_stats.py`` renders the split).  On
     a single-slice mesh every ``dcn_elems`` is 0.
 
+    ``batch`` scales every volume row for a BATCHED application (the
+    multi-register executors: each collective payload grows a leading
+    member axis, so a batch of N moves exactly N times one member's
+    elements — ``mesh_exec.plan_exchange_elems(batch=)``'s accounting,
+    projected into this cost model; the per-item structure, comm
+    classes and hidden-fraction model are batch-invariant).
+
     Returns ``{"per_class": {cls: {"items", "exchange_elems",
     "dcn_elems", "exposed_elems"}}, "exchange_elems", "dcn_elems",
-    "exposed_elems", "hidden_frac_model"}``."""
+    "exposed_elems", "hidden_frac_model", "batch"}``."""
     from . import env as _env
     from .parallel.mesh_exec import (_swap_comm_class,
                                      item_fabric_elems, item_subblocks,
@@ -652,12 +660,19 @@ def plan_comm_cost(plan, num_vec_bits: int, dev_bits: int,
         total += elems
         dcn_total += dcn
         exposed += exp
+    batch = max(int(batch), 1)
+    if batch > 1:
+        for row in per_class.values():
+            row["exchange_elems"] *= batch
+            row["dcn_elems"] *= batch
+            row["exposed_elems"] *= batch
     return {"per_class": per_class,
-            "exchange_elems": int(total),
-            "dcn_elems": int(dcn_total),
-            "exposed_elems": exposed,
+            "exchange_elems": int(total) * batch,
+            "dcn_elems": int(dcn_total) * batch,
+            "exposed_elems": exposed * batch,
             "hidden_frac_model": (1.0 - exposed / total) if total
-            else 0.0}
+            else 0.0,
+            "batch": batch}
 
 
 def compose_swap_perm(run, num_vec_bits: int, perm=None):

@@ -457,7 +457,7 @@ def inflight() -> int:
         return _inflight[0]
 
 
-def _evaluate_gate(reserve: bool = False):
+def _evaluate_gate(reserve_n: int = 0):
     """The admission decision, shared by :func:`admit` and
     :func:`readiness`: returns ``(ok, reason, shed_kind)`` where
     ``shed_kind`` is the counter suffix (``shed_unhealthy`` /
@@ -466,12 +466,15 @@ def _evaluate_gate(reserve: bool = False):
     concurrency cap, then the live p99-vs-SLO comparison from the SLO
     histograms (PR 8's ``run.wall_s.<label>``).
 
-    ``reserve`` (the :func:`admit` path) takes the in-flight slot
-    ATOMICALLY with the cap check — check-then-increment under one
-    lock acquisition, released again if a later check sheds — so
+    ``reserve_n`` (the :func:`admit` path) takes that many in-flight
+    slots ATOMICALLY with the cap check — check-then-increment under
+    one lock acquisition, released again if a later check sheds — so
     concurrent admits can never overshoot ``max_inflight``;
     :func:`run_scope` then consumes the reservation instead of
-    incrementing a second time."""
+    incrementing a second time.  A BATCHED launch reserves its whole
+    member count in one decision (admission pricing reads the batched
+    cost): N coalesced runs hold N slots, and a batch that cannot fit
+    under the cap sheds as one unit."""
     from . import resilience  # deferred: resilience imports metrics
 
     health = resilience.mesh_health()
@@ -483,32 +486,36 @@ def _evaluate_gate(reserve: bool = False):
                        + (f" (whole failure domain(s): slice(s) "
                           f"{slices} DEGRADED)" if slices else ""),
                 "shed_unhealthy")
-    reserved = False
+    reserved = 0
     cap = max_inflight()
+    need = max(int(reserve_n), 0)
     with _lock:
         n = _inflight[0]
-        if cap is not None and n >= cap:
-            return (False, f"concurrency cap saturated ({n} in flight "
-                           f">= cap {cap})", "shed_overload")
-        if reserve:
-            _inflight[0] += 1
-            reserved = True
+        if cap is not None and n + max(need, 1) > cap:
+            what = (f"batch of {need} would exceed cap {cap} "
+                    f"({n} in flight)" if need > 1 else
+                    f"{n} in flight >= cap {cap}")
+            return (False, f"concurrency cap saturated ({what})",
+                    "shed_overload")
+        if need:
+            _inflight[0] += need
+            reserved = need
     slo = slo_p99_s()
     if slo is not None:
         h = metrics.histograms().get(f"run.wall_s.{slo_label()}")
         if h and h["count"] and h["p99"] is not None and h["p99"] > slo:
             if reserved:
                 with _lock:
-                    _inflight[0] -= 1
+                    _inflight[0] -= reserved
             return (False, f"run.wall_s.{slo_label()} p99 "
                            f"{h['p99']:g}s breaches the configured "
                            f"SLO {slo:g}s", "shed_overload")
     if reserved:
-        _tls.admit_reserved = True
+        _tls.admit_reserved = reserved
     return True, None, None
 
 
-def admit(label: str = "circuit_run") -> None:
+def admit(label: str = "circuit_run", batch: int = 1) -> None:
     """Admission decision for one incoming run (``Circuit.run`` entry,
     outermost non-resume runs only).  A no-op while the gate is
     disarmed and no drain is in progress; otherwise every decision is
@@ -516,7 +523,13 @@ def admit(label: str = "circuit_run") -> None:
     ``shed_unhealthy``) and refusals raise
     :class:`QuESTOverloadError` with the ``retry_after_s`` hint.  A
     draining process sheds every new run — the same verdict
-    ``/readyz`` serves as 503."""
+    ``/readyz`` serves as 503.
+
+    ``batch`` is the launch's member count (``Circuit.run_batched``):
+    ONE decision priced at the batched cost — the whole batch's
+    in-flight slots are reserved atomically or the launch sheds as a
+    unit, so a coalesced launch can never slip N runs past a cap that
+    admits one."""
     if _preempt["flag"]:
         metrics.counter_inc("supervisor.shed_overload")
         raise QuESTOverloadError(
@@ -526,10 +539,12 @@ def admit(label: str = "circuit_run") -> None:
             retry_after_s=retry_after_s())
     if not gate_enabled():
         return
-    ok, reason, shed_kind = _evaluate_gate(reserve=True)
+    batch = max(int(batch), 1)
+    ok, reason, shed_kind = _evaluate_gate(reserve_n=batch)
     if ok:
         metrics.counter_inc("supervisor.admitted")
-        metrics.trace(f"admission: admitted {label!r}")
+        metrics.trace(f"admission: admitted {label!r}"
+                      + (f" (batch of {batch})" if batch > 1 else ""))
         return
     metrics.counter_inc(f"supervisor.{shed_kind}")
     ra = retry_after_s()
@@ -554,19 +569,24 @@ def readiness():
 
 @contextlib.contextmanager
 def run_scope(deadline_s: float | None = None, *,
-              outermost: bool = True):
+              outermost: bool = True, slots: int = 1):
     """Per-run lifecycle scope entered by ``Circuit.run``: arms the
-    deadline (when given) and holds one in-flight slot (outermost runs
-    only — nested resumes/rollbacks share the outer run's slot).  A
-    slot already reserved by :func:`admit`'s atomic
-    check-and-increment is CONSUMED here, not taken twice."""
-    reserved = getattr(_tls, "admit_reserved", False)
+    deadline (when given) and holds the run's in-flight slots
+    (outermost runs only — nested resumes/rollbacks share the outer
+    run's slots).  Slots already reserved by :func:`admit`'s atomic
+    check-and-increment are CONSUMED here, not taken twice.
+    ``slots`` is the launch's member count (1 for a plain run, N for
+    a ``Circuit.run_batched`` launch — the in-flight gauge counts
+    logical runs, so a coalesced batch loads the cap like the N runs
+    it replaced)."""
+    reserved = int(getattr(_tls, "admit_reserved", 0) or 0)
     if reserved:
-        _tls.admit_reserved = False
-    track = outermost and not reserved
-    if track:
+        _tls.admit_reserved = 0
+    take = max(int(slots), 1) if outermost and not reserved else 0
+    if take:
         with _lock:
-            _inflight[0] += 1
+            _inflight[0] += take
+    held = reserved or take
     try:
         if deadline_s is not None:
             with deadline_scope(deadline_s):
@@ -574,9 +594,9 @@ def run_scope(deadline_s: float | None = None, *,
         else:
             yield
     finally:
-        if track or reserved:
+        if held:
             with _lock:
-                _inflight[0] -= 1
+                _inflight[0] -= held
 
 
 @contextlib.contextmanager
@@ -598,58 +618,272 @@ def in_recovery() -> bool:
 
 
 # ---------------------------------------------------------------------------
-# Bounded-concurrency in-process run queue
+# Bounded-concurrency in-process run queue (+ batching mode, ISSUE 14)
 # ---------------------------------------------------------------------------
 
+#: Members of currently-executing coalesced launches (0 while none in
+#: flight) — the ``quest_batch_occupancy`` gauge.  A summed counter
+#: under ``_lock``, not a slot: concurrent serve workers may overlap
+#: launches, and one launch finishing must not zero out another's
+#: occupancy mid-scrape.
+_batch = {"occupancy": 0}
 
-def serve(requests, *, workers: int = 2, label: str = "serve") -> list:
-    """Run ``requests`` (zero-argument callables) through a bounded
-    worker pool — the in-process run queue of the serving front end.
-    At most ``workers`` requests execute concurrently (queueing is the
-    backpressure; the admission gate still applies inside each
-    request's own ``Circuit.run``, so an unhealthy mesh sheds queued
-    work with typed errors instead of running it).
+
+def batch_occupancy() -> int:
+    """Total member count of the coalesced launches executing right
+    now (0 when none) — whether batching is actually ENGAGING in
+    production, next to the coalesced-vs-solo launch counters."""
+    with _lock:
+        return _batch["occupancy"]
+
+
+class BatchableRun:
+    """One coalescible serving request: run ``circuit`` on a fresh
+    |0...0> register in ``env`` and return its measurement outcomes.
+
+    Requests whose :meth:`fingerprint` matches — same op stream, qubit
+    count, kind, dtype, environment — are COALESCED by
+    :func:`serve`'s batching mode into one
+    ``Circuit.run_batched`` launch: one compiled program, N members,
+    one admission decision priced at the batched cost.  ``trace_id``
+    is the tenant's trace: it lands on the member's own split-out
+    ledger record (and in the member's result), so per-tenant
+    attribution survives the coalescing.  ``key`` is the member's
+    PRNG key (all-or-none per batch: mixing keyed and keyless
+    requests in one launch would silently re-key someone)."""
+
+    __slots__ = ("circuit", "env", "dtype", "key", "trace_id")
+
+    def __init__(self, circuit, env, *, dtype=None, key=None,
+                 trace_id: str | None = None):
+        self.circuit = circuit
+        self.env = env
+        self.dtype = dtype
+        self.key = key
+        self.trace_id = trace_id
+
+    def fingerprint(self) -> tuple:
+        """Coalescing identity: requests batch together iff this
+        matches (circuit ops are hashable tuples — the same content
+        key ``Circuit.compile`` memoises on)."""
+        return (tuple(self.circuit.ops), self.circuit.num_qubits,
+                self.circuit.is_density,
+                None if self.dtype is None else str(self.dtype),
+                id(self.env))
+
+
+def _run_coalesced(reqs: list) -> list:
+    """Execute one coalesced launch group as a single
+    ``Circuit.run_batched`` and split the results back out per member:
+    per-member outcomes, per-tenant trace_id, and one ``batched_member``
+    ledger record per member linking back to the batched run's own
+    record (``batch_run_id``).  Raises propagate to the caller (the
+    serve worker), which fails EVERY member of the group with the same
+    typed error — a shed batch sheds as the unit it was admitted as."""
+    from .register import create_batched_qureg
+
+    n = len(reqs)
+    r0 = reqs[0]
+    circ = r0.circuit
+    if n > 1:
+        metrics.counter_inc("supervisor.batch_launches")
+        metrics.counter_inc("supervisor.batch_members", n)
+    else:
+        metrics.counter_inc("supervisor.solo_launches")
+    member_keys = None
+    keyed = [r for r in reqs if r.key is not None]
+    if keyed:
+        if len(keyed) != n:
+            raise QuESTValidationError(
+                "serve: a coalesced batch mixes keyed and keyless "
+                "requests — pass a PRNG key on every member or none "
+                "(silently re-keying a member would change its draws)")
+        import jax.numpy as jnp  # deferred: keep the module stdlib-light
+
+        member_keys = jnp.stack([r.key for r in reqs])
+    draws = (circ._has_nonunitary and circ.num_measurements > 0)
+    bq = create_batched_qureg(circ.num_qubits, r0.env, n,
+                              is_density=circ.is_density,
+                              dtype=r0.dtype)
+    # a UNIQUE trace id minted for this launch: run_batched inherits
+    # it as its record's trace_id, which is how the launch's own
+    # record is found back below — metrics' "most recent record" is
+    # process-global, so with concurrent serve workers the last
+    # record may belong to ANOTHER group's launch (reading it would
+    # cross-link tenants' batch_run_id/wall attribution)
+    batch_tid = telemetry.new_run_id()
+    with _lock:
+        _batch["occupancy"] += n
+    try:
+        with telemetry.trace_scope(batch_tid):
+            outs = circ.run_batched(bq, member_keys=member_keys)
+    finally:
+        with _lock:
+            _batch["occupancy"] -= n
+    batch_rec = next(
+        (r for r in reversed(metrics.recent_records(64))
+         if r.get("meta", {}).get("trace_id") == batch_tid), {})
+    batch_meta = batch_rec.get("meta", {})
+    wall = float(batch_rec.get("wall_s") or 0.0)
+    values = []
+    for i, r in enumerate(reqs):
+        member_run_id = telemetry.new_run_id()
+        tid = r.trace_id or batch_meta.get("trace_id")
+        # the split-out per-member record: ONE batched execution, N
+        # attributable ledger rows — what a tenant's dashboard reads
+        with metrics.run_ledger("batched_member"):
+            metrics.annotate_run("run_id", member_run_id)
+            if tid:
+                metrics.annotate_run("trace_id", tid)
+            metrics.annotate_run("batch_run_id",
+                                 batch_meta.get("run_id"))
+            metrics.annotate_run("batch_size", n)
+            metrics.annotate_run("batch_index", i)
+            metrics.annotate_run("num_qubits", circ.num_qubits)
+            if wall:
+                metrics.annotate_run("wall_share_s",
+                                     round(wall / n, 6))
+        value = {"outcomes": (outs[i] if draws else None),
+                 "trace_id": tid,
+                 "run_id": member_run_id,
+                 "batch_run_id": batch_meta.get("run_id"),
+                 "batch_size": n,
+                 "batch_index": i}
+        if not draws:
+            # measurement-free members: the deliverable is the final
+            # state (a copy — tenants never alias the batch)
+            value["qureg"] = bq.member(i)
+        values.append(value)
+    return values
+
+
+def serve(requests, *, workers: int = 2, label: str = "serve",
+          max_batch: int = 1, batch_window_s: float = 0.05) -> list:
+    """Run ``requests`` through a bounded worker pool — the in-process
+    run queue of the serving front end.  At most ``workers`` launch
+    units execute concurrently (queueing is the backpressure; the
+    admission gate still applies inside each unit's own run, so an
+    unhealthy mesh sheds queued work with typed errors instead of
+    running it).
+
+    Requests are zero-argument callables (each executed as its own
+    solo unit, exactly as before) or :class:`BatchableRun` requests.
+    With ``max_batch > 1`` the queue COALESCES: consecutive queued
+    ``BatchableRun`` requests with the same :meth:`fingerprint
+    <BatchableRun.fingerprint>` launch as ONE ``Circuit.run_batched``
+    (up to ``max_batch`` members, waiting at most ``batch_window_s``
+    for the queue to offer the next candidate once it runs dry — the
+    bounded batch window), with one admission decision priced at the
+    batched cost, per-tenant ``trace_id`` preserved on each member's
+    split-out ledger record, and per-member outcomes in each result.
+    Coalescing never reorders: a non-matching request closes the
+    group and keeps its queue position.
 
     Returns one ``{"ok", "value" | "error"}`` dict per request, in
-    request order.  The submit-time trace scope propagates to the
-    worker threads, so queued work joins the caller's trace chain."""
+    request order — a batched member's ``value`` carries its
+    ``outcomes`` / ``trace_id`` / ``batch_size`` / ``batch_index``
+    (and the final-state register for measurement-free circuits); a
+    shed batch fails every member with the same typed error.  The
+    submit-time trace scope propagates to the worker threads, so
+    queued work joins the caller's trace chain."""
     import queue as _queue
 
     jobs = list(requests)
     if workers < 1:
         raise QuESTValidationError(
             f"serve: workers must be >= 1, got {workers}")
+    max_batch = max(int(max_batch), 1)
+    batch_window_s = max(float(batch_window_s), 0.0)
     results: list = [None] * len(jobs)
     q: _queue.Queue = _queue.Queue()
+    lq: _queue.Queue = _queue.Queue()
     submit_tid = telemetry.current_trace_id()
     for i, fn in enumerate(jobs):
         q.put((i, fn))
 
+    def dispatcher():
+        """Drain the request queue into launch units: solo callables
+        pass through; consecutive same-fingerprint BatchableRun
+        requests coalesce up to max_batch within the batch window.
+        Sentinels post in a finally — a dispatcher failure must never
+        leave the workers blocked on an endless launch queue."""
+        try:
+            hold = None
+            remaining = len(jobs)
+            while remaining:
+                item = hold if hold is not None else q.get_nowait()
+                hold = None
+                i, req = item
+                if max_batch <= 1 or not isinstance(req, BatchableRun):
+                    lq.put([item])
+                    remaining -= 1
+                    continue
+                group = [item]
+                fp = req.fingerprint()
+                deadline = metrics.clock() + batch_window_s
+                # never wait past the known backlog: when the group
+                # already holds every outstanding request, no future
+                # arrival exists to wait the window out for
+                while len(group) < max_batch and len(group) < remaining:
+                    try:
+                        to = deadline - metrics.clock()
+                        nxt = (q.get(timeout=to) if to > 0
+                               else q.get_nowait())
+                    except _queue.Empty:
+                        break
+                    if (isinstance(nxt[1], BatchableRun)
+                            and nxt[1].fingerprint() == fp):
+                        group.append(nxt)
+                    else:
+                        hold = nxt  # closes the group, keeps its place
+                        break
+                lq.put(group)
+                remaining -= len(group)
+        finally:
+            for _ in range(max(min(workers, len(jobs)), 1)):
+                lq.put(None)
+
     def worker():
         while True:
-            try:
-                i, fn = q.get_nowait()
-            except _queue.Empty:
+            group = lq.get()
+            if group is None:
                 return
             scope = (telemetry.trace_scope(submit_tid) if submit_tid
                      else contextlib.nullcontext())
             try:
                 with scope:
-                    results[i] = {"ok": True, "value": fn()}
-                metrics.counter_inc("supervisor.serve_completed")
+                    if isinstance(group[0][1], BatchableRun):
+                        reqs = [r for _i, r in group]
+                        values = _run_coalesced(reqs)
+                        for (i, _r), v in zip(group, values):
+                            results[i] = {"ok": True, "value": v}
+                    else:
+                        (i, fn), = group
+                        if max_batch > 1:
+                            metrics.counter_inc(
+                                "supervisor.solo_launches")
+                        results[i] = {"ok": True, "value": fn()}
+                metrics.counter_inc("supervisor.serve_completed",
+                                    len(group))
             except Exception as e:  # typed errors are data here: a
-                # shed/drained request must not kill its worker (or
-                # the queue behind it)
-                results[i] = {"ok": False, "error": e}
-                metrics.counter_inc("supervisor.serve_failed")
-            finally:
-                q.task_done()
+                # shed/drained unit must not kill its worker (or the
+                # queue behind it) — and a shed BATCH fails every
+                # member with the same typed error, the unit it was
+                # admitted as
+                for i, _r in group:
+                    results[i] = {"ok": False, "error": e}
+                metrics.counter_inc("supervisor.serve_failed",
+                                    len(group))
 
+    disp = threading.Thread(target=dispatcher,
+                            name=f"quest-serve-{label}-dispatch")
+    disp.start()
     threads = [threading.Thread(target=worker,
                                 name=f"quest-serve-{label}-{k}")
-               for k in range(min(workers, max(len(jobs), 1)))]
+               for k in range(max(min(workers, len(jobs)), 1))]
     for t in threads:
         t.start()
+    disp.join()
     for t in threads:
         t.join()
     return results
@@ -737,6 +971,7 @@ def reset() -> None:
                  retry_after_s=None, slo_label=None)
     with _lock:
         _inflight[0] = 0
+    _batch["occupancy"] = 0
     _tls.deadlines = []
     _tls.recovering = False
-    _tls.admit_reserved = False
+    _tls.admit_reserved = 0

@@ -1,0 +1,618 @@
+"""Batched multi-register execution (ISSUE 14).
+
+Covers the tentpole end to end: (a) the per-member bit-identity
+property — every member of a batched run equals the same circuit run
+unbatched (a batch of one through the same entry point) bit for bit,
+at f32/f64 across 1/2/4/8 virtual devices with measurement replay
+included, and outcomes equal the default ``Circuit.run``'s exactly;
+(b) ``BatchedQureg`` creation/member access/validation; (c) the
+scheduled batched mesh executor's exchange accounting
+(``plan_exchange_elems(batch=N)`` scales by exactly N) and the
+gate-stream accounting (``stream_exchange_elems``) the batched ledger
+records; (d) the batch-aware ``Circuit.sample(mode="auto")``
+threshold; (e) batched admission pricing (one decision, N in-flight
+slots); (f) ``supervisor.serve``'s coalescing mode — same-fingerprint
+requests launch as ONE ``run_batched`` with per-tenant trace_ids on
+split-out ``batched_member`` ledger records; (g) the ``quest_batch_*``
+export gauges; (h) the config-bound ``batch_circuits_per_sec``
+ledger_diff rule firing in both directions; (i) the ``batched-run``
+timeline kind and trace_view's per-member attribution.
+"""
+
+import os
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics, models, supervisor
+from quest_tpu.ops.lattice import _ilog2, state_shape
+from quest_tpu.parallel.mesh_exec import (as_batched_mesh_fn,
+                                          as_mesh_fused_fn,
+                                          plan_exchange_elems,
+                                          stream_exchange_elems)
+from quest_tpu.register import BatchedQureg
+from quest_tpu.scheduler import plan_comm_cost, schedule_mesh
+from quest_tpu.validation import (QuESTOverloadError,
+                                  QuESTValidationError)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import ledger_diff  # noqa: E402
+import trace_view  # noqa: E402
+
+
+def _mixed_circuit(n):
+    """Random gates + mid-circuit measurement + deterministic collapse
+    + a measurement after more gates: exercises per-member PRNG
+    streams, outcome replay, and collapse-only steps in one plan."""
+    c = models.random_circuit(n, depth=3, seed=9)
+    c.measure(0)
+    c.rotate_y(1, 0.3)
+    c.collapse_to_outcome(2, 0)
+    c.hadamard(1)
+    c.measure(1)
+    return c
+
+
+def _envs(ndev):
+    return qt.create_env(num_devices=ndev)
+
+
+# ---------------------------------------------------------------------------
+# (a) per-member bit-identity property
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("ndev,n", [(1, 9), (2, 9), (4, 10), (8, 12)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+def test_member_bit_identity_property(ndev, n, dtype):
+    """THE batched contract: member i of a batch-of-N launch is
+    bit-identical — amplitudes AND measurement outcomes — to the same
+    request launched unbatched (a batch of one) with the same member
+    key, at every precision and mesh size; and the outcomes equal a
+    plain ``Circuit.run`` with that key (measurement replay), with
+    amplitudes agreeing to the cross-executor reassociation tolerance
+    (the batched kernel path and the fused default differ only in
+    XLA's cross-op FMA grouping)."""
+    env = _envs(ndev)
+    circ = _mixed_circuit(n)
+    N = 3
+    mkeys = jax.random.split(jax.random.PRNGKey(3), N)
+    bq = qt.create_batched_qureg(n, env, N, dtype=dtype)
+    assert bq.amps.dtype == dtype
+    outs = circ.run_batched(bq, member_keys=mkeys)
+    assert outs.shape == (N, circ.num_measurements)
+    eps = float(jnp.finfo(dtype).eps)
+    for i in range(N):
+        # unbatched counterpart: the same request, launched alone
+        b1 = qt.create_batched_qureg(n, env, 1, dtype=dtype)
+        o1 = circ.run_batched(b1, member_keys=mkeys[i:i + 1])
+        assert bool(jnp.all(o1[0] == outs[i]))
+        assert bool(jnp.all(b1.member_amps(0) == bq.member_amps(i))), \
+            f"member {i} amplitudes depend on its batch size"
+        # measurement replay vs the default path: identical draws,
+        # amplitudes within a few ulps of reassociation
+        q = qt.create_qureg(n, env, dtype=dtype)
+        od = circ.run(q, key=mkeys[i])
+        assert bool(jnp.all(od == outs[i]))
+        assert float(jnp.max(jnp.abs(q.amps - bq.member_amps(i)))) \
+            < 64 * eps
+
+
+def test_member_independence_of_neighbours(env8):
+    """Coalescing is tenant-isolated: a member's result does not change
+    when DIFFERENT members share its launch (same key, different
+    neighbours — the serving guarantee behind the fingerprint-coalesce
+    mode)."""
+    n = 12
+    circ = _mixed_circuit(n)
+    keys = jax.random.split(jax.random.PRNGKey(11), 5)
+    a = qt.create_batched_qureg(n, qt.create_env(num_devices=8), 3)
+    oa = circ.run_batched(a, member_keys=keys[:3])
+    b = qt.create_batched_qureg(n, qt.create_env(num_devices=8), 3)
+    ob = circ.run_batched(b, member_keys=jnp.stack(
+        [keys[0], keys[3], keys[4]]))
+    assert bool(jnp.all(oa[0] == ob[0]))
+    assert bool(jnp.all(a.member_amps(0) == b.member_amps(0)))
+
+
+# ---------------------------------------------------------------------------
+# (b) BatchedQureg surface
+# ---------------------------------------------------------------------------
+
+
+def test_batched_qureg_create_members_roundtrip(env8):
+    n, N = 12, 3
+    env = qt.create_env(num_devices=8)
+    bq = qt.create_batched_qureg(n, env, N)
+    rows, lanes = state_shape(1 << n, 8)
+    assert bq.storage_shape == (N, rows, 2 * lanes)
+    assert bq.batch_size == N and bq.num_amps == 1 << n
+    # every member starts in |0...0>
+    for i in range(N):
+        q = bq.member(i)
+        assert float(q.get_prob_amp(0) if hasattr(q, "get_prob_amp")
+                     else qt.get_prob_amp(q, 0)) == pytest.approx(1.0)
+    # member() copies: mutating the copy never touches the batch
+    q0 = bq.member(0)
+    qt.init_plus_state(q0)
+    assert float(qt.get_prob_amp(bq.member(0), 0)) == pytest.approx(1.0)
+    # from_quregs stacks current states
+    qs = [qt.create_qureg(n, env) for _ in range(2)]
+    qt.init_plus_state(qs[1])
+    stacked = BatchedQureg.from_quregs(qs)
+    assert stacked.batch_size == 2
+    assert bool(jnp.all(stacked.member_amps(0) == qs[0].amps))
+    assert bool(jnp.all(stacked.member_amps(1) == qs[1].amps))
+
+
+def test_batched_qureg_validation(env1):
+    env = qt.create_env(num_devices=1)
+    with pytest.raises(QuESTValidationError):
+        qt.create_batched_qureg(4, env, 0)
+    with pytest.raises(QuESTValidationError):
+        qt.create_batched_qureg(4, env, "two")
+    with pytest.raises(QuESTValidationError):
+        BatchedQureg.from_quregs([])
+    q4 = qt.create_qureg(4, env)
+    q5 = qt.create_qureg(5, env)
+    with pytest.raises(QuESTValidationError):
+        BatchedQureg.from_quregs([q4, q5])
+    bq = qt.create_batched_qureg(4, env, 2)
+    with pytest.raises(QuESTValidationError):
+        bq.member_amps(2)
+    circ = models.qft(5)
+    with pytest.raises(QuESTValidationError):
+        circ.run_batched(bq)  # qubit-count mismatch
+    with pytest.raises(QuESTValidationError):
+        circ.run_batched(q4)  # plain register
+    circ4 = models.qft(4)
+    circ4.measure(0)
+    with pytest.raises(QuESTValidationError):
+        circ4.run_batched(bq, member_keys=jax.random.split(
+            jax.random.PRNGKey(0), 3))  # wrong key count
+
+
+def test_density_batched_run(env1):
+    """Density registers batch identically (2N vector qubits, member
+    axis in front)."""
+    env = qt.create_env(num_devices=1)
+    from quest_tpu.circuit import Circuit as _C
+    circ = _C(3, is_density=True)
+    circ.hadamard(0)
+    circ.cnot(0, 1)
+    bq = qt.create_batched_qureg(3, env, 2, is_density=True)
+    circ.run_batched(bq)
+    q = qt.create_density_qureg(3, env)
+    circ.run(q, pallas=False)
+    for i in range(2):
+        assert float(jnp.max(jnp.abs(bq.member_amps(i) - q.amps))) \
+            < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# (c) exchange accounting: batch scaling, exact
+# ---------------------------------------------------------------------------
+
+
+def test_plan_exchange_elems_batch_scaling(env8):
+    n, dev_bits = 12, 3
+    lanes = state_shape(1 << n, 1 << dev_bits)[1]
+    plan = schedule_mesh(list(models.qft(n).ops), n, dev_bits,
+                         _ilog2(lanes))
+    r1, e1 = plan_exchange_elems(plan, n, dev_bits)
+    for N in (2, 5, 8):
+        rN, eN = plan_exchange_elems(plan, n, dev_bits, batch=N)
+        assert rN == r1 and eN == e1 * N
+    cost1 = plan_comm_cost(plan, n, dev_bits)
+    cost8 = plan_comm_cost(plan, n, dev_bits, batch=8)
+    assert cost8["exchange_elems"] == cost1["exchange_elems"] * 8
+    assert cost8["hidden_frac_model"] == \
+        pytest.approx(cost1["hidden_frac_model"])
+
+
+def test_batched_mesh_fn_members_and_counters(env8):
+    """The scheduled batched mesh executor (one vmapped whole-plan
+    program): each member's result equals the unbatched whole-plan
+    program's to reassociation tolerance, and a concrete call records
+    the batch-scaled mesh counters."""
+    n, N = 12, 3
+    env = qt.create_env(num_devices=8)
+    ops = list(models.qft(n).ops)
+    bfn = as_batched_mesh_fn(ops, n, env.mesh)
+    ufn = as_mesh_fused_fn(ops, n, env.mesh, backend="xla")
+    bq = qt.create_batched_qureg(n, env, N)
+    q = qt.create_qureg(n, env)
+    metrics.reset()
+    out = bfn(bq.amps)          # concrete call: counters recorded
+    ref = jax.jit(ufn)(q.amps)
+    for i in range(N):
+        assert float(jnp.max(jnp.abs(out[i] - ref))) < 1e-12
+    c = metrics.counters()
+    st = bfn.plan_stats
+    assert c["mesh.batch_executions"] == 1
+    assert c["mesh.passes"] == st["passes"] * N
+    assert c["mesh.exchange_bytes"] == \
+        st["exchange_elems"] * N * jnp.dtype(bq.real_dtype).itemsize
+
+
+def test_stream_exchange_elems_formula(env8):
+    """The gate-stream accounting mirrors the kernels exactly: one
+    whole-chunk exchange per dev-bit partner fetch — apply_2x2 targets
+    above chunk_bits, dm_chan pair masks; phases/controls/measure move
+    nothing — and the batched run's ledger records exactly this figure
+    times the batch."""
+    n, dev_bits, ndev = 12, 3, 8
+    chunk_bits = n - dev_bits
+    circ = models.qft(n)
+    circ.measure(0)
+    nex, elems = stream_exchange_elems(circ.ops, n, dev_bits)
+    # exactly the 2x2 partner fetches on device-bit targets exchange
+    # (QFT: hadamards plus the final bit-reversal's cnots); phases,
+    # controls and the measurement never move amplitudes
+    expect = sum(1 for kind, statics, _sc in circ.ops
+                 if kind == "apply_2x2" and statics[0] >= chunk_bits)
+    assert nex == expect and expect > 0
+    assert elems == expect * ndev * (1 << (chunk_bits + 1))
+    _, e4 = stream_exchange_elems(circ.ops, n, dev_bits, batch=4)
+    assert e4 == elems * 4
+    # single device: never any exchange
+    assert stream_exchange_elems(circ.ops, n, 0) == (0, 0)
+    # ledger: run_batched records the same accounting, batch-scaled
+    env = qt.create_env(num_devices=ndev)
+    bq = qt.create_batched_qureg(n, env, 4)
+    circ.run_batched(bq, key=jax.random.PRNGKey(0))
+    led = metrics.get_run_ledger()
+    assert led["label"] == "circuit_run_batched"
+    assert led["meta"]["batch_size"] == 4
+    itemsize = jnp.dtype(bq.real_dtype).itemsize
+    assert led["counters"]["exec.exchange_bytes"] == \
+        elems * 4 * itemsize
+    assert led["counters"]["exec.gate_exchanges"] == nex * 4
+    assert led["counters"]["exec.batch_members"] == 4
+    assert led["counters"]["exec.gates"] == circ.num_gates * 4
+
+
+# ---------------------------------------------------------------------------
+# (d) batch-aware sample(mode="auto")
+# ---------------------------------------------------------------------------
+
+
+def test_sample_auto_threshold_batch_aware(env1, monkeypatch):
+    """The auto heuristic prices batch x shots x pair_bytes: a batch
+    that no longer fits must pick the sequential sampler even though
+    the same shots WITHOUT the batch still pick vmap (the ISSUE 14
+    threshold fix)."""
+    circ = models.qft(4)
+    circ.measure(0)
+    pair_bytes = 2 * (1 << 4) * jnp.dtype(jnp.float64).itemsize
+    # 8 shots fit, 4 batches x 8 shots do not
+    from quest_tpu.circuit import Circuit as _C
+    monkeypatch.setattr(_C, "SAMPLE_VMAP_BYTES", 10 * pair_bytes)
+    out = circ.sample(8, key=jax.random.PRNGKey(1))
+    assert ("sample", tuple(circ.ops), "float64", "vmap", None) \
+        in circ._compiled
+    assert out.shape == (8, 1)
+    out_b = circ.sample(8, key=jax.random.PRNGKey(1), batch=4)
+    assert out_b.shape == (4, 8, 1)
+    assert ("sample", tuple(circ.ops), "float64", "sequential", 32) \
+        in circ._compiled
+    # a fitting batch keeps vmap, and the flat draw order makes the
+    # batched result a plain reshape of the unbatched one under the
+    # same key (batch=1 byte-stable by construction)
+    monkeypatch.setattr(_C, "SAMPLE_VMAP_BYTES", 1000 * pair_bytes)
+    out_v = circ.sample(8, key=jax.random.PRNGKey(1), batch=4)
+    assert out_v.shape == (4, 8, 1)
+    flat = circ.sample(32, key=jax.random.PRNGKey(1))
+    assert bool(jnp.all(out_v.reshape(32, 1) == flat))
+    with pytest.raises(QuESTValidationError):
+        circ.sample(8, batch=0)
+    with pytest.raises(QuESTValidationError):
+        circ.sample(8, batch="many")
+
+
+# ---------------------------------------------------------------------------
+# (e) batched admission pricing
+# ---------------------------------------------------------------------------
+
+
+def test_admission_prices_batched_cost(env1):
+    """One decision per launch, priced at N slots: a batch that cannot
+    fit under max_inflight sheds AS A UNIT, a fitting batch admits and
+    holds N in-flight slots for its duration."""
+    env = qt.create_env(num_devices=1)
+    circ = models.qft(6)
+    supervisor.configure_gate(True, max_inflight=3)
+    try:
+        before = metrics.counters().get("supervisor.shed_overload", 0)
+        bq4 = qt.create_batched_qureg(6, env, 4)
+        with pytest.raises(QuESTOverloadError) as ei:
+            circ.run_batched(bq4)
+        assert "batch of 4" in str(ei.value)
+        assert metrics.counters()["supervisor.shed_overload"] \
+            == before + 1
+        assert supervisor.inflight() == 0  # nothing leaked
+        bq2 = qt.create_batched_qureg(6, env, 2)
+        circ.run_batched(bq2)  # admits
+        assert supervisor.inflight() == 0  # released after the run
+        led = metrics.get_run_ledger()
+        assert led["meta"].get("admission") == "admitted"
+        assert led["meta"]["batch_size"] == 2
+    finally:
+        supervisor.reset()
+
+
+# ---------------------------------------------------------------------------
+# (f) serve coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_serve_coalesces_same_fingerprint(env1):
+    """4 queued same-fingerprint requests + 1 callable + 1
+    different-shape request: ONE coalesced launch of 4, two solo
+    units, order preserved, per-tenant trace_ids on the split-out
+    member records, outcomes equal to solo runs with the same keys."""
+    env = qt.create_env(num_devices=1)
+    circ = models.random_circuit(6, depth=2, seed=7)
+    circ.measure(0)
+    other = models.random_circuit(5, depth=2, seed=7)
+    other.measure(0)
+    keys = jax.random.split(jax.random.PRNGKey(2), 4)
+    reqs = [supervisor.BatchableRun(circ, env, key=keys[i],
+                                    trace_id=f"tenant-{i}")
+            for i in range(4)]
+    reqs.append(lambda: "plain")
+    reqs.append(supervisor.BatchableRun(other, env,
+                                        trace_id="tenant-other"))
+    metrics.reset()
+    res = supervisor.serve(reqs, workers=2, max_batch=4)
+    assert all(r["ok"] for r in res)
+    c = metrics.counters()
+    assert c["supervisor.batch_launches"] == 1
+    assert c["supervisor.batch_members"] == 4
+    assert c["supervisor.solo_launches"] == 2
+    assert res[4]["value"] == "plain"
+    assert res[5]["value"]["batch_size"] == 1
+    for i in range(4):
+        v = res[i]["value"]
+        assert v["batch_size"] == 4 and v["batch_index"] == i
+        assert v["trace_id"] == f"tenant-{i}"
+        q = qt.create_qureg(6, env)
+        o = circ.run(q, key=keys[i])
+        assert bool(jnp.all(o == v["outcomes"]))
+    members = [r for r in metrics.recent_records(32)
+               if r["label"] == "batched_member"
+               and r["meta"]["batch_size"] == 4]
+    assert sorted(m["meta"]["trace_id"] for m in members) == \
+        [f"tenant-{i}" for i in range(4)]
+    batched = [r for r in metrics.recent_records(32)
+               if r["label"] == "circuit_run_batched"
+               and r["meta"]["batch_size"] == 4]
+    assert len(batched) == 1
+    assert all(m["meta"]["batch_run_id"]
+               == batched[0]["meta"]["run_id"] for m in members)
+
+
+def test_serve_batch_respects_max_and_order(env1):
+    """max_batch bounds a group; a non-matching arrival closes the
+    group without reordering (consecutive-only coalescing)."""
+    env = qt.create_env(num_devices=1)
+    a = models.qft(5)
+    a.measure(0)
+    b = models.qft(6)
+    b.measure(0)
+    reqs = ([supervisor.BatchableRun(a, env) for _ in range(3)]
+            + [supervisor.BatchableRun(b, env)]
+            + [supervisor.BatchableRun(a, env)])
+    metrics.reset()
+    res = supervisor.serve(reqs, workers=1, max_batch=2)
+    assert all(r["ok"] for r in res)
+    sizes = [r["value"]["batch_size"] for r in res]
+    # groups: [a,a], [a], [b], [a] — max_batch caps at 2, b closes a's
+    # run, the trailing a starts fresh
+    assert sizes == [2, 2, 1, 1, 1]
+    c = metrics.counters()
+    assert c["supervisor.batch_launches"] == 1
+    assert c["supervisor.solo_launches"] == 3
+
+
+def test_serve_concurrent_groups_link_own_batch_records(env1):
+    """With workers >= 2 two coalesced groups execute concurrently;
+    each group's members must link to THEIR OWN launch's record
+    (batch_run_id) — the global most-recent-record shortcut would
+    cross-link tenants (the launch is found back via its own minted
+    trace id instead)."""
+    env = qt.create_env(num_devices=1)
+    a = models.qft(5)
+    a.measure(0)
+    b = models.random_circuit(6, depth=2, seed=3)
+    b.measure(0)
+    reqs = ([supervisor.BatchableRun(a, env, trace_id=f"a{i}")
+             for i in range(2)]
+            + [supervisor.BatchableRun(b, env, trace_id=f"b{i}")
+               for i in range(2)])
+    metrics.reset()
+    res = supervisor.serve(reqs, workers=2, max_batch=2)
+    assert all(r["ok"] for r in res)
+    batched = {r["meta"]["run_id"]: r["meta"]
+               for r in metrics.recent_records(32)
+               if r["label"] == "circuit_run_batched"}
+    assert len(batched) == 2
+    members = [r["meta"] for r in metrics.recent_records(32)
+               if r["label"] == "batched_member"]
+    assert len(members) == 4
+    for m in members:
+        # every member's link resolves to a real batched record whose
+        # batch size matches the member's own group
+        assert m["batch_run_id"] in batched
+        assert batched[m["batch_run_id"]]["batch_size"] \
+            == m["batch_size"] == 2
+    # the two groups link to DIFFERENT launches, grouped by tenant
+    links = {m["trace_id"]: m["batch_run_id"] for m in members}
+    assert links["a0"] == links["a1"]
+    assert links["b0"] == links["b1"]
+    assert links["a0"] != links["b0"]
+
+
+def test_serve_mixed_keys_rejected(env1):
+    env = qt.create_env(num_devices=1)
+    circ = models.qft(5)
+    circ.measure(0)
+    reqs = [supervisor.BatchableRun(circ, env,
+                                    key=jax.random.PRNGKey(0)),
+            supervisor.BatchableRun(circ, env)]
+    res = supervisor.serve(reqs, workers=1, max_batch=2)
+    assert not res[0]["ok"] and not res[1]["ok"]
+    assert isinstance(res[0]["error"], QuESTValidationError)
+    assert "keyed and keyless" in str(res[0]["error"])
+
+
+def test_serve_sheds_batch_as_unit(env1):
+    """An admission refusal fails EVERY member of the coalesced group
+    with the same typed error — the unit it was admitted as."""
+    env = qt.create_env(num_devices=1)
+    circ = models.qft(5)
+    circ.measure(0)
+    reqs = [supervisor.BatchableRun(circ, env) for _ in range(3)]
+    supervisor.configure_gate(True, max_inflight=2)
+    try:
+        res = supervisor.serve(reqs, workers=1, max_batch=3)
+        assert all(not r["ok"] for r in res)
+        assert all(isinstance(r["error"], QuESTOverloadError)
+                   for r in res)
+    finally:
+        supervisor.reset()
+
+
+def test_serve_measurement_free_members_get_states(env1):
+    env = qt.create_env(num_devices=1)
+    circ = models.qft(5)  # no measurements
+    reqs = [supervisor.BatchableRun(circ, env) for _ in range(2)]
+    res = supervisor.serve(reqs, workers=1, max_batch=2)
+    assert all(r["ok"] for r in res)
+    q = qt.create_qureg(5, env)
+    circ.run(q, pallas=False)
+    for r in res:
+        assert r["value"]["outcomes"] is None
+        member = r["value"]["qureg"]
+        assert float(jnp.max(jnp.abs(member.amps - q.amps))) < 1e-12
+
+
+def test_serve_legacy_mode_unchanged(env1):
+    """max_batch=1 (the default) keeps the original callable contract
+    byte for byte — results in order, typed errors as data."""
+    def boom():
+        raise QuESTValidationError("nope")
+
+    res = supervisor.serve([lambda: 1, boom, lambda: 3], workers=2)
+    assert [r["ok"] for r in res] == [True, False, True]
+    assert res[0]["value"] == 1 and res[2]["value"] == 3
+    assert isinstance(res[1]["error"], QuESTValidationError)
+
+
+# ---------------------------------------------------------------------------
+# (g) export gauges
+# ---------------------------------------------------------------------------
+
+
+def test_batch_gauges_exported(env1):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_serve
+
+    env = qt.create_env(num_devices=1)
+    circ = models.qft(5)
+    circ.measure(0)
+    metrics.reset()
+    supervisor.serve([supervisor.BatchableRun(circ, env)
+                      for _ in range(2)], workers=1, max_batch=2)
+    text = metrics.export_text()
+    parsed = metrics_serve.parse_text(text)
+    assert parsed["quest_batch_occupancy"] == 0.0  # idle between runs
+    assert parsed["quest_batch_coalesced_launches"] == 1.0
+    assert parsed["quest_batch_members"] == 2.0
+    assert parsed["quest_batch_solo_launches"] == 0.0
+    assert supervisor.batch_occupancy() == 0
+
+
+# ---------------------------------------------------------------------------
+# (h) the ledger_diff rule, both directions
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_diff_batch_rule_both_directions():
+    old = {"metric": "gate_ops_per_sec_30q",
+           "batch_circuits_per_sec": 4000.0,
+           "batch_metric": "batch_circuits_per_sec-q8-n8-d6-dev4"}
+    ok_new = dict(old, batch_circuits_per_sec=3700.0)   # -7.5%: inside
+    bad_new = dict(old, batch_circuits_per_sec=3000.0)  # -25%: fails
+    v, _c, _s = ledger_diff.gate(old, ok_new)
+    assert not [x for x in v if x["key"] == "batch_circuits_per_sec"]
+    v, _c, _s = ledger_diff.gate(old, bad_new)
+    assert [x for x in v if x["key"] == "batch_circuits_per_sec"], v
+    # an IMPROVEMENT never fires the strictly-regressive rule
+    v, _c, _s = ledger_diff.gate(
+        old, dict(old, batch_circuits_per_sec=9000.0))
+    assert not [x for x in v if x["key"] == "batch_circuits_per_sec"]
+    # a different probe config (batch_metric disagrees) skips the rule
+    other = dict(bad_new,
+                 batch_metric="batch_circuits_per_sec-q10-n4-d8-dev8")
+    v, _c, skipped = ledger_diff.gate(old, other)
+    assert not [x for x in v if x["key"] == "batch_circuits_per_sec"]
+    assert ("batch_circuits_per_sec", "config mismatch") in skipped
+
+
+# ---------------------------------------------------------------------------
+# (i) timeline + trace_view batch attribution
+# ---------------------------------------------------------------------------
+
+
+def test_batched_run_timeline_and_trace_view(env1):
+    env = qt.create_env(num_devices=1)
+    circ = models.qft(6)
+    circ.measure(0)
+    bq = qt.create_batched_qureg(6, env, 4)
+    metrics.start_timeline()
+    try:
+        circ.run_batched(bq, key=jax.random.PRNGKey(0))
+        ev = metrics.timeline_events()
+    finally:
+        metrics.stop_timeline()
+    batched = [e for e in ev if e["name"] == "batched-run"]
+    assert len(batched) == 1
+    assert batched[0]["args"]["batch"] == 4
+    # the kind is COMPUTE in both the metrics sets and the tool's
+    # pinned stdlib copies (test_comm_pipeline pins full equality)
+    assert "batched-run" in metrics.TIMELINE_COMPUTE_KINDS
+    assert trace_view.classify(batched[0]) == "compute"
+    summary = trace_view.batched_summary(ev)
+    assert "per-member" in summary and "4" in summary
+    assert trace_view.batched_summary([]) == ""  # serial captures:
+    # the old summaries stay byte-stable (summarize appends nothing)
+    assert "batched" not in trace_view.summarize(
+        [e for e in ev if e["name"] != "batched-run"])
+
+
+def test_batched_run_ledger_record_shape(env1):
+    """The one batched record: label, batch_size, run/trace ids, and
+    pass/stream attribution at N x the per-member figures."""
+    env = qt.create_env(num_devices=1)
+    circ = models.qft(6)
+    N = 3
+    bq = qt.create_batched_qureg(6, env, N)
+    circ.run_batched(bq)
+    led = metrics.get_run_ledger()
+    assert led["label"] == "circuit_run_batched"
+    m = led["meta"]
+    assert m["batch_size"] == N and m["num_qubits"] == 6
+    assert m["run_id"] and m["trace_id"]
+    c = led["counters"]
+    assert c["exec.batch_runs"] == 1
+    assert c["exec.passes"] == len(circ.ops) * N
+    itemsize = jnp.dtype(bq.real_dtype).itemsize
+    assert c["exec.stream_bytes"] == \
+        len(circ.ops) * N * (1 << (6 + 2)) * itemsize

@@ -1,0 +1,201 @@
+"""Measure ``batch_circuits_per_sec`` on a virtual-mesh serving workload.
+
+Runs N small same-shape circuits (size via ``QUEST_BATCH_PROBE_QUBITS``,
+default 8; depth ``QUEST_BATCH_PROBE_DEPTH``, default 6; batch
+``QUEST_BATCH_PROBE_N``, default 8) over a ``QUEST_BATCH_PROBE_DEVS``
+(default 4) virtual CPU mesh, WARM, two ways:
+
+- **serial**: N back-to-back ``Circuit.run`` calls on fresh registers —
+  exactly what ``supervisor.serve`` did per queued request before the
+  coalescing mode (one compiled-program dispatch, one ledger scope, one
+  admission check per request);
+- **batched**: ONE ``Circuit.run_batched`` launch over a
+  ``BatchedQureg`` of N members with per-member PRNG keys — the
+  coalesced serving path.
+
+Reports ``batch_circuits_per_sec`` (N / best batched wall),
+``serial_circuits_per_sec`` (N / best serial wall) and their ratio
+``batch_speedup`` — the throughput half of ROADMAP item 3, measured
+rather than modelled.  The figures are best-of-reps
+(``QUEST_BATCH_PROBE_REPS``, default 3) and LEDGER-RECORDED: the probe
+runs its measurement under a ``batch_probe`` run-ledger scope and
+annotates the numbers there, so ``QUEST_METRICS_FILE`` streams carry
+them.  ``bench.py`` invokes this tool as a subprocess and copies the
+figures (plus the config-encoding ``metric`` string, as
+``batch_metric``) onto its bench_measure record — the
+``batch_circuits_per_sec`` ledger_diff rule gates the printed BENCH
+record at -10%, config-bound on ``batch_metric``.
+
+``--serve-smoke``: the tier-2 recording smoke (tools/record_all.py) —
+queues 4 same-fingerprint ``supervisor.BatchableRun`` requests through
+``supervisor.serve(max_batch=4)``, asserts they coalesced into ONE
+batched launch with per-member tenant trace_ids preserved on the
+split-out ``batched_member`` ledger records, per-member outcomes equal
+to solo runs with the same keys, and the ``quest_batch_*`` gauges on
+the export surface.
+
+Prints ONE JSON line.  Exit 0 on success, 1 when the mesh cannot be
+built or a smoke assertion fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), os.pardir)))
+
+# virtual CPU mesh, exactly as tools/overlap_probe.py forces it (must
+# precede the jax import)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+
+
+def _config():
+    n = int(os.environ.get("QUEST_BATCH_PROBE_QUBITS", "8"))
+    depth = int(os.environ.get("QUEST_BATCH_PROBE_DEPTH", "6"))
+    batch = int(os.environ.get("QUEST_BATCH_PROBE_N", "8"))
+    ndev = int(os.environ.get("QUEST_BATCH_PROBE_DEVS", "4"))
+    reps = int(os.environ.get("QUEST_BATCH_PROBE_REPS", "3"))
+    return n, depth, batch, ndev, reps
+
+
+def measure() -> int:
+    import quest_tpu as qt
+    from quest_tpu import metrics, models
+    from quest_tpu.reporting import stopwatch
+
+    n, depth, batch, ndev, reps = _config()
+    if len(jax.devices()) < ndev:
+        print(json.dumps({"error": f"need {ndev} devices, have "
+                                   f"{len(jax.devices())}"}))
+        return 1
+    env = qt.create_env(num_devices=ndev)
+    circ = models.random_circuit(n, depth=depth, seed=7)
+    circ.measure(0)
+    keys = jax.random.split(jax.random.PRNGKey(1), batch)
+
+    # warm BOTH paths: the first serial run compiles the fused mesh
+    # program, the first batched run compiles the vmapped kernel
+    # composite — a probe that times a compile measures the compiler
+    q = qt.create_qureg(n, env)
+    circ.run(q, key=keys[0])
+    bq = qt.create_batched_qureg(n, env, batch)
+    circ.run_batched(bq, member_keys=keys)
+
+    with metrics.run_ledger("batch_probe"):
+        serial_best = batched_best = float("inf")
+        for _ in range(reps):
+            sw = stopwatch()
+            for i in range(batch):
+                q = qt.create_qureg(n, env)
+                circ.run(q, key=keys[i])
+                jax.block_until_ready(q.amps)
+            serial_best = min(serial_best, sw.seconds)
+        for _ in range(reps):
+            bq = qt.create_batched_qureg(n, env, batch)
+            sw = stopwatch()
+            outs = circ.run_batched(bq, member_keys=keys)
+            jax.block_until_ready((bq.amps, outs))
+            batched_best = min(batched_best, sw.seconds)
+        rate = batch / batched_best
+        serial_rate = batch / serial_best
+        speedup = serial_best / batched_best
+        # ledger-recorded: the probe's own run record carries the
+        # figures (and streams through QUEST_METRICS_FILE)
+        metrics.annotate_run("batch_circuits_per_sec", round(rate, 1))
+        metrics.annotate_run("serial_circuits_per_sec",
+                             round(serial_rate, 1))
+        metrics.annotate_run("batch_speedup", round(speedup, 3))
+
+    record = {
+        # config-encoding metric string: the ledger_diff rule binds on
+        # it (via bench.py's batch_metric copy), so probes of different
+        # workloads never gate against each other
+        "metric": f"batch_circuits_per_sec-q{n}-n{batch}-d{depth}"
+                  f"-dev{ndev}",
+        "value": round(rate, 1),
+        "unit": "circuits/s",
+        "batch_circuits_per_sec": round(rate, 1),
+        "serial_circuits_per_sec": round(serial_rate, 1),
+        "batch_speedup": round(speedup, 3),
+        "batch": batch,
+        "num_qubits": n,
+        "depth": depth,
+        "num_devices": ndev,
+        "batched_wall_s": round(batched_best, 6),
+        "serial_wall_s": round(serial_best, 6),
+    }
+    print(json.dumps(record))
+    return 0
+
+
+def serve_smoke() -> int:
+    """4 queued same-fingerprint requests -> ONE coalesced launch,
+    per-member trace_ids and split-out ledgers verified."""
+    import jax.numpy as jnp
+
+    import quest_tpu as qt
+    from quest_tpu import metrics, models, supervisor
+
+    n, depth, _batch, ndev, _reps = _config()
+    env = qt.create_env(num_devices=ndev)
+    circ = models.random_circuit(n, depth=depth, seed=7)
+    circ.measure(0)
+    keys = jax.random.split(jax.random.PRNGKey(2), 4)
+    reqs = [supervisor.BatchableRun(circ, env, key=keys[i],
+                                    trace_id=f"tenant-{i}")
+            for i in range(4)]
+    before = metrics.counters().get("supervisor.batch_launches", 0)
+    results = supervisor.serve(reqs, workers=2, max_batch=4)
+    checks = {"all_ok": all(r["ok"] for r in results)}
+    launches = (metrics.counters().get("supervisor.batch_launches", 0)
+                - before)
+    checks["one_coalesced_launch"] = launches == 1
+    checks["batch_of_4"] = all(
+        r["ok"] and r["value"]["batch_size"] == 4 for r in results)
+    checks["member_trace_ids"] = all(
+        results[i]["value"]["trace_id"] == f"tenant-{i}"
+        for i in range(4))
+    members = [r for r in metrics.recent_records(16)
+               if r["label"] == "batched_member"]
+    checks["member_ledgers"] = (
+        len(members) >= 4
+        and sorted(m["meta"]["trace_id"] for m in members[-4:])
+        == [f"tenant-{i}" for i in range(4)]
+        and len({m["meta"]["batch_run_id"] for m in members[-4:]}) == 1)
+    solo_ok = True
+    for i in range(4):
+        q = qt.create_qureg(n, env)
+        o = circ.run(q, key=keys[i])
+        solo_ok &= bool(jnp.all(o == results[i]["value"]["outcomes"]))
+    checks["outcomes_equal_solo"] = solo_ok
+    text = metrics.export_text()
+    checks["gauges_exported"] = ("quest_batch_occupancy" in text
+                                 and "quest_batch_coalesced_launches"
+                                 in text)
+    ok = all(checks.values())
+    print(json.dumps({"smoke": "batch_serve", "ok": ok, **checks}))
+    return 0 if ok else 1
+
+
+def main(argv) -> int:
+    if "--serve-smoke" in argv:
+        return serve_smoke()
+    return measure()
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

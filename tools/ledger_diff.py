@@ -132,6 +132,18 @@ DEFAULT_RULES = [
     # things (e.g. a leftover QUEST_OVERLAP_QUBITS from a tuning
     # sweep)
     ("comm_hidden_frac", -0.10, "comm_overlap_metric"),
+    # batched-serving throughput: MEASURED circuits/s of N coalesced
+    # same-shape circuits through ONE compiled batched program
+    # (tools/batch_probe.py, annotated by bench.py).  Strictly
+    # regressive at -10% relative: a change that silently
+    # de-coalesces the launch — per-member dispatch creeping back, a
+    # lost compile-cache hit, the admission gate serialising members —
+    # collapses this toward the serial-loop figure (3-6x lower), far
+    # past the allowance, while honest host noise stays inside it.
+    # Binds on `batch_metric` (the probe's own config-encoding metric
+    # string bench.py copies onto the record) so probes of different
+    # workload shapes never gate against each other.
+    ("batch_circuits_per_sec", -0.10, "batch_metric"),
 ]
 
 

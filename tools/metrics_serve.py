@@ -6,7 +6,12 @@ A stdlib-only HTTP server over the always-on telemetry layer
 * ``GET /metrics``  — the process counters, SLO histograms and
   mesh-health gauges as Prometheus text exposition format
   (``metrics.export_text``; same payload as the C API's
-  ``getMetricsText``).
+  ``getMetricsText``).  Includes the batched-serving gauges
+  (``quest_batch_occupancy`` — members of the coalesced launch
+  executing right now — plus the ``quest_batch_coalesced_launches`` /
+  ``quest_batch_solo_launches`` / ``quest_batch_members`` split), so
+  the scrape shows whether ``supervisor.serve``'s batching mode is
+  actually engaging in production.
 * ``GET /healthz``  — JSON verdict wired to the mesh-health registry
   (``resilience.mesh_health``): HTTP 200 while no device is marked
   DEGRADED, 503 once the circuit breaker has tripped — the liveness
@@ -179,14 +184,22 @@ def parse_text(text: str) -> dict:
 
 
 def _demo_run() -> None:
-    """Populate the telemetry with one small real workload, so a
-    standalone serve has non-trivial counters and histograms."""
+    """Populate the telemetry with one small real workload — one plain
+    run plus one batch-of-2 coalesced launch, so a standalone serve
+    carries non-trivial counters, histograms AND the quest_batch_*
+    gauges."""
     import quest_tpu as qt
-    from quest_tpu import models
+    from quest_tpu import models, supervisor
 
     env = qt.create_env(num_devices=1)
     q = qt.create_qureg(6, env)
     models.qft(6).run(q)
+    circ = models.qft(6)
+    circ.measure(0)
+    supervisor.serve(
+        [supervisor.BatchableRun(circ, env, trace_id=f"demo-{i}")
+         for i in range(2)],
+        workers=1, max_batch=2)
 
 
 def main(argv) -> int:

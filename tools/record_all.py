@@ -206,6 +206,41 @@ def overlap_smoke(summary) -> None:
         print(detail)
 
 
+def batch_serve_smoke(summary) -> None:
+    """Tier-2 smoke: tools/batch_probe.py --serve-smoke — 4 queued
+    same-fingerprint ``supervisor.BatchableRun`` requests through
+    ``supervisor.serve(max_batch=4)`` must coalesce into ONE batched
+    launch, preserve each tenant's trace_id on its split-out
+    ``batched_member`` ledger record, return per-member outcomes equal
+    to solo runs with the same keys, and export the ``quest_batch_*``
+    gauges.  A regression that de-coalesces the serving queue (or
+    loses a tenant's attribution inside a batch) fails the recording
+    round here instead of in production dashboards."""
+    import json as _json
+
+    t0 = time.time()
+    ok, detail = False, ""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "batch_probe.py"),
+             "--serve-smoke"],
+            capture_output=True, text=True, cwd=REPO, timeout=600)
+        rec = _json.loads(r.stdout.strip().splitlines()[-1]) \
+            if r.stdout.strip() else {}
+        ok = r.returncode == 0 and rec.get("ok") is True
+        if not ok:
+            detail = (f"rc={r.returncode} rec={rec} "
+                      f"err={r.stderr[-400:]}")
+    except Exception as e:
+        detail = f"{type(e).__name__}: {e}"
+    secs = time.time() - t0
+    summary.append(("batch_serve", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'batch_serve':22s} {secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 def metrics_serve_smoke(summary) -> None:
     """Tier-2 smoke: start tools/metrics_serve.py (--demo populates the
     telemetry with one small run), scrape /metrics and /healthz over
@@ -433,6 +468,7 @@ def main():
     slice_loss_smoke(summary)
     roofline_attr_smoke(summary)
     overlap_smoke(summary)
+    batch_serve_smoke(summary)
     metrics_serve_smoke(summary)
     supervise_smoke(summary)
     chaos_drill_smoke(summary, rnd)

@@ -133,6 +133,26 @@ with metrics.suppressed():
                            sort_keys=True))
 
 # ---------------------------------------------------------------------------
+# Batched multi-register projection: the same plan costed at batch N
+# ---------------------------------------------------------------------------
+# The batch dimension of the multi-register executors (MB_BATCH,
+# default 8): one batched application moves exactly N times one
+# member's exchange volume (the payloads grow a leading member axis —
+# plan_comm_cost(batch=)'s accounting), while the per-item structure
+# and hidden-fraction model stay member-invariant, so the per-member
+# attribution of the one batched launch is the batch-1 row verbatim.
+MB_BATCH = int(os.environ.get("MB_BATCH", "8"))
+with metrics.suppressed():
+    one = plan_comm_cost(plan, N, DEV_BITS)
+    batched = plan_comm_cost(plan, N, DEV_BITS, batch=MB_BATCH)
+assert batched["exchange_elems"] == one["exchange_elems"] * MB_BATCH
+print(f"batched comm cost (batch={MB_BATCH}): "
+      f"{batched['exchange_elems']} elems total, "
+      f"per-member {one['exchange_elems']} "
+      f"(hidden_frac_model {batched['hidden_frac_model']:.3f}, "
+      f"batch-invariant)")
+
+# ---------------------------------------------------------------------------
 # Failure-domain fabric split: the same plan costed over a 2-slice mesh
 # ---------------------------------------------------------------------------
 # Per-fabric (ICI vs cross-slice DCN) exchange volumes of the fused

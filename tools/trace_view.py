@@ -43,11 +43,13 @@ from collections import defaultdict
 #: stdlib-only by design; a test pins the copies).
 COMM_KINDS = {"bitswap", "relayout", "bitswap-send", "relayout-send"}
 #: Items that stream the state through the compute units, including
-#: the pipelined exchange's gather/merge legs.  Mirror of
+#: the pipelined exchange's gather/merge legs and the whole-launch
+#: span of a batched multi-register execution ("batched-run", tagged
+#: with its ``batch`` member count).  Mirror of
 #: quest_tpu.metrics.TIMELINE_COMPUTE_KINDS.
 COMPUTE_KINDS = {"pallas-pass", "xla-segment", "stream", "xla-stream",
                  "bitswap-gather", "bitswap-merge",
-                 "relayout-gather", "relayout-merge"}
+                 "relayout-gather", "relayout-merge", "batched-run"}
 #: The observability layer's own walled items (health / integrity /
 #: checkpoint probes — kind "probe", tagged with a ``trigger`` arg).
 PROBE_KINDS = {"probe"}
@@ -200,6 +202,34 @@ def comm_compute_summary(events: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def batched_summary(events: list[dict]) -> str:
+    """Per-MEMBER attribution of batched launches: every
+    ``batched-run`` event is ONE compiled program over ``batch``
+    stacked members, so a member's device-time share is the launch
+    wall divided by the batch — the number a per-tenant dashboard
+    charges each coalesced request with.  Empty string when the
+    capture holds no batched launches (serial captures keep their old
+    summary byte-for-byte)."""
+    rows = [(e.get("args", {}).get("batch", 1), e.get("dur", 0.0),
+             e.get("args", {}))
+            for e in events if e.get("name") == "batched-run"]
+    if not rows:
+        return ""
+    lines = ["batched launches (one program, N members):",
+             f"{'batch':>7}{'launch ms':>12}{'per-member ms':>15}"
+             f"{'gates':>8}"]
+    for batch, dur, args in rows:
+        batch = max(int(batch), 1)
+        lines.append(f"{batch:>7}{dur / 1e3:>12.2f}"
+                     f"{dur / batch / 1e3:>15.3f}"
+                     f"{args.get('gates', '?'):>8}")
+    members = sum(max(int(b), 1) for b, _d, _a in rows)
+    wall = sum(d for _b, d, _a in rows)
+    lines.append(f"  {len(rows)} launch(es), {members} member(s), "
+                 f"mean per-member {wall / max(members, 1) / 1e3:.3f} ms")
+    return "\n".join(lines)
+
+
 def summarize(events: list[dict], top_k: int = 10) -> str:
     total_us = sum(e.get("dur", 0.0) for e in events)
     by_kind = _kind_rows(events)
@@ -214,6 +244,9 @@ def summarize(events: list[dict], top_k: int = 10) -> str:
     exch = sum(k["bytes"] for k in by_kind.values())
     lines.append(f"exchange bytes (all items): {exch}")
     lines.append(comm_compute_summary(events))
+    bsum = batched_summary(events)
+    if bsum:
+        lines.append(bsum)
     lines.append(f"top {min(top_k, len(events))} items by device time:")
     for e in sorted(events, key=lambda e: -e.get("dur", 0.0))[:top_k]:
         args = e.get("args", {})
