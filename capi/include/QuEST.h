@@ -155,9 +155,14 @@ enum QuESTErrorCode {
                                  * policy is armed) and the run is
                                  * resumable via resumeRun / a
                                  * tools/supervise.py restart         */
-    QUEST_ERROR_OVERLOAD = 7    /* admission gate shed the run (mesh
+    QUEST_ERROR_OVERLOAD = 7,   /* admission gate shed the run (mesh
                                  * unhealthy, concurrency cap, or SLO
                                  * p99 breach); retry after backoff   */
+    QUEST_ERROR_POISONED = 8    /* journaled serving request observed
+                                 * to crash the process repeatedly;
+                                 * quarantined instead of retried —
+                                 * resubmit under a new idempotency
+                                 * key after fixing the request       */
 };
 /* Code/message of the most recent recoverable failure (0 / "" when the
  * last recoverable call succeeded). */

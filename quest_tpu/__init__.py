@@ -66,6 +66,7 @@ from .validation import (
     QuESTTopologyError,
     QuESTPreemptedError,
     QuESTOverloadError,
+    QuESTPoisonedRequestError,
 )
 from .ops.gates import (
     hadamard,
@@ -146,6 +147,8 @@ from .supervisor import (
     request_preemption,
     configure_gate,
     run_or_resume,
+    recover_queue,
+    SessionPool,
 )
 from . import reporting
 from .reporting import (

@@ -551,6 +551,23 @@ def export_text() -> str:
         "batch.solo_launches": c.get("supervisor.solo_launches", 0),
         "batch.members": c.get("supervisor.batch_members", 0),
     })
+    # durable-serving gauges (quest_serve_*): whether the write-ahead
+    # journal / session pool / quarantine layer is engaging — the
+    # unreplayed recovery backlog (non-zero = this replica is busy
+    # finishing a crashed process's queue; /readyz serves 503 for the
+    # same verdict), the replayed/deduped/quarantined counter mirrors,
+    # and the session pool's resident registers + eviction churn
+    gauges.update({
+        "serve.journal_backlog": supervisor.journal_backlog(),
+        "serve.journal_replayed": c.get("supervisor.journal_replayed",
+                                        0),
+        "serve.journal_deduped": c.get("supervisor.journal_deduped",
+                                       0),
+        "serve.quarantined": c.get("supervisor.poison_quarantined", 0),
+        "serve.session_occupancy": supervisor.session_occupancy(),
+        "serve.session_evictions": c.get(
+            "supervisor.session_evictions", 0),
+    })
     return telemetry.render_prometheus(c, histograms(), gauges=gauges)
 
 

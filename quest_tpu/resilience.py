@@ -146,7 +146,12 @@ SEAMS = frozenset({
     "sink_write",      # metrics._sink_write: ledger/timeline/flight sinks
     "mesh_exchange",   # mesh_exec.observe_item: items with communication
     "run_item",        # mesh_exec.observe_item: every observed plan item
+                       # (also consulted once per member by the serving
+                       # front end's coalesced launch — supervisor.
+                       # _run_coalesced — so the `poison` kind lands at
+                       # an exact request)
     "stream_dispatch",  # register._run_gates_inner: donated gate dispatch
+    "journal_append",  # stateio.append_journal_entry: serve WAL append
 })
 
 #: Fault kinds a plan entry may script.  ``delay:<ms>`` (a deterministic
@@ -158,8 +163,13 @@ SEAMS = frozenset({
 #: on the :data:`SDC_SEAMS`; ``preempt`` (a deterministic SIGTERM: the
 #: seam flips the cooperative preempt flag, so the run drains at its
 #: NEXT item boundary exactly as a real signal would — zero
-#: randomness) only on the :data:`PREEMPT_SEAMS`.
-KINDS = ("io", "runtime", "nan", "stall", "preempt")
+#: randomness) only on the :data:`PREEMPT_SEAMS`; ``poison`` (a
+#: deterministic PROCESS DEATH: the seam exits the process immediately
+#: with :data:`POISON_EXIT_CODE`, no drain, no checkpoint — modelling a
+#: request that segfaults/OOM-kills the serving process) only on the
+#: :data:`POISON_SEAMS`, the drill fuel for the write-ahead journal's
+#: poison-request quarantine (``supervisor.serve(journal_dir=)``).
+KINDS = ("io", "runtime", "nan", "stall", "preempt", "poison")
 
 #: The seams that model slow/hung devices (``delay:<ms>`` / ``stall``):
 #: the ones walled by the collective watchdog.
@@ -176,6 +186,22 @@ SDC_SEAMS = ("mesh_exchange", "run_item")
 #: per-item seams: a preemption drill fires at a scripted plan item,
 #: modelling a SIGTERM that arrived while that item executed).
 PREEMPT_SEAMS = ("mesh_exchange", "run_item")
+
+#: The seam that may script a deterministic ``poison`` process death.
+#: Only ``run_item``: the kind models a REQUEST killing the process at
+#: launch, and the serving front end consults exactly this seam once
+#: per coalesced-launch member (``supervisor._run_coalesced``) — so a
+#: scripted hit index names a specific in-flight request, making the
+#: journal's quarantine-on-attempt-N contract drillable with zero
+#: randomness.
+POISON_SEAMS = ("run_item",)
+
+#: Exit status of a scripted ``poison`` death: 128+9, the conventional
+#: SIGKILL spelling — deliberately NOT one of the resumable lifecycle
+#: codes ``tools/supervise.py`` keys its default restart on (a crash is
+#: only relaunched under its explicit ``--restart-on-crash`` serving
+#: mode, where the journal's quarantine bounds the loop).
+POISON_EXIT_CODE = 137
 
 #: The seams that model FAILURE-DOMAIN faults (``slice_loss:<s>`` — a
 #: whole slice dies: every chip of slice ``s`` is marked DEGRADED and
@@ -196,6 +222,9 @@ RETRY_POLICY = {
     "ckpt_save": 3,
     "ckpt_load": 3,
     "sink_write": 1,
+    # the serve journal IS the recovery path for queued requests, so it
+    # tries as hard as checkpoint I/O
+    "journal_append": 3,
 }
 
 #: Backoff base delay in seconds; attempt i sleeps base * 2^(i-1) —
@@ -375,6 +404,11 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
                 f"fault kind 'preempt' models a mid-run SIGTERM and "
                 f"is valid only on the {sorted(PREEMPT_SEAMS)} seams, "
                 f"not {seam!r}")
+        if kind == "poison" and seam not in POISON_SEAMS:
+            raise QuESTValidationError(
+                f"fault kind 'poison' models a request killing the "
+                f"process and is valid only on the "
+                f"{sorted(POISON_SEAMS)} seam, not {seam!r}")
         if (slice_loss_param(kind) is not None
                 or dcn_flap_ms(kind) is not None) \
                 and seam not in SLICE_SEAMS:
@@ -479,8 +513,12 @@ def fault_point(name: str) -> str | None:
     ``preempt`` flips the cooperative preemption flag
     (``supervisor.request_preemption``) and RETURNS ``"preempt"`` —
     the item completes and the run drains at its next boundary, a
-    deterministic SIGTERM.  With no plan installed this is a single
-    dict lookup and returns None."""
+    deterministic SIGTERM; ``poison`` EXITS THE PROCESS immediately
+    (``os._exit(POISON_EXIT_CODE)``, no drain, no checkpoint) — the
+    deterministic spelling of a request that segfaults the serving
+    process, which the write-ahead journal's quarantine must bound.
+    With no plan installed this is a single dict lookup and returns
+    None."""
     if _plan is None and not os.environ.get("QUEST_FAULT_PLAN"):
         return None
     plan = _current_plan()
@@ -514,6 +552,12 @@ def fault_point(name: str) -> str | None:
         supervisor.request_preemption(
             source=f"fault:{name}:{idx}")
         return "preempt"
+    if fired == "poison":
+        # a deterministic process DEATH: no drain, no checkpoint, no
+        # atexit — the ungraceful failure mode (segfault, OOM kill)
+        # the write-ahead request journal exists to survive.  os._exit
+        # so not even finally blocks run, exactly like the real thing.
+        os._exit(POISON_EXIT_CODE)
     if sdc_params(fired) is not None:
         return fired
     if slice_loss_param(fired) is not None or dcn_flap_ms(fired) is not None:

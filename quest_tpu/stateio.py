@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading as _threading
 
 import numpy as np
 import jax
@@ -374,3 +375,206 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
     # component arrays back into the one storage array (row sharding
     # preserved; device_put pins the register's own sharding)
     qureg._set_state(jax.device_put(merge_amps(out["re"], out["im"]), sh))
+
+
+# ---------------------------------------------------------------------------
+# Write-ahead serve journal (supervisor.serve(journal_dir=...))
+# ---------------------------------------------------------------------------
+#
+# The durable-serving layer's on-disk format (ISSUE 15): an append-only
+# JSONL file where every line frames one record as
+#
+#     {"crc": "<crc32 of the canonical record JSON>", "rec": {...}}
+#
+# Appends are flushed AND fsynced before the caller proceeds — a record
+# the supervisor acted on must survive the process dying the very next
+# instruction — and run under the ``journal_append`` retry seam.  The
+# sibling ``journal.json`` sidecar (format version, kind) is written
+# once via the same write-temp-then-atomic-rename discipline every
+# other stateio sidecar uses, so a torn sidecar can never exist next to
+# a live journal.  Reads tolerate exactly the failure modes a crash can
+# produce: a TORN FINAL LINE (the append that died mid-write) is
+# ignored with a one-shot warning, while an interior undecodable line
+# or a checksum mismatch — which a crash cannot produce, only bitrot or
+# tampering can — is skipped AND counted
+# (``supervisor.journal_corrupt_entries``), never silently trusted.
+
+#: Journal file and sidecar names inside a journal directory.
+JOURNAL = "journal.jsonl"
+JOURNAL_META = "journal.json"
+
+#: Current journal format (the sidecar's ``format_version``).
+JOURNAL_FORMAT_VERSION = 1
+
+#: Serializes in-process journal appends: the torn-tail heal reads the
+#: file's last byte, and racing it against another thread's buffered
+#: multi-``write()`` flush could misread a mid-append state as a torn
+#: tail and truncate a record being written.
+_journal_lock = _threading.Lock()
+
+
+def _journal_crc(body: str) -> str:
+    import zlib
+
+    return f"{zlib.crc32(body.encode()):08x}"
+
+
+def _warn_torn(path: str) -> None:
+    from . import metrics
+
+    metrics.warn_once(
+        "journal_torn_tail",
+        f"serve journal {path} ends in a torn line (the append in "
+        "flight when the process died); the unacknowledged record "
+        "is ignored")
+
+
+def _heal_torn_tail(path: str) -> None:
+    """Repair a newline-less final line a crash left behind, BEFORE
+    appending: an `'a'`-mode write onto such a tail would glue the new
+    record to it, turning BOTH into one interior undecodable line —
+    the new record, though acknowledged, would be silently dropped by
+    the next scan.  The repair must AGREE with :func:`read_journal`'s
+    verdict on the same bytes: a tail that parses and passes its CRC
+    (the crash tore exactly the trailing newline) is a record the scan
+    just COUNTED, so it is kept — newline-terminated in place — while
+    a tail that fails either check is the unacknowledged in-flight
+    append and is truncated, matching the read's torn-tail drop.  An
+    I/O failure here PROPAGATES: a journal we cannot inspect/repair
+    must not be appended to — gluing would lose the new record."""
+    if not os.path.getsize(path):
+        return
+    with open(path, "rb+") as f:
+        f.seek(-1, os.SEEK_END)
+        if f.read(1) == b"\n":
+            return
+        f.seek(0)
+        data = f.read()
+        tail = data[data.rfind(b"\n") + 1:]
+        try:
+            frame = json.loads(tail.decode())
+            ok = (_journal_crc(json.dumps(frame["rec"],
+                                          sort_keys=True))
+                  == frame["crc"])
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            ok = False
+        if ok:
+            f.write(b"\n")
+            return
+        f.truncate(len(data) - len(tail))
+    _warn_torn(path)
+
+
+def append_journal_entries(directory: str, recs: list[dict]) -> None:
+    """Durably append records to the serve journal under ``directory``
+    (created — with its atomically-written ``journal.json`` sidecar —
+    on first use).  Each line is CRC32-framed over its record's
+    canonical (sorted-keys) JSON; the whole batch is ONE
+    open/write/flush/fsync (a journaled serve's accept pass lands N
+    records for the price of one sync), a pre-existing torn tail is
+    truncated first (see :func:`_heal_torn_tail`), and the open runs
+    under the bounded ``journal_append`` retry seam."""
+    from . import resilience
+
+    if not recs:
+        return
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    meta_path = os.path.join(directory, JOURNAL_META)
+    if not os.path.isfile(meta_path):
+        resilience.with_retries(
+            lambda: resilience._write_json_atomic(
+                meta_path, {"format_version": JOURNAL_FORMAT_VERSION,
+                            "kind": "serve-journal"}),
+            seam="journal_append")
+    lines = []
+    for rec in recs:
+        body = json.dumps(rec, sort_keys=True)
+        lines.append(json.dumps({"crc": _journal_crc(body),
+                                 "rec": rec}, sort_keys=True) + "\n")
+    path = os.path.join(directory, JOURNAL)
+    with _journal_lock:
+        if os.path.isfile(path):
+            _heal_torn_tail(path)
+        f = resilience.with_retries(lambda: open(path, "a"),
+                                    seam="journal_append")
+        try:
+            # the write itself is single-shot (appends are not
+            # idempotent: a retried half-landed line would glue a
+            # fragment to a duplicate record — the _sink_write rule);
+            # durability comes from the fsync, not from retrying
+            f.write("".join(lines))
+            f.flush()
+            os.fsync(f.fileno())
+        finally:
+            f.close()
+
+
+def append_journal_entry(directory: str, rec: dict) -> None:
+    """Durably append one record to the serve journal — a batch of one
+    through :func:`append_journal_entries`."""
+    append_journal_entries(directory, [rec])
+
+
+def read_journal(directory: str) -> list[dict]:
+    """Read every valid record from the serve journal under
+    ``directory`` (missing directory/file: ``[]`` — recovery on a
+    never-journaled dir is a no-op).
+
+    Tolerated damage, in the only two shapes it can take:
+
+    * a TORN FINAL LINE — the append in flight when the process died
+      (no trailing newline, or the tail fails to parse): ignored, with
+      a one-shot ``journal_torn_tail`` warning.  The record was never
+      acknowledged, so dropping it is the correct replay semantics.
+    * an INTERIOR undecodable line or a CRC mismatch anywhere — bitrot
+      or tampering, which a crash cannot produce: the entry is skipped,
+      counted (``supervisor.journal_corrupt_entries``) and warned once;
+      the surviving records still replay.
+    """
+    from . import metrics
+
+    path = os.path.join(os.path.abspath(directory), JOURNAL)
+    if not os.path.isfile(path):
+        return []
+    with open(path) as f:
+        text = f.read()
+    lines = text.split("\n")
+    # a file not ending in "\n" has a partial final line: the torn tail
+    torn_tail = bool(text) and not text.endswith("\n")
+    out: list[dict] = []
+    for n, raw in enumerate(lines):
+        raw = raw.strip()
+        if not raw:
+            continue
+        is_tail = torn_tail and n == len(lines) - 1
+        try:
+            frame = json.loads(raw)
+            rec = frame["rec"]
+            want = frame["crc"]
+        except (ValueError, KeyError, TypeError):
+            if is_tail:
+                _warn_torn(path)
+                continue
+            metrics.counter_inc("supervisor.journal_corrupt_entries")
+            metrics.warn_once(
+                "journal_corrupt",
+                f"serve journal {path} line {n + 1} is undecodable; "
+                "skipped (supervisor.journal_corrupt_entries counts "
+                "further damage)")
+            continue
+        if _journal_crc(json.dumps(rec, sort_keys=True)) != want:
+            if is_tail:
+                # a truncated tail can still parse as JSON by luck;
+                # the CRC proves it incomplete — same torn semantics
+                _warn_torn(path)
+                continue
+            metrics.counter_inc("supervisor.journal_corrupt_entries")
+            metrics.warn_once(
+                "journal_corrupt",
+                f"serve journal {path} line {n + 1} failed its CRC32 "
+                "check; skipped (supervisor.journal_corrupt_entries "
+                "counts further damage)")
+            continue
+        out.append(rec)
+    return out

@@ -50,28 +50,77 @@ consults any of them beyond a flag read):
   200/503.  :func:`serve` is the bounded-concurrency in-process run
   queue on top of the gate.
 
+On top of the lifecycle layer sits the DURABLE SERVING front end
+(ISSUE 15) — :func:`serve` grown four strictly-opt-in subsystems that
+make the serving state itself survive a process death:
+
+* **Write-ahead request journal** — ``serve(journal_dir=...)`` appends
+  every accepted :class:`BatchableRun` (ops, qubits, dtype, PRNG key,
+  tenant, trace_id, idempotency key, attempt count) to a CRC32-framed
+  fsynced JSONL journal (``stateio.append_journal_entry``) BEFORE it
+  launches, and marks completion with the result digest.  A relaunch
+  that calls the same ``serve`` again replays only the incomplete
+  entries — completed idempotency keys return their journaled result
+  instead of re-running (exactly-once), and
+  :func:`recover_queue` reconstructs the backlog as live requests even
+  without the original request list.
+
+* **Session pool** — :class:`SessionPool` holds named LONG-LIVED
+  registers that ``BatchableRun(session=...)`` requests target instead
+  of a fresh |0...0>: capacity-bounded, LRU eviction spills a session
+  through the existing checksummed checkpoint path
+  (``stateio.save_checkpoint``) and restores it bit-identically on the
+  next touch, so sessions survive both capacity pressure and process
+  restarts.
+
+* **Poison-request quarantine** — journal attempt counts bound the
+  crash loop: a request observed to kill the process
+  ``QUEST_POISON_ATTEMPTS`` times (default 2) without completing is
+  QUARANTINED with a typed
+  :class:`~quest_tpu.validation.QuESTPoisonedRequestError` (ABI code
+  8) on replay instead of retried.  The deterministic ``poison`` fault
+  kind (``resilience`` — process exit at the ``run_item`` seam, which
+  the coalesced launch consults once per member) makes the whole
+  contract drillable.
+
+* **Per-tenant fairness** — requests carry a ``tenant``; the
+  dispatcher dequeues launch units WEIGHTED ROUND-ROBIN across
+  tenants (coalescing still order-preserving within a tenant),
+  enforces per-tenant in-flight caps by deferring (never reordering
+  within the tenant), and sheds work beyond a tenant's queue-depth
+  quota with ``QuESTOverloadError`` naming the tenant — one tenant's
+  burst can no longer starve the rest.
+
 ``tools/supervise.py`` is the out-of-process face: a stdlib-only
 restart loop that relaunches a run script whenever it exits with the
-preempted/deadline codes, making kill→resume chains fully automatic
-(:func:`run_or_resume` / :func:`supervised_main` are the script-side
-helpers).  Everything here is deterministic — no randomness in
-sampling, shedding, or backoff — so every lifecycle drill reproduces
-exactly (``tools/chaos_drill.py`` rows ``preempt_drain`` /
-``deadline_budget`` / ``overload_shed``).
+preempted/deadline codes — or, under ``--restart-on-crash`` (the
+journaled-serving mode), ANY nonzero exit within the restart budget —
+making kill→resume chains fully automatic (:func:`run_or_resume` /
+:func:`supervised_main` are the script-side helpers).  Everything here
+is deterministic — no randomness in sampling, shedding, dispatch, or
+backoff — so every lifecycle drill reproduces exactly
+(``tools/chaos_drill.py`` rows ``preempt_drain`` / ``deadline_budget``
+/ ``overload_shed`` / ``serve_crash_replay`` / ``poison_quarantine`` /
+``session_evict_restore``).
 """
 
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
+import numbers
 import os
 import signal
 import sys
 import threading
+import weakref
 
 from . import metrics
 from . import telemetry
-from .validation import (QuESTOverloadError, QuESTPreemptedError,
-                         QuESTTimeoutError, QuESTValidationError)
+from .validation import (QuESTOverloadError, QuESTPoisonedRequestError,
+                         QuESTPreemptedError, QuESTTimeoutError,
+                         QuESTValidationError)
 
 #: Default retry_after_s hint carried by shed runs (override via
 #: configure_gate / QUEST_RETRY_AFTER_S).
@@ -556,11 +605,19 @@ def admit(label: str = "circuit_run", batch: int = 1) -> None:
 
 def readiness():
     """The ``/readyz`` verdict (never counts a decision): ``(ready,
-    reason, retry_after_s)`` — ready iff the process is not draining
-    AND the admission gate would admit a run right now."""
+    reason, retry_after_s)`` — ready iff the process is not draining,
+    is not mid journal recovery (an unreplayed backlog from a prior
+    process means this replica is busy finishing crashed work — a load
+    balancer should not route new traffic here yet), AND the admission
+    gate would admit a run right now."""
     if _preempt["flag"]:
         return (False, "draining (preemption requested by "
                        f"{_preempt['source']})", retry_after_s())
+    backlog = journal_backlog()
+    if backlog:
+        return (False, f"journal recovery in progress: {backlog} "
+                       "unreplayed backlog entry(ies) from a prior "
+                       "process", retry_after_s())
     if not gate_enabled():
         return True, None, 0.0
     ok, reason, _kind = _evaluate_gate()
@@ -618,7 +675,8 @@ def in_recovery() -> bool:
 
 
 # ---------------------------------------------------------------------------
-# Bounded-concurrency in-process run queue (+ batching mode, ISSUE 14)
+# Bounded-concurrency in-process run queue (+ batching mode, ISSUE 14;
+# durable serving: journal / sessions / quarantine / fairness, ISSUE 15)
 # ---------------------------------------------------------------------------
 
 #: Members of currently-executing coalesced launches (0 while none in
@@ -627,6 +685,74 @@ def in_recovery() -> bool:
 #: launches, and one launch finishing must not zero out another's
 #: occupancy mid-scrape.
 _batch = {"occupancy": 0}
+
+#: Tenant bucket for requests that do not name one.
+TENANT_DEFAULT = "default"
+
+#: Launches-without-completion after which a journaled request is
+#: quarantined instead of retried (override: QUEST_POISON_ATTEMPTS).
+POISON_ATTEMPTS_DEFAULT = 2
+
+#: Unreplayed journal-backlog entries from a PRIOR process currently
+#: being recovered (the ``quest_serve_journal_backlog`` gauge; /readyz
+#: reports not-ready while it is non-zero).  Guarded by _lock.
+_journal_recovery = {"pending": 0}
+
+#: Live session pools (gauge registry — ``session_occupancy``).
+_pools: "weakref.WeakSet[SessionPool]" = weakref.WeakSet()
+
+#: Stable env identity tokens for BatchableRun.fingerprint: a monotonic
+#: counter handed out per LIVE env instance.  ``id(env)`` alone is a
+#: coalescing hazard — CPython recycles addresses, so a GC'd env's id
+#: can reappear on a DIFFERENT env and silently batch requests across
+#: environments.  The weakref callback retires an entry when its env
+#: dies, and the counter never reuses a token, so a recycled address
+#: gets a FRESH token.  Guarded by _lock.
+_env_tokens: dict = {"next": 0, "by_id": {}}
+
+
+def poison_attempts() -> int:
+    """The quarantine threshold: a journaled request launched this many
+    times without ever completing is poisoned (``QUEST_POISON_ATTEMPTS``,
+    default :data:`POISON_ATTEMPTS_DEFAULT`)."""
+    try:
+        v = int(os.environ["QUEST_POISON_ATTEMPTS"])
+    except (KeyError, ValueError):
+        return POISON_ATTEMPTS_DEFAULT
+    return v if v > 0 else POISON_ATTEMPTS_DEFAULT
+
+
+def journal_backlog() -> int:
+    """Unreplayed journal-backlog entries from a prior process still
+    being recovered by a running :func:`serve` (0 outside recovery) —
+    the ``quest_serve_journal_backlog`` gauge, and a /readyz 503 while
+    non-zero (a replica mid-recovery should not take new traffic)."""
+    with _lock:
+        return _journal_recovery["pending"]
+
+
+def session_occupancy() -> int:
+    """Resident registers across every live :class:`SessionPool` (the
+    ``quest_serve_session_occupancy`` gauge)."""
+    return sum(p.occupancy() for p in list(_pools))
+
+
+def _env_token(env) -> int:
+    """The stable identity token of ``env`` (see :data:`_env_tokens`)."""
+    with _lock:
+        ent = _env_tokens["by_id"].get(id(env))
+        if ent is not None and ent[1]() is env:
+            return ent[0]
+        _env_tokens["next"] += 1
+        tok = _env_tokens["next"]
+
+        def _retire(_ref, _eid=id(env)):
+            # dict ops are GIL-atomic; taking _lock here could deadlock
+            # against a GC triggered while the lock is already held
+            _env_tokens["by_id"].pop(_eid, None)
+
+        _env_tokens["by_id"][id(env)] = (tok, weakref.ref(env, _retire))
+        return tok
 
 
 def batch_occupancy() -> int:
@@ -639,7 +765,9 @@ def batch_occupancy() -> int:
 
 class BatchableRun:
     """One coalescible serving request: run ``circuit`` on a fresh
-    |0...0> register in ``env`` and return its measurement outcomes.
+    |0...0> register in ``env`` — or, with ``session=``, on a named
+    long-lived register held by the serve call's
+    :class:`SessionPool` — and return its measurement outcomes.
 
     Requests whose :meth:`fingerprint` matches — same op stream, qubit
     count, kind, dtype, environment — are COALESCED by
@@ -650,26 +778,556 @@ class BatchableRun:
     ledger record (and in the member's result), so per-tenant
     attribution survives the coalescing.  ``key`` is the member's
     PRNG key (all-or-none per batch: mixing keyed and keyless
-    requests in one launch would silently re-key someone)."""
+    requests in one launch would silently re-key someone).
 
-    __slots__ = ("circuit", "env", "dtype", "key", "trace_id")
+    ``tenant`` names the request's fairness bucket (weighted
+    round-robin dispatch, in-flight caps, queue-depth quotas — see
+    :func:`serve`); unset requests share :data:`TENANT_DEFAULT`.
+    ``idempotency_key`` is the request's exactly-once identity under a
+    write-ahead journal (``serve(journal_dir=...)``): a completed key
+    returns its journaled result instead of re-running, and a key
+    observed to kill the process repeatedly is quarantined.  Omitted,
+    a deterministic key is derived from the request's content and
+    queue position, so an identical relaunch dedupes naturally.
+    ``session`` requests always run SOLO (never coalesced — members of
+    one batched launch must share the fresh |0...0> start), in
+    submission order per session."""
+
+    __slots__ = ("circuit", "env", "dtype", "key", "trace_id",
+                 "tenant", "idempotency_key", "session")
 
     def __init__(self, circuit, env, *, dtype=None, key=None,
-                 trace_id: str | None = None):
+                 trace_id: str | None = None,
+                 tenant: str | None = None,
+                 idempotency_key: str | None = None,
+                 session: str | None = None):
         self.circuit = circuit
         self.env = env
         self.dtype = dtype
         self.key = key
         self.trace_id = trace_id
+        self.tenant = tenant
+        self.idempotency_key = idempotency_key
+        self.session = session
 
     def fingerprint(self) -> tuple:
         """Coalescing identity: requests batch together iff this
         matches (circuit ops are hashable tuples — the same content
-        key ``Circuit.compile`` memoises on)."""
+        key ``Circuit.compile`` memoises on).  The environment leg is
+        a STABLE per-instance token plus the device count and live
+        comm config — never ``id(env)``, whose recycling after a GC
+        could coalesce requests across different environments."""
+        from .parallel.mesh_exec import comm_config_token
+
         return (tuple(self.circuit.ops), self.circuit.num_qubits,
                 self.circuit.is_density,
                 None if self.dtype is None else str(self.dtype),
-                id(self.env))
+                ("env", _env_token(self.env), self.env.num_devices,
+                 comm_config_token()),
+                self.session)
+
+
+# ---------------------------------------------------------------------------
+# Journal codec: requests <-> JSON records (stateio owns the framing)
+# ---------------------------------------------------------------------------
+
+
+def _encode_ops(ops) -> list:
+    """Circuit op stream as pure JSON: ops are nested tuples of
+    ints/floats/strings (hashable by design), so tuples become lists
+    and numeric scalars normalise through int/float — floats survive a
+    JSON round trip bit-exactly (shortest-repr), which is what makes a
+    replayed request's compiled program identical to the original's."""
+    def enc(v):
+        if isinstance(v, tuple):
+            return [enc(x) for x in v]
+        if isinstance(v, (str, bool)) or v is None:
+            return v
+        if isinstance(v, numbers.Integral):
+            return int(v)
+        if isinstance(v, numbers.Real):
+            return float(v)
+        raise QuESTValidationError(
+            f"serve journal: op value {v!r} ({type(v).__name__}) is "
+            "not journalable — journaled circuits must record plain "
+            "numeric op streams")
+
+    return [enc(op) for op in ops]
+
+
+def _decode_ops(doc) -> list:
+    def dec(v):
+        if isinstance(v, list):
+            return tuple(dec(x) for x in v)
+        return v
+
+    return [dec(op) for op in doc or []]
+
+
+def _encode_prng(key):
+    """A member PRNG key as JSON (raw uint32 ``PRNGKey`` arrays and
+    new-style typed keys both round-trip bit-exactly)."""
+    if key is None:
+        return None
+    import jax
+    import numpy as np
+
+    typed = False
+    arr = key
+    try:
+        if jax.dtypes.issubdtype(arr.dtype, jax.dtypes.prng_key):
+            typed = True
+            arr = jax.random.key_data(arr)
+    except (AttributeError, TypeError):
+        pass
+    a = np.asarray(arr)
+    return {"typed": typed, "dtype": str(a.dtype),
+            "shape": list(a.shape),
+            "data": [int(x) for x in a.reshape(-1).tolist()]}
+
+
+def _decode_prng(doc):
+    if doc is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    a = np.asarray(doc["data"], dtype=np.dtype(doc["dtype"])) \
+        .reshape(tuple(doc["shape"]))
+    k = jnp.asarray(a)
+    if doc.get("typed"):
+        k = jax.random.wrap_key_data(k)
+    return k
+
+
+def _auto_idem_key(req: BatchableRun, index: int) -> str:
+    """Deterministic idempotency key for a request that did not bring
+    one: content hash over (ops, shape, dtype, PRNG key, trace, tenant)
+    plus the QUEUE POSITION — so the same request list replayed by a
+    relaunch dedupes entry-for-entry, while two intentionally identical
+    submissions at different positions stay distinct requests."""
+    import numpy as np
+
+    doc = {"ops": _encode_ops(req.circuit.ops),
+           "nq": int(req.circuit.num_qubits),
+           "density": bool(req.circuit.is_density),
+           "dtype": (None if req.dtype is None
+                     else str(np.dtype(req.dtype))),
+           "prng": _encode_prng(req.key),
+           "trace": req.trace_id, "tenant": req.tenant, "i": int(index)}
+    h = hashlib.sha256(json.dumps(doc, sort_keys=True).encode())
+    return f"auto-{h.hexdigest()[:16]}"
+
+
+def _accept_record(req: BatchableRun, key: str, index: int,
+                   attempts: int) -> dict:
+    import numpy as np
+
+    return {"kind": "accept", "key": key,
+            "tenant": req.tenant or TENANT_DEFAULT,
+            "trace_id": req.trace_id,
+            "num_qubits": int(req.circuit.num_qubits),
+            "is_density": bool(req.circuit.is_density),
+            "dtype": (None if req.dtype is None
+                      else str(np.dtype(req.dtype))),
+            "prng": _encode_prng(req.key),
+            "ops": _encode_ops(req.circuit.ops),
+            "attempts": int(attempts), "index": int(index)}
+
+
+def _request_from_record(rec: dict, env) -> BatchableRun:
+    """Reconstruct a live request from its journal ``accept`` record
+    (the :func:`recover_queue` path: replay a crashed process's backlog
+    without the original request list)."""
+    from .circuit import Circuit
+    import numpy as np
+
+    circ = Circuit(int(rec["num_qubits"]), bool(rec.get("is_density")))
+    circ.ops.extend(_decode_ops(rec.get("ops")))
+    return BatchableRun(
+        circ, env,
+        dtype=(None if rec.get("dtype") is None
+               else np.dtype(rec["dtype"])),
+        key=_decode_prng(rec.get("prng")),
+        trace_id=rec.get("trace_id"),
+        tenant=rec.get("tenant"),
+        idempotency_key=rec.get("key"))
+
+
+def _result_digest(value: dict) -> tuple:
+    """``(digest, outcomes_list)`` of one completed member's result —
+    what the journal's ``complete`` record carries.  Measurement
+    outcomes digest (and journal) directly; measurement-free members
+    digest their final state bytes (the register itself is not
+    journaled — a dedupe replay of a stateless request returns the
+    digest, not the state)."""
+    import numpy as np
+
+    out = value.get("outcomes")
+    if out is not None:
+        lst = [int(x) for x in np.asarray(out).reshape(-1).tolist()]
+        h = hashlib.sha256(json.dumps(lst).encode()).hexdigest()[:16]
+        return "o:" + h, lst
+    q = value.get("qureg")
+    if q is not None:
+        a = np.ascontiguousarray(np.asarray(q.amps))
+        return "s:" + hashlib.sha256(a.tobytes()).hexdigest()[:16], None
+    return None, None
+
+
+def _journal_value(rec: dict, key: str) -> dict:
+    """The deduped result a completed journal entry stands in for."""
+    out = rec.get("outcomes")
+    if out is not None:
+        import numpy as np
+
+        out = np.asarray(out, dtype=np.int32)
+    return {"outcomes": out, "trace_id": rec.get("trace_id"),
+            "journaled": True, "digest": rec.get("digest"),
+            "idempotency_key": key}
+
+
+def _journal_scan(directory: str) -> dict:
+    """Fold the journal's records into replay state: first ``accept``
+    per key (in order), ``launch``/``failed`` counts, first
+    ``complete``, and the ``quarantine`` set.  A ``failed`` record is
+    an IN-PROCESS typed failure (shed, preemption drain, executor
+    error) journaled by the surviving worker — a launch with neither
+    ``complete`` nor ``failed`` is the signature of a process death,
+    and only those count toward poison quarantine."""
+    from . import stateio
+
+    recs = stateio.read_journal(directory)
+    accepted: dict = {}
+    order: list = []
+    launches: dict = {}
+    failed: dict = {}
+    completed: dict = {}
+    quarantined: set = set()
+    for r in recs:
+        k = r.get("key")
+        if k is None:
+            continue
+        kind = r.get("kind")
+        if kind == "accept":
+            if k not in accepted:
+                accepted[k] = r
+                order.append(k)
+        elif kind == "launch":
+            launches[k] = launches.get(k, 0) + 1
+        elif kind == "failed":
+            failed[k] = failed.get(k, 0) + 1
+        elif kind == "complete":
+            completed.setdefault(k, r)
+        elif kind == "quarantine":
+            quarantined.add(k)
+    return {"accepted": accepted, "order": order, "launches": launches,
+            "failed": failed, "completed": completed,
+            "quarantined": quarantined, "entries": len(recs)}
+
+
+def recover_queue(directory: str, env=None) -> dict:
+    """Replay state of the serve journal under ``directory`` — the
+    crash-recovery entry point.  Returns::
+
+        {"entries":     total valid journal records,
+         "backlog":     [accept records never completed/quarantined,
+                         in acceptance order],
+         "launches":    {key: observed launch count},
+         "failed":      {key: in-process typed failure count — these
+                         launches did NOT kill the process and never
+                         count toward quarantine},
+         "completed":   {key: journaled result (outcomes/digest/trace)},
+         "quarantined": [poisoned keys]}
+
+    plus ``"requests"`` — the backlog reconstructed as live
+    :class:`BatchableRun` objects — when ``env`` is given; feed those
+    straight back into ``serve(requests, journal_dir=directory)`` to
+    finish the crashed process's queue exactly-once.  An empty or
+    missing directory is a no-op (everything empty): recovery is
+    always safe to attempt."""
+    st = _journal_scan(directory)
+    backlog = [st["accepted"][k] for k in st["order"]
+               if k not in st["completed"]
+               and k not in st["quarantined"]]
+    out = {"entries": st["entries"], "backlog": backlog,
+           "launches": dict(st["launches"]),
+           "failed": dict(st["failed"]),
+           "completed": {k: _journal_value(r, k)
+                         for k, r in st["completed"].items()},
+           "quarantined": sorted(st["quarantined"])}
+    if env is not None:
+        out["requests"] = [_request_from_record(r, env)
+                           for r in backlog]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Session pool: named long-lived registers with LRU spill/restore
+# ---------------------------------------------------------------------------
+
+
+def _np_dtype(dtype):
+    import numpy as np
+
+    return np.dtype(dtype)
+
+
+class SessionPool:
+    """Named LONG-LIVED registers for multi-turn tenants (ROADMAP item
+    3's session half): a request targeting ``session="alice"`` runs on
+    alice's register — accumulated state and all — instead of a fresh
+    |0...0>.
+
+    Capacity-bounded: at most ``capacity`` registers stay RESIDENT in
+    device memory; admitting one more spills the least-recently-used
+    unpinned session through the existing checksummed checkpoint path
+    (``stateio.save_checkpoint`` → ``directory/<name>/``) and the next
+    touch restores it BIT-IDENTICALLY (spill → restore → continue
+    equals uninterrupted — property-pinned in
+    ``tests/test_durable_serving.py``).  Because spill state is the
+    ordinary v2 checkpoint format, sessions also survive process
+    restarts: a fresh pool over the same directory restores them on
+    first touch.  All mutations are lock-serialised; :func:`serve`
+    additionally dispatches at most ONE in-flight request per session
+    (submission order preserved), and pins a session for the duration
+    of its run so eviction can never spill a register mid-mutation.
+
+    Counters: ``supervisor.session_creates`` / ``session_restores`` /
+    ``session_evictions``; the ``quest_serve_session_occupancy`` gauge
+    sums residents across live pools."""
+
+    def __init__(self, env, directory: str, capacity: int = 4):
+        capacity = int(capacity)
+        if capacity < 1:
+            raise QuESTValidationError(
+                f"SessionPool: capacity must be >= 1, got {capacity}")
+        self.env = env
+        self.directory = os.path.abspath(directory)
+        self.capacity = capacity
+        self._plock = threading.RLock()
+        self._seq = 0
+        #: name -> {"qureg", "last" (LRU seq), "pins"}
+        self._resident: dict = {}
+        _pools.add(self)
+
+    @staticmethod
+    def _check_name(name: str) -> str:
+        name = str(name)
+        if (not name or name.startswith(".")
+                or not all(c.isalnum() or c in "._-" for c in name)):
+            raise QuESTValidationError(
+                f"SessionPool: session name {name!r} must be non-empty "
+                "[A-Za-z0-9._-] and not start with '.' (it becomes an "
+                "on-disk directory name)")
+        return name
+
+    def _dir(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    def occupancy(self) -> int:
+        """Registers currently resident in device memory."""
+        with self._plock:
+            return len(self._resident)
+
+    def names(self) -> list:
+        """Resident session names (sorted)."""
+        with self._plock:
+            return sorted(self._resident)
+
+    def spilled(self) -> list:
+        """Sessions with spilled on-disk state (sorted; includes ones
+        also resident when a stale spill dir remains)."""
+        from . import stateio
+
+        if not os.path.isdir(self.directory):
+            return []
+        return sorted(
+            n for n in os.listdir(self.directory)
+            if os.path.isfile(os.path.join(self._dir(n), stateio._META)))
+
+    def session(self, name: str, num_qubits: int | None = None, *,
+                is_density: bool = False, dtype=None):
+        """The named session's register — created fresh (|0...0>,
+        ``num_qubits`` required), restored from spill, or the resident
+        one — LRU-touched but NOT pinned (the direct-driver form;
+        :func:`serve` uses :meth:`acquire`/:meth:`release`)."""
+        return self.acquire(name, num_qubits, is_density=is_density,
+                            dtype=dtype, pin=False)
+
+    def acquire(self, name: str, num_qubits: int | None = None, *,
+                is_density: bool = False, dtype=None, pin: bool = True):
+        name = self._check_name(name)
+        with self._plock:
+            self._seq += 1
+            ent = self._resident.get(name)
+            if ent is None:
+                qureg = self._load_or_create(name, num_qubits,
+                                             is_density, dtype)
+                self._admit(name, qureg)
+                ent = self._resident[name]
+            q = ent["qureg"]
+            if num_qubits is not None and (
+                    q.num_qubits != int(num_qubits)
+                    or q.is_density != bool(is_density)):
+                raise QuESTValidationError(
+                    f"SessionPool: session {name!r} is a "
+                    f"{q.num_qubits}-qubit "
+                    f"{'density matrix' if q.is_density else 'state-vector'}"
+                    f"; the request wants {int(num_qubits)} qubits "
+                    f"(density={bool(is_density)}) — sessions never "
+                    "silently change shape")
+            if dtype is not None \
+                    and q.amps.dtype != _np_dtype(dtype):
+                raise QuESTValidationError(
+                    f"SessionPool: session {name!r} is "
+                    f"{q.amps.dtype}; the request wants "
+                    f"{_np_dtype(dtype)} — sessions never silently "
+                    "change precision")
+            ent["last"] = self._seq
+            if pin:
+                if ent["pins"] > 0:
+                    # the one-in-flight-per-session invariant is a
+                    # POOL property, not per-serve-call state: two
+                    # concurrent serves sharing a pool must not
+                    # interleave mutations on one register
+                    raise QuESTValidationError(
+                        f"SessionPool: session {name!r} is already "
+                        "pinned by an in-flight run — at most one "
+                        "request may mutate a session at a time; "
+                        "route this session's traffic through one "
+                        "serve call (which serializes it), or retry "
+                        "after the in-flight run completes")
+                ent["pins"] += 1
+            return q
+
+    def release(self, name: str) -> None:
+        """Drop one :meth:`acquire` pin (eviction becomes legal again)."""
+        with self._plock:
+            ent = self._resident.get(name)
+            if ent is not None and ent["pins"] > 0:
+                ent["pins"] -= 1
+
+    def _load_or_create(self, name, num_qubits, is_density, dtype):
+        from . import stateio
+        from .register import create_density_qureg, create_qureg
+        import numpy as np
+
+        d = self._dir(name)
+        meta_path = os.path.join(d, stateio._META)
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            dens = bool(meta["is_density"])
+            if num_qubits is not None and (
+                    int(meta["num_qubits"]) != int(num_qubits)
+                    or dens != bool(is_density)):
+                # refuse from the SIDECAR, before any restore or LRU
+                # eviction — an invalid request must not churn the
+                # pool (spill an innocent resident) as a side effect
+                raise QuESTValidationError(
+                    f"SessionPool: session {name!r} is a spilled "
+                    f"{int(meta['num_qubits'])}-qubit "
+                    f"{'density matrix' if dens else 'state-vector'}; "
+                    f"the request wants {int(num_qubits)} qubits "
+                    f"(density={bool(is_density)}) — sessions never "
+                    "silently change shape")
+            if dtype is not None \
+                    and np.dtype(meta["dtype"]) != _np_dtype(dtype):
+                raise QuESTValidationError(
+                    f"SessionPool: session {name!r} is a spilled "
+                    f"{meta['dtype']} register; the request wants "
+                    f"{_np_dtype(dtype)} — sessions never silently "
+                    "change precision")
+            mk = create_density_qureg if dens else create_qureg
+            q = mk(int(meta["num_qubits"]), self.env,
+                   dtype=np.dtype(meta["dtype"]))
+            stateio.restore_checkpoint(q, d)
+            metrics.counter_inc("supervisor.session_restores")
+            metrics.trace(f"session {name!r} restored from spill ({d})")
+            return q
+        if num_qubits is None:
+            raise QuESTValidationError(
+                f"SessionPool: session {name!r} does not exist (no "
+                f"spilled state under {d}) and no num_qubits was given "
+                "to create it fresh")
+        mk = create_density_qureg if is_density else create_qureg
+        q = mk(int(num_qubits), self.env, dtype=dtype)
+        metrics.counter_inc("supervisor.session_creates")
+        return q
+
+    def _admit(self, name, qureg) -> None:
+        # caller holds _plock; spill LRU unpinned residents until the
+        # newcomer fits
+        while len(self._resident) >= self.capacity:
+            victims = sorted((e["last"], n)
+                             for n, e in self._resident.items()
+                             if e["pins"] == 0)
+            if not victims:
+                metrics.warn_once(
+                    "session_pool_overcommit",
+                    f"SessionPool at {self.directory!r}: every resident "
+                    f"session is pinned by an in-flight run; admitting "
+                    f"{name!r} OVER capacity {self.capacity} (raise the "
+                    "capacity or the serve worker bound)")
+                break
+            self._spill(victims[0][1])
+        self._resident[name] = {"qureg": qureg, "last": self._seq,
+                                "pins": 0}
+
+    def _spill(self, name) -> None:
+        # caller holds _plock
+        from . import stateio
+
+        ent = self._resident[name]
+        # save FIRST, pop only on success: a failed spill must leave
+        # the live register resident — popping first would silently
+        # roll the session back to a stale earlier spill (or a fresh
+        # |0...0>) on its next touch
+        stateio.save_checkpoint(ent["qureg"], self._dir(name))
+        self._resident.pop(name, None)
+        metrics.counter_inc("supervisor.session_evictions")
+        metrics.trace(f"session {name!r} spilled to {self._dir(name)} "
+                      "(LRU eviction)")
+
+    def evict(self, name: str) -> None:
+        """Spill the named resident session now (no-op if not
+        resident; refused while pinned by an in-flight run)."""
+        name = self._check_name(name)
+        with self._plock:
+            ent = self._resident.get(name)
+            if ent is None:
+                return
+            if ent["pins"] > 0:
+                raise QuESTValidationError(
+                    f"SessionPool: session {name!r} is pinned by an "
+                    "in-flight run; evict after it completes")
+            self._spill(name)
+
+    def spill_all(self) -> None:
+        """Spill every unpinned resident session (the graceful-drain
+        hook: call before a planned shutdown so every session survives
+        the restart)."""
+        with self._plock:
+            for n in sorted(self._resident):
+                if self._resident[n]["pins"] == 0:
+                    self._spill(n)
+
+    def drop(self, name: str) -> None:
+        """Forget a session entirely — resident register AND spilled
+        on-disk state (refused while pinned)."""
+        import shutil
+
+        name = self._check_name(name)
+        with self._plock:
+            ent = self._resident.get(name)
+            if ent is not None and ent["pins"] > 0:
+                raise QuESTValidationError(
+                    f"SessionPool: session {name!r} is pinned by an "
+                    "in-flight run; drop after it completes")
+            self._resident.pop(name, None)
+            shutil.rmtree(self._dir(name), ignore_errors=True)
 
 
 def _run_coalesced(reqs: list) -> list:
@@ -680,8 +1338,22 @@ def _run_coalesced(reqs: list) -> list:
     record (``batch_run_id``).  Raises propagate to the caller (the
     serve worker), which fails EVERY member of the group with the same
     typed error — a shed batch sheds as the unit it was admitted as."""
+    from . import resilience
     from .register import create_batched_qureg
 
+    if resilience.fault_active():
+        # the serving front end's consult of the run_item seam: one
+        # hit per member about to launch, so a scripted ``poison``
+        # (deterministic process death) names an exact in-flight
+        # request — the journal-quarantine drill's kill point.  The
+        # hit lands AFTER the worker journaled the member's ``launch``
+        # record, exactly like a real crash mid-execution.  Other
+        # kinds keep their usual side effects (a ``delay`` sleeps, a
+        # ``preempt`` flips the drain flag); the payload-targeting
+        # kinds have no payload at this seam and their return is
+        # ignored.
+        for _ in reqs:
+            resilience.fault_point("run_item")
     n = len(reqs)
     r0 = reqs[0]
     circ = r0.circuit
@@ -757,8 +1429,73 @@ def _run_coalesced(reqs: list) -> list:
     return values
 
 
+def _tenant_of(req) -> str:
+    if isinstance(req, BatchableRun) and req.tenant:
+        return str(req.tenant)
+    return TENANT_DEFAULT
+
+
+def _tenant_quota(v) -> int | None:
+    """Resolve the per-tenant queue-depth quota (argument wins over
+    ``QUEST_TENANT_QUEUE_DEPTH``; non-positive means none)."""
+    if v is None:
+        try:
+            v = int(os.environ["QUEST_TENANT_QUEUE_DEPTH"])
+        except (KeyError, ValueError):
+            return None
+    v = int(v)
+    return v if v > 0 else None
+
+
+def _tenant_cap(spec, tenant: str) -> int | None:
+    """Resolve one tenant's in-flight cap: a dict maps tenant names
+    (missing = uncapped), an int applies uniformly, None falls back to
+    ``QUEST_TENANT_MAX_INFLIGHT``."""
+    if isinstance(spec, dict):
+        v = spec.get(tenant)
+    elif spec is not None:
+        v = spec
+    else:
+        try:
+            v = int(os.environ["QUEST_TENANT_MAX_INFLIGHT"])
+        except (KeyError, ValueError):
+            v = None
+    if v is None:
+        return None
+    v = int(v)
+    return v if v > 0 else None
+
+
+def _run_session(pool, req: BatchableRun) -> dict:
+    """Execute one session-targeted request on its pooled register
+    (solo ``Circuit.run``, pinned against eviction for the duration;
+    the serve dispatcher already guarantees one in-flight request per
+    session, in submission order)."""
+    circ = req.circuit
+    qureg = pool.acquire(req.session, circ.num_qubits,
+                         is_density=circ.is_density, dtype=req.dtype)
+    try:
+        metrics.counter_inc("supervisor.session_requests")
+        draws = (circ._has_nonunitary and circ.num_measurements > 0)
+        scope = (telemetry.trace_scope(req.trace_id) if req.trace_id
+                 else contextlib.nullcontext())
+        with scope:
+            out = circ.run(qureg, key=req.key)
+        # the session register is the deliverable and deliberately
+        # ALIASED (it is the tenant's long-lived state, not a copy)
+        return {"outcomes": out if draws else None,
+                "trace_id": req.trace_id,
+                "session": req.session,
+                "qureg": qureg}
+    finally:
+        pool.release(req.session)
+
+
 def serve(requests, *, workers: int = 2, label: str = "serve",
-          max_batch: int = 1, batch_window_s: float = 0.05) -> list:
+          max_batch: int = 1, batch_window_s: float = 0.05,
+          journal_dir: str | None = None, session_pool=None,
+          tenant_max_inflight=None, tenant_queue_depth=None,
+          tenant_weights: dict | None = None) -> list:
     """Run ``requests`` through a bounded worker pool — the in-process
     run queue of the serving front end.  At most ``workers`` launch
     units execute concurrently (queueing is the backpressure; the
@@ -769,23 +1506,61 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
     Requests are zero-argument callables (each executed as its own
     solo unit, exactly as before) or :class:`BatchableRun` requests.
     With ``max_batch > 1`` the queue COALESCES: consecutive queued
-    ``BatchableRun`` requests with the same :meth:`fingerprint
-    <BatchableRun.fingerprint>` launch as ONE ``Circuit.run_batched``
-    (up to ``max_batch`` members, waiting at most ``batch_window_s``
-    for the queue to offer the next candidate once it runs dry — the
-    bounded batch window), with one admission decision priced at the
-    batched cost, per-tenant ``trace_id`` preserved on each member's
-    split-out ledger record, and per-member outcomes in each result.
-    Coalescing never reorders: a non-matching request closes the
-    group and keeps its queue position.
+    ``BatchableRun`` requests of the same tenant with the same
+    :meth:`fingerprint <BatchableRun.fingerprint>` launch as ONE
+    ``Circuit.run_batched`` (up to ``max_batch`` members), with one
+    admission decision priced at the batched cost, per-tenant
+    ``trace_id`` preserved on each member's split-out ledger record,
+    and per-member outcomes in each result.  Coalescing never reorders
+    within a tenant: a non-matching request closes the group and keeps
+    its queue position.  (``batch_window_s`` is accepted for
+    compatibility; the queue is fully materialised at submit time, so
+    grouping is resolved deterministically with no waiting.)
+
+    Strictly-opt-in durable-serving extensions (the default call is
+    byte-stable without them):
+
+    ``journal_dir``
+        arms the WRITE-AHEAD REQUEST JOURNAL: every request (which
+        must then be a :class:`BatchableRun` — an opaque callable
+        cannot be replayed, and session-targeted requests are refused
+        because a replayed mutation cannot prove its pre-crash session
+        state) is appended as an ``accept`` record before anything
+        launches, each launch attempt and completion is journaled, and
+        on a relaunch completed idempotency keys return their
+        journaled result instead of re-running
+        (``supervisor.journal_deduped``), incomplete ones re-run
+        (``supervisor.journal_replayed``), duplicate keys within one
+        call execute once, and a key observed to kill the process
+        ``QUEST_POISON_ATTEMPTS`` times is QUARANTINED with
+        :class:`QuESTPoisonedRequestError` instead of retried
+        (``supervisor.poison_quarantined``).
+
+    ``session_pool``
+        a :class:`SessionPool`; requests with ``session=`` run SOLO on
+        their named long-lived register, at most one in flight per
+        session, submission order preserved.
+
+    ``tenant_max_inflight`` / ``tenant_queue_depth`` /
+    ``tenant_weights``
+        PER-TENANT FAIRNESS (env fallbacks ``QUEST_TENANT_MAX_INFLIGHT``
+        / ``QUEST_TENANT_QUEUE_DEPTH``): launch units are dequeued
+        weighted round-robin across tenants (``tenant_weights`` maps
+        tenant → units per turn, default 1); a tenant at its in-flight
+        cap is DEFERRED (its own queue order intact) while other
+        tenants proceed; and requests beyond a tenant's queue-depth
+        quota are shed immediately with ``QuESTOverloadError`` naming
+        the tenant (``supervisor.shed_tenant_quota``).
 
     Returns one ``{"ok", "value" | "error"}`` dict per request, in
     request order — a batched member's ``value`` carries its
     ``outcomes`` / ``trace_id`` / ``batch_size`` / ``batch_index``
     (and the final-state register for measurement-free circuits); a
-    shed batch fails every member with the same typed error.  The
-    submit-time trace scope propagates to the worker threads, so
-    queued work joins the caller's trace chain."""
+    journal-deduped result carries ``journaled: True`` plus the
+    recorded outcomes/digest; a shed batch fails every member with the
+    same typed error.  The submit-time trace scope propagates to the
+    worker threads, so queued work joins the caller's trace chain."""
+    import collections
     import queue as _queue
 
     jobs = list(requests)
@@ -793,99 +1568,493 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
         raise QuESTValidationError(
             f"serve: workers must be >= 1, got {workers}")
     max_batch = max(int(max_batch), 1)
-    batch_window_s = max(float(batch_window_s), 0.0)
+    float(batch_window_s)  # validated for compatibility (unused: the
+    # queue is materialised at submit time, so grouping never waits)
+    # fairness knobs validate UP FRONT: a malformed spec must raise
+    # here, not inside the dispatcher thread (where it would silently
+    # leave None result entries behind dead workers)
+    if tenant_weights is not None and not isinstance(tenant_weights,
+                                                     dict):
+        raise QuESTValidationError(
+            f"serve: tenant_weights must be a dict mapping tenant -> "
+            f"units per round-robin turn, got "
+            f"{type(tenant_weights).__name__} (per-tenant in-flight "
+            "caps take a scalar via tenant_max_inflight)")
+    caps = (tenant_max_inflight.values()
+            if isinstance(tenant_max_inflight, dict)
+            else () if tenant_max_inflight is None
+            else (tenant_max_inflight,))
+    for v in caps:
+        if v is not None and not isinstance(v, numbers.Real):
+            raise QuESTValidationError(
+                "serve: tenant_max_inflight values must be numeric "
+                f"(or None), got {v!r}")
+    if tenant_queue_depth is not None \
+            and not isinstance(tenant_queue_depth, numbers.Real):
+        raise QuESTValidationError(
+            "serve: tenant_queue_depth must be a single numeric "
+            f"quota (or None), got {tenant_queue_depth!r}")
     results: list = [None] * len(jobs)
-    q: _queue.Queue = _queue.Queue()
-    lq: _queue.Queue = _queue.Queue()
     submit_tid = telemetry.current_trace_id()
-    for i, fn in enumerate(jobs):
-        q.put((i, fn))
 
-    def dispatcher():
-        """Drain the request queue into launch units: solo callables
-        pass through; consecutive same-fingerprint BatchableRun
-        requests coalesce up to max_batch within the batch window.
-        Sentinels post in a finally — a dispatcher failure must never
-        leave the workers blocked on an endless launch queue."""
-        try:
-            hold = None
-            remaining = len(jobs)
-            while remaining:
-                item = hold if hold is not None else q.get_nowait()
-                hold = None
-                i, req = item
-                if max_batch <= 1 or not isinstance(req, BatchableRun):
-                    lq.put([item])
-                    remaining -= 1
-                    continue
-                group = [item]
-                fp = req.fingerprint()
-                deadline = metrics.clock() + batch_window_s
-                # never wait past the known backlog: when the group
-                # already holds every outstanding request, no future
-                # arrival exists to wait the window out for
-                while len(group) < max_batch and len(group) < remaining:
-                    try:
-                        to = deadline - metrics.clock()
-                        nxt = (q.get(timeout=to) if to > 0
-                               else q.get_nowait())
-                    except _queue.Empty:
-                        break
-                    if (isinstance(nxt[1], BatchableRun)
-                            and nxt[1].fingerprint() == fp):
-                        group.append(nxt)
-                    else:
-                        hold = nxt  # closes the group, keeps its place
-                        break
-                lq.put(group)
-                remaining -= len(group)
-        finally:
-            for _ in range(max(min(workers, len(jobs)), 1)):
-                lq.put(None)
+    # --- validate the opt-in combinations -----------------------------
+    if journal_dir is not None:
+        bad = [i for i, r in enumerate(jobs)
+               if not isinstance(r, BatchableRun)]
+        if bad:
+            raise QuESTValidationError(
+                f"serve: journal_dir is set but request(s) {bad} are "
+                "plain callables — the write-ahead journal can only "
+                "replay requests it can reconstruct; wrap them as "
+                "BatchableRun (circuit + env + key), or serve them "
+                "without journal_dir")
+        sessioned = [i for i, r in enumerate(jobs) if r.session]
+        if sessioned:
+            raise QuESTValidationError(
+                f"serve: journal_dir cannot cover session-targeted "
+                f"request(s) {sessioned}: a replayed mutation on a "
+                "pooled long-lived register cannot prove the pre-crash "
+                "session state it would re-apply onto — journal "
+                "stateless requests, or serve session work without "
+                "journal_dir")
+    for i, r in enumerate(jobs):
+        if isinstance(r, BatchableRun) and r.session \
+                and session_pool is None:
+            raise QuESTValidationError(
+                f"serve: request {i} targets session {r.session!r} but "
+                "no session_pool= was given "
+                "(supervisor.SessionPool(env, directory))")
 
-    def worker():
-        while True:
-            group = lq.get()
-            if group is None:
-                return
-            scope = (telemetry.trace_scope(submit_tid) if submit_tid
-                     else contextlib.nullcontext())
+    # --- write-ahead journal: scan, dedupe, quarantine ----------------
+    # (runs BEFORE the quota pass: a relaunch answering requests from
+    # the journal costs nothing, so deduped/quarantined entries must
+    # not count against — or be shed by — a tenant's queue-depth quota)
+    jstate = None
+    jkeys: dict = {}       # request index -> idempotency key
+    jlaunches: dict = {}   # key -> observed launch count (live)
+    replays: set = set()   # indices re-running after a prior launch
+    recovery: set = set()  # indices backing prior-process journal state
+    dup_of: dict = {}      # duplicate index -> primary index
+    rec_left = [0]         # unresolved recovery entries (gauge share)
+    to_accept: list = []   # (index, request, key, prior launches)
+    if journal_dir is not None:
+        from . import stateio
+
+        jstate = _journal_scan(journal_dir)
+        jlaunches = dict(jstate["launches"])
+        seen: dict = {}
+        for i, r in enumerate(jobs):
+            k = r.idempotency_key or _auto_idem_key(r, i)
+            jkeys[i] = k
+            if k in seen:
+                # duplicate within this call: executes once; the copy
+                # is filled from the primary's result after the join
+                dup_of[i] = seen[k]
+                metrics.counter_inc("supervisor.journal_deduped")
+                continue
+            seen[k] = i
+            if k in jstate["completed"]:
+                results[i] = {"ok": True, "value": _journal_value(
+                    jstate["completed"][k], k)}
+                metrics.counter_inc("supervisor.journal_deduped")
+                continue
+            n_launch = jlaunches.get(k, 0)
+            # only launches that ended in NEITHER complete NOR failed
+            # are observed process deaths: an in-process typed failure
+            # (shed, preemption drain) journals a `failed` record, and
+            # retrying those is the advertised contract — they must
+            # never push a healthy request into quarantine
+            n_crash = max(n_launch - jstate["failed"].get(k, 0), 0)
+            if k in jstate["quarantined"] \
+                    or n_crash >= poison_attempts():
+                if k not in jstate["quarantined"]:
+                    stateio.append_journal_entry(
+                        journal_dir, {"kind": "quarantine", "key": k,
+                                      "attempts": n_crash})
+                    jstate["quarantined"].add(k)
+                metrics.counter_inc("supervisor.poison_quarantined")
+                t = _tenant_of(r)
+                results[i] = {"ok": False,
+                              "error": QuESTPoisonedRequestError(
+                    f"request {k!r} (tenant {t!r}) quarantined: "
+                    f"observed to kill the process {n_crash} time(s) "
+                    f"without completing (QUEST_POISON_ATTEMPTS="
+                    f"{poison_attempts()}); it will not be retried — "
+                    f"inspect the journal at {journal_dir} and "
+                    "resubmit under a new idempotency key after "
+                    "fixing the request")}
+                continue
+            to_accept.append((i, r, k, n_launch))
+
+    # --- per-tenant queue-depth quota ---------------------------------
+    # counts only work that would actually RUN (journal-settled entries
+    # are already answered); an over-quota request is shed before its
+    # accept record lands, so it never enters the recoverable backlog
+    quota = _tenant_quota(tenant_queue_depth)
+    if quota is not None:
+        depth: dict = {}
+        for i, r in enumerate(jobs):
+            if results[i] is not None or i in dup_of:
+                continue
+            t = _tenant_of(r)
+            depth[t] = depth.get(t, 0) + 1
+            if depth[t] > quota:
+                ra = retry_after_s()
+                metrics.counter_inc("supervisor.shed_tenant_quota")
+                metrics.trace(f"serve: shed request {i} over tenant "
+                              f"{t!r} queue-depth quota {quota}")
+                results[i] = {"ok": False, "error": QuESTOverloadError(
+                    f"run shed (tenant quota): tenant {t!r} already "
+                    f"has {quota} request(s) queued, its queue-depth "
+                    f"quota (retry_after_s={ra:g})",
+                    retry_after_s=ra)}
+
+    # --- journal accepts for the surviving (runnable) entries ---------
+    if journal_dir is not None:
+        from . import stateio
+
+        pending = 0
+        to_append: list = []
+        for i, r, k, n_launch in to_accept:
+            if results[i] is not None:  # shed over quota above
+                continue
+            # the scan keeps only the FIRST accept per key, so a
+            # relaunch re-serving an already-accepted backlog skips the
+            # redundant fsync'd append instead of growing the journal
+            # by O(backlog) per restart
+            if k not in jstate["accepted"]:
+                to_append.append(_accept_record(r, k, i, n_launch))
+            else:
+                recovery.add(i)
+                pending += 1
+            if n_launch > 0 and i not in recovery:
+                recovery.add(i)
+                pending += 1
+            if n_launch > 0:
+                replays.add(i)
+                metrics.counter_inc("supervisor.journal_replayed")
+        # one open/write/fsync for the whole accept batch — same
+        # write-ahead guarantee (every accept durable before anything
+        # launches) at 1/N the sync cost
+        stateio.append_journal_entries(journal_dir, to_append)
+        rec_left[0] = pending
+        if pending:
+            with _lock:
+                _journal_recovery["pending"] += pending
+
+    # everything between the recovery-gauge increment above and the
+    # hygiene below runs under try/finally: an exception escaping
+    # serve (unit building, thread start) must not leave
+    # _journal_recovery['pending'] stuck and /readyz at 503 forever
+    try:
+        # --- per-tenant launch units (coalescing within a tenant) ---------
+        tq: dict = {}      # tenant -> deque of launch units
+        order: list = []   # tenant first-appearance order (dispatch cycle)
+        building: dict = {}  # tenant -> open coalescing group
+        sess_order: dict = {}  # session -> deque of submission indices
+
+        def _close(t):
+            b = building.pop(t, None)
+            if b is not None:
+                tq[t].append({"tenant": t, "kind": "batch",
+                              "entries": b["entries"], "session": None})
+
+        for i, r in enumerate(jobs):
+            if results[i] is not None or i in dup_of:
+                continue
+            t = _tenant_of(r)
+            if t not in tq:
+                tq[t] = collections.deque()
+                order.append(t)
+            if not isinstance(r, BatchableRun):
+                _close(t)
+                tq[t].append({"tenant": t, "kind": "call",
+                              "entries": [(i, r)], "session": None})
+                continue
+            if r.session:
+                _close(t)
+                tq[t].append({"tenant": t, "kind": "session",
+                              "entries": [(i, r)], "session": r.session})
+                sess_order.setdefault(
+                    r.session, collections.deque()).append(i)
+                continue
+            if max_batch <= 1 or i in replays:
+                # replays run SOLO even when coalescing is on: a crash
+                # increments the attempt count of EVERY member journaled
+                # into its launch unit, so a suspect re-running inside a
+                # fresh batch would poison innocent co-members toward
+                # quarantine — isolating it keeps attempt accounting
+                # per-request
+                _close(t)
+                tq[t].append({"tenant": t, "kind": "batch",
+                              "entries": [(i, r)], "session": None})
+                continue
+            fp = r.fingerprint()
+            b = building.get(t)
+            if b is not None and b["fp"] == fp \
+                    and len(b["entries"]) < max_batch:
+                b["entries"].append((i, r))
+            else:
+                _close(t)
+                building[t] = {"fp": fp, "entries": [(i, r)]}
+        for t in list(building):
+            _close(t)
+
+        total_units = sum(len(q) for q in tq.values())
+        lq: _queue.Queue = _queue.Queue()
+        nworkers = max(min(workers, len(jobs)), 1)
+        cond = threading.Condition()
+        tinfl = {t: 0 for t in order}   # in-flight member counts
+        sess_active: set = set()
+
+        def dispatcher():
+            """Hand launch units to the workers, WEIGHTED ROUND-ROBIN
+            across tenants: each pass grants every tenant up to its weight
+            in units, head-of-queue only (strict FIFO per tenant — caps
+            and busy sessions DEFER a tenant, never reorder it).  A tenant
+            at its in-flight cap, or whose head targets a busy session or
+            a session with an earlier-submitted request still queued under
+            another tenant (per-session order is GLOBAL submission order),
+            yields its turn; when nothing can dispatch the thread waits on
+            a completion.  Sentinels post in a finally — a dispatcher
+            failure must never leave the workers blocked."""
             try:
-                with scope:
-                    if isinstance(group[0][1], BatchableRun):
-                        reqs = [r for _i, r in group]
-                        values = _run_coalesced(reqs)
-                        for (i, _r), v in zip(group, values):
-                            results[i] = {"ok": True, "value": v}
-                    else:
-                        (i, fn), = group
-                        if max_batch > 1:
-                            metrics.counter_inc(
-                                "supervisor.solo_launches")
-                        results[i] = {"ok": True, "value": fn()}
-                metrics.counter_inc("supervisor.serve_completed",
-                                    len(group))
-            except Exception as e:  # typed errors are data here: a
-                # shed/drained unit must not kill its worker (or the
-                # queue behind it) — and a shed BATCH fails every
-                # member with the same typed error, the unit it was
-                # admitted as
-                for i, _r in group:
-                    results[i] = {"ok": False, "error": e}
-                metrics.counter_inc("supervisor.serve_failed",
-                                    len(group))
+                with cond:
+                    left = total_units
+                    while left:
+                        progressed = False
+                        for t in order:
+                            w = 1
+                            if tenant_weights:
+                                try:
+                                    w = max(int(tenant_weights.get(t, 1)),
+                                            1)
+                                except (TypeError, ValueError):
+                                    w = 1
+                            taken = 0
+                            while taken < w and tq[t]:
+                                unit = tq[t][0]
+                                size = len(unit["entries"])
+                                cap = _tenant_cap(tenant_max_inflight, t)
+                                # an oversize unit dispatches when the
+                                # tenant is idle — a cap smaller than one
+                                # coalesced batch must defer, not deadlock
+                                if cap is not None and tinfl[t] \
+                                        and tinfl[t] + size > cap:
+                                    break
+                                s = unit["session"]
+                                if s and (s in sess_active
+                                          or sess_order[s][0]
+                                          != unit["entries"][0][0]):
+                                    # busy session, OR an earlier-submitted
+                                    # request to the same session is still
+                                    # queued under ANOTHER tenant — defer:
+                                    # per-session submission order is
+                                    # global, not per-tenant
+                                    break
+                                tq[t].popleft()
+                                tinfl[t] += size
+                                if s:
+                                    sess_active.add(s)
+                                    sess_order[s].popleft()
+                                lq.put(unit)
+                                left -= 1
+                                taken += 1
+                                progressed = True
+                        if left and not progressed:
+                            cond.wait(0.25)
+            finally:
+                for _ in range(nworkers):
+                    lq.put(None)
 
-    disp = threading.Thread(target=dispatcher,
-                            name=f"quest-serve-{label}-dispatch")
-    disp.start()
-    threads = [threading.Thread(target=worker,
-                                name=f"quest-serve-{label}-{k}")
-               for k in range(max(min(workers, len(jobs)), 1))]
-    for t in threads:
-        t.start()
-    disp.join()
-    for t in threads:
-        t.join()
+        def _finish(unit):
+            with cond:
+                tinfl[unit["tenant"]] -= len(unit["entries"])
+                if unit["session"]:
+                    sess_active.discard(unit["session"])
+                cond.notify_all()
+            if jstate is not None:
+                n_rec = sum(1 for i, _r in unit["entries"]
+                            if i in recovery)
+                if n_rec:
+                    with _lock:
+                        rec_left[0] -= n_rec
+                        _journal_recovery["pending"] = max(
+                            _journal_recovery["pending"] - n_rec, 0)
+
+        def worker():
+            while True:
+                unit = lq.get()
+                if unit is None:
+                    return
+                group = unit["entries"]
+                scope = (telemetry.trace_scope(submit_tid) if submit_tid
+                         else contextlib.nullcontext())
+                try:
+                    with scope:
+                        if unit["kind"] == "call":
+                            (i, fn), = group
+                            if max_batch > 1:
+                                metrics.counter_inc(
+                                    "supervisor.solo_launches")
+                            results[i] = {"ok": True, "value": fn()}
+                        elif unit["kind"] == "session":
+                            (i, req), = group
+                            results[i] = {"ok": True, "value":
+                                          _run_session(session_pool, req)}
+                        else:
+                            if jstate is not None:
+                                from . import stateio
+
+                                # write-ahead: the launch attempts land in
+                                # the journal BEFORE execution (one fsync
+                                # for the unit), so a death during the run
+                                # is an observed attempt for every member
+                                launch_recs = []
+                                for i, _r in group:
+                                    with _lock:
+                                        att = jlaunches[jkeys[i]] = \
+                                            jlaunches.get(jkeys[i], 0) + 1
+                                    launch_recs.append(
+                                        {"kind": "launch",
+                                         "key": jkeys[i],
+                                         "attempt": att})
+                                stateio.append_journal_entries(
+                                    journal_dir, launch_recs)
+                            values = _run_coalesced(
+                                [r for _i, r in group])
+                            # results land FIRST: a failed complete-append
+                            # below must not retract a success the caller
+                            # is owed (the un-journaled completion simply
+                            # re-runs on the next replay — at-least-once,
+                            # the correct degradation for a dying disk)
+                            for (i, _r), v in zip(group, values):
+                                results[i] = {"ok": True, "value": v}
+                            if jstate is not None:
+                                from . import stateio
+
+                                comp_recs = []
+                                try:
+                                    for (i, _r), v in zip(group, values):
+                                        digest, outs = _result_digest(v)
+                                        v["idempotency_key"] = jkeys[i]
+                                        v["digest"] = digest
+                                        comp_recs.append(
+                                            {"kind": "complete",
+                                             "key": jkeys[i],
+                                             "digest": digest,
+                                             "outcomes": outs,
+                                             "trace_id":
+                                                 v.get("trace_id")})
+                                    # one fsync for the unit's completions
+                                    # (mirroring the launch batch above)
+                                    stateio.append_journal_entries(
+                                        journal_dir, comp_recs)
+                                except Exception as je:
+                                    # whether the digest or the append
+                                    # failed, none of the unit's
+                                    # completions reached the journal
+                                    metrics.counter_inc(
+                                        "supervisor."
+                                        "journal_append_failures",
+                                        len(group))
+                                    metrics.warn_once(
+                                        "journal_complete_append",
+                                        "serve journal at "
+                                        f"{journal_dir!r} could not "
+                                        "record completion(s) "
+                                        f"({je}); the request(s) stay "
+                                        "incomplete in the journal "
+                                        "and will RE-RUN on the next "
+                                        "replay (at-least-once)")
+                                    try:
+                                        # best-effort `failed` markers:
+                                        # the process SURVIVED, so the
+                                        # at-least-once re-run must not
+                                        # read as a death to the poison
+                                        # quarantine accounting
+                                        stateio.append_journal_entries(
+                                            journal_dir,
+                                            [{"kind": "failed",
+                                              "key": jkeys[i],
+                                              "error":
+                                              "complete_append_failed"}
+                                             for i, _r in group])
+                                    except Exception:
+                                        metrics.counter_inc(
+                                            "supervisor."
+                                            "journal_append_failures",
+                                            len(group))
+                    metrics.counter_inc("supervisor.serve_completed",
+                                        len(group))
+                except Exception as e:  # typed errors are data here: a
+                    # shed/drained unit must not kill its worker (or the
+                    # queue behind it) — and a shed BATCH fails every
+                    # member with the same typed error, the unit it was
+                    # admitted as
+                    lifecycle = isinstance(e, (QuESTOverloadError,
+                                               QuESTPreemptedError))
+                    for i, _r in group:
+                        results[i] = {"ok": False, "error": e}
+                        if jstate is not None and i in replays \
+                                and not lifecycle:
+                            # a journaled replay failed AGAIN: the
+                            # strictly-regressive ledger_diff rule watches
+                            # this never move in a healthy drill (a shed
+                            # or preemption drain during recovery is a
+                            # routine lifecycle event, not a regression
+                            # of the exactly-once contract)
+                            metrics.counter_inc(
+                                "supervisor.journal_replay_failures")
+                    if jstate is not None and unit["kind"] == "batch":
+                        # the process survived: journal the failures (one
+                        # batched fsync, like the launch records) so the
+                        # launch records above are not mistaken for
+                        # process deaths by the quarantine accounting
+                        try:
+                            from . import stateio
+
+                            stateio.append_journal_entries(
+                                journal_dir,
+                                [{"kind": "failed", "key": jkeys[i],
+                                  "error": type(e).__name__}
+                                 for i, _r in group])
+                        except Exception:
+                            metrics.counter_inc(
+                                "supervisor.journal_append_failures",
+                                len(group))
+                    metrics.counter_inc("supervisor.serve_failed",
+                                        len(group))
+                finally:
+                    _finish(unit)
+
+        disp = threading.Thread(target=dispatcher,
+                                name=f"quest-serve-{label}-dispatch")
+        disp.start()
+        threads = [threading.Thread(target=worker,
+                                    name=f"quest-serve-{label}-{k}")
+                   for k in range(nworkers)]
+        for t in threads:
+            t.start()
+        disp.join()
+        for t in threads:
+            t.join()
+    finally:
+        # recovery-gauge hygiene: anything left unresolved (a
+        # dispatcher crash, an exception above) must not wedge
+        # /readyz at not-ready forever
+        if jstate is not None and rec_left[0] > 0:
+            with _lock:
+                _journal_recovery["pending"] = max(
+                    _journal_recovery["pending"] - rec_left[0], 0)
+            rec_left[0] = 0
+    # duplicates mirror their primary's result (one execution per key)
+    for i, p in dup_of.items():
+        src = results[p]
+        results[i] = (dict(src) if isinstance(src, dict)
+                      else {"ok": False, "error": QuESTValidationError(
+                          f"serve: duplicate idempotency key "
+                          f"{jkeys.get(i)!r} had no primary result")})
     return results
 
 
@@ -954,6 +2123,8 @@ def state_snapshot() -> dict:
         "max_inflight": max_inflight(),
         "slo_p99_s": slo_p99_s(),
         "inflight": inflight(),
+        "journal_backlog": journal_backlog(),
+        "session_occupancy": session_occupancy(),
         "ready": ready,
         "reason": reason,
         "retry_after_s": ra,
@@ -971,7 +2142,9 @@ def reset() -> None:
                  retry_after_s=None, slo_label=None)
     with _lock:
         _inflight[0] = 0
+        _journal_recovery["pending"] = 0
     _batch["occupancy"] = 0
+    _pools.clear()
     _tls.deadlines = []
     _tls.recovering = False
     _tls.admit_reserved = 0

@@ -103,6 +103,20 @@ class QuESTOverloadError(QuESTError):
         self.retry_after_s = float(retry_after_s)
 
 
+class QuESTPoisonedRequestError(QuESTError):
+    """A journaled serving request was QUARANTINED instead of retried:
+    the write-ahead request journal (``supervisor.serve(journal_dir=)``)
+    observed it launch — and the process die — ``QUEST_POISON_ATTEMPTS``
+    times (default 2) without ever completing, so replaying it again
+    would crash-loop the service.  The request's idempotency key,
+    tenant, and observed attempt count are in the message; the journal
+    keeps a ``quarantine`` record so every later replay refuses it
+    instantly.  Fix the request (or the bug it trips) and resubmit
+    under a NEW idempotency key."""
+
+    code = 8
+
+
 def _fail(msg: str, func: str | None = None):
     raise QuESTValidationError(msg if func is None else f"{func}: {msg}")
 

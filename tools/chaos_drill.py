@@ -86,6 +86,25 @@ each scenario's recovery contract:
   armed, incoming runs shed with ``QuESTOverloadError`` naming the
   degraded failure domain, ``/readyz`` serves 503 with the same
   reason, and a repaired mesh admits again.
+* ``session_evict_restore`` — a :class:`supervisor.SessionPool` at
+  capacity 1 evicts the LRU session under pressure (spill through the
+  checksummed checkpoint path) and restores it on the next touch:
+  spill → restore → continue must be BIT-IDENTICAL to the same ops on
+  an uninterrupted register, with the eviction/restore counters moved.
+* ``serve_crash_replay``  — a journaled ``supervisor.serve`` of 4
+  requests is killed by a scripted ``poison`` process death while
+  request 2 is in flight, then relaunched by ``tools/supervise.py
+  --restart-on-crash``: the write-ahead journal must complete the
+  backlog EXACTLY-ONCE (completed idempotency keys return journaled
+  results, the in-flight and queued ones re-run), outcomes and
+  per-tenant trace_ids equal to an uninterrupted serve, one
+  ``complete`` record per key in the journal.
+* ``poison_quarantine``   — the same serve with the poison firing on
+  request 2's first TWO launches: the third relaunch must QUARANTINE
+  it with a typed ``QuESTPoisonedRequestError`` on its 2nd observed
+  crash (never a third launch), complete every other request, and end
+  the supervise chain with exit 0 — one bad request can no longer
+  crash-loop the service.
 
 Every scenario must end in either a clean recovery (with the
 resilience counters recorded) or a ``QuESTError`` naming the seam —
@@ -1113,6 +1132,261 @@ def drill_slice_quarantine_shed(circ, env, ndev, pallas):
            admitted_after_repair=admitted_after, **delta)
 
 
+def drill_session_evict_restore(circ, env, ndev, pallas):
+    # a SessionPool at capacity 1: touching a second session evicts the
+    # first (spill through the checksummed checkpoint path); touching
+    # the first again restores it bit-identically and CONTINUES — the
+    # pooled-session durability contract (spill -> restore -> continue
+    # == uninterrupted)
+    d = tempfile.mkdtemp(prefix="chaos-session-")
+    before = metrics.counters()
+    c1 = models.random_circuit(N_QUBITS, depth=2, seed=11)
+    c2 = models.random_circuit(N_QUBITS, depth=2, seed=12)
+    # uninterrupted reference: both circuits on ONE register
+    q_ref = qt.create_qureg(N_QUBITS, env)
+    c1.run(q_ref, pallas=pallas)
+    c2.run(q_ref, pallas=pallas)
+    ref = qt.get_state_vector(q_ref)
+    pool = supervisor.SessionPool(env, d, capacity=1)
+    r1 = supervisor.serve(
+        [supervisor.BatchableRun(c1, env, session="alice",
+                                 trace_id="tenant-a")],
+        workers=1, session_pool=pool)
+    # capacity pressure: a second session evicts alice to disk
+    r2 = supervisor.serve(
+        [supervisor.BatchableRun(c1, env, session="bob",
+                                 trace_id="tenant-b")],
+        workers=1, session_pool=pool)
+    evicted = "alice" not in pool.names() and "alice" in pool.spilled()
+    # touch alice again: restore from spill, CONTINUE with c2
+    r3 = supervisor.serve(
+        [supervisor.BatchableRun(c2, env, session="alice",
+                                 trace_id="tenant-a")],
+        workers=1, session_pool=pool)
+    all_ok = all(r[0]["ok"] for r in (r1, r2, r3))
+    got = qt.get_state_vector(pool.session("alice"))
+    bit_identical = bool(np.array_equal(got, ref))
+    delta = counters_delta(before, ("supervisor.session_evictions",
+                                    "supervisor.session_restores",
+                                    "supervisor.session_creates"))
+    ok = (all_ok and evicted and bit_identical
+          and delta["supervisor.session_evictions"] >= 1
+          and delta["supervisor.session_restores"] >= 1
+          and delta["supervisor.session_creates"] == 2)
+    record("session_evict_restore", ok, all_ok=all_ok,
+           evicted_under_pressure=evicted, bit_identical=bit_identical,
+           **delta)
+    shutil.rmtree(d, ignore_errors=True)
+
+
+#: The journaled-serve child the crash/poison drills supervise: 4
+#: keyed requests (2 tenants) through supervisor.serve(journal_dir=),
+#: with a scripted `poison` process death aimed at request "req-2"
+#: while it is in flight.  The child decides per attempt whether to
+#: arm the fault FROM THE JOURNAL ITSELF (launch counts), modelling a
+#: request that deterministically kills the process — until (poison
+#: mode) the quarantine refuses it.  Prints one RESULTS= line (per
+#: request outcome/trace/journaled/error) and one COUNTERS= line.
+_SERVE_CHILD = """\
+import os, sys, json
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import quest_tpu as qt
+from quest_tpu import metrics, models, resilience, supervisor
+
+JDIR = {jdir!r}
+MODE = {mode!r}  # "none" | "crash_once" | "poison"
+TARGET = "req-2"
+
+env = qt.create_env(num_devices=1)
+circ = models.qft(6)
+circ.measure(0)
+circ.measure(3)
+keys = jax.random.split(jax.random.PRNGKey(5), 4)
+reqs = [supervisor.BatchableRun(circ, env, key=keys[i],
+                                trace_id=f"tenant-{{i}}",
+                                tenant=f"t{{i % 2}}",
+                                idempotency_key=f"req-{{i}}")
+        for i in range(4)]
+state = supervisor.recover_queue(JDIR)
+crashes = state["launches"].get(TARGET, 0)
+arm = False
+if MODE == "crash_once":
+    arm = crashes == 0
+elif MODE == "poison":
+    arm = (crashes < supervisor.poison_attempts()
+           and TARGET not in state["quarantined"])
+if arm:
+    # the coalesced launch consults the run_item seam once per member,
+    # in dispatch order (workers=1): the hit index of TARGET's launch
+    # is the number of runnable (not-yet-completed) requests before it
+    ahead = 0
+    for r in reqs:
+        if r.idempotency_key == TARGET:
+            break
+        if r.idempotency_key not in state["completed"]:
+            ahead += 1
+    resilience.set_fault_plan([("run_item", ahead, "poison")])
+results = supervisor.serve(reqs, workers=1, max_batch=1,
+                           journal_dir=JDIR)
+resilience.clear_fault_plan()
+rows = []
+for r in results:
+    if r["ok"]:
+        v = r["value"]
+        rows.append({{
+            "ok": True,
+            "outcomes": [int(x) for x in
+                         np.asarray(v["outcomes"]).reshape(-1).tolist()],
+            "trace_id": v.get("trace_id"),
+            "journaled": bool(v.get("journaled"))}})
+    else:
+        rows.append({{"ok": False, "error": type(r["error"]).__name__,
+                      "message": str(r["error"])}})
+print("RESULTS=" + json.dumps(rows), flush=True)
+c = metrics.counters()
+print("COUNTERS=" + json.dumps(
+    {{k: v for k, v in c.items() if k.startswith("supervisor.")}}),
+    flush=True)
+"""
+
+
+def _run_supervised_serve(td, jdir, mode, max_restarts=4):
+    """Run the journaled-serve child under tools/supervise.py
+    --restart-on-crash and return (rc, attempts, rows, counters)."""
+    child = os.path.join(td, f"serve_child_{mode}.py")
+    with open(child, "w") as f:
+        f.write(_SERVE_CHILD.format(repo=REPO, jdir=jdir, mode=mode))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "supervise.py"),
+         "--restart-on-crash", "--max-restarts", str(max_restarts),
+         "--", child],
+        capture_output=True, text=True, cwd=REPO, timeout=600)
+    rows, counters = [], {}
+    for line in r.stdout.splitlines():
+        if line.startswith("RESULTS="):
+            rows = json.loads(line.split("=", 1)[1])
+        elif line.startswith("COUNTERS="):
+            counters = json.loads(line.split("=", 1)[1])
+    attempts = len(re.findall(r"^supervise: attempt \d+:",
+                              r.stdout, re.MULTILINE))
+    return r.returncode, attempts, rows, counters, r
+
+
+def _journal_complete_counts(jdir):
+    from quest_tpu import stateio
+
+    counts = {}
+    for rec in stateio.read_journal(jdir):
+        if rec.get("kind") == "complete":
+            counts[rec["key"]] = counts.get(rec["key"], 0) + 1
+    return counts
+
+
+def drill_serve_crash_replay(circ, env, ndev, pallas):
+    # SIGKILL-equivalent (scripted `poison` process death) mid-serve,
+    # relaunch via tools/supervise.py --restart-on-crash: the
+    # write-ahead journal must complete the backlog EXACTLY-ONCE with
+    # outcomes and per-tenant trace_ids equal to an uninterrupted serve
+    td = tempfile.mkdtemp(prefix="chaos-serve-crash-")
+    try:
+        # uninterrupted reference serve (its own journal dir)
+        rc0, att0, ref_rows, _c0, _r0 = _run_supervised_serve(
+            td, os.path.join(td, "journal-ref"), "none")
+        jdir = os.path.join(td, "journal")
+        rc, attempts, rows, counters, r = _run_supervised_serve(
+            td, jdir, "crash_once")
+        crashed_once = attempts == 2
+        completed = bool(rows) and all(x["ok"] for x in rows)
+        outcomes_equal = (completed and bool(ref_rows)
+                          and [x["outcomes"] for x in rows]
+                          == [x["outcomes"] for x in ref_rows])
+        traces_intact = (completed and
+                         [x["trace_id"] for x in rows]
+                         == [f"tenant-{i}" for i in range(4)])
+        # exactly-once: ONE complete record per key, and the final
+        # attempt served the pre-crash completions from the journal
+        cc = _journal_complete_counts(jdir)
+        exactly_once = (sorted(cc) == [f"req-{i}" for i in range(4)]
+                        and set(cc.values()) == {1})
+        deduped = (completed and rows[0]["journaled"]
+                   and rows[1]["journaled"]
+                   and not rows[2]["journaled"]
+                   and not rows[3]["journaled"])
+        replayed = counters.get("supervisor.journal_replayed", 0) == 1
+        no_replay_failures = counters.get(
+            "supervisor.journal_replay_failures", 0) == 0
+        ok = (rc0 == 0 and att0 == 1 and rc == 0 and crashed_once
+              and completed and outcomes_equal and traces_intact
+              and exactly_once and deduped and replayed
+              and no_replay_failures)
+        record("serve_crash_replay", ok, rc=rc, attempts=attempts,
+               completed=completed, outcomes_equal=outcomes_equal,
+               tenant_traces_intact=traces_intact,
+               exactly_once=exactly_once, deduped_from_journal=deduped,
+               journal_replayed=replayed,
+               replay_failures_zero=no_replay_failures)
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def drill_poison_quarantine(circ, env, ndev, pallas):
+    # the poison fault kills the process on request 2's first TWO
+    # launches; the third relaunch must QUARANTINE it (typed error on
+    # its 2nd observed crash, never a third launch) and complete the
+    # rest — the supervise chain ends 0 instead of crash-looping
+    td = tempfile.mkdtemp(prefix="chaos-poison-")
+    try:
+        rc0, _a0, ref_rows, _c0, _r0 = _run_supervised_serve(
+            td, os.path.join(td, "journal-ref"), "none")
+        jdir = os.path.join(td, "journal")
+        rc, attempts, rows, counters, r = _run_supervised_serve(
+            td, jdir, "poison")
+        crashed_twice = attempts == 3
+        quarantined = (len(rows) == 4 and not rows[2]["ok"]
+                       and rows[2]["error"]
+                       == "QuESTPoisonedRequestError"
+                       and "quarantined" in rows[2]["message"])
+        rest_completed = (len(rows) == 4
+                          and all(rows[i]["ok"] for i in (0, 1, 3)))
+        rest_equal = (rest_completed and bool(ref_rows) and all(
+            rows[i]["outcomes"] == ref_rows[i]["outcomes"]
+            for i in (0, 1, 3)))
+        # the poisoned key was LAUNCHED exactly twice (the two observed
+        # crashes) and never completed; everything else completed once
+        from quest_tpu import stateio
+
+        launches = {}
+        for rec in stateio.read_journal(jdir):
+            if rec.get("kind") == "launch":
+                launches[rec["key"]] = launches.get(rec["key"], 0) + 1
+        two_launches = launches.get("req-2", 0) == 2
+        cc = _journal_complete_counts(jdir)
+        others_once = (sorted(cc) == ["req-0", "req-1", "req-3"]
+                       and set(cc.values()) == {1})
+        counted = counters.get("supervisor.poison_quarantined", 0) == 1
+        ok = (rc0 == 0 and rc == 0 and crashed_twice and quarantined
+              and rest_completed and rest_equal and two_launches
+              and others_once and counted)
+        record("poison_quarantine", ok, rc=rc, attempts=attempts,
+               quarantined_typed=quarantined,
+               rest_completed=rest_completed, rest_equal=rest_equal,
+               poisoned_launches=launches.get("req-2", 0),
+               others_completed_once=others_once,
+               quarantine_counted=counted)
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 #: The scenario matrix, in execution order: (name, needs_ref, runner).
 #: ``needs_ref`` tells the per-scenario subprocess whether to pay for
 #: the 8-device reference run (the bit-identity oracle) — scenarios
@@ -1155,6 +1429,12 @@ SCENARIOS = [
      lambda c, e, n, p, r: drill_dcn_straggler(c, e, n, p)),
     ("slice_quarantine_shed", False,
      lambda c, e, n, p, r: drill_slice_quarantine_shed(c, e, n, p)),
+    ("session_evict_restore", False,
+     lambda c, e, n, p, r: drill_session_evict_restore(c, e, n, p)),
+    ("serve_crash_replay", False,
+     lambda c, e, n, p, r: drill_serve_crash_replay(c, e, n, p)),
+    ("poison_quarantine", False,
+     lambda c, e, n, p, r: drill_poison_quarantine(c, e, n, p)),
 ]
 
 #: Per-SCENARIO subprocess wall budget (QUEST_CHAOS_SCENARIO_TIMEOUT_S):

@@ -78,6 +78,18 @@ DEFAULT_RULES = [
     # fires on any appearance regardless of config
     ("counters.supervisor.shed_unhealthy", +0.0, True),
     ("counters.supervisor.preempt_ckpt_failures", +0.0, False),
+    # durable-serving health, strictly regressive: ANY appearance of a
+    # journal replay failing AGAIN on its re-run is a regression of the
+    # exactly-once recovery contract (the baseline is 0, so the +0 rule
+    # fires on any appearance regardless of config); and at a fixed
+    # drill matrix the poison scenarios quarantine a FIXED number of
+    # requests, so MORE quarantines than baseline = the attempt
+    # accounting grew false positives and is refusing healthy requests
+    # (+0 cost rule, CONFIG-BOUND like the sibling detector rules — a
+    # grown matrix quarantining more on purpose is progress, not a
+    # regression)
+    ("counters.supervisor.journal_replay_failures", +0.0, False),
+    ("counters.supervisor.poison_quarantined", +0.0, True),
     # failure-domain health, strictly regressive in both directions
     # (config-bound like the sibling detector rules): at a fixed drill
     # matrix the scenarios lose a FIXED number of slices, so MORE

@@ -22,11 +22,17 @@ A stdlib-only HTTP server over the always-on telemetry layer
   alone.
 * ``GET /readyz``   — the ADMISSION verdict (``quest_tpu.supervisor``):
   HTTP 200 only when the gate would admit a run right now; 503 while
-  the process is draining after a preemption request, the mesh-health
-  breaker is tripped, the in-flight cap is saturated, or the run-wall
-  p99 breaches the configured SLO.  The body carries the reason and a
-  ``retry_after_s`` hint, so a load balancer stops routing here
-  BEFORE runs start getting shed with ``QuESTOverloadError``.
+  the process is draining after a preemption request, a JOURNAL
+  RECOVERY is replaying a crashed process's backlog
+  (``journal_backlog`` in the body counts the unreplayed entries), the
+  mesh-health breaker is tripped, the in-flight cap is saturated, or
+  the run-wall p99 breaches the configured SLO.  The body carries the
+  reason and a ``retry_after_s`` hint, so a load balancer stops
+  routing here BEFORE runs start getting shed with
+  ``QuESTOverloadError``.  ``/metrics`` additionally exports the
+  durable-serving gauges (``quest_serve_journal_backlog`` /
+  ``_journal_replayed`` / ``_journal_deduped`` / ``_quarantined`` /
+  ``_session_occupancy`` / ``_session_evictions``).
 
 The CLI process handles SIGTERM/SIGINT by shutting the serving thread
 down cleanly (exit 0), so the endpoint itself survives a preemption
@@ -120,6 +126,7 @@ class MetricsHandler(BaseHTTPRequestHandler):
                    "retry_after_s": retry_after,
                    "draining": supervisor.preempt_requested(),
                    "inflight": supervisor.inflight(),
+                   "journal_backlog": supervisor.journal_backlog(),
                    "gate_enabled": supervisor.gate_enabled()}
             self._send(200 if ready else 503, json.dumps(doc) + "\n",
                        "application/json")

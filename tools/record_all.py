@@ -241,6 +241,52 @@ def batch_serve_smoke(summary) -> None:
         print(detail)
 
 
+def journaled_serve_smoke(summary) -> None:
+    """Tier-2 smoke: the full durable-serving crash chain — the chaos
+    harness's ``serve_crash_replay`` scenario run through its own
+    per-scenario subprocess protocol: ``tools/supervise.py
+    --restart-on-crash`` wraps a journaled ``supervisor.serve`` of 4
+    keyed, 2-tenant requests; a scripted ``poison`` process death
+    kills the serve while request 2 is in flight; the relaunch must
+    complete the backlog EXACTLY-ONCE from the write-ahead journal
+    (journaled results for completed idempotency keys, re-runs for
+    incomplete ones), with outcomes and per-tenant trace_ids equal to
+    an uninterrupted serve.  A journal that loses requests, replays a
+    completed one, or drops a tenant's attribution fails the recording
+    round here instead of in the next real crash."""
+    import json as _json
+    import tempfile
+
+    t0 = time.time()
+    ok, detail = False, ""
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "rows.json")
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "chaos_drill.py"), "0",
+                 "--scenario", "serve_crash_replay", "--out", out],
+                capture_output=True, text=True, cwd=REPO,
+                timeout=900)
+            with open(out) as f:
+                rows = _json.load(f)["scenarios"]
+            row = rows[0] if rows else {}
+            ok = (r.returncode == 0 and row.get("ok")
+                  and row.get("exactly_once")
+                  and row.get("outcomes_equal")
+                  and row.get("tenant_traces_intact"))
+            if not ok:
+                detail = f"rc={r.returncode} row={row}"
+        except Exception as e:
+            detail = f"{type(e).__name__}: {e}"
+    secs = time.time() - t0
+    summary.append(("journaled_serve", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'journaled_serve':22s} "
+          f"{secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 def metrics_serve_smoke(summary) -> None:
     """Tier-2 smoke: start tools/metrics_serve.py (--demo populates the
     telemetry with one small run), scrape /metrics and /healthz over
@@ -469,6 +515,7 @@ def main():
     roofline_attr_smoke(summary)
     overlap_smoke(summary)
     batch_serve_smoke(summary)
+    journaled_serve_smoke(summary)
     metrics_serve_smoke(summary)
     supervise_smoke(summary)
     chaos_drill_smoke(summary, rnd)

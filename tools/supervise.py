@@ -27,6 +27,18 @@ bit-identically under the SAME trace_id, which rides the checkpoint
 sidecar across the process boundary.  Any other exit code is final: a
 crash must surface, not be blindly restarted.
 
+**Serving mode** (``--restart-on-crash``): a JOURNALED serve child
+(``supervisor.serve(journal_dir=...)``) is the one case where
+relaunching after a crash is correct — the write-ahead journal makes
+the relaunch resume the BACKLOG exactly-once (completed idempotency
+keys return journaled results, incomplete ones re-run), and the
+journal's poison-request quarantine bounds the loop: a request that
+kills the process ``QUEST_POISON_ATTEMPTS`` times is refused with a
+typed error on the next replay instead of crashing the chain forever.
+Under this flag ANY nonzero exit relaunches within the same bounded
+``--max-restarts`` budget; without it the historical contract is
+byte-stable.
+
 A SIGTERM/SIGINT delivered to THIS wrapper is forwarded to the child —
 so preempting the supervisor preempts the run gracefully, the child
 drains with code 6, and the wrapper immediately resumes it (the
@@ -50,7 +62,8 @@ quest_tpu.
 Usage::
 
     python tools/supervise.py [--max-restarts N]
-                              [--no-resume-on-signal] [--]
+                              [--no-resume-on-signal]
+                              [--restart-on-crash] [--]
                               script.py [args...]
 
 Exit status: the final child attempt's exit code (0 on a completed
@@ -86,7 +99,8 @@ def _launch(cmd, attempt: int):
 
 
 def supervise(cmd, max_restarts: int = MAX_RESTARTS_DEFAULT,
-              resume_on_signal: bool = True) -> int:
+              resume_on_signal: bool = True,
+              restart_on_crash: bool = False) -> int:
     """Run ``cmd`` (argv list) under the restart loop; returns the
     final exit code.  See the module docstring for the contract."""
     # Signal bookkeeping is PER ATTEMPT: each preemption event (which
@@ -132,7 +146,7 @@ def supervise(cmd, max_restarts: int = MAX_RESTARTS_DEFAULT,
                 print(f"supervise: attempt {attempt} completed",
                       flush=True)
                 return 0
-            if code not in RESUMABLE_CODES:
+            if code not in RESUMABLE_CODES and not restart_on_crash:
                 print(f"supervise: attempt {attempt} exited {code} "
                       "(not a resumable lifecycle code) — giving up",
                       flush=True)
@@ -149,9 +163,10 @@ def supervise(cmd, max_restarts: int = MAX_RESTARTS_DEFAULT,
                 return code
             restarts += 1
             delay = RETRY_BASE_DELAY * (1 << (restarts - 1))
+            why = ("preempted" if code == 6 else
+                   "deadline" if code == 3 else "crashed")
             print(f"supervise: attempt {attempt} exited {code} "
-                  f"({'preempted' if code == 6 else 'deadline'}); "
-                  f"resuming in {delay:g}s "
+                  f"({why}); resuming in {delay:g}s "
                   f"(restart {restarts}/{max_restarts})", flush=True)
             time.sleep(delay)
             attempt += 1
@@ -164,6 +179,7 @@ def main(argv) -> int:
     args = list(argv)
     max_restarts = MAX_RESTARTS_DEFAULT
     resume_on_signal = True
+    restart_on_crash = False
     # wrapper options are parsed only BEFORE the `--` separator or the
     # first non-option token — everything after belongs to the child
     # script verbatim (its own --max-restarts must reach it untouched)
@@ -184,6 +200,10 @@ def main(argv) -> int:
             resume_on_signal = False
             args.pop(0)
             continue
+        if a == "--restart-on-crash":
+            restart_on_crash = True
+            args.pop(0)
+            continue
         if a.startswith("-"):
             print(__doc__)
             return 2
@@ -193,7 +213,8 @@ def main(argv) -> int:
         return 2
     cmd = [sys.executable] + args if args[0].endswith(".py") else args
     return supervise(cmd, max_restarts=max_restarts,
-                     resume_on_signal=resume_on_signal)
+                     resume_on_signal=resume_on_signal,
+                     restart_on_crash=restart_on_crash)
 
 
 if __name__ == "__main__":
