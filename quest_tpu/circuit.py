@@ -762,7 +762,8 @@ class Circuit:
             supervisor.admit("circuit_run_batched", batch=n)
         run_id = _tm.new_run_id()
         with supervisor.run_scope(None, outermost=outermost, slots=n), \
-                _tm.trace_scope(_tm.current_trace_id() or run_id), \
+                _tm.trace_scope(_tm.current_trace_id()
+                                or _tm.from_context() or run_id), \
                 metrics.run_ledger("circuit_run_batched"):
             resilience.begin_run()
             metrics.annotate_run("run_id", run_id)
@@ -1279,7 +1280,8 @@ class Circuit:
         # checkpoint sidecar
         run_id = _tm.new_run_id()
         with supervisor.run_scope(dl, outermost=outermost), \
-                _tm.trace_scope(_tm.current_trace_id() or run_id), \
+                _tm.trace_scope(_tm.current_trace_id()
+                                or _tm.from_context() or run_id), \
                 metrics.run_ledger("circuit_run"):
             # per-run resilience baseline: the record's `resilience`
             # annotation reports THIS run's retry/fault numbers, not

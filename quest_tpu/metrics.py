@@ -72,6 +72,13 @@ The always-on production surface (ISSUE-10, ``docs/OBSERVABILITY.md``):
   ``quest_tpu.telemetry``, and ``QUEST_TRACE_SAMPLE=N`` deep-traces
   every Nth ``Circuit.run`` (deterministic counter sampling) while the
   rest stay on the fast whole-program jit.
+* **Fleet snapshots** — ``snapshot()``/``merge_snapshots()`` export
+  the RAW telemetry state (integer log2 bucket counts, not collapsed
+  quantiles) as versioned mergeable documents; with
+  ``QUEST_METRICS_SNAPDIR`` set, workers spill one CRC-framed
+  snapshot file atomically per ``QUEST_METRICS_SNAP_EVERY`` finalised
+  runs, and ``tools/fleet_agg.py`` merges a directory of them into
+  fleet-level Prometheus text with exact union quantiles.
 
 Instrumentation timing discipline: this module and ``reporting.py`` are
 the ONLY places in ``quest_tpu`` allowed to call ``time.perf_counter``
@@ -362,6 +369,16 @@ def _finalize(rec: dict, wall: float) -> None:
     path = os.environ.get("QUEST_METRICS_FILE")
     if path:
         _sink_write("ledger", path, json.dumps(rec, sort_keys=True) + "\n")
+    # fleet snapshot spill cadence: strictly opt-in (QUEST_METRICS_SNAPDIR
+    # unset -> zero extra work), deterministic (every k-th finalised
+    # record), and atomic per spill (write_snapshot replaces the
+    # worker's file whole)
+    if os.environ.get("QUEST_METRICS_SNAPDIR"):
+        with _lock:
+            _snap_state["finalized"] += 1
+            due = _snap_state["finalized"] % snapshot_every() == 0
+        if due:
+            write_snapshot()
 
 
 def get_run_ledger() -> dict | None:
@@ -504,12 +521,11 @@ def _hist_serialize(h: dict) -> dict:
             "zeros": h["zeros"]}
 
 
-def export_text() -> str:
-    """The process telemetry as Prometheus text exposition format —
-    every counter, every SLO histogram (cumulative ``_bucket``/
-    ``_sum``/``_count`` series), and the mesh-health gauges — the
-    payload of the C API's ``getMetricsText`` and of
-    ``tools/metrics_serve.py``'s ``/metrics`` endpoint."""
+def _gauges(c: dict) -> dict:
+    """The point-in-time gauge set exported next to the counters —
+    built from ONE counter snapshot ``c`` so a scrape (or a spilled
+    fleet snapshot) can never disagree with itself.  Shared by
+    :func:`export_text` and :func:`snapshot`."""
     from . import resilience  # deferred: resilience imports metrics
     from . import supervisor  # deferred: supervisor imports metrics
 
@@ -541,9 +557,9 @@ def export_text() -> str:
     # coalesced-vs-solo launch split and the members those coalesced
     # launches carried (mirrors of the supervisor.* counters, exported
     # as gauges so a dashboard can plot occupancy without rate()
-    # math).  ONE counter snapshot feeds both the mirrors and the
-    # rendered counters, so a scrape can never disagree with itself
-    c = counters()
+    # math).  The caller's ONE counter snapshot ``c`` feeds both the
+    # mirrors and the rendered counters, so a scrape can never
+    # disagree with itself
     gauges.update({
         "batch.occupancy": supervisor.batch_occupancy(),
         "batch.coalesced_launches": c.get("supervisor.batch_launches",
@@ -568,7 +584,260 @@ def export_text() -> str:
         "serve.session_evictions": c.get(
             "supervisor.session_evictions", 0),
     })
-    return telemetry.render_prometheus(c, histograms(), gauges=gauges)
+    return gauges
+
+
+def build_info() -> dict:
+    """Identity labels for the ``quest_build_info`` info-style gauge
+    (standard Prometheus practice: a constant-1 series whose labels
+    carry the build/config identity).  A fleet scrape joins it against
+    the per-worker series to tell heterogeneous workers apart — a
+    worker still on f32 wire words or a different comm sub-block split
+    shows up HERE, not as an unexplained latency delta."""
+    from . import precision  # deferred: precision has no metrics dep, but keep import time lean
+
+    try:
+        import jax
+        jax_version = getattr(jax, "__version__", "unknown")
+    except Exception:  # pragma: no cover - jax always present in-tree
+        jax_version = "unavailable"
+    try:
+        from .parallel.mesh_exec import comm_config_token
+        comm = "/".join(comm_config_token())
+    except Exception:  # pragma: no cover - parallel stack unavailable
+        comm = ""
+    dtype = precision.default_real_dtype()
+    return {
+        "jax": str(jax_version),
+        "precision": getattr(dtype, "__name__", str(dtype)),
+        "comm_config": comm,
+        "worker": telemetry.worker_id(),
+    }
+
+
+def export_text() -> str:
+    """The process telemetry as Prometheus text exposition format —
+    every counter, every SLO histogram (cumulative ``_bucket``/
+    ``_sum``/``_count`` series), the mesh-health gauges, and the
+    ``quest_build_info`` identity gauge — the payload of the C API's
+    ``getMetricsText`` and of ``tools/metrics_serve.py``'s ``/metrics``
+    endpoint."""
+    c = counters()
+    return telemetry.render_prometheus(
+        c, histograms(), gauges=_gauges(c),
+        infos={"build_info": build_info()})
+
+
+# ---------------------------------------------------------------------------
+# Fleet metric snapshots (mergeable, spillable)
+# ---------------------------------------------------------------------------
+#
+# A fleet aggregator cannot sum Prometheus TEXT: quantiles don't add
+# and a scrape has already collapsed the sparse buckets to floats.  So
+# each worker spills its RAW state — integer log2 bucket counts,
+# counters, gauges — as one versioned, CRC-framed snapshot document,
+# and ``merge_snapshots`` combines them EXACTLY: a log2 histogram's
+# quantiles depend only on the integer bucket counts, so bucket-wise
+# integer summation makes the merged p50/p90/p99 bit-equal to the
+# quantiles over the union of the raw observation streams (at bucket
+# resolution — the same resolution a single process reports).  The
+# float ``sum`` is the only order-dependent field; everything the
+# quantile math touches is exact integer arithmetic.  All of this is
+# strictly opt-in: no snapshot is ever written unless
+# ``QUEST_METRICS_SNAPDIR`` is set or ``write_snapshot`` is called.
+
+#: Snapshot schema tag, bumped on incompatible shape changes.
+SNAPSHOT_SCHEMA = "quest-tpu-metrics-snapshot/1"
+
+#: Spilled snapshot filename prefix (one file per worker; atomic
+#: replace keeps exactly the newest epoch on disk).
+SNAPSHOT_PREFIX = "snap-"
+
+#: Per-process snapshot state: ``epoch`` increments per snapshot taken
+#: (so an aggregator seeing two files from one worker_id keeps the
+#: newest), ``finalized`` counts ledger records toward the spill
+#: cadence.
+_snap_state = {"epoch": 0, "finalized": 0}
+
+
+def snapshot() -> dict:
+    """One versioned, JSON-serializable, MERGEABLE snapshot of this
+    process's telemetry: counters, sparse log2 histogram state (raw
+    integer bucket counts keyed by stringified exponent — NOT the
+    collapsed ``histograms()`` view), and the point-in-time gauges,
+    stamped with the worker identity (``telemetry.worker_id()``), pid,
+    a per-process monotonic ``epoch``, and the active/propagated
+    trace context."""
+    with _lock:
+        _snap_state["epoch"] += 1
+        epoch = _snap_state["epoch"]
+        c = dict(_counters)
+        hists = {name: _hist_serialize(h) for name, h in _hists.items()}
+    return {
+        "schema": SNAPSHOT_SCHEMA,
+        "worker": telemetry.worker_id(),
+        "pid": os.getpid(),
+        "epoch": epoch,
+        "trace": telemetry.effective_trace_id() or telemetry.from_context(),
+        "counters": c,
+        "hists": hists,
+        "gauges": _gauges(c),
+    }
+
+
+def hist_stats(serialized: dict) -> dict:
+    """The ``histograms()``-shaped view (count/sum/zeros/ascending
+    ``[[le, n], ...]`` buckets/p50/p90/p99) of one SERIALIZED histogram
+    — the string-keyed-exponent form ledger records, snapshots, and
+    ``merge_snapshots`` output all carry.  The one quantile path for
+    single-process and fleet-merged state, so the two can never use
+    different math."""
+    h = {"buckets": {int(e): int(n)
+                     for e, n in (serialized.get("buckets") or {}).items()},
+         "count": int(serialized.get("count", 0)),
+         "sum": float(serialized.get("sum", 0.0)),
+         "zeros": int(serialized.get("zeros", 0))}
+    return _hist_snapshot(h)
+
+
+def merge_snapshots(snaps) -> dict:
+    """Combine worker snapshots EXACTLY into one fleet document.
+
+    Duplicate ``worker`` ids keep the newest ``epoch`` only (a worker
+    that spilled twice must not double-count; on an epoch tie the later
+    list entry wins).  Counters and gauges sum; histograms merge
+    bucket-wise — integer sums of ``buckets``/``count``/``zeros`` —
+    so quantiles computed from the merged state (via
+    :func:`hist_stats`) are bit-equal to the quantiles over the union
+    of the raw observation streams.  Returns ``{"schema", "workers":
+    {wid: snapshot}, "counters", "gauges", "hists"}`` with ``hists``
+    in the serialized (string-keyed) form.  Raises ``ValueError`` on a
+    document that is not a supported snapshot — corrupt FILES never
+    get this far (``read_snapshot`` already screened them)."""
+    by_worker: dict[str, dict] = {}
+    for s in snaps:
+        sch = s.get("schema") if isinstance(s, dict) else None
+        if sch != SNAPSHOT_SCHEMA:
+            raise ValueError(
+                f"merge_snapshots: unsupported snapshot schema {sch!r} "
+                f"(want {SNAPSHOT_SCHEMA!r})")
+        wid = str(s.get("worker") or f"pid-{s.get('pid', 0):x}")
+        prev = by_worker.get(wid)
+        if prev is None or int(s.get("epoch") or 0) >= int(prev.get("epoch")
+                                                           or 0):
+            by_worker[wid] = s
+    counters_m: dict[str, float] = {}
+    gauges_m: dict[str, float] = {}
+    hists_m: dict[str, dict] = {}
+    for wid in sorted(by_worker):
+        s = by_worker[wid]
+        for k, v in (s.get("counters") or {}).items():
+            counters_m[k] = counters_m.get(k, 0) + v
+        for k, v in (s.get("gauges") or {}).items():
+            gauges_m[k] = gauges_m.get(k, 0) + v
+        for name, h in (s.get("hists") or {}).items():
+            m = hists_m.setdefault(name, {"buckets": {}, "count": 0,
+                                          "sum": 0.0, "zeros": 0})
+            m["count"] += int(h.get("count", 0))
+            m["sum"] = round(m["sum"] + float(h.get("sum", 0.0)), 9)
+            m["zeros"] += int(h.get("zeros", 0))
+            for e, n in (h.get("buckets") or {}).items():
+                e = str(int(e))
+                m["buckets"][e] = m["buckets"].get(e, 0) + int(n)
+    for m in hists_m.values():
+        m["buckets"] = {e: m["buckets"][e]
+                        for e in sorted(m["buckets"], key=int)}
+    return {"schema": "quest-tpu-fleet-metrics/1",
+            "workers": by_worker,
+            "counters": counters_m,
+            "gauges": gauges_m,
+            "hists": hists_m}
+
+
+def write_snapshot(directory: str | None = None,
+                   snap: dict | None = None) -> str | None:
+    """Spill one snapshot atomically into ``directory`` (default
+    ``$QUEST_METRICS_SNAPDIR``; None and unset -> no-op).
+
+    CRC32-framed exactly like the request journal
+    (``stateio.frame_record``), written to a temp file through the
+    ``sink_write`` retry seam, then ``os.replace``d to
+    ``snap-<worker>.json`` — a concurrent aggregator scan sees the old
+    snapshot or the new one, never a torn write.  Failures degrade
+    like every metrics sink (warn once + ``metrics.sink_errors``);
+    returns the final path, or None."""
+    d = directory or os.environ.get("QUEST_METRICS_SNAPDIR")
+    if not d:
+        return None
+    from . import stateio  # deferred: shared CRC journal framing
+
+    if snap is None:
+        snap = snapshot()
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError as e:
+        counter_inc("metrics.sink_errors")
+        warn_once("snapshot", f"snapshot dir {d!r} unusable ({e}); "
+                  "degrading silently (metrics.sink_errors counts "
+                  "further failures)")
+        return None
+    final = os.path.join(d, f"{SNAPSHOT_PREFIX}{snap['worker']}.json")
+    tmp = f"{final}.tmp-{os.getpid()}"
+    text = stateio.frame_record(snap, field="snap") + "\n"
+    if not _sink_write("snapshot", tmp, text, mode="w"):
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        return None
+    try:
+        os.replace(tmp, final)
+    except OSError as e:
+        counter_inc("metrics.sink_errors")
+        warn_once("snapshot", f"snapshot rename to {final!r} failed "
+                  f"({e}); degrading silently (metrics.sink_errors "
+                  "counts further failures)")
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        return None
+    return final
+
+
+def read_snapshot(path: str) -> dict | None:
+    """Parse one spilled snapshot file; None if unusable.
+
+    A corrupt, torn, or wrong-schema file is skipped with ONE stderr
+    warning per process and a ``metrics.snapshot_corrupt`` counter
+    bump per file — one worker's bad disk must not take down the
+    fleet view.  A file that has VANISHED (worker cleanup racing the
+    scan) is not corruption and is skipped silently."""
+    from . import stateio  # deferred: shared CRC journal framing
+
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    snap = stateio.unframe_record(text.strip(), field="snap")
+    if (not isinstance(snap, dict)
+            or snap.get("schema") != SNAPSHOT_SCHEMA):
+        counter_inc("metrics.snapshot_corrupt")
+        warn_once("snapshot_corrupt",
+                  f"metrics snapshot {path!r} is corrupt or not a "
+                  f"{SNAPSHOT_SCHEMA} document; skipped "
+                  "(metrics.snapshot_corrupt counts further damage)")
+        return None
+    return snap
+
+
+def snapshot_every() -> int:
+    """The ``QUEST_METRICS_SNAP_EVERY=k`` cadence knob: with
+    ``QUEST_METRICS_SNAPDIR`` set, spill a snapshot after every k-th
+    finalised run record (default 1 — every run).  Deterministic
+    counter cadence, same style as ``QUEST_TRACE_SAMPLE``."""
+    try:
+        return max(1, int(os.environ.get("QUEST_METRICS_SNAP_EVERY",
+                                         "1")))
+    except ValueError:
+        return 1
 
 
 # ---------------------------------------------------------------------------
@@ -885,5 +1154,7 @@ def reset() -> None:
         _timeline["t0"] = None
         _timeline["dropped"] = 0
         del _flight[:]
+        _snap_state["epoch"] = 0
+        _snap_state["finalized"] = 0
     clear_warn_once()
     telemetry.reset()

@@ -419,6 +419,35 @@ def _journal_crc(body: str) -> str:
     return f"{zlib.crc32(body.encode()):08x}"
 
 
+def frame_record(rec: dict, field: str = "rec") -> str:
+    """One record as a CRC32-framed JSON line (no trailing newline):
+    ``{"crc": "<crc32 of the canonical record JSON>", <field>: rec}``
+    — the journal's line format, shared with the fleet metric
+    snapshots (``metrics.write_snapshot`` frames under ``"snap"``) so
+    every durable observability artifact has ONE framing to audit."""
+    body = json.dumps(rec, sort_keys=True)
+    return json.dumps({"crc": _journal_crc(body), field: rec},
+                      sort_keys=True)
+
+
+def unframe_record(text: str, field: str = "rec") -> dict | None:
+    """Parse one CRC32-framed line back into its record; None when the
+    frame fails to decode, lacks the ``field``/``crc`` keys, or the
+    checksum disagrees — torn and corrupt lines look the same to the
+    caller, which decides warn/count semantics (``read_journal``
+    distinguishes a torn tail from interior damage; the snapshot
+    scanner counts every skip)."""
+    try:
+        frame = json.loads(text)
+        rec = frame[field]
+        want = frame["crc"]
+    except (ValueError, KeyError, TypeError):
+        return None
+    if _journal_crc(json.dumps(rec, sort_keys=True)) != want:
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
 def _warn_torn(path: str) -> None:
     from . import metrics
 
@@ -473,7 +502,14 @@ def append_journal_entries(directory: str, recs: list[dict]) -> None:
     open/write/flush/fsync (a journaled serve's accept pass lands N
     records for the price of one sync), a pre-existing torn tail is
     truncated first (see :func:`_heal_torn_tail`), and the open runs
-    under the bounded ``journal_append`` retry seam."""
+    under the bounded ``journal_append`` retry seam.
+
+    When a parent process propagated a trace context
+    (``QUEST_TRACE_CONTEXT`` — see ``telemetry.from_context``), every
+    record that does not already carry a ``ctx`` field is stamped with
+    it, so a relaunch chain's journal lines name the chain they belong
+    to; with the env var unset (the default) the written bytes are
+    unchanged."""
     from . import resilience
 
     if not recs:
@@ -487,11 +523,11 @@ def append_journal_entries(directory: str, recs: list[dict]) -> None:
                 meta_path, {"format_version": JOURNAL_FORMAT_VERSION,
                             "kind": "serve-journal"}),
             seam="journal_append")
-    lines = []
-    for rec in recs:
-        body = json.dumps(rec, sort_keys=True)
-        lines.append(json.dumps({"crc": _journal_crc(body),
-                                 "rec": rec}, sort_keys=True) + "\n")
+    ctx = telemetry.from_context()
+    if ctx:
+        recs = [rec if "ctx" in rec else {**rec, "ctx": ctx}
+                for rec in recs]
+    lines = [frame_record(rec) + "\n" for rec in recs]
     path = os.path.join(directory, JOURNAL)
     with _journal_lock:
         if os.path.isfile(path):

@@ -1595,7 +1595,11 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
             "serve: tenant_queue_depth must be a single numeric "
             f"quota (or None), got {tenant_queue_depth!r}")
     results: list = [None] * len(jobs)
-    submit_tid = telemetry.current_trace_id()
+    # the submitting scope's trace id, falling back to a propagated
+    # cross-process context (QUEST_TRACE_CONTEXT): a supervise-relaunch
+    # chain's replay serve() continues the crashed parent's trace
+    # natively instead of leaning on the checkpoint sidecar
+    submit_tid = telemetry.current_trace_id() or telemetry.from_context()
 
     # --- validate the opt-in combinations -----------------------------
     if journal_dir is not None:

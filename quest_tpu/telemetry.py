@@ -34,8 +34,25 @@ always-on:
   (the payload of ``metrics.export_text`` / the C API's
   ``getMetricsText`` / ``tools/metrics_serve.py``'s ``/metrics``).
 
+* **Cross-process trace propagation** — :func:`trace_context`
+  serializes the active trace scope into the ``QUEST_TRACE_CONTEXT``
+  env-var encoding and :func:`from_context` reads it back, so a
+  relaunch chain (``tools/supervise.py``) or a fleet worker continues
+  the parent's trace_id NATIVELY instead of riding the checkpoint
+  sidecar; :func:`worker_id` names this process for fleet metric
+  snapshots (``QUEST_WORKER_ID``, defaulting to a pid-derived id).
+
+* **Request audit trail** — :func:`audit_trail` reconstructs one
+  request's full lifecycle (accepted → launch(es) → complete / failed
+  / quarantined journal records, ledger records with resilience
+  deltas, timeline event counts) as one ordered, schema-validated
+  JSON document; ``tools/trace_view.py --trace-id`` renders it.
+
 This module is deliberately leaf-level (stdlib only, no quest_tpu
-imports), so ``metrics`` can import it without cycles.
+imports), so ``metrics`` can import it without cycles — the audit
+trail therefore carries its OWN stdlib journal reader, a forensic
+mirror of ``stateio.read_journal``'s damage tolerance (a test pins
+the two readers agree on damaged journals).
 """
 
 from __future__ import annotations
@@ -125,6 +142,56 @@ def supervise_attempt() -> int | None:
 
 
 # ---------------------------------------------------------------------------
+# Cross-process trace propagation (QUEST_TRACE_CONTEXT)
+# ---------------------------------------------------------------------------
+
+#: Env var carrying the serialized trace scope across process
+#: boundaries.  ``tools/supervise.py`` exports it into every relaunch
+#: attempt (minting a chain id on the first when none is inherited),
+#: and any future fleet launcher can do the same — a child process
+#: whose first run finds no active scope adopts the propagated id
+#: instead of minting a fresh one, so the whole chain shares ONE
+#: trace_id without the checkpoint-sidecar crutch.
+TRACE_CONTEXT_ENV = "QUEST_TRACE_CONTEXT"
+
+
+def worker_id() -> str:
+    """This process's fleet worker identity: ``QUEST_WORKER_ID`` when
+    the launcher named it, else a pid-derived ``pid-<hex>`` fallback —
+    the id every spilled metric snapshot and fleet-level Prometheus
+    series (``worker="..."``) is stamped with."""
+    wid = (os.environ.get("QUEST_WORKER_ID") or "").strip()
+    return wid or f"pid-{os.getpid():x}"
+
+
+def trace_context(trace_id: str | None = None) -> str | None:
+    """Serialize the active trace scope for the
+    :data:`TRACE_CONTEXT_ENV` env var: ``trace_id`` when given, else
+    the effective trace id, else None (nothing to propagate).  The
+    encoding is the bare trace id — grep-compatible with every ledger
+    record and journal line that carries it."""
+    tid = trace_id if trace_id is not None else effective_trace_id()
+    if tid is None:
+        return None
+    tid = str(tid).strip()
+    return tid or None
+
+
+def from_context(value: str | None = None) -> str | None:
+    """The trace id propagated by a parent process: decodes ``value``
+    when given, else this process's :data:`TRACE_CONTEXT_ENV` env var;
+    None when nothing was propagated.  Consumers treat it strictly as
+    a FALLBACK — an explicitly requested trace id, or an already
+    active scope, always wins."""
+    if value is None:
+        value = os.environ.get(TRACE_CONTEXT_ENV)
+    if value is None:
+        return None
+    value = str(value).strip()
+    return value or None
+
+
+# ---------------------------------------------------------------------------
 # Deterministic trace sampling (QUEST_TRACE_SAMPLE=N)
 # ---------------------------------------------------------------------------
 
@@ -197,8 +264,20 @@ def _prom_num(v) -> str:
     return repr(f)
 
 
+def _prom_label_str(labels: dict) -> str:
+    """``{k: v}`` -> ``k1="v1",k2="v2"`` with Prometheus label-value
+    escaping (backslash, double quote, newline), keys sorted."""
+    out = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace('"', r'\"') \
+            .replace("\n", r"\n")
+        out.append(f'{k}="{v}"')
+    return ",".join(out)
+
+
 def render_prometheus(counters: dict, histograms: dict,
-                      gauges: dict | None = None) -> str:
+                      gauges: dict | None = None,
+                      infos: dict | None = None) -> str:
     """Render counter / histogram / gauge snapshots as the Prometheus
     text exposition format (version 0.0.4).
 
@@ -207,7 +286,12 @@ def render_prometheus(counters: dict, histograms: dict,
     ``metrics.histograms()`` shape (``buckets`` as ``[le, count]``
     pairs, plus ``count``/``sum``/``zeros``) — exported as cumulative
     ``_bucket{le=...}`` series with ``+Inf``, ``_sum`` and ``_count``;
-    ``gauges`` is ``{name: value}`` point-in-time values."""
+    ``gauges`` is ``{name: value}`` point-in-time values; ``infos`` is
+    ``{name: {label: value}}`` — each rendered as the standard
+    Prometheus *info* pattern, a constant-``1`` gauge whose labels
+    carry the facts (``quest_build_info`` is the canonical use: a
+    fleet scrape tells heterogeneous workers apart by labels, not by
+    parsing values)."""
     lines = []
     for name in sorted(counters):
         pn = _prom_name(name)
@@ -217,6 +301,10 @@ def render_prometheus(counters: dict, histograms: dict,
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {_prom_num(g)}")
+    for name, labels in sorted((infos or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn}{{{_prom_label_str(labels or {})}}} 1")
     for name in sorted(histograms):
         h = histograms[name]
         pn = _prom_name(name)
@@ -231,6 +319,244 @@ def render_prometheus(counters: dict, histograms: dict,
         lines.append(f"{pn}_sum {_prom_num(h['sum'])}")
         lines.append(f"{pn}_count {int(h['count'])}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Request audit trail (one trace_id -> one ordered lifecycle document)
+# ---------------------------------------------------------------------------
+
+#: Audit-trail document schema tag, bumped on incompatible changes.
+AUDIT_SCHEMA = "quest-tpu-audit-trail/1"
+
+#: Journal record kinds in the serve write-ahead journal
+#: (``quest_tpu.supervisor`` / ``stateio.append_journal_entries``).
+JOURNAL_KINDS = ("accept", "launch", "complete", "failed", "quarantine")
+
+
+def _read_journal_forensic(directory: str) -> list[dict]:
+    """Stdlib mirror of ``stateio.read_journal`` for post-mortem use:
+    every CRC32-framed line that parses and checksums is returned in
+    file order; torn or corrupt lines are silently skipped (the live
+    reader warns and counts — forensics over a copied journal must not
+    mutate process counters).  A test pins both readers returning the
+    SAME records over a damaged journal, so the tolerance semantics
+    cannot drift."""
+    import json
+    import zlib
+
+    path = os.path.join(os.path.abspath(directory), "journal.jsonl")
+    if not os.path.isfile(path):
+        return []
+    out: list[dict] = []
+    with open(path) as f:
+        for raw in f.read().split("\n"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                frame = json.loads(raw)
+                rec = frame["rec"]
+                body = json.dumps(rec, sort_keys=True)
+                if f"{zlib.crc32(body.encode()):08x}" == frame["crc"]:
+                    out.append(rec)
+            except (ValueError, KeyError, TypeError):
+                continue
+    return out
+
+
+def _ledger_records(ledger) -> list[dict]:
+    """Normalise the ``ledger=`` argument: a path to a
+    ``QUEST_METRICS_FILE`` JSONL file, or an iterable of already-read
+    record dicts.  Undecodable lines are skipped (forensics)."""
+    import json
+
+    if ledger is None:
+        return []
+    if isinstance(ledger, (str, os.PathLike)):
+        recs = []
+        if os.path.isfile(ledger):
+            with open(ledger) as f:
+                for raw in f:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        rec = json.loads(raw)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        recs.append(rec)
+        return recs
+    return [r for r in ledger if isinstance(r, dict)]
+
+
+def audit_trail(trace_id: str, journal_dir: str | None = None,
+                ledger=None) -> dict:
+    """Reconstruct one request chain's full lifecycle as ONE ordered,
+    schema-validated JSON document — the "what happened to this
+    request, across every process that touched it" answer without
+    grepping N workers.
+
+    ``trace_id`` selects the chain: a journal record belongs when its
+    ``trace_id`` or its propagated ``ctx`` (stamped by
+    ``stateio.append_journal_entries`` when ``QUEST_TRACE_CONTEXT`` is
+    set) equals it, or when its idempotency ``key`` was accepted /
+    completed under the chain; a ledger record belongs when its
+    ``meta.trace_id`` matches.  ``journal_dir`` is a serve write-ahead
+    journal directory (``supervisor.serve(journal_dir=...)``);
+    ``ledger`` is a ``QUEST_METRICS_FILE`` path or an iterable of
+    ledger records.
+
+    The document: ``events`` (ordered — journal records in journal
+    order, then ledger records in ledger order, each with a strictly
+    increasing ``seq``), ``requests`` (per idempotency key: accepted /
+    launches / failed / completes / quarantined counts plus the kind
+    ``lifecycle`` in order), and ``ledger`` (record count, summed
+    ``resilience.*`` counter deltas, timeline event counts, run ids
+    and supervise attempts).  Raises ``ValueError`` when the built
+    document fails its own schema check."""
+    tid = str(trace_id)
+    events: list[dict] = []
+    requests: dict = {}
+
+    def _req(key):
+        return requests.setdefault(key, {
+            "accepted": 0, "launches": 0, "failed": 0, "completes": 0,
+            "quarantined": 0, "lifecycle": []})
+
+    jrecs = _read_journal_forensic(journal_dir) if journal_dir else []
+    # pass 1: the chain's idempotency keys — records carrying the
+    # trace id (or the propagated context) directly claim their key,
+    # so the key-only kinds (launch/failed/quarantine) join via it
+    keys = {r.get("key") for r in jrecs
+            if r.get("key") is not None
+            and tid in (r.get("trace_id"), r.get("ctx"))}
+    for r in jrecs:
+        key = r.get("key")
+        if key not in keys \
+                and tid not in (r.get("trace_id"), r.get("ctx")):
+            continue
+        kind = r.get("kind")
+        if kind not in JOURNAL_KINDS:
+            continue
+        ev = {"seq": 0, "source": "journal", "kind": kind, "key": key}
+        for field in ("attempt", "attempts", "tenant", "index",
+                      "digest", "error", "ctx"):
+            if r.get(field) is not None:
+                ev[field] = r[field]
+        events.append(ev)
+        if key is not None:
+            req = _req(key)
+            req["lifecycle"].append(kind)
+            if kind == "accept":
+                req["accepted"] += 1
+            elif kind == "launch":
+                req["launches"] += 1
+            elif kind == "failed":
+                req["failed"] += 1
+            elif kind == "complete":
+                req["completes"] += 1
+            elif kind == "quarantine":
+                req["quarantined"] += 1
+
+    resilience_deltas: dict = {}
+    timeline_events = 0
+    run_ids: list = []
+    attempts: list = []
+    n_ledger = 0
+    for rec in _ledger_records(ledger):
+        meta = rec.get("meta") or {}
+        if meta.get("trace_id") != tid:
+            continue
+        n_ledger += 1
+        n_events = len(rec.get("events") or [])
+        timeline_events += n_events
+        if meta.get("run_id") is not None:
+            run_ids.append(meta["run_id"])
+        if meta.get("supervise_attempt") is not None:
+            attempts.append(meta["supervise_attempt"])
+        deltas = {k: v for k, v in (rec.get("counters") or {}).items()
+                  if k.startswith("resilience.")}
+        for k, v in deltas.items():
+            resilience_deltas[k] = resilience_deltas.get(k, 0) + v
+        ev = {"seq": 0, "source": "ledger", "kind": "ledger-record",
+              "label": rec.get("label"), "events": n_events}
+        for field, val in (("run_id", meta.get("run_id")),
+                           ("supervise_attempt",
+                            meta.get("supervise_attempt")),
+                           ("wall_s", rec.get("wall_s"))):
+            if val is not None:
+                ev[field] = val
+        if deltas:
+            ev["resilience"] = deltas
+        events.append(ev)
+
+    for seq, ev in enumerate(events, 1):
+        ev["seq"] = seq
+    doc = {
+        "schema": AUDIT_SCHEMA,
+        "trace_id": tid,
+        "keys": sorted(k for k in requests if k is not None),
+        "events": events,
+        "requests": requests,
+        "ledger": {"records": n_ledger,
+                   "resilience": resilience_deltas,
+                   "timeline_events": timeline_events,
+                   "run_ids": run_ids,
+                   "supervise_attempts": attempts},
+    }
+    return validate_audit_trail(doc)
+
+
+def validate_audit_trail(doc: dict) -> dict:
+    """Schema check for one audit-trail document; returns ``doc`` or
+    raises ``ValueError`` naming the first violation.  Checked on
+    every :func:`audit_trail` build AND by consumers handed a document
+    from elsewhere (``tools/trace_view.py --trace-id``)."""
+    def fail(msg):
+        raise ValueError(f"audit trail: {msg}")
+
+    if not isinstance(doc, dict):
+        fail(f"document must be a dict, got {type(doc).__name__}")
+    if doc.get("schema") != AUDIT_SCHEMA:
+        fail(f"schema {doc.get('schema')!r} != {AUDIT_SCHEMA!r}")
+    if not isinstance(doc.get("trace_id"), str) or not doc["trace_id"]:
+        fail("trace_id must be a non-empty string")
+    for field, typ in (("keys", list), ("events", list),
+                       ("requests", dict), ("ledger", dict)):
+        if not isinstance(doc.get(field), typ):
+            fail(f"{field} must be a {typ.__name__}")
+    prev = 0
+    for ev in doc["events"]:
+        if not isinstance(ev, dict):
+            fail("every event must be a dict")
+        if ev.get("source") not in ("journal", "ledger"):
+            fail(f"event {ev.get('seq')}: bad source "
+                 f"{ev.get('source')!r}")
+        if ev["source"] == "journal" \
+                and ev.get("kind") not in JOURNAL_KINDS:
+            fail(f"event {ev.get('seq')}: bad journal kind "
+                 f"{ev.get('kind')!r}")
+        if not isinstance(ev.get("seq"), int) or ev["seq"] <= prev:
+            fail(f"event seq {ev.get('seq')!r} not strictly "
+                 f"increasing after {prev}")
+        prev = ev["seq"]
+    for key, req in doc["requests"].items():
+        for field in ("accepted", "launches", "failed", "completes",
+                      "quarantined"):
+            if not isinstance(req.get(field), int) \
+                    or req[field] < 0:
+                fail(f"request {key!r}: {field} must be a "
+                     "non-negative int")
+        if not isinstance(req.get("lifecycle"), list):
+            fail(f"request {key!r}: lifecycle must be a list")
+    led = doc["ledger"]
+    for field in ("records", "timeline_events"):
+        if not isinstance(led.get(field), int) or led[field] < 0:
+            fail(f"ledger.{field} must be a non-negative int")
+    if not isinstance(led.get("resilience"), dict):
+        fail("ledger.resilience must be a dict")
+    return doc
 
 
 def reset() -> None:

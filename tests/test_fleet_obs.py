@@ -1,0 +1,538 @@
+"""Fleet observability (ISSUE 16 acceptance criteria).
+
+Covers: (a) mergeable metric snapshots — versioned shape, the
+merged-quantile == union-quantile exactness pin for any partition of
+an observation stream (f64 and f32 feeds), duplicate-worker
+newest-epoch dedupe, wrong-schema rejection; (b) atomic CRC-framed
+snapshot spill — temp+rename roundtrip, corrupt/torn files skipped
+warn-once and counted, unwritable sinks degrade, the deterministic
+``QUEST_METRICS_SNAP_EVERY`` cadence hook, and the default path
+spilling NOTHING; (c) the fleet aggregator — empty-dir no-op,
+``/metrics/fleet`` over real HTTP parsing with ``quest_fleet_*``
+totals equal to the sum of per-worker values, the ``/healthz``
+staleness rollup marking SUSPECT workers; (d) cross-process trace
+propagation — ``trace_context``/``from_context`` round trip, a
+``Circuit.run`` adopting the propagated context (and the fresh-chain
+``run_id == trace_id`` fast-path pin staying intact), the
+``tools/supervise.py`` chain exporting ONE context to every attempt
+(stdlib mirror pinned against ``telemetry.TRACE_CONTEXT_ENV``), and
+journal records stamped with ``ctx`` only when a context is set
+(byte-stable default); (e) the request audit trail — forensic journal
+reader pinned against ``stateio.read_journal`` over a damaged
+journal, lifecycle reconstruction over a real journaled serve and a
+simulated crash→relaunch chain, schema validation rejecting tampered
+documents, and the ``tools/trace_view.py --trace-id`` CLI; (f) the
+``counters.metrics.snapshot_corrupt`` ledger_diff rule, both
+directions.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics, models, stateio, supervisor, telemetry
+from quest_tpu.circuit import Circuit
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import fleet_agg  # noqa: E402
+import ledger_diff  # noqa: E402
+import metrics_serve  # noqa: E402
+import supervise  # noqa: E402
+
+N = 6
+
+
+def _measured_circ(seed=7):
+    circ = models.random_circuit(N, depth=2, seed=seed)
+    circ.measure(0)
+    circ.measure(3)
+    return circ
+
+
+def _reqs(env, n=4):
+    circ = _measured_circ()
+    keys = jax.random.split(jax.random.PRNGKey(2), n)
+    return [supervisor.BatchableRun(circ, env, key=keys[i],
+                                    trace_id=f"tenant-{i}",
+                                    idempotency_key=f"req-{i}")
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# (a) mergeable snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_shape_and_identity(monkeypatch):
+    monkeypatch.setenv("QUEST_WORKER_ID", "w-test")
+    metrics.counter_inc("fleet.test.counter", 2)
+    s = metrics.snapshot()
+    assert s["schema"] == metrics.SNAPSHOT_SCHEMA
+    assert s["worker"] == "w-test"
+    assert s["pid"] == os.getpid()
+    assert s["counters"]["fleet.test.counter"] >= 2
+    assert isinstance(s["epoch"], int) and s["epoch"] >= 1
+    assert metrics.snapshot()["epoch"] == s["epoch"] + 1
+    assert "up" in s["gauges"]
+    json.dumps(s)  # JSON-serializable, whole document
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.float32])
+def test_merge_partition_exactness(monkeypatch, dtype):
+    """THE exactness pin: for any partition of an observation stream
+    across N>=2 snapshots, merged quantiles are bit-equal to the
+    single-process quantiles over the whole stream (including the
+    zeros underflow bucket), at f64 and f32 feeds."""
+    rng = np.random.default_rng(42)
+    stream = rng.gamma(2.0, 0.01, size=257).astype(dtype)
+    stream[::40] = 0.0  # exercise the zeros bucket too
+    name = "fleet.test.part"
+
+    metrics.reset()
+    for v in stream:
+        metrics.hist_record(name, v)
+    ref = metrics.histograms()[name]
+
+    snaps = []
+    for i, part in enumerate(np.array_split(stream, 3)):
+        metrics.reset()
+        monkeypatch.setenv("QUEST_WORKER_ID", f"pw{i}")
+        for v in part:
+            metrics.hist_record(name, v)
+        snaps.append(metrics.snapshot())
+    metrics.reset()
+
+    merged = metrics.merge_snapshots(snaps)
+    assert sorted(merged["workers"]) == ["pw0", "pw1", "pw2"]
+    stats = metrics.hist_stats(merged["hists"][name])
+    for q in ("p50", "p90", "p99"):
+        assert stats[q] == ref[q]  # bit-equal, not approx
+    assert stats["count"] == ref["count"]
+    assert stats["zeros"] == ref["zeros"]
+    assert stats["buckets"] == ref["buckets"]
+    # the float sum is the one order-dependent field: close, not pinned
+    assert stats["sum"] == pytest.approx(ref["sum"], rel=1e-9)
+
+
+def test_merge_duplicate_worker_keeps_newest_epoch():
+    old = {"schema": metrics.SNAPSHOT_SCHEMA, "worker": "w", "pid": 1,
+           "epoch": 3, "trace": None, "counters": {"c": 10},
+           "hists": {}, "gauges": {}}
+    new = dict(old, epoch=7, counters={"c": 25})
+    other = {"schema": metrics.SNAPSHOT_SCHEMA, "worker": "x", "pid": 2,
+             "epoch": 1, "trace": None, "counters": {"c": 1},
+             "hists": {}, "gauges": {}}
+    for order in ([old, new, other], [new, other, old]):
+        merged = metrics.merge_snapshots(order)
+        assert merged["counters"]["c"] == 26  # newest w + x, never both w
+        assert merged["workers"]["w"]["epoch"] == 7
+
+
+def test_merge_rejects_wrong_schema():
+    with pytest.raises(ValueError, match="unsupported snapshot schema"):
+        metrics.merge_snapshots([{"schema": "bogus/9"}])
+    with pytest.raises(ValueError):
+        metrics.merge_snapshots([42])
+
+
+# ---------------------------------------------------------------------------
+# (b) atomic spill + cadence
+# ---------------------------------------------------------------------------
+
+
+def test_spill_roundtrip_atomic(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUEST_WORKER_ID", "wspill")
+    metrics.counter_inc("fleet.test.spill", 5)
+    path = metrics.write_snapshot(str(tmp_path))
+    assert path == str(tmp_path / "snap-wspill.json")
+    assert [p.name for p in tmp_path.iterdir()] == ["snap-wspill.json"]
+    snap = metrics.read_snapshot(path)
+    assert snap["worker"] == "wspill"
+    assert snap["counters"]["fleet.test.spill"] >= 5
+    # a re-spill atomically replaces (never a second/torn file)
+    metrics.write_snapshot(str(tmp_path))
+    assert [p.name for p in tmp_path.iterdir()] == ["snap-wspill.json"]
+    assert metrics.read_snapshot(path)["epoch"] == snap["epoch"] + 1
+
+
+def test_corrupt_snapshot_skipped_warn_once_counted(tmp_path, capsys):
+    good = metrics.write_snapshot(str(tmp_path))
+    (tmp_path / "snap-torn.json").write_text(
+        good and open(good).read()[:40] or "torn")
+    (tmp_path / "snap-badcrc.json").write_text(
+        '{"crc": "00000000", "snap": {"schema": "%s"}}'
+        % metrics.SNAPSHOT_SCHEMA)
+    metrics.clear_warn_once()
+    before = metrics.counters().get("metrics.snapshot_corrupt", 0)
+    rows = fleet_agg.scan_snapshots(str(tmp_path))
+    assert len(rows) == 1 and rows[0]["path"] == good
+    after = metrics.counters().get("metrics.snapshot_corrupt", 0)
+    assert after - before == 2  # every corrupt FILE counts
+    err = capsys.readouterr().err
+    assert err.count("is corrupt or not a") == 1  # warns ONCE
+
+
+def test_unwritable_spill_degrades_not_crashes(tmp_path):
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x")
+    before = metrics.counters().get("metrics.sink_errors", 0)
+    assert metrics.write_snapshot(str(blocker)) is None
+    assert metrics.counters().get("metrics.sink_errors", 0) > before
+
+
+def test_cadence_hook_every_kth_record(tmp_path, monkeypatch):
+    metrics.reset()
+    monkeypatch.setenv("QUEST_WORKER_ID", "wcad")
+    monkeypatch.setenv("QUEST_METRICS_SNAPDIR", str(tmp_path))
+    monkeypatch.setenv("QUEST_METRICS_SNAP_EVERY", "2")
+    with metrics.run_ledger("cadence"):
+        pass
+    assert not list(tmp_path.iterdir())  # 1st record: not due yet
+    with metrics.run_ledger("cadence"):
+        pass
+    assert [p.name for p in tmp_path.iterdir()] == ["snap-wcad.json"]
+
+
+def test_default_path_spills_nothing(tmp_path, monkeypatch):
+    monkeypatch.delenv("QUEST_METRICS_SNAPDIR", raising=False)
+    with metrics.run_ledger("quiet"):
+        pass
+    assert metrics.write_snapshot() is None  # no dir -> no-op
+    assert not list(tmp_path.iterdir())
+
+
+# ---------------------------------------------------------------------------
+# (c) fleet aggregation + endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_empty_snapshot_dir_is_noop(tmp_path):
+    assert fleet_agg.scan_snapshots(str(tmp_path)) == []
+    assert fleet_agg.scan_snapshots(str(tmp_path / "missing")) == []
+    assert fleet_agg.fleet_merge(str(tmp_path)) is None
+    text = fleet_agg.fleet_text(str(tmp_path))
+    samples = metrics_serve.parse_text(text)
+    assert samples["quest_fleet_workers"] == 0
+
+
+def _spill_two_workers(snapdir, monkeypatch):
+    """Two simulated workers' snapshots, with known disjoint loads."""
+    for wid, work in (("w1", 3), ("w2", 4)):
+        metrics.reset()
+        monkeypatch.setenv("QUEST_WORKER_ID", wid)
+        metrics.counter_inc("fleet.test.work", work)
+        for v in [0.5] * work:
+            metrics.hist_record("fleet.test.lat", v)
+        assert metrics.write_snapshot(str(snapdir))
+    metrics.reset()
+
+
+def test_fleet_endpoint_totals_and_health(tmp_path, monkeypatch):
+    snapdir = tmp_path / "snaps"
+    _spill_two_workers(snapdir, monkeypatch)
+    monkeypatch.setenv("QUEST_METRICS_SNAPDIR", str(snapdir))
+    server, port = metrics_serve.start_in_thread(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics/fleet",
+                timeout=30) as r:
+            text = r.read().decode()
+        samples = metrics_serve.parse_text(text)
+        assert samples["quest_fleet_fleet_test_work"] == 7
+        assert samples['quest_fleet_test_work{worker="w1"}'] == 3
+        assert samples['quest_fleet_test_work{worker="w2"}'] == 4
+        assert samples["quest_fleet_fleet_test_lat_p99"] == 0.5
+        assert samples["quest_fleet_fleet_test_lat_count"] == 7
+        assert samples["quest_fleet_workers"] == 2
+        assert samples["quest_fleet_up"] == 2  # gauges sum: live workers
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            health = json.loads(r.read().decode())
+        assert health["ok"] is True
+        assert sorted(health["fleet"]["workers"]) == ["w1", "w2"]
+        assert health["fleet"]["suspect"] == []
+    finally:
+        server.shutdown()
+
+
+def test_staleness_marks_worker_suspect(tmp_path, monkeypatch):
+    _spill_two_workers(tmp_path, monkeypatch)
+    old = os.path.getmtime(tmp_path / "snap-w1.json")
+    os.utime(tmp_path / "snap-w1.json", (old - 120, old - 120))
+    doc = fleet_agg.fleet_health(str(tmp_path), staleness_s=60.0)
+    assert doc["workers"]["w1"]["status"] == fleet_agg.STATUS_SUSPECT
+    assert doc["workers"]["w2"]["status"] == fleet_agg.STATUS_OK
+    assert doc["suspect"] == ["w1"]
+    # SUSPECT is advisory: the totals still count the stale worker
+    samples = metrics_serve.parse_text(
+        fleet_agg.fleet_text(str(tmp_path), staleness_s=60.0))
+    assert samples["quest_fleet_fleet_test_work"] == 7
+    assert samples["quest_fleet_workers_suspect"] == 1
+
+
+def test_build_info_in_export(monkeypatch):
+    monkeypatch.setenv("QUEST_WORKER_ID", "wbuild")
+    samples = metrics_serve.parse_text(metrics.export_text())
+    keys = [k for k in samples if k.startswith("quest_build_info{")]
+    assert len(keys) == 1
+    assert 'worker="wbuild"' in keys[0]
+    assert f'jax="{jax.__version__}"' in keys[0]
+    assert 'precision="' in keys[0] and 'comm_config="' in keys[0]
+    assert samples[keys[0]] == 1
+
+
+# ---------------------------------------------------------------------------
+# (d) cross-process trace propagation
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_roundtrip(monkeypatch):
+    monkeypatch.delenv(telemetry.TRACE_CONTEXT_ENV, raising=False)
+    assert telemetry.from_context() is None
+    with telemetry.trace_scope("chain-77"):
+        assert telemetry.trace_context() == "chain-77"
+    assert telemetry.trace_context("  padded  ") == "padded"
+    assert telemetry.trace_context("") is None
+    monkeypatch.setenv(telemetry.TRACE_CONTEXT_ENV, " chain-88 ")
+    assert telemetry.from_context() == "chain-88"
+    # an explicit value beats the env var; empty decodes to None
+    assert telemetry.from_context("other") == "other"
+    assert telemetry.from_context(" ") is None
+
+
+def test_circuit_run_adopts_propagated_context(env1, monkeypatch):
+    monkeypatch.setenv(telemetry.TRACE_CONTEXT_ENV, "chain-ctx-1")
+    q = qt.create_qureg(3, env1)
+    circ = Circuit(3)
+    circ.hadamard(0)
+    circ.run(q)
+    rec = metrics.get_run_ledger()
+    assert rec["meta"]["trace_id"] == "chain-ctx-1"
+    assert rec["meta"]["run_id"] != "chain-ctx-1"
+    # fast-path pin: with nothing propagated a fresh chain still mints
+    # run_id == trace_id (the PR 8 identity contract, unchanged)
+    monkeypatch.delenv(telemetry.TRACE_CONTEXT_ENV)
+    circ.run(q)
+    rec = metrics.get_run_ledger()
+    assert rec["meta"]["trace_id"] == rec["meta"]["run_id"]
+
+
+def test_supervise_mirror_and_chain_context(monkeypatch):
+    assert supervise.TRACE_CONTEXT_ENV == telemetry.TRACE_CONTEXT_ENV
+    monkeypatch.delenv(telemetry.TRACE_CONTEXT_ENV, raising=False)
+    ctx = supervise._chain_context()
+    # minted in telemetry.new_run_id's format, deterministically
+    assert re.fullmatch(r"run-[0-9a-f]+-[0-9a-f]{6}", ctx)
+    assert supervise._chain_context() == ctx
+    monkeypatch.setenv(telemetry.TRACE_CONTEXT_ENV, "outer-ctx")
+    assert supervise._chain_context() == "outer-ctx"  # inherited wins
+
+
+def test_supervise_chain_exports_one_context(tmp_path, monkeypatch):
+    """A crash -> relaunch chain: every attempt's child sees the SAME
+    QUEST_TRACE_CONTEXT (stdlib child, no jax — the wrapper contract
+    itself, not the simulator)."""
+    monkeypatch.delenv(telemetry.TRACE_CONTEXT_ENV, raising=False)
+    out = tmp_path / "ctx.log"
+    marker = tmp_path / "first-attempt"
+    child = tmp_path / "child.py"
+    child.write_text(
+        "import os, sys\n"
+        f"out, marker = {str(out)!r}, {str(marker)!r}\n"
+        "with open(out, 'a') as f:\n"
+        "    f.write(os.environ.get('QUEST_TRACE_CONTEXT',\n"
+        "                           'MISSING') + '\\n')\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').write('x')\n"
+        "    sys.exit(6)\n"  # preempted: resumable
+        "sys.exit(0)\n")
+    rc = supervise.supervise([sys.executable, str(child)],
+                             max_restarts=2)
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert len(lines) == 2  # drained attempt + its relaunch
+    assert len(set(lines)) == 1  # ONE context across the chain
+    assert re.fullmatch(r"run-[0-9a-f]+-[0-9a-f]{6}", lines[0])
+
+
+def test_journal_ctx_stamping_opt_in(tmp_path, monkeypatch):
+    jdir = str(tmp_path / "j")
+    monkeypatch.delenv(telemetry.TRACE_CONTEXT_ENV, raising=False)
+    stateio.append_journal_entries(jdir, [{"kind": "accept", "key": "a"}])
+    plain = (tmp_path / "j" / "journal.jsonl").read_text()
+    assert '"ctx"' not in plain  # byte-stable default: no stamp
+    monkeypatch.setenv(telemetry.TRACE_CONTEXT_ENV, "chain-9")
+    stateio.append_journal_entries(
+        jdir, [{"kind": "launch", "key": "a", "attempt": 1},
+               {"kind": "complete", "key": "a", "ctx": "explicit"}])
+    recs = stateio.read_journal(jdir)
+    assert [r.get("ctx") for r in recs] == [None, "chain-9", "explicit"]
+
+
+def test_frame_unframe_roundtrip():
+    rec = {"kind": "accept", "key": "k", "n": 3}
+    line = stateio.frame_record(rec)
+    assert stateio.unframe_record(line) == rec
+    assert stateio.unframe_record(line.replace('"n": 3', '"n": 4')) \
+        is None  # CRC catches the mutation
+    assert stateio.unframe_record("not json") is None
+    snap_line = stateio.frame_record(rec, field="snap")
+    assert stateio.unframe_record(snap_line, field="snap") == rec
+    assert stateio.unframe_record(snap_line) is None  # wrong field
+
+
+# ---------------------------------------------------------------------------
+# (e) audit trail
+# ---------------------------------------------------------------------------
+
+
+def _damaged_journal(tmp_path) -> str:
+    jdir = str(tmp_path / "jd")
+    stateio.append_journal_entries(jdir, [
+        {"kind": "accept", "key": "r0", "trace_id": "t-0"},
+        {"kind": "launch", "key": "r0", "attempt": 1},
+        {"kind": "complete", "key": "r0", "trace_id": "t-0"}])
+    path = os.path.join(jdir, "journal.jsonl")
+    lines = open(path).read().splitlines()
+    lines[1] = lines[1][:-10] + 'X' * 10  # interior corruption
+    lines.append('{"crc": "12')  # torn tail
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return jdir
+
+
+def test_forensic_reader_pins_stateio_tolerance(tmp_path):
+    """telemetry's stdlib journal reader and stateio.read_journal must
+    return the SAME records over a damaged journal — the forensic
+    mirror cannot drift from the live reader."""
+    jdir = _damaged_journal(tmp_path)
+    live = stateio.read_journal(jdir)
+    forensic = telemetry._read_journal_forensic(jdir)
+    assert forensic == live
+    assert [r["kind"] for r in forensic] == ["accept", "complete"]
+
+
+def test_audit_trail_over_real_journaled_serve(env1, tmp_path,
+                                               monkeypatch):
+    jdir = str(tmp_path / "journal")
+    ledger = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("QUEST_METRICS_FILE", ledger)
+    results = supervisor.serve(_reqs(env1), workers=1,
+                               journal_dir=jdir)
+    assert all(r["ok"] for r in results)
+    doc = telemetry.audit_trail("tenant-2", journal_dir=jdir,
+                                ledger=ledger)
+    assert doc["schema"] == telemetry.AUDIT_SCHEMA
+    assert doc["keys"] == ["req-2"]  # only ITS key joins the chain
+    req = doc["requests"]["req-2"]
+    assert req["lifecycle"] == ["accept", "launch", "complete"]
+    assert (req["accepted"], req["launches"], req["completes"]) \
+        == (1, 1, 1)
+    assert doc["ledger"]["records"] >= 1  # its run's ledger record
+    assert doc["ledger"]["run_ids"]
+    seqs = [ev["seq"] for ev in doc["events"]]
+    assert seqs == list(range(1, len(seqs) + 1))
+
+
+def test_audit_trail_simulated_crash_relaunch(tmp_path):
+    """The crash shape without a real crash: attempt 1 journals
+    accept+launch then dies; attempt 2 launches again and completes.
+    One document reconstructs accepted -> launch -> launch -> complete
+    with exactly one complete."""
+    jdir = str(tmp_path / "j")
+    stateio.append_journal_entries(jdir, [
+        {"kind": "accept", "key": "req-9", "trace_id": "tenant-9",
+         "ctx": "chain-1"},
+        {"kind": "launch", "key": "req-9", "attempt": 1,
+         "ctx": "chain-1"}])
+    stateio.append_journal_entries(jdir, [
+        {"kind": "launch", "key": "req-9", "attempt": 2,
+         "ctx": "chain-1"},
+        {"kind": "complete", "key": "req-9", "trace_id": "tenant-9",
+         "ctx": "chain-1"}])
+    doc = telemetry.audit_trail("tenant-9", journal_dir=jdir)
+    req = doc["requests"]["req-9"]
+    assert req["lifecycle"] == ["accept", "launch", "launch",
+                                "complete"]
+    assert req["completes"] == 1 and req["launches"] == 2
+    # the chain context ALSO selects: auditing by ctx finds the same
+    doc2 = telemetry.audit_trail("chain-1", journal_dir=jdir)
+    assert doc2["requests"]["req-9"]["lifecycle"] \
+        == req["lifecycle"]
+
+
+def test_validate_audit_trail_rejects_tampering(tmp_path):
+    jdir = str(tmp_path / "j")
+    stateio.append_journal_entries(
+        jdir, [{"kind": "accept", "key": "k", "trace_id": "t"}])
+    doc = telemetry.audit_trail("t", journal_dir=jdir)
+    bad = json.loads(json.dumps(doc))
+    bad["schema"] = "bogus"
+    with pytest.raises(ValueError, match="schema"):
+        telemetry.validate_audit_trail(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["events"][0]["seq"] = 0
+    with pytest.raises(ValueError, match="strictly"):
+        telemetry.validate_audit_trail(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["events"][0]["source"] = "gossip"
+    with pytest.raises(ValueError, match="source"):
+        telemetry.validate_audit_trail(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["requests"]["k"]["completes"] = -1
+    with pytest.raises(ValueError, match="non-negative"):
+        telemetry.validate_audit_trail(bad)
+
+
+def test_trace_view_trace_id_cli(tmp_path):
+    """The --trace-id mode renders the lifecycle table from a journal
+    dir, in a bare subprocess (stdlib-only path: telemetry is loaded
+    by file path, jax never imports)."""
+    jdir = str(tmp_path / "j")
+    stateio.append_journal_entries(jdir, [
+        {"kind": "accept", "key": "req-1", "trace_id": "t-cli"},
+        {"kind": "launch", "key": "req-1", "attempt": 1},
+        {"kind": "complete", "key": "req-1", "trace_id": "t-cli"}])
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"),
+         "--trace-id", "t-cli", "--journal", jdir],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "audit trail for trace t-cli" in r.stdout
+    assert "accept -> launch -> complete" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"),
+         "--trace-id"], capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 2  # usage error, not a traceback
+
+
+# ---------------------------------------------------------------------------
+# (f) ledger_diff rule
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_diff_snapshot_corrupt_rule_both_directions():
+    old = {"counters": {"metrics.snapshot_corrupt": 0}}
+    ok_new = {"counters": {"metrics.snapshot_corrupt": 0}}
+    bad_new = {"counters": {"metrics.snapshot_corrupt": 1}}
+    v, _c, _s = ledger_diff.gate(old, ok_new)
+    assert not [x for x in v if "snapshot_corrupt" in x["key"]]
+    v, _c, _s = ledger_diff.gate(old, bad_new)
+    hits = [x for x in v if "snapshot_corrupt" in x["key"]]
+    assert hits and hits[0]["new"] == 1
+    # the reverse direction (corruption disappearing) is progress
+    v, _c, _s = ledger_diff.gate(bad_new, old)
+    assert not [x for x in v if "snapshot_corrupt" in x["key"]]
+    # records without the counter skip the rule
+    v, _c, skipped = ledger_diff.gate({}, {})
+    assert ("counters.metrics.snapshot_corrupt", "missing") in skipped

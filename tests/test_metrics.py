@@ -288,6 +288,55 @@ def test_no_adhoc_instrumentation_outside_metrics():
         "time_fn:\n" + "\n".join(offenders))
 
 
+#: Write-mode file opens.  Inside quest_tpu/metrics.py every one must
+#: live in ``_sink_write`` — the single seam that owns sink retry,
+#: warn-once degradation, and the ``metrics.sink_errors`` counter.  A
+#: snapshot spill (or any future sink) opening its own file handle
+#: would silently escape that failure discipline.
+_WRITE_OPEN = regex.compile(
+    r"\bopen\(\s*[^)]*,\s*(?:mode\s*=\s*)?[\"'][wax]")
+
+
+def test_metrics_writes_only_through_sink_write_seam():
+    import ast
+
+    path = os.path.join(REPO, "quest_tpu", "metrics.py")
+    with open(path) as f:
+        src = f.read()
+    spans = [(n.lineno, n.end_lineno)
+             for n in ast.walk(ast.parse(src))
+             if isinstance(n, ast.FunctionDef)
+             and n.name == "_sink_write"]
+    assert len(spans) == 1, "metrics.py must define _sink_write once"
+    lo, hi = spans[0]
+    offenders = [
+        f"quest_tpu/metrics.py:{lineno}: {line.strip()}"
+        for lineno, line in enumerate(src.splitlines(), 1)
+        if _WRITE_OPEN.search(line) and not lo <= lineno <= hi]
+    assert not offenders, (
+        "write-mode open() in metrics.py outside _sink_write — every "
+        "sink (ledger file, flight dump, snapshot spill) must go "
+        "through the one seam:\n" + "\n".join(offenders))
+
+
+def test_fleet_aggregator_is_read_only():
+    """tools/fleet_agg.py merges what workers spilled; it must never
+    write, rename, or delete anything — a crashed or misconfigured
+    aggregator cannot be allowed to damage the snapshot directory it
+    reports on."""
+    with open(os.path.join(REPO, "tools", "fleet_agg.py")) as f:
+        src = f.read()
+    offenders = [f"fleet_agg.py:{lineno}: {line.strip()}"
+                 for lineno, line in enumerate(src.splitlines(), 1)
+                 if _WRITE_OPEN.search(line)
+                 or regex.search(r"\bos\.(replace|remove|unlink|"
+                                 r"rename|makedirs|rmdir)\s*\(", line)
+                 or "shutil." in line]
+    assert not offenders, (
+        "the fleet aggregator must stay strictly read-only:\n"
+        + "\n".join(offenders))
+
+
 # ---------------------------------------------------------------------------
 # Interleaved-storage discipline lint (quest_tpu.ops.lattice)
 # ---------------------------------------------------------------------------

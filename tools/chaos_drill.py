@@ -1325,16 +1325,44 @@ def drill_serve_crash_replay(circ, env, ndev, pallas):
         replayed = counters.get("supervisor.journal_replayed", 0) == 1
         no_replay_failures = counters.get(
             "supervisor.journal_replay_failures", 0) == 0
+        # audit trail over the crashed chain's journal: ONE schema-
+        # validated document must reconstruct the target request's full
+        # accepted -> launch (crashed) -> launch (relaunch) -> complete
+        # lifecycle under its tenant trace_id, with exactly one
+        # complete; and every journal record of the chain must carry
+        # the ONE propagated supervise-chain context (the native
+        # cross-process trace propagation, no checkpoint sidecar)
+        from quest_tpu import stateio, telemetry
+
+        try:
+            audit = telemetry.audit_trail("tenant-2", journal_dir=jdir)
+            req2 = audit["requests"].get("req-2", {})
+            audit_lifecycle = (
+                audit["keys"] == ["req-2"]
+                and req2.get("accepted") == 1
+                and req2.get("launches") == 2
+                and req2.get("completes") == 1
+                and req2.get("failed") == 0
+                and req2.get("quarantined") == 0
+                and req2.get("lifecycle", [None])[0] == "accept"
+                and req2.get("lifecycle", [None])[-1] == "complete")
+        except ValueError:
+            audit_lifecycle = False
+        ctxs = {rec.get("ctx") for rec in stateio.read_journal(jdir)}
+        one_chain_ctx = len(ctxs) == 1 and None not in ctxs
         ok = (rc0 == 0 and att0 == 1 and rc == 0 and crashed_once
               and completed and outcomes_equal and traces_intact
               and exactly_once and deduped and replayed
-              and no_replay_failures)
+              and no_replay_failures and audit_lifecycle
+              and one_chain_ctx)
         record("serve_crash_replay", ok, rc=rc, attempts=attempts,
                completed=completed, outcomes_equal=outcomes_equal,
                tenant_traces_intact=traces_intact,
                exactly_once=exactly_once, deduped_from_journal=deduped,
                journal_replayed=replayed,
-               replay_failures_zero=no_replay_failures)
+               replay_failures_zero=no_replay_failures,
+               audit_trail_lifecycle=audit_lifecycle,
+               one_chain_ctx=one_chain_ctx)
     finally:
         shutil.rmtree(td, ignore_errors=True)
 

@@ -1,0 +1,263 @@
+"""Fleet metrics aggregator: merge worker snapshots into one view.
+
+The cross-process half of the observability layer.  Each worker spills
+its RAW telemetry state (integer log2 bucket counts, counters, gauges
+— ``metrics.snapshot()``) as one CRC-framed file into a shared
+``QUEST_METRICS_SNAPDIR``; this module scans that directory, skips
+corrupt snapshots warn-once (counted under
+``metrics.snapshot_corrupt``), merges the survivors EXACTLY
+(``metrics.merge_snapshots`` — bucket-wise integer sums, so fleet
+p50/p90/p99 are bit-equal to the quantiles over the union of the raw
+observation streams at bucket resolution), and renders:
+
+* **Fleet Prometheus text** (:func:`fleet_text`, served at
+  ``/metrics/fleet`` by ``tools/metrics_serve.py``): per-worker
+  counter/gauge series labeled ``worker="..."``, a
+  ``quest_fleet_worker_info`` identity series per worker, and merged
+  ``quest_fleet_*`` totals — summed counters and gauges, full merged
+  histograms, and ``quest_fleet_<hist>_p50/_p90/_p99`` quantile
+  gauges computed from the MERGED buckets (the only correct way:
+  quantiles don't add, buckets do).
+* **Fleet health rollup** (:func:`fleet_health`, folded into
+  ``/healthz`` when the snapshot dir is configured): each worker's
+  snapshot age against the staleness budget
+  (``QUEST_FLEET_STALENESS_S``, default 60s) — a worker whose
+  snapshot is older is marked SUSPECT (crashed, hung, or partitioned;
+  its last-known numbers still count, which is the honest choice: a
+  stale snapshot is STILL the best available lower bound).  The
+  rollup is advisory — it never flips the health verdict, because a
+  missing worker is a capacity problem, not a local liveness one.
+
+The aggregator only READS the snapshot directory — workers own their
+files (atomic replace), so the scan needs no locks and tolerates any
+interleaving.  A test lints exactly that: this module never opens a
+file for writing.
+
+Usage::
+
+    python tools/fleet_agg.py [--dir DIR] [--staleness S] [--health]
+
+Prints fleet Prometheus text (default) or the health rollup as JSON
+(``--health``); exit 2 when no snapshot directory is configured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from quest_tpu import metrics, telemetry  # noqa: E402
+
+#: Default staleness budget (seconds) before a worker goes SUSPECT;
+#: override with ``QUEST_FLEET_STALENESS_S``.
+STALENESS_DEFAULT_S = 60.0
+
+#: Worker statuses in the health rollup.
+STATUS_OK = "OK"
+STATUS_SUSPECT = "SUSPECT"
+
+
+def staleness_budget() -> float:
+    """The ``QUEST_FLEET_STALENESS_S`` knob (seconds; default 60)."""
+    try:
+        v = float(os.environ.get("QUEST_FLEET_STALENESS_S",
+                                 str(STALENESS_DEFAULT_S)))
+    except ValueError:
+        return STALENESS_DEFAULT_S
+    return v if v > 0 else STALENESS_DEFAULT_S
+
+
+def snapshot_dir(directory: str | None = None) -> str | None:
+    """The snapshot directory to aggregate: the argument, else
+    ``$QUEST_METRICS_SNAPDIR``, else None (fleet mode off)."""
+    return directory or os.environ.get("QUEST_METRICS_SNAPDIR") or None
+
+
+def scan_snapshots(directory: str | None = None) -> list[dict]:
+    """Scan the snapshot dir; one ``{"path", "snap", "mtime"}`` row per
+    readable snapshot file, sorted by path.  Corrupt/torn files are
+    skipped by ``metrics.read_snapshot`` (one warning per process,
+    ``metrics.snapshot_corrupt`` counts every file).  An empty or
+    missing directory is a no-op empty scan, not an error — a fleet
+    that has not spilled yet is healthy, just silent."""
+    d = snapshot_dir(directory)
+    rows: list[dict] = []
+    if not d or not os.path.isdir(d):
+        return rows
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return rows
+    for name in names:
+        if not (name.startswith(metrics.SNAPSHOT_PREFIX)
+                and name.endswith(".json")):
+            continue
+        path = os.path.join(d, name)
+        snap = metrics.read_snapshot(path)
+        if snap is None:
+            continue
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            # the worker replaced/removed the file mid-scan; the
+            # parsed content is still valid — treat it as fresh-now
+            mtime = time.time()
+        rows.append({"path": path, "snap": snap, "mtime": mtime})
+    return rows
+
+
+def fleet_merge(directory: str | None = None) -> dict | None:
+    """Scan + merge: the exact fleet document
+    (``metrics.merge_snapshots`` over every readable snapshot), or
+    None when the scan found nothing."""
+    rows = scan_snapshots(directory)
+    if not rows:
+        return None
+    return metrics.merge_snapshots([r["snap"] for r in rows])
+
+
+def fleet_health(directory: str | None = None,
+                 staleness_s: float | None = None,
+                 now: float | None = None) -> dict:
+    """The fleet staleness rollup: per worker, the snapshot age and an
+    OK/SUSPECT verdict against the budget.  ``now`` is injectable for
+    deterministic tests; production uses wall-clock ``time.time()``
+    (snapshot files carry mtimes on the same timebase)."""
+    budget = staleness_s if staleness_s is not None else staleness_budget()
+    t = time.time() if now is None else now
+    workers: dict[str, dict] = {}
+    for row in scan_snapshots(directory):
+        snap = row["snap"]
+        wid = str(snap.get("worker"))
+        age = max(0.0, t - row["mtime"])
+        prev = workers.get(wid)
+        if prev is not None and prev["epoch"] >= int(snap.get("epoch")
+                                                     or 0):
+            continue
+        workers[wid] = {
+            "status": STATUS_SUSPECT if age > budget else STATUS_OK,
+            "age_s": round(age, 3),
+            "epoch": int(snap.get("epoch") or 0),
+            "pid": snap.get("pid"),
+            "trace": snap.get("trace"),
+        }
+    return {
+        "schema": "quest-tpu-fleet-health/1",
+        "staleness_s": budget,
+        "workers": workers,
+        "suspect": sorted(w for w, row in workers.items()
+                          if row["status"] == STATUS_SUSPECT),
+    }
+
+
+def _typed_series(lines: list, kind: str, name: str,
+                  samples: list) -> None:
+    """Append one ``# TYPE`` comment + its labeled samples."""
+    pn = telemetry._prom_name(name)
+    lines.append(f"# TYPE {pn} {kind}")
+    for labels, value in samples:
+        lines.append(f"{pn}{{{telemetry._prom_label_str(labels)}}} "
+                     f"{telemetry._prom_num(value)}")
+
+
+def fleet_text(directory: str | None = None,
+               staleness_s: float | None = None) -> str:
+    """The fleet as Prometheus text exposition format.
+
+    Per-worker series first (every counter and gauge any worker
+    reported, labeled ``worker="..."``; absent-on-a-worker means no
+    sample, not zero), then the merged ``quest_fleet_*`` block: summed
+    counters/gauges, per-histogram quantile gauges from the MERGED
+    buckets, fleet meta-gauges (worker/suspect counts), and the full
+    merged histograms.  Empty scan -> just the meta-gauges, so a
+    scrape of a not-yet-spilling fleet still parses."""
+    rows = scan_snapshots(directory)
+    health = fleet_health(directory, staleness_s=staleness_s)
+    lines: list[str] = []
+    by_worker: dict[str, dict] = {}
+    if rows:
+        merged = metrics.merge_snapshots([r["snap"] for r in rows])
+        by_worker = merged["workers"]
+        # --- per-worker series -------------------------------------
+        cnames = sorted({k for s in by_worker.values()
+                         for k in (s.get("counters") or {})})
+        for name in cnames:
+            _typed_series(lines, "counter", name, [
+                ({"worker": wid}, s["counters"][name])
+                for wid, s in sorted(by_worker.items())
+                if name in (s.get("counters") or {})])
+        gnames = sorted({k for s in by_worker.values()
+                         for k in (s.get("gauges") or {})})
+        for name in gnames:
+            _typed_series(lines, "gauge", name, [
+                ({"worker": wid}, s["gauges"][name])
+                for wid, s in sorted(by_worker.items())
+                if name in (s.get("gauges") or {})])
+        _typed_series(lines, "gauge", "fleet.worker_info", [
+            ({"worker": wid, "pid": s.get("pid", ""),
+              "epoch": s.get("epoch", 0),
+              "trace": s.get("trace") or ""}, 1)
+            for wid, s in sorted(by_worker.items())])
+    else:
+        merged = None
+    # --- merged fleet block ----------------------------------------
+    fleet_counters = {f"fleet.{k}": v
+                      for k, v in (merged or {}).get("counters",
+                                                     {}).items()}
+    fleet_gauges = {f"fleet.{k}": v
+                    for k, v in (merged or {}).get("gauges", {}).items()}
+    fleet_hists = {}
+    for name, h in (merged or {}).get("hists", {}).items():
+        stats = metrics.hist_stats(h)
+        fleet_hists[f"fleet.{name}"] = stats
+        for q in ("p50", "p90", "p99"):
+            if stats[q] is not None:
+                fleet_gauges[f"fleet.{name}.{q}"] = stats[q]
+    fleet_gauges["fleet.workers"] = len(by_worker)
+    fleet_gauges["fleet.workers_suspect"] = len(health["suspect"])
+    lines.append(telemetry.render_prometheus(
+        fleet_counters, fleet_hists, gauges=fleet_gauges).rstrip("\n"))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv) -> int:
+    args = list(argv)
+    directory = None
+    staleness = None
+    want_health = False
+    while args:
+        a = args.pop(0)
+        if a == "--dir" and args:
+            directory = args.pop(0)
+        elif a == "--staleness" and args:
+            try:
+                staleness = float(args.pop(0))
+            except ValueError:
+                print(__doc__)
+                return 2
+        elif a == "--health":
+            want_health = True
+        else:
+            print(__doc__)
+            return 2
+    if snapshot_dir(directory) is None:
+        print("fleet_agg: no snapshot directory (pass --dir or set "
+              "QUEST_METRICS_SNAPDIR)")
+        return 2
+    if want_health:
+        print(json.dumps(fleet_health(directory,
+                                      staleness_s=staleness),
+                         indent=1, sort_keys=True))
+    else:
+        sys.stdout.write(fleet_text(directory, staleness_s=staleness))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

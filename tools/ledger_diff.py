@@ -90,6 +90,12 @@ DEFAULT_RULES = [
     # regression)
     ("counters.supervisor.journal_replay_failures", +0.0, False),
     ("counters.supervisor.poison_quarantined", +0.0, True),
+    # fleet-observability health, strictly regressive: ANY corrupt
+    # snapshot skipped by the fleet aggregator is a regression of the
+    # atomic write-temp-then-rename spill contract (workers must never
+    # publish a torn snapshot; the baseline is 0, so the +0 rule fires
+    # on any appearance regardless of config)
+    ("counters.metrics.snapshot_corrupt", +0.0, False),
     # failure-domain health, strictly regressive in both directions
     # (config-bound like the sibling detector rules): at a fixed drill
     # matrix the scenarios lose a FIXED number of slices, so MORE

@@ -72,6 +72,17 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+def _fleet_agg():
+    """Deferred import of the sibling aggregator module (`tools/` is
+    not a package; imported by file-directory path like the tests
+    do)."""
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import fleet_agg
+    return fleet_agg
+
+
 class MetricsHandler(BaseHTTPRequestHandler):
     def _send(self, code: int, body: str, ctype: str) -> None:
         payload = body.encode()
@@ -95,6 +106,10 @@ class MetricsHandler(BaseHTTPRequestHandler):
         if path == "/metrics":
             self._send(200, metrics.export_text(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/metrics/fleet":
+            fleet_agg = _fleet_agg()
+            self._send(200, fleet_agg.fleet_text(),
+                       "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
             health = resilience.mesh_health()
             degraded_slices = health.get("degraded_slices") or []
@@ -116,6 +131,13 @@ class MetricsHandler(BaseHTTPRequestHandler):
                         "degraded_chips": row["degraded_chips"],
                         "strikes": row["strikes"]}
                     for s, row in health["slices"].items()}
+            # fleet staleness rollup (opt-in: only with a snapshot dir
+            # configured): which workers' snapshots exceeded the
+            # staleness budget.  ADVISORY — a SUSPECT worker never
+            # flips this process's own liveness verdict; a missing
+            # worker is fleet capacity, not local health
+            if os.environ.get("QUEST_METRICS_SNAPDIR"):
+                doc["fleet"] = _fleet_agg().fleet_health()
             self._send(200 if ok else 503, json.dumps(doc) + "\n",
                        "application/json")
         elif path == "/readyz":
@@ -132,7 +154,8 @@ class MetricsHandler(BaseHTTPRequestHandler):
                        "application/json")
         elif path == "/":
             self._send(200, "quest-tpu metrics endpoint: "
-                            "/metrics /healthz /readyz\n", "text/plain")
+                            "/metrics /metrics/fleet /healthz "
+                            "/readyz\n", "text/plain")
         else:
             self._send(404, "not found\n", "text/plain")
 

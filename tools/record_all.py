@@ -346,6 +346,125 @@ def metrics_serve_smoke(summary) -> None:
         print(detail)
 
 
+#: One fleet worker: a small real run whose telemetry spills a
+#: CRC-framed metric snapshot into the shared QUEST_METRICS_SNAPDIR
+#: (the run_ledger finalize cadence hook) next to its own run-ledger
+#: file — the two independent artifacts the smoke reconciles.
+_FLEET_CHILD = """\
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import quest_tpu as qt
+from quest_tpu import metrics, models
+
+env = qt.create_env(num_devices=1)
+q = qt.create_qureg(6, env)
+with metrics.run_ledger("fleet_smoke"):
+    metrics.counter_inc("smoke.work", {work})
+models.qft(6).run(q)
+print("OK", flush=True)
+"""
+
+
+def fleet_obs_smoke(summary) -> None:
+    """Tier-2 smoke: the fleet observability layer end to end.  Two
+    REAL subprocess workers each run a small circuit with
+    ``QUEST_METRICS_SNAPDIR`` set (spilling mergeable CRC-framed metric
+    snapshots on the run-ledger cadence) and their own
+    ``QUEST_METRICS_FILE`` run ledgers; the parent then serves
+    ``/metrics/fleet`` over real HTTP (``metrics_serve`` +
+    ``fleet_agg``) and asserts the scrape parses via ``parse_text``,
+    carries a merged fleet p99, labels per-worker series, and that the
+    merged ``quest_fleet_*`` counter totals reconcile against the sum
+    of the per-worker run ledgers — the independent artifact trail.  A
+    torn spill, a lossy merge, or a fleet total that disagrees with
+    the workers' own ledgers fails the recording round here instead of
+    in a fleet dashboard."""
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_serve
+
+    t0 = time.time()
+    ok, detail = False, ""
+    server = None
+    prev_snapdir = os.environ.get("QUEST_METRICS_SNAPDIR")
+    with tempfile.TemporaryDirectory() as td:
+        snapdir = os.path.join(td, "snaps")
+        child = os.path.join(td, "worker.py")
+        works = {"fw1": 3, "fw2": 4}
+        try:
+            ledgers = {}
+            for wid, work in works.items():
+                with open(child, "w") as f:
+                    f.write(_FLEET_CHILD.format(repo=REPO, work=work))
+                env = dict(os.environ)
+                ledgers[wid] = os.path.join(td, f"ledger-{wid}.jsonl")
+                env.update(QUEST_WORKER_ID=wid,
+                           QUEST_METRICS_SNAPDIR=snapdir,
+                           QUEST_METRICS_FILE=ledgers[wid])
+                r = subprocess.run([sys.executable, child],
+                                   capture_output=True, text=True,
+                                   cwd=REPO, env=env, timeout=600)
+                if r.returncode != 0:
+                    raise RuntimeError(f"worker {wid} failed: "
+                                       f"{r.stderr[-400:]}")
+            os.environ["QUEST_METRICS_SNAPDIR"] = snapdir
+            server, port = metrics_serve.start_in_thread(0)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics/fleet",
+                    timeout=30) as r:
+                text = r.read().decode()
+            samples = metrics_serve.parse_text(text)
+            # per-worker ledger counter sums: the independent artifact
+            # the fleet totals must reconcile against (>= because a
+            # process counter can also tick outside a run scope; the
+            # smoke's own counter only ticks inside one, so it is
+            # EXACT)
+            ledger_sums: dict = {}
+            for wid, path in ledgers.items():
+                with open(path) as f:
+                    for line in f:
+                        for k, v in _json.loads(line).get(
+                                "counters", {}).items():
+                            ledger_sums[k] = ledger_sums.get(k, 0) + v
+            reconciled = all(
+                samples.get(f"quest_fleet_{k.replace('.', '_')}",
+                            -1) >= v - 1e-6
+                for k, v in ledger_sums.items())
+            exact = samples.get("quest_fleet_smoke_work") \
+                == sum(works.values()) == ledger_sums.get("smoke.work")
+            per_worker = all(
+                samples.get(f'quest_smoke_work{{worker="{w}"}}') == n
+                for w, n in works.items())
+            p99 = "quest_fleet_run_wall_s_circuit_run_p99" in samples
+            nworkers = samples.get("quest_fleet_workers") == 2.0
+            ok = (reconciled and exact and per_worker and p99
+                  and nworkers)
+            if not ok:
+                detail = (f"reconciled={reconciled} exact={exact} "
+                          f"per_worker={per_worker} p99={p99} "
+                          f"workers={samples.get('quest_fleet_workers')}")
+        except Exception as e:
+            detail = f"{type(e).__name__}: {e}"
+        finally:
+            if server is not None:
+                server.shutdown()
+            if prev_snapdir is None:
+                os.environ.pop("QUEST_METRICS_SNAPDIR", None)
+            else:
+                os.environ["QUEST_METRICS_SNAPDIR"] = prev_snapdir
+    secs = time.time() - t0
+    summary.append(("fleet_obs", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'fleet_obs':22s} {secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 #: The supervised child: a checkpointed QFT run under QUEST_PREEMPT
 #: with a deterministic straggler holding the plan open long enough
 #: for the drill's SIGTERM to land mid-run.  On relaunch (a restorable
@@ -517,6 +636,7 @@ def main():
     batch_serve_smoke(summary)
     journaled_serve_smoke(summary)
     metrics_serve_smoke(summary)
+    fleet_obs_smoke(summary)
     supervise_smoke(summary)
     chaos_drill_smoke(summary, rnd)
     n_fail = sum(1 for _, ok, _ in summary if not ok)

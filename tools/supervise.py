@@ -23,9 +23,13 @@ Scripts opt into the contract with ``supervisor.supervised_main`` (map
 the two lifecycle errors to exit codes) and ``supervisor.run_or_resume``
 (resume from the checkpoint directory when a restorable rotation is
 there, else start fresh) — the relaunched attempt then completes
-bit-identically under the SAME trace_id, which rides the checkpoint
-sidecar across the process boundary.  Any other exit code is final: a
-crash must surface, not be blindly restarted.
+bit-identically under the SAME trace_id, which the wrapper now
+propagates NATIVELY: every attempt is launched with one per-chain
+``QUEST_TRACE_CONTEXT`` (inherited if the supervisor itself runs
+inside a trace), which ``telemetry.from_context`` picks up as the
+fallback trace scope — the checkpoint sidecar still carries the id as
+a belt-and-braces second path.  Any other exit code is final: a crash
+must surface, not be blindly restarted.
 
 **Serving mode** (``--restart-on-crash``): a JOURNALED serve child
 (``supervisor.serve(journal_dir=...)``) is the one case where
@@ -92,9 +96,29 @@ MAX_RESTARTS_DEFAULT = 3
 RETRY_BASE_DELAY = 0.02
 
 
-def _launch(cmd, attempt: int):
+#: Env var carrying the chain's trace context into every child —
+#: a MIRROR of ``telemetry.TRACE_CONTEXT_ENV`` (this wrapper is
+#: stdlib-only and cannot import it; ``tests/test_fleet_obs.py`` pins
+#: the two names equal).
+TRACE_CONTEXT_ENV = "QUEST_TRACE_CONTEXT"
+
+
+def _chain_context() -> str:
+    """The trace context every attempt of this chain runs under: an
+    inherited ``QUEST_TRACE_CONTEXT`` (this supervisor is itself part
+    of a larger trace), else one deterministic id minted per chain in
+    ``telemetry.new_run_id``'s format.  Each child minting a fresh
+    run_id per attempt is correct — but all attempts of one chain must
+    share ONE trace_id, natively, not via the checkpoint sidecar."""
+    return os.environ.get(TRACE_CONTEXT_ENV) \
+        or f"run-{os.getpid():x}-{1:06x}"
+
+
+def _launch(cmd, attempt: int, ctx: str | None = None):
     env = dict(os.environ)
     env["QUEST_SUPERVISE_ATTEMPT"] = str(attempt)
+    if ctx:
+        env[TRACE_CONTEXT_ENV] = ctx
     return subprocess.Popen(cmd, env=env)
 
 
@@ -112,6 +136,7 @@ def supervise(cmd, max_restarts: int = MAX_RESTARTS_DEFAULT,
     # silently dropped.
     state = {"during": 0, "pending": False, "any": False}
     child = {"proc": None}
+    ctx = _chain_context()
 
     def _forward(signum, frame):
         state["any"] = True
@@ -134,7 +159,7 @@ def supervise(cmd, max_restarts: int = MAX_RESTARTS_DEFAULT,
             print(f"supervise: attempt {attempt}: {' '.join(cmd)}",
                   flush=True)
             state["during"] = 0
-            child["proc"] = _launch(cmd, attempt)
+            child["proc"] = _launch(cmd, attempt, ctx=ctx)
             if state["pending"]:
                 # a preemption arrived while no child was alive:
                 # honour it now — the fresh child drains immediately

@@ -28,12 +28,25 @@ wall, how much of it a compute span overlapped, and the resulting
 per-item ``comm_hidden_frac`` — which plan item still exposes wire
 time, not just whether the aggregate is healthy.
 
+**Audit-trail mode** (``--trace-id``): instead of a timeline file,
+reconstruct ONE request chain's lifecycle from a serve write-ahead
+journal (and optionally a run-ledger file) via
+``telemetry.audit_trail`` and print it as a lifecycle table — the
+journal's accepted → launch(es) → complete/failed/quarantined records
+in order, per-idempotency-key roll-ups, and the ledger summary
+(resilience deltas, timeline event counts, supervise attempts).  The
+telemetry module is loaded by FILE PATH, so this tool stays jax-free
+for offline forensics over a copied journal directory.
+
 Usage: python tools/trace_view.py timeline.json [-k N] [--by-kind]
+       python tools/trace_view.py --trace-id TID --journal DIR
+                                  [--ledger FILE]
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 from collections import defaultdict
 
@@ -259,8 +272,90 @@ def summarize(events: list[dict], top_k: int = 10) -> str:
     return "\n".join(lines)
 
 
+def _load_telemetry():
+    """Load ``quest_tpu/telemetry.py`` by file path — it is stdlib-only
+    by design, so importing it this way keeps this tool jax-free (no
+    ``import quest_tpu``, which would pull the whole simulator in)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "quest_tpu", "telemetry.py")
+    spec = importlib.util.spec_from_file_location(
+        "_quest_tpu_telemetry_standalone", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def audit_table(doc: dict) -> str:
+    """One audit-trail document (``telemetry.audit_trail``) as the
+    human-readable lifecycle table."""
+    lines = [f"audit trail for trace {doc['trace_id']}: "
+             f"{len(doc['events'])} event(s), "
+             f"{len(doc['keys'])} request key(s)"]
+    lines.append(f"{'seq':>4}  {'source':<8}{'kind':<14}{'key':<14}"
+                 "detail")
+    for ev in doc["events"]:
+        detail = ", ".join(
+            f"{k}={ev[k]}" for k in ("attempt", "attempts", "tenant",
+                                     "index", "error", "ctx", "label",
+                                     "run_id", "supervise_attempt",
+                                     "wall_s", "events")
+            if ev.get(k) is not None)
+        lines.append(f"{ev['seq']:>4}  {ev['source']:<8}"
+                     f"{ev['kind']:<14}{str(ev.get('key', '')):<14}"
+                     f"{detail}")
+    for key in doc["keys"]:
+        req = doc["requests"][key]
+        lines.append(f"request {key}: {' -> '.join(req['lifecycle'])} "
+                     f"(accepted {req['accepted']}, launches "
+                     f"{req['launches']}, completes {req['completes']}, "
+                     f"failed {req['failed']}, quarantined "
+                     f"{req['quarantined']})")
+    led = doc["ledger"]
+    lines.append(f"ledger: {led['records']} record(s), "
+                 f"{led['timeline_events']} timeline event(s), "
+                 f"run_ids {led['run_ids']}, "
+                 f"supervise attempts {led['supervise_attempts']}")
+    if led["resilience"]:
+        deltas = ", ".join(f"{k}={v}" for k, v in
+                           sorted(led["resilience"].items()))
+        lines.append(f"resilience deltas: {deltas}")
+    return "\n".join(lines)
+
+
+def _audit_main(args: list) -> int:
+    trace_id = journal = ledger = None
+    rest = list(args)
+    while rest:
+        a = rest.pop(0)
+        if a == "--trace-id" and rest:
+            trace_id = rest.pop(0)
+        elif a == "--journal" and rest:
+            journal = rest.pop(0)
+        elif a == "--ledger" and rest:
+            ledger = rest.pop(0)
+        else:
+            print(__doc__)
+            return 2
+    if not trace_id or not journal:
+        print(__doc__)
+        return 2
+    telemetry = _load_telemetry()
+    try:
+        doc = telemetry.audit_trail(trace_id, journal_dir=journal,
+                                    ledger=ledger)
+    except (OSError, ValueError) as e:
+        print(f"trace-view: audit trail failed: {e}")
+        return 2
+    print(audit_table(doc))
+    return 0
+
+
 def main(argv) -> int:
     args = list(argv)
+    if "--trace-id" in args:
+        return _audit_main(args)
     top_k = 10
     if "-k" in args:
         i = args.index("-k")
