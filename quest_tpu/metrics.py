@@ -584,6 +584,21 @@ def _gauges(c: dict) -> dict:
         "serve.session_evictions": c.get(
             "supervisor.session_evictions", 0),
     })
+    # fleet-serving gauges (still quest_serve_*): the leased-claim
+    # protocol's health — claims written / stolen (expired-lease
+    # reclaims) / heartbeat renewals, fenced late completes observed,
+    # and cross-worker session migrations.  Counter mirrors from the
+    # same snapshot ``c``, so tools/fleet_agg.py sums them across
+    # worker snapshots with zero changes
+    gauges.update({
+        "serve.claims": c.get("supervisor.claims", 0),
+        "serve.claims_stolen": c.get("supervisor.claims_stolen", 0),
+        "serve.lease_renewals": c.get("supervisor.lease_renewals", 0),
+        "serve.fenced_completes": c.get(
+            "supervisor.fenced_completes", 0),
+        "serve.sessions_migrated": c.get(
+            "supervisor.sessions_migrated", 0),
+    })
     return gauges
 
 

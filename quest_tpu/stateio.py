@@ -398,6 +398,20 @@ def restore_checkpoint(qureg: Qureg, directory: str) -> None:
 # or a checksum mismatch — which a crash cannot produce, only bitrot or
 # tampering can — is skipped AND counted
 # (``supervisor.journal_corrupt_entries``), never silently trusted.
+#
+# FLEET SHARING (ISSUE 18): several worker processes on one host may
+# append to the SAME journal — the fleet's ``claim`` records (worker
+# id, fencing epoch, lease expiry; see ``supervisor.serve(fleet=)``)
+# ride this exact framing and batched-fsync path, and torn/corrupt
+# claims heal/skip identically.  Cross-process safety rests on
+# append-mode (``O_APPEND``) writes being atomic seek+write on a local
+# POSIX filesystem: each batch lands as one buffered write, so
+# concurrently-appending workers interleave at LINE-BATCH granularity,
+# never mid-line (batches far beyond the stdio buffer could split —
+# the claim/launch/complete batches here are a few hundred bytes).
+# The in-process ``_journal_lock`` still serialises threads; the
+# torn-tail heal only ever truncates a tail that fails its CRC, which
+# a peer's completed atomic append can never be.
 
 #: Journal file and sidecar names inside a journal directory.
 JOURNAL = "journal.jsonl"

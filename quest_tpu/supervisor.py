@@ -763,6 +763,56 @@ def batch_occupancy() -> int:
         return _batch["occupancy"]
 
 
+# ---------------------------------------------------------------------------
+# Fleet serving: leased journal claims with fencing epochs (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+#: Default lease duration for fleet-mode journal claims (override:
+#: QUEST_LEASE_S).  A worker death converts into at most this much
+#: added latency before a peer reclaims its keys.
+LEASE_S_DEFAULT = 30.0
+
+#: Set by tools/fleet_serve.py in worker processes: arms fleet mode
+#: (leased claims) on every journaled serve() without a code change.
+FLEET_WORKER_ENV = "QUEST_FLEET_WORKER"
+
+
+def _lease_default() -> float:
+    try:
+        v = float(os.environ["QUEST_LEASE_S"])
+    except (KeyError, ValueError):
+        return LEASE_S_DEFAULT
+    return v if v > 0 else LEASE_S_DEFAULT
+
+
+def lease_s() -> float:
+    """The fleet lease duration in seconds (``QUEST_LEASE_S``, default
+    :data:`LEASE_S_DEFAULT`): how long a worker's claim on a journaled
+    key stays exclusive without a heartbeat renewal.  The timebase is
+    :func:`metrics.clock` (``CLOCK_MONOTONIC`` — machine-wide, so
+    expiries compare correctly ACROSS the fleet's processes on one
+    host, and tests exercise expiry clock-free by patching it)."""
+    return _lease_default()
+
+
+def fleet_worker_env() -> bool:
+    """True when :data:`FLEET_WORKER_ENV` (``QUEST_FLEET_WORKER``) is
+    set non-empty/non-zero — the runner's way of arming fleet-mode
+    claims in its worker processes."""
+    return os.environ.get(FLEET_WORKER_ENV, "") not in ("", "0")
+
+
+def _claim_record(key: str, worker: str, epoch: int,
+                  expires: float) -> dict:
+    """One journal ``claim`` record: ``worker`` asserts exclusive
+    ownership of ``key`` at fencing ``epoch`` until ``expires`` (on the
+    ``metrics.clock`` timebase).  Rides the same CRC32 framing and
+    batched-fsync append path as every other journal record, so torn
+    or corrupt claims heal/skip identically."""
+    return {"kind": "claim", "key": str(key), "worker": str(worker),
+            "epoch": int(epoch), "expires": float(expires)}
+
+
 class BatchableRun:
     """One coalescible serving request: run ``circuit`` on a fresh
     |0...0> register in ``env`` — or, with ``session=``, on a named
@@ -787,8 +837,10 @@ class BatchableRun:
     write-ahead journal (``serve(journal_dir=...)``): a completed key
     returns its journaled result instead of re-running, and a key
     observed to kill the process repeatedly is quarantined.  Omitted,
-    a deterministic key is derived from the request's content and
-    queue position, so an identical relaunch dedupes naturally.
+    a deterministic key is derived from the request's content and its
+    submission sequence among identical-content requests, so an
+    identical relaunch dedupes naturally even when two workers (or a
+    recovery pass) enumerate different sub-queues of one backlog.
     ``session`` requests always run SOLO (never coalesced — members of
     one batched launch must share the fresh |0...0> start), in
     submission order per session."""
@@ -901,12 +953,10 @@ def _decode_prng(doc):
     return k
 
 
-def _auto_idem_key(req: BatchableRun, index: int) -> str:
-    """Deterministic idempotency key for a request that did not bring
-    one: content hash over (ops, shape, dtype, PRNG key, trace, tenant)
-    plus the QUEUE POSITION — so the same request list replayed by a
-    relaunch dedupes entry-for-entry, while two intentionally identical
-    submissions at different positions stay distinct requests."""
+def _auto_content_hash(req: BatchableRun) -> str:
+    """Position-free content hash of a request — ops, shape, dtype,
+    PRNG key, trace, tenant — the stable half of an auto idempotency
+    key."""
     import numpy as np
 
     doc = {"ops": _encode_ops(req.circuit.ops),
@@ -915,25 +965,53 @@ def _auto_idem_key(req: BatchableRun, index: int) -> str:
            "dtype": (None if req.dtype is None
                      else str(np.dtype(req.dtype))),
            "prng": _encode_prng(req.key),
-           "trace": req.trace_id, "tenant": req.tenant, "i": int(index)}
+           "trace": req.trace_id, "tenant": req.tenant}
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+
+def _auto_idem_key(req: BatchableRun, seq: int) -> str:
+    """Deterministic idempotency key for a request that did not bring
+    one: content hash over (ops, shape, dtype, PRNG key, trace, tenant)
+    plus the SUBMISSION SEQUENCE among identical-content requests in
+    the same call (0 for the first, 1 for the second copy, ...).
+
+    The sequence is deliberately NOT the absolute queue position: two
+    workers — or a recovery pass — enumerating different sub-queues of
+    one logical backlog assign different positions to the same request,
+    which under the old position-derived scheme minted two keys for one
+    request and silently double-ran it.  Content + per-content
+    occurrence is stable under removing or reordering OTHER requests,
+    while two intentionally identical submissions in one call still get
+    distinct keys.  ``serve`` stamps the resolved key back onto the
+    request and records the sequence in the accept record (``seq``), so
+    recovery and live submission provably agree (pinned in
+    ``tests/test_fleet_serving.py``)."""
+    doc = {"content": _auto_content_hash(req), "seq": int(seq)}
     h = hashlib.sha256(json.dumps(doc, sort_keys=True).encode())
     return f"auto-{h.hexdigest()[:16]}"
 
 
 def _accept_record(req: BatchableRun, key: str, index: int,
-                   attempts: int) -> dict:
+                   attempts: int, seq: int | None = None) -> dict:
     import numpy as np
 
-    return {"kind": "accept", "key": key,
-            "tenant": req.tenant or TENANT_DEFAULT,
-            "trace_id": req.trace_id,
-            "num_qubits": int(req.circuit.num_qubits),
-            "is_density": bool(req.circuit.is_density),
-            "dtype": (None if req.dtype is None
-                      else str(np.dtype(req.dtype))),
-            "prng": _encode_prng(req.key),
-            "ops": _encode_ops(req.circuit.ops),
-            "attempts": int(attempts), "index": int(index)}
+    rec = {"kind": "accept", "key": key,
+           "tenant": req.tenant or TENANT_DEFAULT,
+           "trace_id": req.trace_id,
+           "num_qubits": int(req.circuit.num_qubits),
+           "is_density": bool(req.circuit.is_density),
+           "dtype": (None if req.dtype is None
+                     else str(np.dtype(req.dtype))),
+           "prng": _encode_prng(req.key),
+           "ops": _encode_ops(req.circuit.ops),
+           "attempts": int(attempts), "index": int(index)}
+    if seq is not None:
+        # auto-keyed request: the explicit submission sequence the key
+        # was derived from, stamped at accept time so recovery can
+        # audit that it agrees with live submission
+        rec["seq"] = int(seq)
+    return rec
 
 
 def _request_from_record(rec: dict, env) -> BatchableRun:
@@ -1004,8 +1082,12 @@ def _journal_scan(directory: str) -> dict:
     launches: dict = {}
     failed: dict = {}
     completed: dict = {}
+    completed_at: dict = {}
     quarantined: set = set()
-    for r in recs:
+    claims: dict = {}   # key -> {worker, epoch, expires, renewals, at}
+    fenced: dict = {}   # key -> ignored (epoch-stale) complete count
+    double: dict = {}   # key -> extra non-fenced epoch-stamped completes
+    for n, r in enumerate(recs):
         k = r.get("key")
         if k is None:
             continue
@@ -1018,13 +1100,47 @@ def _journal_scan(directory: str) -> dict:
             launches[k] = launches.get(k, 0) + 1
         elif kind == "failed":
             failed[k] = failed.get(k, 0) + 1
+        elif kind == "claim":
+            w, e = r.get("worker"), r.get("epoch")
+            if w is None or not isinstance(e, numbers.Integral):
+                continue  # framed fine but malformed: treat as absent
+            e = int(e)
+            exp = float(r.get("expires") or 0.0)
+            cur = claims.get(k)
+            if cur is None or e > cur["epoch"]:
+                # first claim, or a higher-epoch steal: the new epoch
+                # FENCES every lower epoch from here on
+                claims[k] = {"worker": str(w), "epoch": e,
+                             "expires": exp, "renewals": 0, "at": n}
+            elif e == cur["epoch"] and str(w) == cur["worker"]:
+                # heartbeat renewal: the holder extends its own lease
+                cur["expires"] = max(cur["expires"], exp)
+                cur["renewals"] += 1
+            # same-epoch claim by a DIFFERENT worker: the append race
+            # lost — first claim in journal order wins, later ignored
         elif kind == "complete":
-            completed.setdefault(k, r)
+            ce = r.get("epoch")
+            cur = claims.get(k)
+            if ce is not None and cur is not None \
+                    and int(ce) < cur["epoch"]:
+                # a fenced worker's late complete for a stolen key:
+                # recorded-but-ignored, never applied as the result
+                fenced[k] = fenced.get(k, 0) + 1
+            elif k in completed:
+                if ce is not None:
+                    # a second APPLIED-epoch complete: the same key ran
+                    # twice in the fleet (the expiry-steal race window)
+                    double[k] = double.get(k, 0) + 1
+            else:
+                completed[k] = r
+                completed_at[k] = n
         elif kind == "quarantine":
             quarantined.add(k)
     return {"accepted": accepted, "order": order, "launches": launches,
             "failed": failed, "completed": completed,
-            "quarantined": quarantined, "entries": len(recs)}
+            "completed_at": completed_at, "quarantined": quarantined,
+            "claims": claims, "fenced": fenced, "double": double,
+            "entries": len(recs)}
 
 
 def recover_queue(directory: str, env=None) -> dict:
@@ -1039,7 +1155,18 @@ def recover_queue(directory: str, env=None) -> dict:
                          launches did NOT kill the process and never
                          count toward quarantine},
          "completed":   {key: journaled result (outcomes/digest/trace)},
-         "quarantined": [poisoned keys]}
+         "quarantined": [poisoned keys],
+         "claims":      {key: {"claimed_by": worker id holding the
+                               highest-epoch claim,
+                               "epoch": its fencing epoch,
+                               "expires": lease expiry on the
+                               metrics.clock timebase,
+                               "renewals": heartbeat renewals folded
+                               into that epoch,
+                               "lease_expired": True when the lease
+                               has lapsed (a peer may reclaim),
+                               "fenced": late completes recorded at a
+                               stale epoch and ignored}}}
 
     plus ``"requests"`` — the backlog reconstructed as live
     :class:`BatchableRun` objects — when ``env`` is given; feed those
@@ -1051,12 +1178,19 @@ def recover_queue(directory: str, env=None) -> dict:
     backlog = [st["accepted"][k] for k in st["order"]
                if k not in st["completed"]
                and k not in st["quarantined"]]
+    now = metrics.clock()
+    claims = {k: {"claimed_by": c["worker"], "epoch": c["epoch"],
+                  "expires": c["expires"], "renewals": c["renewals"],
+                  "lease_expired": bool(now >= c["expires"]),
+                  "fenced": st["fenced"].get(k, 0)}
+              for k, c in st["claims"].items()}
     out = {"entries": st["entries"], "backlog": backlog,
            "launches": dict(st["launches"]),
            "failed": dict(st["failed"]),
            "completed": {k: _journal_value(r, k)
                          for k, r in st["completed"].items()},
-           "quarantined": sorted(st["quarantined"])}
+           "quarantined": sorted(st["quarantined"]),
+           "claims": claims}
     if env is not None:
         out["requests"] = [_request_from_record(r, env)
                            for r in backlog]
@@ -1096,9 +1230,28 @@ class SessionPool:
 
     Counters: ``supervisor.session_creates`` / ``session_restores`` /
     ``session_evictions``; the ``quest_serve_session_occupancy`` gauge
-    sums residents across live pools."""
+    sums residents across live pools.
 
-    def __init__(self, env, directory: str, capacity: int = 4):
+    FLEET MODE (``worker=`` a worker id, ISSUE 18): pools on different
+    workers may share one spill directory, and a session MIGRATES by
+    spilling on worker A and restoring on worker B through the same
+    checksummed checkpoint path.  Each restore-or-create bumps the
+    session's per-session FENCING EPOCH (an atomically-written
+    ``fence.json`` sidecar naming ``{epoch, worker}``) BEFORE touching
+    the register, and :meth:`evict`/:meth:`spill_all` refuse to write
+    a register whose on-disk epoch has advanced past the one this pool
+    holds — a zombie worker resuming after its session migrated can
+    never clobber the migrated state with its stale copy (the stale
+    resident is dropped instead: ``supervisor.session_fenced_spills``).
+    Restoring a session whose fence names a DIFFERENT worker counts
+    ``supervisor.sessions_migrated``.  Without ``worker=`` (the
+    default) no fence sidecar is read or written — byte-stable."""
+
+    #: Per-session fencing sidecar inside the session's spill dir.
+    FENCE = "fence.json"
+
+    def __init__(self, env, directory: str, capacity: int = 4, *,
+                 worker: str | None = None):
         capacity = int(capacity)
         if capacity < 1:
             raise QuESTValidationError(
@@ -1106,9 +1259,10 @@ class SessionPool:
         self.env = env
         self.directory = os.path.abspath(directory)
         self.capacity = capacity
+        self.worker = None if worker is None else str(worker)
         self._plock = threading.RLock()
         self._seq = 0
-        #: name -> {"qureg", "last" (LRU seq), "pins"}
+        #: name -> {"qureg", "last" (LRU seq), "pins", "epoch"}
         self._resident: dict = {}
         _pools.add(self)
 
@@ -1163,9 +1317,9 @@ class SessionPool:
             self._seq += 1
             ent = self._resident.get(name)
             if ent is None:
-                qureg = self._load_or_create(name, num_qubits,
-                                             is_density, dtype)
-                self._admit(name, qureg)
+                qureg, epoch = self._load_or_create(name, num_qubits,
+                                                    is_density, dtype)
+                self._admit(name, qureg, epoch)
                 ent = self._resident[name]
             q = ent["qureg"]
             if num_qubits is not None and (
@@ -1209,6 +1363,45 @@ class SessionPool:
             if ent is not None and ent["pins"] > 0:
                 ent["pins"] -= 1
 
+    def _fence_path(self, name: str) -> str:
+        return os.path.join(self._dir(name), self.FENCE)
+
+    def _read_fence(self, name: str) -> dict | None:
+        """The session's on-disk fencing state, or None when absent or
+        unreadable (a pre-fleet spill dir has no fence: epoch 0)."""
+        try:
+            with open(self._fence_path(name)) as f:
+                doc = json.load(f)
+            return {"epoch": int(doc["epoch"]),
+                    "worker": doc.get("worker")}
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _write_fence(self, name: str, epoch: int) -> None:
+        from . import resilience
+
+        os.makedirs(self._dir(name), exist_ok=True)
+        resilience._write_json_atomic(
+            self._fence_path(name),
+            {"epoch": int(epoch), "worker": self.worker})
+
+    def _claim_session(self, name: str, migrating: bool) -> int:
+        """Fleet mode: take ownership of ``name`` by bumping the
+        on-disk fencing epoch BEFORE the restore/create touches any
+        state — from this instant every earlier epoch's holder is a
+        zombie whose spills will be refused."""
+        fence = self._read_fence(name)
+        epoch = (fence["epoch"] if fence else 0) + 1
+        self._write_fence(name, epoch)
+        if migrating and fence is not None \
+                and fence.get("worker") not in (None, self.worker):
+            metrics.counter_inc("supervisor.sessions_migrated")
+            metrics.trace(
+                f"session {name!r} migrated from worker "
+                f"{fence['worker']!r} to {self.worker!r} "
+                f"(fencing epoch {epoch})")
+        return epoch
+
     def _load_or_create(self, name, num_qubits, is_density, dtype):
         from . import stateio
         from .register import create_density_qureg, create_qureg
@@ -1240,24 +1433,28 @@ class SessionPool:
                     f"{meta['dtype']} register; the request wants "
                     f"{_np_dtype(dtype)} — sessions never silently "
                     "change precision")
+            epoch = (self._claim_session(name, migrating=True)
+                     if self.worker is not None else None)
             mk = create_density_qureg if dens else create_qureg
             q = mk(int(meta["num_qubits"]), self.env,
                    dtype=np.dtype(meta["dtype"]))
             stateio.restore_checkpoint(q, d)
             metrics.counter_inc("supervisor.session_restores")
             metrics.trace(f"session {name!r} restored from spill ({d})")
-            return q
+            return q, epoch
         if num_qubits is None:
             raise QuESTValidationError(
                 f"SessionPool: session {name!r} does not exist (no "
                 f"spilled state under {d}) and no num_qubits was given "
                 "to create it fresh")
+        epoch = (self._claim_session(name, migrating=False)
+                 if self.worker is not None else None)
         mk = create_density_qureg if is_density else create_qureg
         q = mk(int(num_qubits), self.env, dtype=dtype)
         metrics.counter_inc("supervisor.session_creates")
-        return q
+        return q, epoch
 
-    def _admit(self, name, qureg) -> None:
+    def _admit(self, name, qureg, epoch=None) -> None:
         # caller holds _plock; spill LRU unpinned residents until the
         # newcomer fits
         while len(self._resident) >= self.capacity:
@@ -1274,13 +1471,32 @@ class SessionPool:
                 break
             self._spill(victims[0][1])
         self._resident[name] = {"qureg": qureg, "last": self._seq,
-                                "pins": 0}
+                                "pins": 0, "epoch": epoch}
 
     def _spill(self, name) -> None:
         # caller holds _plock
         from . import stateio
 
         ent = self._resident[name]
+        if self.worker is not None and ent.get("epoch") is not None:
+            fence = self._read_fence(name)
+            if fence is not None and fence["epoch"] > ent["epoch"]:
+                # FENCED: the session migrated to another worker while
+                # this register sat resident here — writing it back
+                # would clobber the migrated state with a stale copy.
+                # Drop the zombie resident instead (the authoritative
+                # state lives with the fence holder).
+                self._resident.pop(name, None)
+                metrics.counter_inc("supervisor.session_fenced_spills")
+                metrics.warn_once(
+                    f"session_fenced_spill:{name}",
+                    f"SessionPool (worker {self.worker!r}): session "
+                    f"{name!r} migrated to worker "
+                    f"{fence.get('worker')!r} at fencing epoch "
+                    f"{fence['epoch']} (this pool holds epoch "
+                    f"{ent['epoch']}); the stale resident register was "
+                    "DROPPED, not spilled")
+                return
         # save FIRST, pop only on success: a failed spill must leave
         # the live register resident — popping first would silently
         # roll the session back to a stale earlier spill (or a fresh
@@ -1495,7 +1711,8 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
           max_batch: int = 1, batch_window_s: float = 0.05,
           journal_dir: str | None = None, session_pool=None,
           tenant_max_inflight=None, tenant_queue_depth=None,
-          tenant_weights: dict | None = None) -> list:
+          tenant_weights: dict | None = None,
+          fleet: bool = False, lease_s: float | None = None) -> list:
     """Run ``requests`` through a bounded worker pool — the in-process
     run queue of the serving front end.  At most ``workers`` launch
     units execute concurrently (queueing is the backpressure; the
@@ -1540,6 +1757,32 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
         a :class:`SessionPool`; requests with ``session=`` run SOLO on
         their named long-lived register, at most one in flight per
         session, submission order preserved.
+
+    ``fleet=True`` (or the ``QUEST_FLEET_WORKER`` env var, set by
+    ``tools/fleet_serve.py`` in its workers)
+        arms the LEASED CLAIM PROTOCOL over the shared journal
+        (requires ``journal_dir``): before launching, this call appends
+        a ``claim`` record per runnable key — worker id
+        (``telemetry.worker_id()``), monotonic fencing epoch, lease
+        expiry ``lease_s`` (default ``QUEST_LEASE_S`` /
+        :data:`LEASE_S_DEFAULT`) on the ``metrics.clock`` timebase —
+        in the same batched fsync as the accepts.  Keys under a LIVE
+        foreign lease are deferred with :class:`QuESTOverloadError`
+        carrying the remaining lease as ``retry_after_s``
+        (``supervisor.lease_deferred``); expired foreign leases are
+        reclaimed by a higher-epoch claim
+        (``supervisor.claims_stolen``); a same-epoch append race is
+        resolved by re-scan, first claim in journal order wins.  A
+        heartbeat thread renews held leases every ``lease_s / 3``
+        (``supervisor.lease_renewals``), launch/complete records are
+        stamped with worker + epoch, and a FENCED worker's late
+        complete for a stolen key is recorded-but-ignored
+        (``supervisor.fenced_completes`` — never double-applied:
+        ``supervisor.fenced_completes_applied`` and
+        ``supervisor.lease_double_run`` are the strictly-regressive
+        tripwires).  Without the opt-in, nothing here runs and no
+        claim records are written — single-process serve is
+        byte-stable.
 
     ``tenant_max_inflight`` / ``tenant_queue_depth`` /
     ``tenant_weights``
@@ -1628,6 +1871,24 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
                 f"serve: request {i} targets session {r.session!r} but "
                 "no session_pool= was given "
                 "(supervisor.SessionPool(env, directory))")
+    if fleet and journal_dir is None:
+        raise QuESTValidationError(
+            "serve: fleet=True requires journal_dir= — the leased "
+            "claim protocol lives in the shared journal")
+    if lease_s is not None and not (fleet or fleet_worker_env()):
+        raise QuESTValidationError(
+            "serve: lease_s= is only meaningful with fleet=True (or "
+            "QUEST_FLEET_WORKER) — single-process serving holds no "
+            "leases")
+    # the env opt-in arms claims only for JOURNALED serves: a fleet
+    # worker's incidental unjournaled serve has no journal to claim in
+    fleet_on = journal_dir is not None and (bool(fleet)
+                                            or fleet_worker_env())
+    lease = float(lease_s) if lease_s is not None else _lease_default()
+    if fleet_on and lease <= 0:
+        raise QuESTValidationError(
+            f"serve: lease_s must be > 0, got {lease!r}")
+    my_wid = telemetry.worker_id() if fleet_on else None
 
     # --- write-ahead journal: scan, dedupe, quarantine ----------------
     # (runs BEFORE the quota pass: a relaunch answering requests from
@@ -1641,14 +1902,53 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
     dup_of: dict = {}      # duplicate index -> primary index
     rec_left = [0]         # unresolved recovery entries (gauge share)
     to_accept: list = []   # (index, request, key, prior launches)
+    jseqs: dict = {}       # request index -> auto-key sequence (stamped)
+    claim_plan: dict = {}  # request index -> (key, fencing epoch) held
     if journal_dir is not None:
         from . import stateio
 
         jstate = _journal_scan(journal_dir)
         jlaunches = dict(jstate["launches"])
+        if fleet_on:
+            # observer-side fleet accounting, once per serve pass: the
+            # fold above already refused to apply epoch-stale completes
+            # (fenced) and extra applied-epoch completes (double runs);
+            # here they become counters the drills and the
+            # strictly-regressive ledger_diff rules watch
+            nf = sum(jstate["fenced"].values())
+            if nf:
+                metrics.counter_inc("supervisor.fenced_completes", nf)
+            nd = sum(jstate["double"].values())
+            if nd:
+                metrics.counter_inc("supervisor.lease_double_run", nd)
+            for k, rec in jstate["completed"].items():
+                # independent re-check of the fold's fencing verdict: an
+                # APPLIED complete that is epoch-stale relative to a
+                # claim that landed BEFORE it means the fold applied a
+                # fenced complete — the exactly-once contract broke
+                c = jstate["claims"].get(k)
+                ce = rec.get("epoch")
+                if c is not None and ce is not None \
+                        and int(ce) < c["epoch"] \
+                        and jstate["completed_at"].get(k, -1) > c["at"]:
+                    metrics.counter_inc(
+                        "supervisor.fenced_completes_applied")
+        fnow = metrics.clock()
         seen: dict = {}
+        auto_seq: dict = {}
         for i, r in enumerate(jobs):
-            k = r.idempotency_key or _auto_idem_key(r, i)
+            k = r.idempotency_key
+            if k is None:
+                # auto key: content + per-content submission sequence
+                # (NOT queue position — see _auto_idem_key), stamped
+                # back onto the request and into the accept record so
+                # recovery re-derives the very same key
+                ch = _auto_content_hash(r)
+                s = auto_seq.get(ch, 0)
+                auto_seq[ch] = s + 1
+                k = _auto_idem_key(r, s)
+                r.idempotency_key = k
+                jseqs[i] = s
             jkeys[i] = k
             if k in seen:
                 # duplicate within this call: executes once; the copy
@@ -1688,6 +1988,23 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
                     "resubmit under a new idempotency key after "
                     "fixing the request")}
                 continue
+            if fleet_on:
+                c = jstate["claims"].get(k)
+                if c is not None and c["worker"] != my_wid \
+                        and fnow < c["expires"]:
+                    # a live foreign lease: the holder is running this
+                    # key right now — honour it, defer typed with the
+                    # remaining lease as the retry hint
+                    ra = max(c["expires"] - fnow, 0.01)
+                    metrics.counter_inc("supervisor.lease_deferred")
+                    results[i] = {"ok": False,
+                                  "error": QuESTOverloadError(
+                        f"request {k!r} is leased to worker "
+                        f"{c['worker']!r} (fencing epoch "
+                        f"{c['epoch']}); deferred while its lease is "
+                        f"live (retry_after_s={ra:g})",
+                        retry_after_s=ra)}
+                    continue
             to_accept.append((i, r, k, n_launch))
 
     # --- per-tenant queue-depth quota ---------------------------------
@@ -1727,8 +2044,68 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
             # redundant fsync'd append instead of growing the journal
             # by O(backlog) per restart
             if k not in jstate["accepted"]:
-                to_append.append(_accept_record(r, k, i, n_launch))
-            else:
+                to_append.append(
+                    _accept_record(r, k, i, n_launch, seq=jseqs.get(i)))
+        if fleet_on:
+            # claims ride the SAME batched fsync as the accepts: one
+            # sync makes both the acceptance and the exclusive lease
+            # durable before anything launches
+            for i, r, k, n_launch in to_accept:
+                if results[i] is not None:
+                    continue
+                cur = jstate["claims"].get(k)
+                if cur is None:
+                    epoch = 1
+                elif cur["worker"] == my_wid:
+                    # still ours (or our own expired lease): same epoch
+                    epoch = cur["epoch"]
+                else:
+                    # an EXPIRED foreign lease (live ones deferred
+                    # above): reclaim by fencing the old holder out
+                    epoch = cur["epoch"] + 1
+                    metrics.counter_inc("supervisor.claims_stolen")
+                claim_plan[i] = (k, epoch)
+                to_append.append(_claim_record(
+                    k, my_wid, epoch, metrics.clock() + lease))
+                metrics.counter_inc("supervisor.claims")
+        # one open/write/fsync for the whole accept(+claim) batch —
+        # same write-ahead guarantee (every accept durable before
+        # anything launches) at 1/N the sync cost
+        stateio.append_journal_entries(journal_dir, to_append)
+        if fleet_on and claim_plan:
+            # claim-race resolution: two workers may append same-epoch
+            # claims for one key concurrently — re-scan and let journal
+            # order arbitrate (the fold keeps the FIRST same-epoch
+            # claim).  Losers defer exactly like a live foreign lease;
+            # a key a peer managed to COMPLETE in the window dedupes.
+            rescan = _journal_scan(journal_dir)
+            for i in list(claim_plan):
+                k, epoch = claim_plan[i]
+                if k in rescan["completed"]:
+                    results[i] = {"ok": True, "value": _journal_value(
+                        rescan["completed"][k], k)}
+                    metrics.counter_inc("supervisor.journal_deduped")
+                    del claim_plan[i]
+                    continue
+                won = rescan["claims"].get(k)
+                if won is None or won["worker"] != my_wid \
+                        or won["epoch"] != epoch:
+                    hold = won or {}
+                    ra = max(hold.get("expires", 0.0) - metrics.clock(),
+                             0.01)
+                    metrics.counter_inc("supervisor.lease_deferred")
+                    results[i] = {"ok": False,
+                                  "error": QuESTOverloadError(
+                        f"request {k!r} lost the claim race to worker "
+                        f"{hold.get('worker')!r} (fencing epoch "
+                        f"{hold.get('epoch')}); deferred "
+                        f"(retry_after_s={ra:g})",
+                        retry_after_s=ra)}
+                    del claim_plan[i]
+        for i, r, k, n_launch in to_accept:
+            if results[i] is not None:  # shed, deferred, or deduped
+                continue
+            if k in jstate["accepted"]:
                 recovery.add(i)
                 pending += 1
             if n_launch > 0 and i not in recovery:
@@ -1737,14 +2114,48 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
             if n_launch > 0:
                 replays.add(i)
                 metrics.counter_inc("supervisor.journal_replayed")
-        # one open/write/fsync for the whole accept batch — same
-        # write-ahead guarantee (every accept durable before anything
-        # launches) at 1/N the sync cost
-        stateio.append_journal_entries(journal_dir, to_append)
         rec_left[0] = pending
         if pending:
             with _lock:
                 _journal_recovery["pending"] += pending
+
+    # --- fleet heartbeat: renew held leases while their runs are live
+    renew_stop = None
+    renew_thread = None
+    if fleet_on and claim_plan:
+        from . import stateio as _stateio_renew
+
+        renew_stop = threading.Event()
+
+        def _renew_leases():
+            # rides the ordinary batched-fsync append path; a renewal
+            # is a same-epoch claim by the same worker, which the scan
+            # folds into an extended expiry (never a steal)
+            interval = max(lease / 3.0, 0.02)
+            while not renew_stop.wait(interval):
+                recs = [_claim_record(k, my_wid, ep,
+                                      metrics.clock() + lease)
+                        for i, (k, ep) in list(claim_plan.items())
+                        if results[i] is None]
+                if not recs:
+                    continue
+                try:
+                    _stateio_renew.append_journal_entries(
+                        journal_dir, recs)
+                    metrics.counter_inc("supervisor.lease_renewals",
+                                        len(recs))
+                except Exception:
+                    # a missed heartbeat is survivable by design: the
+                    # lease lapses and a peer reclaims — exactly the
+                    # worker-death path
+                    metrics.counter_inc(
+                        "supervisor.journal_append_failures",
+                        len(recs))
+
+        renew_thread = threading.Thread(
+            target=_renew_leases, daemon=True,
+            name=f"quest-serve-{label}-lease")
+        renew_thread.start()
 
     # everything between the recovery-gauge increment above and the
     # hygiene below runs under try/finally: an exception escaping
@@ -1919,10 +2330,13 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
                                     with _lock:
                                         att = jlaunches[jkeys[i]] = \
                                             jlaunches.get(jkeys[i], 0) + 1
-                                    launch_recs.append(
-                                        {"kind": "launch",
-                                         "key": jkeys[i],
-                                         "attempt": att})
+                                    lrec = {"kind": "launch",
+                                            "key": jkeys[i],
+                                            "attempt": att}
+                                    if i in claim_plan:
+                                        lrec["worker"] = my_wid
+                                        lrec["epoch"] = claim_plan[i][1]
+                                    launch_recs.append(lrec)
                                 stateio.append_journal_entries(
                                     journal_dir, launch_recs)
                             values = _run_coalesced(
@@ -1943,13 +2357,21 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
                                         digest, outs = _result_digest(v)
                                         v["idempotency_key"] = jkeys[i]
                                         v["digest"] = digest
-                                        comp_recs.append(
-                                            {"kind": "complete",
-                                             "key": jkeys[i],
-                                             "digest": digest,
-                                             "outcomes": outs,
-                                             "trace_id":
-                                                 v.get("trace_id")})
+                                        crec = {"kind": "complete",
+                                                "key": jkeys[i],
+                                                "digest": digest,
+                                                "outcomes": outs,
+                                                "trace_id":
+                                                    v.get("trace_id")}
+                                        if i in claim_plan:
+                                            # epoch-stamped so a steal
+                                            # after this worker zombied
+                                            # FENCES this complete at
+                                            # fold time
+                                            crec["worker"] = my_wid
+                                            crec["epoch"] = \
+                                                claim_plan[i][1]
+                                        comp_recs.append(crec)
                                     # one fsync for the unit's completions
                                     # (mirroring the launch batch above)
                                     stateio.append_journal_entries(
@@ -2044,6 +2466,9 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
         for t in threads:
             t.join()
     finally:
+        if renew_stop is not None:
+            renew_stop.set()
+            renew_thread.join(timeout=10.0)
         # recovery-gauge hygiene: anything left unresolved (a
         # dispatcher crash, an exception above) must not wedge
         # /readyz at not-ready forever

@@ -330,7 +330,10 @@ AUDIT_SCHEMA = "quest-tpu-audit-trail/1"
 
 #: Journal record kinds in the serve write-ahead journal
 #: (``quest_tpu.supervisor`` / ``stateio.append_journal_entries``).
-JOURNAL_KINDS = ("accept", "launch", "complete", "failed", "quarantine")
+#: ``claim`` is the fleet lease record (worker id, fencing epoch,
+#: expiry) appended before a worker's ``launch`` in fleet mode.
+JOURNAL_KINDS = ("accept", "claim", "launch", "complete", "failed",
+                 "quarantine")
 
 
 def _read_journal_forensic(directory: str) -> list[dict]:
@@ -421,8 +424,8 @@ def audit_trail(trace_id: str, journal_dir: str | None = None,
 
     def _req(key):
         return requests.setdefault(key, {
-            "accepted": 0, "launches": 0, "failed": 0, "completes": 0,
-            "quarantined": 0, "lifecycle": []})
+            "accepted": 0, "claims": 0, "launches": 0, "failed": 0,
+            "completes": 0, "quarantined": 0, "lifecycle": []})
 
     jrecs = _read_journal_forensic(journal_dir) if journal_dir else []
     # pass 1: the chain's idempotency keys — records carrying the
@@ -441,15 +444,22 @@ def audit_trail(trace_id: str, journal_dir: str | None = None,
             continue
         ev = {"seq": 0, "source": "journal", "kind": kind, "key": key}
         for field in ("attempt", "attempts", "tenant", "index",
-                      "digest", "error", "ctx"):
+                      "digest", "error", "ctx", "worker", "epoch",
+                      "expires"):
             if r.get(field) is not None:
                 ev[field] = r[field]
+        if r.get("seq") is not None:
+            # the accept record's auto-key submission sequence ("seq"
+            # would collide with the event ordinal)
+            ev["submit_seq"] = r["seq"]
         events.append(ev)
         if key is not None:
             req = _req(key)
             req["lifecycle"].append(kind)
             if kind == "accept":
                 req["accepted"] += 1
+            elif kind == "claim":
+                req["claims"] += 1
             elif kind == "launch":
                 req["launches"] += 1
             elif kind == "failed":
@@ -548,6 +558,12 @@ def validate_audit_trail(doc: dict) -> dict:
                     or req[field] < 0:
                 fail(f"request {key!r}: {field} must be a "
                      "non-negative int")
+        # "claims" joined the schema with fleet serving; validated
+        # when present so pre-fleet documents still check clean
+        if "claims" in req and (not isinstance(req["claims"], int)
+                                or req["claims"] < 0):
+            fail(f"request {key!r}: claims must be a "
+                 "non-negative int")
         if not isinstance(req.get("lifecycle"), list):
             fail(f"request {key!r}: lifecycle must be a list")
     led = doc["ledger"]

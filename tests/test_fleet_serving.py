@@ -1,0 +1,873 @@
+"""Fleet-serving tests (ISSUE 18): the leased claim protocol over the
+shared write-ahead journal (``claim`` records with worker id,
+monotonic fencing epoch and lease expiry), content-derived auto
+idempotency keys, cross-worker session migration with per-session
+fencing, the stdlib fleet ingress (``tools/fleet_serve.py``), the
+``quest_serve_*`` fleet gauges, and the new strictly-regressive
+``ledger_diff`` rules.
+
+Everything here is deterministic and in-process — the real
+SIGKILL/SIGSTOP multi-process chains are subprocess-drilled by
+``tools/chaos_drill.py`` rows ``fleet_worker_kill`` /
+``fleet_lease_fencing`` / ``fleet_session_migrate`` and the
+``record_all.py`` ``fleet_serve`` tier-2 smoke; these tests pin the
+same machinery at the API seam where a debugger can reach it.
+Simulated peers are spelled as synthesized journal records (the claim
+protocol is a journal fold, so a peer IS its records).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics, models, stateio, supervisor, telemetry
+from quest_tpu.validation import (QuESTOverloadError,
+                                  QuESTValidationError)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+N = 6
+
+
+def _measured_circ(seed=7):
+    circ = models.random_circuit(N, depth=2, seed=seed)
+    circ.measure(0)
+    circ.measure(3)
+    return circ
+
+
+def _reqs(env, circ=None, n=4, keyed=True, **kw):
+    circ = circ or _measured_circ()
+    keys = jax.random.split(jax.random.PRNGKey(2), n)
+    return [supervisor.BatchableRun(
+        circ, env, key=keys[i], trace_id=f"tenant-{i}",
+        idempotency_key=(f"req-{i}" if keyed else None), **kw)
+        for i in range(n)]
+
+
+def _counter(name, before=None):
+    v = metrics.counters().get(name, 0)
+    return v - (before or {}).get(name, 0) \
+        if before is not None else v
+
+
+def _claim(key, worker, epoch, expires, ctx=None):
+    rec = {"kind": "claim", "key": key, "worker": worker,
+           "epoch": epoch, "expires": expires}
+    if ctx:
+        rec["ctx"] = ctx
+    return rec
+
+
+def _seed_accepts(d, reqs):
+    for i, r in enumerate(reqs):
+        stateio.append_journal_entry(
+            d, supervisor._accept_record(r, r.idempotency_key, i, 2))
+
+
+# ---------------------------------------------------------------------------
+# Auto idempotency keys: content + submission sequence (satellite a)
+# ---------------------------------------------------------------------------
+
+
+def test_auto_key_is_position_free_and_sequence_stable(env1):
+    """The unit contract: the auto key depends on request CONTENT and
+    its occurrence sequence among identical-content requests — never
+    on the absolute queue position (the old scheme's bug: recovery
+    enumerating a sub-queue minted different keys and double-ran)."""
+    env = env1
+    a = _reqs(env, n=1, keyed=False)[0]
+    b = supervisor.BatchableRun(_measured_circ(seed=9), env,
+                                trace_id="other")
+    # same content, seq 0: identical key regardless of list position
+    assert supervisor._auto_idem_key(a, 0) \
+        == supervisor._auto_idem_key(a, 0)
+    # different content or different sequence: distinct keys
+    assert supervisor._auto_idem_key(a, 0) \
+        != supervisor._auto_idem_key(b, 0)
+    assert supervisor._auto_idem_key(a, 0) \
+        != supervisor._auto_idem_key(a, 1)
+    assert supervisor._auto_idem_key(a, 0).startswith("auto-")
+
+
+def test_auto_keys_agree_between_live_and_recovery(env1, tmp_path):
+    """The regression pin: serve [A, B, C] auto-keyed; a later serve
+    of fresh [B, C] objects (the recovery shape — A's prefix removed)
+    over the SAME journal must resolve to the SAME keys and dedupe
+    from the journal instead of re-running.  Under the old
+    position-derived scheme B and C would mint new keys at positions
+    0/1 and silently double-run."""
+    d = str(tmp_path / "journal")
+    env = env1
+    circs = [_measured_circ(seed=s) for s in (1, 2, 3)]
+
+    def fresh():
+        return [supervisor.BatchableRun(
+            c, env, key=jax.random.PRNGKey(5), trace_id=f"t-{i}")
+            for i, c in enumerate(circs)]
+
+    full = fresh()
+    res = supervisor.serve(full, workers=1, max_batch=1,
+                           journal_dir=d)
+    assert all(r["ok"] for r in res)
+    # keys were stamped back onto the requests at accept time
+    keys = [r.idempotency_key for r in full]
+    assert all(k and k.startswith("auto-") for k in keys)
+    before = metrics.counters()
+    sub = fresh()[1:]
+    res2 = supervisor.serve(sub, workers=1, max_batch=1,
+                            journal_dir=d)
+    assert all(r["ok"] and r["value"].get("journaled") for r in res2)
+    assert [r.idempotency_key for r in sub] == keys[1:]
+    assert _counter("supervisor.journal_deduped", before) == 2
+    assert _counter("supervisor.journal_replayed", before) == 0
+    # the accept records carry the submission sequence the key hashed
+    seqs = [rec.get("seq") for rec in stateio.read_journal(d)
+            if rec.get("kind") == "accept"]
+    assert seqs == [0, 0, 0]  # three distinct contents: first of each
+
+
+def test_duplicate_content_in_one_call_gets_distinct_seqs(env1,
+                                                          tmp_path):
+    """Two INTENTIONALLY identical submissions in one call get
+    sequence 0 and 1 — distinct keys, both run — and the sequences
+    land in their accept records."""
+    d = str(tmp_path / "journal")
+    env = env1
+    circ = _measured_circ()
+    twins = [supervisor.BatchableRun(circ, env, trace_id="t")
+             for _ in range(2)]
+    res = supervisor.serve(twins, workers=1, max_batch=1,
+                           journal_dir=d)
+    assert all(r["ok"] for r in res)
+    k0, k1 = (t.idempotency_key for t in twins)
+    assert k0 != k1
+    recs = [r for r in stateio.read_journal(d)
+            if r.get("kind") == "accept"]
+    assert sorted(r.get("seq") for r in recs) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Claim protocol: opt-in, stamping, fold edge cases (satellite c)
+# ---------------------------------------------------------------------------
+
+
+def test_default_journaled_serve_writes_no_claims(env1, tmp_path):
+    """Byte-stability: without the fleet opt-in a journaled serve
+    writes exactly the historical record kinds — no claims, no
+    worker/epoch stamps."""
+    d = str(tmp_path / "journal")
+    res = supervisor.serve(_reqs(env1, n=2), workers=1, max_batch=1,
+                           journal_dir=d)
+    assert all(r["ok"] for r in res)
+    recs = stateio.read_journal(d)
+    assert {r["kind"] for r in recs} == {"accept", "launch",
+                                         "complete"}
+    assert all("worker" not in r and "epoch" not in r for r in recs)
+
+
+def test_fleet_serve_claims_and_stamps_records(env1, tmp_path,
+                                               monkeypatch):
+    """fleet=True appends one claim per runnable key BEFORE its
+    launch (same batched fsync as the accept), stamps launch/complete
+    with worker + epoch, and counts supervisor.claims."""
+    d = str(tmp_path / "journal")
+    monkeypatch.setenv("QUEST_WORKER_ID", "wA")
+    before = metrics.counters()
+    res = supervisor.serve(_reqs(env1, n=2), workers=1, max_batch=1,
+                           journal_dir=d, fleet=True)
+    assert all(r["ok"] for r in res)
+    recs = stateio.read_journal(d)
+    claims = [r for r in recs if r["kind"] == "claim"]
+    assert {c["key"] for c in claims} == {"req-0", "req-1"}
+    assert all(c["worker"] == "wA" and c["epoch"] == 1
+               and isinstance(c["expires"], float) for c in claims)
+    # claim precedes its launch in journal order
+    kinds_req0 = [r["kind"] for r in recs if r["key"] == "req-0"]
+    assert kinds_req0.index("claim") < kinds_req0.index("launch")
+    for kind in ("launch", "complete"):
+        stamped = [r for r in recs if r["kind"] == kind]
+        assert all(r["worker"] == "wA" and r["epoch"] == 1
+                   for r in stamped)
+    assert _counter("supervisor.claims", before) == 2
+
+
+def test_fleet_validation_errors(env1, tmp_path):
+    with pytest.raises(QuESTValidationError) as ei:
+        supervisor.serve(_reqs(env1, n=1), fleet=True)
+    assert "journal_dir" in str(ei.value)
+    with pytest.raises(QuESTValidationError) as ei:
+        supervisor.serve(_reqs(env1, n=1),
+                         journal_dir=str(tmp_path / "j"),
+                         lease_s=1.0)
+    assert "fleet" in str(ei.value)
+    with pytest.raises(QuESTValidationError):
+        supervisor.serve(_reqs(env1, n=1),
+                         journal_dir=str(tmp_path / "j"),
+                         fleet=True, lease_s=0.0)
+
+
+def test_live_foreign_lease_defers_with_retry_hint(env1, tmp_path,
+                                                   monkeypatch):
+    """A key under a LIVE foreign lease is deferred with a typed
+    QuESTOverloadError carrying the remaining lease as retry_after_s
+    — the peer is running it right now."""
+    d = str(tmp_path / "journal")
+    env = env1
+    reqs = _reqs(env, n=1)
+    _seed_accepts(d, reqs)
+    stateio.append_journal_entry(
+        d, _claim("req-0", "peer", 3, metrics.clock() + 50.0))
+    monkeypatch.setenv("QUEST_WORKER_ID", "wB")
+    before = metrics.counters()
+    res = supervisor.serve(_reqs(env, n=1), workers=1, max_batch=1,
+                           journal_dir=d, fleet=True)
+    assert not res[0]["ok"]
+    err = res[0]["error"]
+    assert isinstance(err, QuESTOverloadError)
+    assert "peer" in str(err) and "epoch 3" in str(err)
+    assert 0 < err.retry_after_s <= 50.0
+    assert _counter("supervisor.lease_deferred", before) == 1
+    # nothing launched, nothing completed, claim untouched
+    st = supervisor._journal_scan(d)
+    assert st["launches"] == {} and st["completed"] == {}
+    assert st["claims"]["req-0"]["worker"] == "peer"
+
+
+def test_expired_lease_stolen_with_higher_epoch(env1, tmp_path,
+                                                monkeypatch):
+    """Clock-free expiry: the lease verdict flips with metrics.clock
+    alone (no wall clock in the protocol), and a LAPSED foreign lease
+    is reclaimed with a HIGHER-epoch claim (claims_stolen) — the
+    complete then carries the stealing epoch."""
+    d = str(tmp_path / "journal")
+    env = env1
+    _seed_accepts(d, _reqs(env, n=1))
+    exp = metrics.clock() - 5.0  # already lapsed on the real timebase
+    stateio.append_journal_entry(d, _claim("req-0", "peer", 5, exp))
+    # expiry is a pure clock comparison: patch the clock either side
+    # of the recorded expiry and watch the verdict flip
+    monkeypatch.setattr(metrics, "clock", lambda: exp - 10.0)
+    assert supervisor.recover_queue(
+        d)["claims"]["req-0"]["lease_expired"] is False
+    monkeypatch.setattr(metrics, "clock", lambda: exp + 10.0)
+    assert supervisor.recover_queue(
+        d)["claims"]["req-0"]["lease_expired"] is True
+    monkeypatch.undo()  # serve below needs the real timebase
+    monkeypatch.setenv("QUEST_WORKER_ID", "wB")
+    before = metrics.counters()
+    res = supervisor.serve(_reqs(env, n=1), workers=1, max_batch=1,
+                           journal_dir=d, fleet=True)
+    assert res[0]["ok"]
+    assert _counter("supervisor.claims_stolen", before) == 1
+    st = supervisor._journal_scan(d)
+    assert st["claims"]["req-0"]["worker"] == "wB"
+    assert st["claims"]["req-0"]["epoch"] == 6
+    assert st["completed"]["req-0"]["epoch"] == 6
+
+
+def test_fenced_complete_recorded_but_ignored(env1, tmp_path,
+                                              monkeypatch):
+    """A zombie's epoch-stale complete is RECORDED-BUT-IGNORED: the
+    fold refuses to apply it (the key stays in the backlog), the
+    serve observer counts fenced_completes, and the tripwires stay
+    zero."""
+    d = str(tmp_path / "journal")
+    env = env1
+    reqs = _reqs(env, n=1)
+    _seed_accepts(d, reqs)
+    stateio.append_journal_entry(d, _claim("req-0", "wA", 1, 0.0))
+    stateio.append_journal_entry(d, _claim("req-0", "wB", 2, 1e12))
+    # the zombie wA's late complete at its stale epoch 1
+    stateio.append_journal_entry(
+        d, {"kind": "complete", "key": "req-0", "outcomes": [0, 0],
+            "digest": "o:dead", "trace_id": "tenant-0",
+            "worker": "wA", "epoch": 1})
+    st = supervisor._journal_scan(d)
+    assert "req-0" not in st["completed"]
+    assert st["fenced"] == {"req-0": 1}
+    assert sum(st["double"].values()) == 0
+    rq = supervisor.recover_queue(d)
+    assert [r["key"] for r in rq["backlog"]] == ["req-0"]
+    assert rq["claims"]["req-0"]["fenced"] == 1
+    # a serve pass over this journal counts the fence ONCE, and the
+    # exactly-once tripwires stay zero; wB (the claim holder) then
+    # legitimately completes it at epoch 2
+    monkeypatch.setenv("QUEST_WORKER_ID", "wB")
+    before = metrics.counters()
+    res = supervisor.serve(_reqs(env, n=1), workers=1, max_batch=1,
+                           journal_dir=d, fleet=True)
+    assert res[0]["ok"] and not res[0]["value"].get("journaled")
+    assert _counter("supervisor.fenced_completes", before) == 1
+    assert _counter("supervisor.lease_double_run", before) == 0
+    assert _counter("supervisor.fenced_completes_applied",
+                    before) == 0
+    st = supervisor._journal_scan(d)
+    assert st["completed"]["req-0"]["epoch"] == 2
+
+
+def test_same_epoch_duplicate_claim_first_wins(env1, tmp_path):
+    """The append-race resolution: two same-epoch claims for one key
+    resolve to the FIRST in journal order; the second is ignored (not
+    a steal, not a renewal)."""
+    d = str(tmp_path / "journal")
+    _seed_accepts(d, _reqs(env1, n=1))
+    stateio.append_journal_entry(d, _claim("req-0", "wA", 1, 100.0))
+    stateio.append_journal_entry(d, _claim("req-0", "wB", 1, 200.0))
+    st = supervisor._journal_scan(d)
+    c = st["claims"]["req-0"]
+    assert c["worker"] == "wA" and c["epoch"] == 1
+    assert c["expires"] == 100.0 and c["renewals"] == 0
+
+
+def test_same_worker_same_epoch_claim_is_renewal(env1, tmp_path):
+    """A held lease renews by re-claiming at the SAME epoch: expiry
+    extends monotonically (max), renewals count."""
+    d = str(tmp_path / "journal")
+    _seed_accepts(d, _reqs(env1, n=1))
+    for exp in (100.0, 300.0, 200.0):
+        stateio.append_journal_entry(d, _claim("req-0", "wA", 1, exp))
+    c = supervisor._journal_scan(d)["claims"]["req-0"]
+    assert c["renewals"] == 2
+    assert c["expires"] == 300.0  # never shortens
+
+
+def test_torn_claim_tail_healed_like_journal_entries(env1, tmp_path):
+    """A torn claim append (the crash mid-write) heals exactly like a
+    torn journal entry: dropped from the scan, truncated before the
+    next append, and the next serve just re-claims."""
+    d = str(tmp_path / "journal")
+    env = env1
+    _seed_accepts(d, _reqs(env, n=1))
+    path = os.path.join(d, stateio.JOURNAL)
+    with open(path, "a") as f:
+        f.write(stateio.frame_record(
+            _claim("req-0", "wA", 1, 100.0))[:25])  # torn mid-frame
+    st = supervisor._journal_scan(d)
+    assert st["claims"] == {}  # the torn claim never happened
+    res = supervisor.serve(_reqs(env, n=1), workers=1, max_batch=1,
+                           journal_dir=d, fleet=True)
+    assert res[0]["ok"]
+    recs = stateio.read_journal(d)
+    assert [r for r in recs if r["kind"] == "claim"]
+    with open(path) as f:
+        assert f.read().endswith("\n")  # healed, not glued
+
+
+def test_corrupt_interior_claim_skipped_and_counted(env1, tmp_path):
+    """An interior bit-rotted claim line is skipped (counted as
+    journal corruption) while surrounding records survive."""
+    d = str(tmp_path / "journal")
+    _seed_accepts(d, _reqs(env1, n=1))
+    stateio.append_journal_entry(d, _claim("req-0", "wA", 1, 100.0))
+    stateio.append_journal_entry(d, _claim("req-0", "wA", 1, 200.0))
+    path = os.path.join(d, stateio.JOURNAL)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    lines[-1] = lines[-1].replace('"epoch": 1', '"epoch": 2')  # rot
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    before = metrics.counters()
+    c = supervisor._journal_scan(d)["claims"]["req-0"]
+    assert c["expires"] == 100.0 and c["renewals"] == 0
+    assert _counter("supervisor.journal_corrupt_entries", before) == 1
+
+
+def test_malformed_claim_records_are_ignored(env1, tmp_path):
+    """Claims with missing/invalid fields (no epoch+worker, string
+    epoch, no worker) are skipped by the fold rather than poisoning
+    the scan."""
+    d = str(tmp_path / "journal")
+    _seed_accepts(d, _reqs(env1, n=1))
+    for bad in ({"kind": "claim", "key": "req-0"},
+                {"kind": "claim", "key": "req-0", "epoch": 1,
+                 "expires": 1.0},
+                {"kind": "claim", "key": "req-0", "worker": "w",
+                 "epoch": "one", "expires": 1.0}):
+        stateio.append_journal_entry(d, bad)
+    assert supervisor._journal_scan(d)["claims"] == {}
+
+
+def test_three_worker_interleaving_property(env1, tmp_path,
+                                            monkeypatch):
+    """Property test over simulated 3-worker schedules: for EVERY
+    interleaving of three workers' claim→launch→complete sequences
+    (epochs 1, 2, 3 — each later worker stealing after the earlier
+    lease lapsed), the fold must apply EXACTLY ONE complete, fence
+    every complete whose epoch is stale at its landing position, and
+    resolve the final claim to the highest epoch.  Workers' own event
+    orders are preserved; only the interleaving varies."""
+    _seed_accepts(str(tmp_path / "seed"), _reqs(env1, n=1))
+    accept = [r for r in stateio.read_journal(str(tmp_path / "seed"))
+              if r["kind"] == "accept"]
+
+    def worker_events(w, epoch):
+        return [
+            _claim("req-0", w, epoch, float(epoch)),
+            {"kind": "launch", "key": "req-0", "attempt": epoch,
+             "worker": w, "epoch": epoch},
+            {"kind": "complete", "key": "req-0",
+             "outcomes": [epoch, 0], "digest": f"o:{epoch}",
+             "trace_id": "tenant-0", "worker": w, "epoch": epoch},
+        ]
+
+    seqs = [worker_events(f"w{e}", e) for e in (1, 2, 3)]
+    # every merge of the three 3-event sequences (9!/(3!3!3!) = 1680)
+    labels = "000111222"
+    n_checked = n_raced = 0
+    for perm in sorted(set(itertools.permutations(labels))):
+        idx = [0, 0, 0]
+        recs = list(accept)
+        for ch in perm:
+            w = int(ch)
+            recs.append(seqs[w][idx[w]])
+            idx[w] += 1
+        # the oracle, computed from the schedule alone: a complete is
+        # FENCED iff a higher-epoch claim landed before it; the first
+        # un-fenced complete is APPLIED; any later un-fenced complete
+        # is a DOUBLE (the tripwire the live protocol's live-lease
+        # deferral makes unreachable — synthetic schedules here ignore
+        # that gate on purpose, to prove the fold's accounting is
+        # exhaustive: applied + fenced + double == every complete)
+        want_f = want_d = 0
+        want_applied_epoch = None
+        hi = 0
+        for rec in recs:
+            if rec["kind"] == "claim":
+                hi = max(hi, rec["epoch"])
+            elif rec["kind"] == "complete":
+                if rec["epoch"] < hi:
+                    want_f += 1
+                elif want_applied_epoch is None:
+                    want_applied_epoch = rec["epoch"]
+                else:
+                    want_d += 1
+        monkeypatch.setattr(stateio, "read_journal",
+                            lambda d, _r=recs: list(_r))
+        st = supervisor._journal_scan("unused")
+        assert "req-0" in st["completed"]  # exactly one applied
+        assert st["completed"]["req-0"]["epoch"] == want_applied_epoch
+        assert st["fenced"].get("req-0", 0) == want_f
+        assert st["double"].get("req-0", 0) == want_d
+        assert 1 + want_f + want_d == 3  # every complete accounted
+        # claim epochs are monotone: the fold resolves to the max
+        assert st["claims"]["req-0"]["epoch"] == 3
+        # PROTOCOL-reachable schedules — each steal claim (epoch e+1)
+        # lands BEFORE the epoch-e complete, the only ordering a real
+        # stealer produces (a complete already in the journal would
+        # have deduped at its rescan instead of claiming) — never
+        # double-run: the fence catches every stale complete
+        pos = {(r["kind"], r.get("epoch")): i
+               for i, r in enumerate(recs)}
+        reachable = all(pos[("claim", e + 1)] < pos[("complete", e)]
+                        for e in (1, 2))
+        if reachable:
+            assert want_d == 0 and want_f == 2
+            n_raced += 1
+        n_checked += 1
+    monkeypatch.undo()
+    assert n_checked == 1680
+    assert n_raced > 0  # the reachable family is actually exercised
+
+
+def test_heartbeat_renews_lease_during_long_run(env1, tmp_path,
+                                                monkeypatch):
+    """The batched-fsync heartbeat: a run longer than lease_s/3 gets
+    its claim re-appended (lease_renewals) so a live worker never
+    loses a key mid-run; renewals fold as the SAME epoch."""
+    d = str(tmp_path / "journal")
+    env = env1
+    monkeypatch.setenv("QUEST_WORKER_ID", "wA")
+    resilience = pytest.importorskip("quest_tpu.resilience")
+    before = metrics.counters()
+    resilience.set_fault_plan([("run_item", 0, "delay:400")])
+    try:
+        res = supervisor.serve(_reqs(env, n=1), workers=1,
+                               max_batch=1, journal_dir=d,
+                               fleet=True, lease_s=0.09)
+    finally:
+        resilience.clear_fault_plan()
+    assert res[0]["ok"]
+    assert _counter("supervisor.lease_renewals", before) >= 1
+    c = supervisor._journal_scan(d)["claims"]["req-0"]
+    assert c["epoch"] == 1 and c["renewals"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Session migration and fencing (tentpole part 3)
+# ---------------------------------------------------------------------------
+
+
+def test_session_pool_without_worker_writes_no_fence(env1, tmp_path):
+    """Byte-stability: the historical pool (no worker=) never writes
+    fence sidecars and counts no migrations."""
+    d = str(tmp_path / "pool")
+    pool = supervisor.SessionPool(env1, d)
+    _measured_circ().run(pool.session("s", N))
+    pool.spill_all()
+    assert not os.path.exists(os.path.join(d, "s",
+                                           supervisor.SessionPool
+                                           .FENCE))
+
+
+def test_session_migrates_across_workers_bit_identical(env1,
+                                                       tmp_path):
+    """Spill on worker A, restore on worker B: counted as a
+    migration, fencing epoch bumped BEFORE the restore, and c1 on A
+    then c2 on B equals c1;c2 on one uninterrupted register."""
+    d = str(tmp_path / "pool")
+    env = env1
+    c1 = models.random_circuit(N, depth=2, seed=31)
+    c2 = models.random_circuit(N, depth=2, seed=32)
+    ref = qt.create_qureg(N, env)
+    c1.run(ref)
+    c2.run(ref)
+    before = metrics.counters()
+    pa = supervisor.SessionPool(env, d, worker="wA")
+    c1.run(pa.session("s", N))
+    pa.spill_all()
+    pb = supervisor.SessionPool(env, d, worker="wB")
+    qb = pb.session("s")
+    c2.run(qb)
+    assert np.array_equal(qt.get_state_vector(qb),
+                          qt.get_state_vector(ref))
+    assert _counter("supervisor.sessions_migrated", before) == 1
+    fence = json.load(open(os.path.join(
+        d, "s", supervisor.SessionPool.FENCE)))
+    assert fence["worker"] == "wB" and fence["epoch"] >= 2
+
+
+def test_zombie_session_spill_refused_by_fence(env1, tmp_path):
+    """The stale write-back: after B migrated the session, zombie A's
+    spill is REFUSED (resident dropped, session_fenced_spills) — B's
+    on-disk lineage survives and a third pool restores B's state."""
+    d = str(tmp_path / "pool")
+    env = env1
+    c1 = models.random_circuit(N, depth=2, seed=41)
+    c2 = models.random_circuit(N, depth=2, seed=42)
+    ref = qt.create_qureg(N, env)
+    c1.run(ref)
+    c2.run(ref)
+    pa = supervisor.SessionPool(env, d, worker="wA")
+    c1.run(pa.session("s", N))
+    pa.spill_all()
+    pa.session("s")  # the zombie re-holds its own (now stale) epoch
+    pb = supervisor.SessionPool(env, d, worker="wB")
+    qb = pb.session("s")
+    c2.run(qb)
+    pb.spill_all()  # disk now holds c1;c2 at B's epoch
+    before = metrics.counters()
+    pa.spill_all()  # the zombie write-back
+    assert _counter("supervisor.session_fenced_spills", before) == 1
+    assert "s" not in pa.names()  # stale resident dropped, not saved
+    pc = supervisor.SessionPool(env, d, worker="wC")
+    assert np.array_equal(qt.get_state_vector(pc.session("s")),
+                          qt.get_state_vector(ref))
+
+
+# ---------------------------------------------------------------------------
+# Audit surfacing (satellite b)
+# ---------------------------------------------------------------------------
+
+
+def test_audit_trail_surfaces_claim_lifecycle(env1, tmp_path,
+                                              monkeypatch):
+    """telemetry.audit_trail over a fleet journal: claim events carry
+    worker/epoch/expires, the per-key rollup counts claims, accepts
+    surface their submission sequence as submit_seq, and
+    trace_view.audit_table renders all of it."""
+    import trace_view
+
+    d = str(tmp_path / "journal")
+    env = env1
+    monkeypatch.setenv("QUEST_WORKER_ID", "wA")
+    circ = _measured_circ()
+    req = supervisor.BatchableRun(circ, env,
+                                  key=jax.random.PRNGKey(3),
+                                  trace_id="fleet-t0")
+    res = supervisor.serve([req], workers=1, max_batch=1,
+                           journal_dir=d, fleet=True)
+    assert res[0]["ok"]
+    doc = telemetry.audit_trail("fleet-t0", journal_dir=d)
+    telemetry.validate_audit_trail(doc)
+    key = req.idempotency_key
+    assert doc["requests"][key]["claims"] == 1
+    ev_claim = [e for e in doc["events"] if e["kind"] == "claim"]
+    assert ev_claim and ev_claim[0]["worker"] == "wA"
+    assert ev_claim[0]["epoch"] == 1
+    assert "expires" in ev_claim[0]
+    ev_accept = [e for e in doc["events"] if e["kind"] == "accept"]
+    assert ev_accept[0].get("submit_seq") == 0
+    table = trace_view.audit_table(doc)
+    assert "claim" in table and "worker=wA" in table
+    assert "claims 1" in table and "submit_seq=0" in table
+
+
+# ---------------------------------------------------------------------------
+# Fleet gauges (satellite f) and ledger_diff rules (satellite e)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_counters_export_as_quest_serve_gauges(env1, tmp_path,
+                                                     monkeypatch):
+    """The fleet counters ride the quest_serve_* gauge family, so
+    tools/fleet_agg.py aggregates them across workers with ZERO
+    changes (per-worker series + quest_fleet_* sums)."""
+    d = str(tmp_path / "journal")
+    monkeypatch.setenv("QUEST_WORKER_ID", "wA")
+    res = supervisor.serve(_reqs(env1, n=1), workers=1, max_batch=1,
+                           journal_dir=d, fleet=True)
+    assert res[0]["ok"]
+    text = metrics.export_text()
+    for g in ("quest_serve_claims", "quest_serve_claims_stolen",
+              "quest_serve_lease_renewals",
+              "quest_serve_fenced_completes",
+              "quest_serve_sessions_migrated"):
+        assert g in text
+    claims = [ln for ln in text.splitlines()
+              if ln.startswith("quest_serve_claims ")]
+    assert claims and float(claims[0].split()[1]) >= 1
+    # and the snapshot doc (what fleet_agg merges) carries them too
+    snap = metrics.snapshot()
+    assert snap["gauges"]["serve.claims"] >= 1
+
+
+def test_ledger_diff_fleet_rules_fire_both_directions():
+    import ledger_diff
+
+    base = {"supervisor.lease_double_run": 0,
+            "supervisor.fenced_completes_applied": 0}
+    old = {"metric": "chaos-q10-s24", "counters": dict(base)}
+    same = {"metric": "chaos-q10-s24", "counters": dict(base)}
+    v, _c, _s = ledger_diff.gate(old, same)
+    assert not [x for x in v if "lease" in x["key"]
+                or "fenced" in x["key"]]
+    for key in ("supervisor.lease_double_run",
+                "supervisor.fenced_completes_applied"):
+        worse = {"metric": "chaos-q10-s24",
+                 "counters": dict(base, **{key: 1})}
+        v, _c, _s = ledger_diff.gate(old, worse)
+        assert any(x["key"] == f"counters.{key}" for x in v), key
+        # and the rule is direction-aware: a HIGHER baseline healing
+        # back to zero is an improvement, not a violation
+        v, _c, _s = ledger_diff.gate(worse, old)
+        assert not any(x["key"] == f"counters.{key}" for x in v), key
+    # NOT config-bound (unlike poison_quarantined): a double-run is
+    # never acceptable, so a grown drill matrix does NOT excuse it —
+    # the tripwire fires across the config mismatch
+    worse2 = {"metric": "chaos-q10-s99",
+              "counters": dict(base,
+                               **{"supervisor.lease_double_run": 1})}
+    v, _c, skipped = ledger_diff.gate(old, worse2)
+    assert any(x["key"] == "counters.supervisor.lease_double_run"
+               for x in v)
+    assert ("counters.supervisor.lease_double_run",
+            "config mismatch") not in skipped
+
+
+# ---------------------------------------------------------------------------
+# Fleet ingress (tools/fleet_serve.py): stdlib mirrors + HTTP routes
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_serve_mirrors_pin_library_constants():
+    """The stdlib-only ingress re-states the journal framing; these
+    pins keep the mirrors from drifting."""
+    import fleet_serve
+
+    assert fleet_serve.JOURNAL == stateio.JOURNAL
+    assert fleet_serve.JOURNAL_META == stateio.JOURNAL_META
+    assert fleet_serve.JOURNAL_FORMAT_VERSION \
+        == stateio.JOURNAL_FORMAT_VERSION
+    assert fleet_serve.TRACE_CONTEXT_ENV == telemetry.TRACE_CONTEXT_ENV
+    rec = {"kind": "claim", "key": "k", "worker": "w", "epoch": 2,
+           "expires": 1.5}
+    assert fleet_serve.frame_record(rec) == stateio.frame_record(rec)
+
+
+def test_fleet_serve_append_interops_with_stateio(tmp_path,
+                                                  monkeypatch):
+    """Ingress-appended records read back through stateio (and vice
+    versa), including the sidecar and torn-tail healing."""
+    import fleet_serve
+
+    monkeypatch.delenv("QUEST_TRACE_CONTEXT", raising=False)
+    d = str(tmp_path / "journal")
+    rec = {"kind": "accept", "key": "k", "index": 0}
+    fleet_serve.append_records(d, [rec])
+    assert stateio.read_journal(d) == [rec]
+    meta = json.load(open(os.path.join(d, stateio.JOURNAL_META)))
+    assert meta["kind"] == "serve-journal"
+    # torn tail: healed by the next ingress append
+    path = os.path.join(d, stateio.JOURNAL)
+    with open(path, "a") as f:
+        f.write('{"crc": "dead", "rec": {"kind": "x"')
+    fleet_serve.append_records(d, [{"kind": "launch", "key": "k",
+                                    "attempt": 1}])
+    recs = stateio.read_journal(d)
+    assert [r["kind"] for r in recs] == ["accept", "launch"]
+
+
+def test_fleet_serve_fold_matches_supervisor_scan(env1, tmp_path):
+    """The ingress's stdlib journal fold agrees with the library's on
+    a real fleet-served journal: same backlog, same completed keys,
+    same claim winners, same fencing verdict."""
+    import fleet_serve
+
+    d = str(tmp_path / "journal")
+    env = env1
+    _seed_accepts(d, _reqs(env, n=2))
+    os.environ["QUEST_WORKER_ID"] = "wA"
+    try:
+        res = supervisor.serve(_reqs(env, n=2), workers=1,
+                               max_batch=1, journal_dir=d,
+                               fleet=True)
+    finally:
+        os.environ.pop("QUEST_WORKER_ID", None)
+    assert all(r["ok"] for r in res)
+    # a zombie's stale complete exercises the fencing verdict too
+    stateio.append_journal_entry(d, _claim("req-0", "wZ", 9, 1e12))
+    stateio.append_journal_entry(
+        d, {"kind": "complete", "key": "req-1", "outcomes": [9],
+            "digest": "o:bad", "worker": "wY", "epoch": 0})
+    st = supervisor._journal_scan(d)
+    fs = fleet_serve.fold_journal(d)
+    assert set(fs["completed"]) == set(st["completed"])
+    assert fs["backlog"] == [k for k in st["order"]
+                             if k not in st["completed"]
+                             and k not in st["quarantined"]]
+    assert {k: (c["worker"], c["epoch"])
+            for k, c in fs["claims"].items()} \
+        == {k: (c["worker"], c["epoch"])
+            for k, c in st["claims"].items()}
+
+
+def test_fleet_ingress_http_routes(env1, tmp_path):
+    """The HTTP surface in-thread (no subprocesses): submit journals
+    an accept, duplicate submit dedupes, status/result track the
+    lifecycle, readyz sums worker gauges, bad requests 400, and the
+    backlog overload sheds 503 with retry_after_s WITHOUT
+    journaling."""
+    import fleet_serve
+    import metrics_serve
+
+    d = str(tmp_path / "journal")
+    snapdir = str(tmp_path / "snaps")
+    os.makedirs(snapdir)
+    fleet_serve.FleetHandler.journal_dir = d
+    fleet_serve.FleetHandler.snapdir = snapdir
+    fleet_serve.FleetHandler.max_backlog = 3
+    fleet_serve.FleetHandler.fleet_view = staticmethod(
+        lambda: [{"id": "fleet-w0", "pid": 1, "alive": True}])
+    server, port = metrics_serve.start_in_thread(
+        0, handler=fleet_serve.FleetHandler)
+    base = f"http://127.0.0.1:{port}"
+    env = env1
+    circ = _measured_circ()
+    ops = supervisor._encode_ops(circ.ops)
+
+    def post(doc):
+        req = urllib.request.Request(
+            base + "/submit", data=json.dumps(doc).encode(),
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def get(path, expect_json=True):
+        try:
+            with urllib.request.urlopen(base + path, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            return e.code, (json.loads(body) if expect_json else body)
+
+    try:
+        code, doc = post({"ops": ops, "num_qubits": N, "key": "h0",
+                          "trace_id": "t-h0",
+                          "prng": supervisor._encode_prng(
+                              jax.random.PRNGKey(4))})
+        assert (code, doc["state"]) == (200, "accepted")
+        code, doc = post({"ops": ops, "num_qubits": N, "key": "h0"})
+        assert doc.get("deduped") is True
+        code, doc = post({"ops": "nope", "num_qubits": N})
+        assert code == 400 and doc["error"] == "bad_request"
+        code, doc = post({"ops": ops, "num_qubits": 0})
+        assert code == 400
+        assert get("/status?key=h0")[1]["state"] == "accepted"
+        assert get("/status?key=nope")[0] == 404
+        code, doc = get("/result?key=h0")
+        assert (code, doc["state"]) == (202, "pending")
+        code, doc = get("/readyz")
+        assert code == 200 and doc["journal_backlog"] == 1
+        assert doc["workers_alive"] == 1
+        assert "serve.journal_backlog" in doc["fleet_gauges"]
+        # drain as a fleet worker (in-process), then the result lands
+        rq = supervisor.recover_queue(d, env)
+        os.environ["QUEST_WORKER_ID"] = "fleet-w0"
+        try:
+            res = supervisor.serve(rq["requests"], workers=1,
+                                   max_batch=1, journal_dir=d,
+                                   fleet=True)
+        finally:
+            os.environ.pop("QUEST_WORKER_ID", None)
+        assert all(r["ok"] for r in res)
+        code, doc = get("/result?key=h0")
+        assert (code, doc["state"]) == (200, "done")
+        assert doc["worker"] == "fleet-w0" and doc["epoch"] == 1
+        assert doc["trace_id"] == "t-h0"
+        assert isinstance(doc["outcomes"], list)
+        # overload: fill the backlog past max_backlog, then shed
+        for i in range(3):
+            post({"ops": ops, "num_qubits": N, "key": f"ov-{i}"})
+        before = len(stateio.read_journal(d))
+        code, doc = post({"ops": ops, "num_qubits": N, "key": "ov-x"})
+        assert code == 503
+        assert doc["error"] == "QuESTOverloadError"
+        assert doc["retry_after_s"] > 0
+        assert len(stateio.read_journal(d)) == before  # nothing wrote
+        code, doc = get("/readyz")
+        assert code == 503 and doc["retry_after_s"] > 0
+        assert get("/healthz")[0] == 200
+        assert get("/metrics", expect_json=False)[0] == 404
+    finally:
+        server.shutdown()
+
+
+def test_fleet_snapshot_probe_helpers(tmp_path, monkeypatch):
+    """The ingress's stdlib snapshot reader agrees with the library's
+    writer: gauges sum across workers, torn spills are skipped."""
+    import fleet_serve
+
+    snapdir = str(tmp_path / "snaps")
+    os.makedirs(snapdir)
+    for wid, backlog in (("w1", 2.0), ("w2", 3.0)):
+        monkeypatch.setenv("QUEST_WORKER_ID", wid)
+        snap = metrics.snapshot()
+        snap["gauges"]["serve.journal_backlog"] = backlog
+        metrics.write_snapshot(snapdir, snap=snap)
+    sums = fleet_serve.sum_fleet_gauges(
+        snapdir, ("serve.journal_backlog",))
+    assert sums["serve.journal_backlog"] == 5.0
+    # a torn spill is skipped, not summed
+    with open(os.path.join(snapdir, "snap-w1.json"), "w") as f:
+        f.write('{"crc": "00000000", "snap"')
+    sums = fleet_serve.sum_fleet_gauges(
+        snapdir, ("serve.journal_backlog",))
+    assert sums["serve.journal_backlog"] == 3.0
+    ages = fleet_serve.snapshot_ages(snapdir)
+    assert {a["worker"]: a["readable"] for a in ages} \
+        == {"w1": False, "w2": True}

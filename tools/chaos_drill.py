@@ -105,6 +105,29 @@ each scenario's recovery contract:
   crash (never a third launch), complete every other request, and end
   the supervise chain with exit 0 — one bad request can no longer
   crash-loop the service.
+* ``fleet_worker_kill``   — two real ``tools/fleet_serve.py --worker``
+  subprocesses drain ONE shared journal under the leased claim
+  protocol; the worker that launched first is SIGKILLed mid-backlog.
+  The survivor must reclaim the lapsed leases with higher-epoch
+  claims and finish the backlog EXACTLY-ONCE (one applied
+  ``complete`` per key), outcomes bit-identical to an uninterrupted
+  serve, every worker-written record carrying its own chain's ONE
+  trace context, and the survivor still drains to exit 0.
+* ``fleet_lease_fencing`` — the zombie drill: worker A claims the only
+  key and is SIGSTOPped mid-run (heartbeat frozen, not dead); worker B
+  reclaims the lapsed lease with an epoch-2 claim and completes;
+  SIGCONT resumes A, whose late epoch-1 ``complete`` must be
+  RECORDED-BUT-IGNORED (fenced in the fold and the audit view, never
+  double-applied) while A still exits 0 — plus an in-process
+  session-fence coda proving the same zombie's stale session spill is
+  refused after a migration.
+* ``fleet_session_migrate`` — a named session runs c1 on worker A's
+  pool, spills, and MIGRATES to worker B's pool over the shared spill
+  directory (fencing epoch bumped before the restore,
+  ``sessions_migrated`` counted); after c2 on B the state must be
+  bit-identical to c1;c2 uninterrupted, zombie A's stale write-back
+  refused (``session_fenced_spills``), and a third pool's restore
+  must see B's lineage.
 
 Every scenario must end in either a clean recovery (with the
 resilience counters recorded) or a ``QuESTError`` naming the seam —
@@ -132,9 +155,11 @@ import json
 import os
 import re
 import shutil
+import signal
 import subprocess
 import sys
 import tempfile
+import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 if REPO not in sys.path:
@@ -1415,6 +1440,307 @@ def drill_poison_quarantine(circ, env, ndev, pallas):
         shutil.rmtree(td, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Fleet serving drills (ISSUE 18): leased claims over one shared journal
+# ---------------------------------------------------------------------------
+
+
+def _fleet_reqs(env, n=4):
+    import jax
+
+    circ = models.qft(6)
+    circ.measure(0)
+    circ.measure(3)
+    keys = jax.random.split(jax.random.PRNGKey(7), n)
+    return [supervisor.BatchableRun(circ, env, key=keys[i],
+                                    trace_id=f"fleet-tr-{i}",
+                                    idempotency_key=f"req-{i}")
+            for i in range(n)]
+
+
+def _seed_fleet_journal(jdir, reqs):
+    """Append the backlog's accept records (what the fleet ingress
+    does over HTTP) so the worker subprocesses find work to claim."""
+    from quest_tpu import stateio
+
+    recs = [supervisor._accept_record(r, r.idempotency_key, i,
+                                      supervisor.poison_attempts())
+            for i, r in enumerate(reqs)]
+    stateio.append_journal_entries(jdir, recs)
+
+
+def _spawn_fleet_worker(wid, jdir, snapdir, lease, td, *,
+                        poll=0.05, extra=None):
+    """One ``tools/fleet_serve.py --worker`` subprocess: its own
+    worker id, its own trace chain, fleet mode armed, 1 CPU device
+    (the drill parent's 8-device XLA_FLAGS must not leak in)."""
+    env = dict(os.environ)
+    env.update({"QUEST_WORKER_ID": wid, "QUEST_FLEET_WORKER": "1",
+                "QUEST_METRICS_SNAPDIR": snapdir,
+                "QUEST_TRACE_CONTEXT": f"chain-{wid}",
+                "QUEST_LEASE_S": str(lease),
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS":
+                    "--xla_force_host_platform_device_count=1"})
+    env.update(extra or {})
+    err = open(os.path.join(td, f"{wid}.stderr"), "w")
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "fleet_serve.py"),
+         "--worker", "--journal", jdir, "--poll", str(poll)],
+        env=env, cwd=REPO, stdout=subprocess.DEVNULL, stderr=err)
+
+
+def _wait_for(pred, timeout_s, poll=0.05):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def _stop_worker(proc, timeout=90):
+    """Graceful drain: SIGTERM, bounded wait, SIGKILL stragglers.
+    Returns the exit code (None only if even the kill hung)."""
+    if proc.poll() is None:
+        try:
+            proc.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+    try:
+        return proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.wait(timeout=10)
+
+
+def drill_fleet_worker_kill(circ, env, ndev, pallas):
+    # two real fleet workers drain one shared journal; the one that
+    # launched first is SIGKILLed mid-backlog.  The survivor must
+    # reclaim the dead worker's expired leases with higher-epoch
+    # claims and finish the backlog EXACTLY-ONCE, with outcomes
+    # bit-identical to an uninterrupted serve and every worker-written
+    # record carrying its own chain's ONE trace context.
+    from quest_tpu import stateio
+
+    td = tempfile.mkdtemp(prefix="chaos-fleet-kill-")
+    wa = wb = None
+    try:
+        jdir = os.path.join(td, "journal")
+        snapdir = os.path.join(td, "snaps")
+        os.makedirs(snapdir)
+        reqs = _fleet_reqs(env)
+        ref = supervisor.serve(_fleet_reqs(env),
+                               journal_dir=os.path.join(td, "jref"),
+                               max_batch=1)
+        ref_out = [[int(x) for x in
+                    np.asarray(r["value"]["outcomes"])
+                    .reshape(-1).tolist()] for r in ref]
+        _seed_fleet_journal(jdir, reqs)
+        # slow every item so the SIGKILL lands with work in flight
+        slow = ";".join(f"run_item:{h}:delay:700" for h in range(4))
+        wa = _spawn_fleet_worker("fleet-wA", jdir, snapdir, 1.0, td,
+                                 extra={"QUEST_FAULT_PLAN": slow})
+        saw_launch = _wait_for(
+            lambda: any(r.get("kind") == "launch"
+                        for r in stateio.read_journal(jdir)), 240)
+        if saw_launch:
+            wa.kill()  # SIGKILL: no drain, no checkpoint, no goodbye
+            wa.wait(timeout=30)
+        wb = _spawn_fleet_worker("fleet-wB", jdir, snapdir, 1.0, td)
+
+        def _drained():
+            st = supervisor.recover_queue(jdir)
+            return (not st["backlog"]
+                    and len(st["completed"]) == len(reqs))
+
+        drained = _wait_for(_drained, 240)
+        rc_b = _stop_worker(wb)
+        st = supervisor._journal_scan(jdir)
+        cc = _journal_complete_counts(jdir)
+        exactly_once = (sorted(cc) == [f"req-{i}" for i in range(4)]
+                        and set(cc.values()) == {1})
+        outcomes_equal = drained and [
+            st["completed"][f"req-{i}"].get("outcomes")
+            for i in range(4)] == ref_out
+        no_double = sum(st["double"].values()) == 0
+        # the survivor's claims outrank the dead worker's
+        stolen = any(c["worker"] == "fleet-wB" and c["epoch"] > 1
+                     for c in st["claims"].values())
+        # one trace context per worker chain, on every record that
+        # worker wrote (claim/launch/complete carry the worker field)
+        ctxs = {}
+        for r in stateio.read_journal(jdir):
+            if r.get("kind") in ("claim", "launch", "complete"):
+                ctxs.setdefault(r.get("worker"), set()).add(
+                    r.get("ctx"))
+        one_ctx_per_chain = bool(ctxs) and all(
+            v == {f"chain-{w}"} for w, v in ctxs.items())
+        ok = (saw_launch and drained and rc_b == 0 and exactly_once
+              and outcomes_equal and no_double and stolen
+              and one_ctx_per_chain)
+        record("fleet_worker_kill", ok, saw_launch=saw_launch,
+               drained=drained, survivor_rc=rc_b,
+               exactly_once=exactly_once,
+               outcomes_equal=outcomes_equal, no_double=no_double,
+               leases_stolen=stolen,
+               one_ctx_per_chain=one_ctx_per_chain,
+               complete_counts=cc)
+    finally:
+        for p in (wa, wb):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def drill_fleet_lease_fencing(circ, env, ndev, pallas):
+    # the zombie-worker drill: worker A claims the only key and is
+    # SIGSTOPped mid-run (its heartbeat freezes, the realistic zombie
+    # — not dead, just not renewing).  Worker B reclaims the lapsed
+    # lease with an epoch-2 claim and completes.  SIGCONT resumes A,
+    # whose late epoch-1 complete must be RECORDED-BUT-IGNORED (the
+    # fold fences it; never double-applied), and A still drains to
+    # exit 0.  An in-process session-fence coda proves the same
+    # zombie cannot clobber a migrated session either.
+    from quest_tpu import stateio
+
+    td = tempfile.mkdtemp(prefix="chaos-fleet-fence-")
+    wa = wb = None
+    try:
+        jdir = os.path.join(td, "journal")
+        snapdir = os.path.join(td, "snaps")
+        os.makedirs(snapdir)
+        reqs = _fleet_reqs(env, n=1)
+        _seed_fleet_journal(jdir, reqs)
+        key = reqs[0].idempotency_key
+        wa = _spawn_fleet_worker(
+            "fleet-wA", jdir, snapdir, 0.6, td,
+            extra={"QUEST_FAULT_PLAN": "run_item:0:delay:8000"})
+        saw_launch = _wait_for(
+            lambda: any(r.get("kind") == "launch"
+                        and r.get("worker") == "fleet-wA"
+                        for r in stateio.read_journal(jdir)), 240)
+        if saw_launch:
+            os.kill(wa.pid, signal.SIGSTOP)  # freeze mid-delay
+        wb = _spawn_fleet_worker("fleet-wB", jdir, snapdir, 0.6, td)
+
+        def _b_completed():
+            st = supervisor._journal_scan(jdir)
+            rec = st["completed"].get(key)
+            return rec is not None and rec.get("worker") == "fleet-wB"
+
+        b_done = _wait_for(_b_completed, 240)
+        rc_b = _stop_worker(wb)
+        if saw_launch:
+            os.kill(wa.pid, signal.SIGCONT)  # the zombie resumes
+        late = _wait_for(
+            lambda: _journal_complete_counts(jdir).get(key, 0) >= 2,
+            120)
+        rc_a = _stop_worker(wa)
+        st = supervisor._journal_scan(jdir)
+        applied = st["completed"].get(key, {})
+        applied_is_b = (applied.get("worker") == "fleet-wB"
+                        and applied.get("epoch") == 2)
+        fenced = st["fenced"].get(key, 0) >= 1
+        no_double = sum(st["double"].values()) == 0
+        claim = supervisor.recover_queue(jdir)["claims"].get(key, {})
+        audit_fenced = (claim.get("claimed_by") == "fleet-wB"
+                        and claim.get("epoch") == 2
+                        and claim.get("fenced", 0) >= 1)
+        # session-fence coda: zombie A's stale write-back is refused
+        d = os.path.join(td, "sessions")
+        c1 = models.random_circuit(6, depth=2, seed=11)
+        c0 = metrics.counters()
+        pa = supervisor.SessionPool(env, d, worker="wA")
+        c1.run(pa.session("s", 6))
+        pa.spill_all()                      # disk: c1, epoch 1
+        pa.session("s")                     # A re-holds at epoch 2
+        pb = supervisor.SessionPool(env, d, worker="wB")
+        pb.session("s")                     # migrates: epoch 3
+        pa.spill_all()                      # zombie write-back
+        c1c = metrics.counters()
+        migrated = (c1c.get("supervisor.sessions_migrated", 0)
+                    - c0.get("supervisor.sessions_migrated", 0)) >= 1
+        fenced_spill = (c1c.get("supervisor.session_fenced_spills", 0)
+                        - c0.get("supervisor.session_fenced_spills",
+                                 0)) >= 1
+        ok = (saw_launch and b_done and late and rc_a == 0
+              and rc_b == 0 and applied_is_b and fenced and no_double
+              and audit_fenced and migrated and fenced_spill)
+        record("fleet_lease_fencing", ok, saw_launch=saw_launch,
+               stolen_completed_by_b=b_done, zombie_rc=rc_a,
+               survivor_rc=rc_b, late_complete_recorded=late,
+               applied_is_epoch2=applied_is_b, fenced=fenced,
+               no_double_run=no_double, audit_fenced=audit_fenced,
+               session_migrated=migrated,
+               zombie_spill_refused=fenced_spill)
+    finally:
+        for p in (wa, wb):
+            if p is not None and p.poll() is None:
+                try:
+                    os.kill(p.pid, signal.SIGCONT)  # un-freeze first:
+                    # SIGKILL is uncatchable but a STOPped process
+                    # still needs the CONT to die promptly
+                except OSError:
+                    pass
+                p.kill()
+                p.wait()
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def drill_fleet_session_migrate(circ, env, ndev, pallas):
+    # cross-worker session migration: worker A's pool runs c1 on a
+    # named session and spills; worker B's pool (same directory,
+    # different worker id) restores it — counted as a MIGRATION, the
+    # per-session fencing epoch bumped BEFORE the restore — runs c2
+    # and spills.  The migrated lineage must be bit-identical to
+    # c1;c2 on one uninterrupted register, the zombie A's stale
+    # write-back refused, and a third pool's restore must see B's
+    # state (the refusal provably protected the migrated lineage).
+    td = tempfile.mkdtemp(prefix="chaos-fleet-migrate-")
+    try:
+        d = os.path.join(td, "sessions")
+        nq = 6
+        c1 = models.random_circuit(nq, depth=2, seed=21)
+        c2 = models.random_circuit(nq, depth=2, seed=22)
+        ref = qt.create_qureg(nq, env)
+        c1.run(ref)
+        c2.run(ref)
+        want = qt.get_state_vector(ref)
+        c0 = metrics.counters()
+        pa = supervisor.SessionPool(env, d, worker="wA")
+        c1.run(pa.session("s", nq))
+        pa.spill_all()                      # disk: c1, fence epoch 1
+        pa.session("s")                     # zombie A re-holds (ep 2)
+        pb = supervisor.SessionPool(env, d, worker="wB")
+        qb = pb.session("s")                # migrate: epoch 3
+        c2.run(qb)
+        migrated_equal = np.array_equal(qt.get_state_vector(qb), want)
+        pb.spill_all()                      # disk: c1;c2, epoch 3
+        pa.spill_all()                      # zombie write-back: must
+        #                                     be refused, not clobber
+        c1c = metrics.counters()
+        migrated = (c1c.get("supervisor.sessions_migrated", 0)
+                    - c0.get("supervisor.sessions_migrated", 0)) >= 1
+        fenced_spill = (c1c.get("supervisor.session_fenced_spills", 0)
+                        - c0.get("supervisor.session_fenced_spills",
+                                 0)) >= 1
+        pc = supervisor.SessionPool(env, d, worker="wC")
+        restored_equal = np.array_equal(
+            qt.get_state_vector(pc.session("s")), want)
+        ok = (migrated_equal and migrated and fenced_spill
+              and restored_equal)
+        record("fleet_session_migrate", ok,
+               migrated_equal=migrated_equal,
+               migration_counted=migrated,
+               zombie_spill_refused=fenced_spill,
+               survives_restart_equal=restored_equal)
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 #: The scenario matrix, in execution order: (name, needs_ref, runner).
 #: ``needs_ref`` tells the per-scenario subprocess whether to pay for
 #: the 8-device reference run (the bit-identity oracle) — scenarios
@@ -1463,6 +1789,12 @@ SCENARIOS = [
      lambda c, e, n, p, r: drill_serve_crash_replay(c, e, n, p)),
     ("poison_quarantine", False,
      lambda c, e, n, p, r: drill_poison_quarantine(c, e, n, p)),
+    ("fleet_worker_kill", False,
+     lambda c, e, n, p, r: drill_fleet_worker_kill(c, e, n, p)),
+    ("fleet_lease_fencing", False,
+     lambda c, e, n, p, r: drill_fleet_lease_fencing(c, e, n, p)),
+    ("fleet_session_migrate", False,
+     lambda c, e, n, p, r: drill_fleet_session_migrate(c, e, n, p)),
 ]
 
 #: Per-SCENARIO subprocess wall budget (QUEST_CHAOS_SCENARIO_TIMEOUT_S):

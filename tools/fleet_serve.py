@@ -1,0 +1,781 @@
+#!/usr/bin/env python3
+"""Fleet serving front end: N worker processes, one shared journal.
+
+``tools/supervise.py`` restarts ONE process; this runner scales the
+durable-serving story OUT — it launches ``--workers`` worker processes
+(each a fresh Python running ``supervisor.serve`` in fleet mode against
+the SAME write-ahead journal directory) and an HTTP ingress in the
+parent, so a request submitted once completes exactly-once even when
+the worker that picked it up is SIGKILLed mid-backlog:
+
+* every worker runs with ``QUEST_FLEET_WORKER=1``, which arms the
+  LEASED CLAIM PROTOCOL in ``supervisor.serve`` (claim records with
+  worker id + monotonic fencing epoch + lease expiry appended before
+  ``launch``; peers honour live leases, reclaim expired ones with a
+  higher epoch, and a fenced worker's late ``complete`` is
+  recorded-but-ignored — see ``docs/ROBUSTNESS.md``, "Fleet serving");
+* each worker gets its own ``QUEST_TRACE_CONTEXT`` chain (the
+  ``tools/supervise.py`` contract: one context per relaunch chain, so
+  journal records name the chain that wrote them) and its own
+  ``QUEST_WORKER_ID`` (``fleet-w<i>``), and spills metric snapshots
+  into a shared ``--snapdir`` that ``tools/fleet_agg.py`` merges
+  UNCHANGED — the parent's ``/readyz`` and ``/metrics/fleet`` are
+  that aggregation over live HTTP;
+* a worker that dies is relaunched (same worker id, next attempt in
+  the SAME trace chain) up to ``--max-restarts`` times; past the
+  budget it stays down and the survivors drain its claims — the
+  journal, not the process, owns the backlog;
+* SIGTERM to the parent forwards SIGTERM to every worker (the
+  cooperative preemption drain from ``supervisor.
+  install_preemption_handler``), waits, and exits 0 — the fleet-wide
+  graceful drain.
+
+The parent is STDLIB-ONLY (the ``tools/supervise.py`` rule: the
+process that survives the simulator must not import it — no jax, no
+quest_tpu).  Its HTTP ingress therefore appends ``accept`` records
+with a byte-compatible local implementation of the journal framing
+(CRC32 over canonical sorted-keys JSON, O_APPEND + fsync, torn-tail
+heal, ``journal.json`` sidecar — mirrors of ``stateio``, pinned equal
+by ``tests/test_fleet_serving.py``) and answers status/result queries
+by folding the journal directly.
+
+HTTP API (extends ``tools/metrics_serve.py``; same handler idioms)::
+
+    POST /submit          {"ops": [...], "num_qubits": n, ...}
+                          -> {"key": ..., "state": "accepted"}
+                          (503 + retry_after_s when the journal
+                          backlog exceeds --max-backlog: typed
+                          overload shed, nothing journaled)
+    GET  /status?key=K    -> {"state": accepted|running|done|
+                              quarantined, "claim": {...}}
+    GET  /result?key=K    -> journaled outcomes/digest/trace (200),
+                          202 while pending, 404 unknown
+    GET  /readyz          fleet readiness: per-worker backlog and
+                          in-flight gauges summed from the snapshot
+                          directory plus the journal's own backlog
+    GET  /healthz         per-worker snapshot staleness (fleet_agg)
+    GET  /metrics/fleet   merged fleet exposition (fleet_agg)
+
+Worker mode (``--worker``, launched by the parent — not user-facing)
+imports quest_tpu and loops: recover the journal backlog, serve it
+with ``fleet=True``, spill a metric snapshot, sleep ``--poll``; a
+SIGTERM drains cooperatively and exits 0.
+
+Usage::
+
+    python tools/fleet_serve.py --journal DIR [--workers N]
+        [--port P] [--max-restarts N] [--max-backlog N]
+        [--lease S] [--poll S]
+
+Exit status: 0 on a signalled drain or completed ``--max-loops``
+smoke, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import metrics_serve  # noqa: E402  (sibling; stdlib-only at import)
+
+#: Journal file names — MIRRORS of ``stateio.JOURNAL`` /
+#: ``stateio.JOURNAL_META`` / ``stateio.JOURNAL_FORMAT_VERSION`` (this
+#: parent is stdlib-only and cannot import them;
+#: ``tests/test_fleet_serving.py`` pins the values equal).
+JOURNAL = "journal.jsonl"
+JOURNAL_META = "journal.json"
+JOURNAL_FORMAT_VERSION = 1
+
+#: Mirror of ``telemetry.TRACE_CONTEXT_ENV`` (same pin).
+TRACE_CONTEXT_ENV = "QUEST_TRACE_CONTEXT"
+
+#: Fleet membership manifest written into the journal directory.
+FLEET_MANIFEST = "fleet.json"
+
+MAX_RESTARTS_DEFAULT = 3
+MAX_BACKLOG_DEFAULT = 64
+POLL_DEFAULT = 0.2
+
+_append_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# Stdlib journal codec (byte-compatible with stateio's framing)
+# ---------------------------------------------------------------------------
+
+
+def _crc(body: str) -> str:
+    return f"{zlib.crc32(body.encode()):08x}"
+
+
+def frame_record(rec: dict) -> str:
+    """One CRC32-framed JSON line, bytes-equal to
+    ``stateio.frame_record`` for the same record."""
+    body = json.dumps(rec, sort_keys=True)
+    return json.dumps({"crc": _crc(body), "rec": rec}, sort_keys=True)
+
+
+def _heal_torn_tail(path: str) -> None:
+    """``stateio._heal_torn_tail``'s verdict, stdlib-side: a
+    newline-less tail that parses and passes its CRC is terminated in
+    place; one that fails either check is the unacknowledged in-flight
+    append and is truncated."""
+    if not os.path.getsize(path):
+        return
+    with open(path, "rb+") as f:
+        f.seek(-1, os.SEEK_END)
+        if f.read(1) == b"\n":
+            return
+        f.seek(0)
+        data = f.read()
+        tail = data[data.rfind(b"\n") + 1:]
+        try:
+            frame = json.loads(tail.decode())
+            ok = (_crc(json.dumps(frame["rec"], sort_keys=True))
+                  == frame["crc"])
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            ok = False
+        if ok:
+            f.write(b"\n")
+            return
+        f.truncate(len(data) - len(tail))
+
+
+def append_records(directory: str, recs: list[dict]) -> None:
+    """Durably append records to the shared serve journal — the
+    ingress-side twin of ``stateio.append_journal_entries``: sidecar
+    on first use, trace-context stamping, torn-tail heal, then ONE
+    O_APPEND write + flush + fsync for the whole batch."""
+    if not recs:
+        return
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    meta_path = os.path.join(directory, JOURNAL_META)
+    if not os.path.isfile(meta_path):
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"format_version": JOURNAL_FORMAT_VERSION,
+                       "kind": "serve-journal"}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, meta_path)
+    ctx = os.environ.get(TRACE_CONTEXT_ENV) or None
+    if ctx:
+        recs = [r if "ctx" in r else {**r, "ctx": ctx} for r in recs]
+    lines = "".join(frame_record(r) + "\n" for r in recs)
+    path = os.path.join(directory, JOURNAL)
+    with _append_lock:
+        if os.path.isfile(path):
+            _heal_torn_tail(path)
+        with open(path, "a") as f:
+            f.write(lines)
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def read_journal(directory: str) -> list[dict]:
+    """Every valid record under ``directory`` — the lenient read:
+    torn tails and interior damage are SKIPPED (the workers own the
+    warn/count semantics; the ingress only needs the surviving
+    records to answer status queries)."""
+    path = os.path.join(os.path.abspath(directory), JOURNAL)
+    if not os.path.isfile(path):
+        return []
+    out = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                frame = json.loads(raw)
+                rec = frame["rec"]
+                if _crc(json.dumps(rec, sort_keys=True)) != frame["crc"]:
+                    continue
+            except (ValueError, KeyError, TypeError):
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def fold_journal(directory: str) -> dict:
+    """The ingress's view of the shared journal: per-key state for
+    ``/status`` and ``/result``, plus the backlog count ``/submit``
+    sheds on.  A tiny stdlib re-statement of ``supervisor.
+    _journal_scan``'s fold (first accept per key wins, launches
+    count, first epoch-valid complete wins — higher claim epoch
+    replaces, stale-epoch completes are fenced)."""
+    accepted: dict = {}
+    order: list = []
+    launches: dict = {}
+    completed: dict = {}
+    quarantined: set = set()
+    claims: dict = {}
+    for rec in read_journal(directory):
+        kind = rec.get("kind")
+        key = rec.get("key")
+        if not isinstance(key, str):
+            continue
+        if kind == "accept":
+            if key not in accepted:
+                accepted[key] = rec
+                order.append(key)
+        elif kind == "launch":
+            launches[key] = launches.get(key, 0) + 1
+        elif kind == "claim":
+            epoch = rec.get("epoch")
+            if not isinstance(epoch, int) or isinstance(epoch, bool):
+                continue
+            cur = claims.get(key)
+            if cur is None or epoch > cur["epoch"]:
+                claims[key] = {"worker": rec.get("worker"),
+                               "epoch": epoch,
+                               "expires": rec.get("expires")}
+        elif kind == "complete":
+            epoch = rec.get("epoch")
+            cur = claims.get(key)
+            stale = (isinstance(epoch, int) and cur is not None
+                     and epoch < cur["epoch"])
+            if key not in completed and not stale:
+                completed[key] = rec
+        elif kind == "quarantine":
+            quarantined.add(key)
+    backlog = [k for k in order
+               if k not in completed and k not in quarantined]
+    return {"accepted": accepted, "order": order, "launches": launches,
+            "completed": completed, "quarantined": quarantined,
+            "claims": claims, "backlog": backlog}
+
+
+# ---------------------------------------------------------------------------
+# Stdlib snapshot reader (the probe half of tools/fleet_agg.py)
+# ---------------------------------------------------------------------------
+
+
+def read_snap(path: str) -> dict | None:
+    """One spilled metric snapshot (``metrics.write_snapshot``'s
+    CRC32 frame under ``"snap"``), or None when torn/corrupt — the
+    stdlib twin of ``metrics.read_snapshot`` for the ingress's
+    probes (no counting: the workers own corruption telemetry)."""
+    try:
+        with open(path) as f:
+            frame = json.loads(f.read())
+        snap = frame["snap"]
+        if _crc(json.dumps(snap, sort_keys=True)) != frame["crc"]:
+            return None
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    return snap if isinstance(snap, dict) else None
+
+
+def sum_fleet_gauges(snapdir: str, keys: tuple) -> dict:
+    """Per-worker gauges summed across every readable ``snap-*.json``
+    — the ``/readyz`` aggregation.  One file per worker
+    (``write_snapshot`` replaces in place), so a directory scan never
+    double-counts a worker."""
+    out = {k: 0.0 for k in keys}
+    try:
+        names = sorted(os.listdir(snapdir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("snap-") and name.endswith(".json")):
+            continue
+        snap = read_snap(os.path.join(snapdir, name))
+        if not snap:
+            continue
+        g = snap.get("gauges") or {}
+        for k in keys:
+            try:
+                out[k] += float(g.get(k, 0))
+            except (TypeError, ValueError):
+                pass
+    return out
+
+
+def snapshot_ages(snapdir: str) -> list[dict]:
+    """Per-snapshot worker id + age rows for ``/healthz`` (mtime
+    based; the full staleness verdict lives in fleet_agg)."""
+    rows = []
+    try:
+        names = sorted(os.listdir(snapdir))
+    except OSError:
+        return rows
+    now = time.time()
+    for name in names:
+        if not (name.startswith("snap-") and name.endswith(".json")):
+            continue
+        path = os.path.join(snapdir, name)
+        snap = read_snap(path)
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue
+        rows.append({"worker": (snap or {}).get("worker",
+                                                name[5:-5]),
+                     "age_s": round(age, 3),
+                     "readable": snap is not None})
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# HTTP ingress
+# ---------------------------------------------------------------------------
+
+
+def _submit_record(doc: dict, key: str, index: int) -> dict:
+    """An ``accept`` record from a ``/submit`` body — the same shape
+    ``supervisor._accept_record`` writes (the workers' replay path
+    reconstructs the request from these fields alone)."""
+    nq = doc.get("num_qubits")
+    if not isinstance(nq, int) or isinstance(nq, bool) or nq < 1:
+        raise ValueError("num_qubits must be a positive int")
+    ops = doc.get("ops")
+    if not isinstance(ops, list):
+        raise ValueError("ops must be a list (supervisor._encode_ops "
+                         "form)")
+    dtype = doc.get("dtype")
+    if dtype is not None and not isinstance(dtype, str):
+        raise ValueError("dtype must be a string or null")
+    return {"kind": "accept", "key": key,
+            "tenant": doc.get("tenant") or "default",
+            "trace_id": doc.get("trace_id"),
+            "num_qubits": nq,
+            "is_density": bool(doc.get("is_density")),
+            "dtype": dtype,
+            "prng": doc.get("prng"),
+            "ops": ops,
+            "attempts": int(os.environ.get("QUEST_POISON_ATTEMPTS",
+                                           2)),
+            "index": int(index)}
+
+
+class FleetHandler(metrics_serve.MetricsHandler):
+    """The fleet ingress: ``MetricsHandler``'s transport idioms
+    (``_send``, threading server, silenced logging) with a FULL route
+    override — the parent stays stdlib-only, and the base class's
+    ``/metrics`` imports quest_tpu, so no route may fall through to
+    it.  The operational probes (``/readyz``, ``/healthz``) aggregate
+    worker snapshots with the local stdlib reader; only the
+    diagnostic ``/metrics/fleet`` exposition defers to
+    ``tools/fleet_agg.py`` (lazy quest_tpu import, 503 when
+    unavailable — a broken simulator install must not take down the
+    ingress probes)."""
+
+    #: Configured by serve_fleet() before the server starts.
+    journal_dir: str = ""
+    snapdir: str = ""
+    max_backlog: int = MAX_BACKLOG_DEFAULT
+    fleet_view = None  # () -> list of worker rows (id/pid/alive)
+
+    #: Serializes submit's backlog-check + append (two racing submits
+    #: must not both pass one remaining backlog slot).
+    _submit_lock = threading.Lock()
+    _submit_seq = [0]
+
+    def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+        path, _, query = self.path.partition("?")
+        params = {}
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k:
+                params[k] = v
+        if path == "/status":
+            self._get_status(params.get("key", ""))
+        elif path == "/result":
+            self._get_result(params.get("key", ""))
+        elif path == "/readyz":
+            self._get_readyz()
+        elif path == "/healthz":
+            self._get_healthz()
+        elif path == "/metrics/fleet":
+            self._get_metrics_fleet()
+        elif path == "/":
+            self._send(200, "quest-tpu fleet ingress: POST /submit; "
+                            "GET /status?key= /result?key= /readyz "
+                            "/healthz /metrics/fleet\n",
+                       "text/plain")
+        else:
+            self._send(404, "not found (fleet ingress routes: "
+                            "/submit /status /result /readyz "
+                            "/healthz /metrics/fleet)\n",
+                       "text/plain")
+
+    def do_POST(self):  # noqa: N802
+        if self.path.partition("?")[0] != "/submit":
+            self._send(404, "not found\n", "text/plain")
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n).decode() or "{}")
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, TypeError) as e:
+            self._send(400, json.dumps({"error": "bad_request",
+                                        "message": str(e)}) + "\n",
+                       "application/json")
+            return
+        with self._submit_lock:
+            st = fold_journal(self.journal_dir)
+            if len(st["backlog"]) >= self.max_backlog:
+                # typed overload shed: nothing journaled, the client
+                # retries after roughly one worker drain pass
+                body = json.dumps({
+                    "error": "QuESTOverloadError",
+                    "message": (f"fleet backlog "
+                                f"{len(st['backlog'])} >= "
+                                f"{self.max_backlog}"),
+                    "retry_after_s": 1.0}) + "\n"
+                self.send_response(503)
+                self.send_header("Content-Type",
+                                 "application/json; charset=utf-8")
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Length",
+                                 str(len(body.encode())))
+                self.end_headers()
+                try:
+                    self.wfile.write(body.encode())
+                except BrokenPipeError:
+                    pass
+                return
+            key = doc.get("key")
+            try:
+                seq = self._submit_seq[0]
+                if not key:
+                    # content + ingress sequence, the same shape as
+                    # supervisor._auto_idem_key's content half — the
+                    # ingress mints http-<hash> so two identical
+                    # bodies submitted twice still get distinct keys
+                    import hashlib
+                    h = hashlib.sha256(json.dumps(
+                        {"content": {k: doc.get(k) for k in
+                                     ("ops", "num_qubits",
+                                      "is_density", "dtype", "prng",
+                                      "trace_id", "tenant")},
+                         "seq": seq}, sort_keys=True).encode())
+                    key = f"http-{h.hexdigest()[:16]}"
+                key = str(key)
+                if key in st["accepted"]:
+                    done = key in st["completed"]
+                    self._send(200,
+                               json.dumps({"key": key,
+                                           "state": ("done" if done
+                                                     else "accepted"),
+                                           "deduped": True}) + "\n",
+                               "application/json")
+                    return
+                rec = _submit_record(doc, key,
+                                     len(st["order"]))
+                append_records(self.journal_dir, [rec])
+                self._submit_seq[0] = seq + 1
+            except ValueError as e:
+                self._send(400, json.dumps({"error": "bad_request",
+                                            "message": str(e)})
+                           + "\n", "application/json")
+                return
+        self._send(200, json.dumps({"key": key,
+                                    "state": "accepted"}) + "\n",
+                   "application/json")
+
+    # -- GET route bodies ---------------------------------------------------
+
+    def _get_status(self, key: str) -> None:
+        st = fold_journal(self.journal_dir)
+        if key not in st["accepted"]:
+            self._send(404, json.dumps({"key": key,
+                                        "state": "unknown"}) + "\n",
+                       "application/json")
+            return
+        if key in st["quarantined"]:
+            state = "quarantined"
+        elif key in st["completed"]:
+            state = "done"
+        elif st["launches"].get(key):
+            state = "running"
+        else:
+            state = "accepted"
+        doc = {"key": key, "state": state,
+               "launches": st["launches"].get(key, 0)}
+        c = st["claims"].get(key)
+        if c:
+            doc["claim"] = c
+        self._send(200, json.dumps(doc) + "\n", "application/json")
+
+    def _get_result(self, key: str) -> None:
+        st = fold_journal(self.journal_dir)
+        if key not in st["accepted"]:
+            self._send(404, json.dumps({"key": key,
+                                        "state": "unknown"}) + "\n",
+                       "application/json")
+            return
+        rec = st["completed"].get(key)
+        if rec is None:
+            state = ("quarantined" if key in st["quarantined"]
+                     else "pending")
+            self._send(202 if state == "pending" else 200,
+                       json.dumps({"key": key, "state": state})
+                       + "\n", "application/json")
+            return
+        self._send(200,
+                   json.dumps({"key": key, "state": "done",
+                               "outcomes": rec.get("outcomes"),
+                               "digest": rec.get("digest"),
+                               "trace_id": rec.get("trace_id"),
+                               "worker": rec.get("worker"),
+                               "epoch": rec.get("epoch")}) + "\n",
+                   "application/json")
+
+    def _get_readyz(self) -> None:
+        """Fleet readiness: the journal's own backlog plus the
+        per-worker backlog/in-flight gauges SUMMED across the workers'
+        snapshot spills (the PR 17 snapshots, read with the stdlib
+        twin of ``metrics.read_snapshot``)."""
+        st = fold_journal(self.journal_dir)
+        backlog = len(st["backlog"])
+        gauges = sum_fleet_gauges(
+            self.snapdir, ("serve.journal_backlog",
+                           "supervisor.inflight"))
+        workers = self.fleet_view() if self.fleet_view else []
+        alive = sum(1 for w in workers if w.get("alive"))
+        ok = backlog < self.max_backlog
+        doc = {"ok": ok, "journal_backlog": backlog,
+               "max_backlog": self.max_backlog,
+               "workers_alive": alive, "workers": workers,
+               "fleet_gauges": gauges}
+        if not ok:
+            doc["retry_after_s"] = 1.0
+        self._send(200 if ok else 503, json.dumps(doc) + "\n",
+                   "application/json")
+
+    def _get_healthz(self) -> None:
+        workers = self.fleet_view() if self.fleet_view else []
+        doc = {"ok": True, "workers": workers,
+               "snapshots": snapshot_ages(self.snapdir)}
+        self._send(200, json.dumps(doc) + "\n", "application/json")
+
+    def _get_metrics_fleet(self) -> None:
+        try:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            text = metrics_serve._fleet_agg().fleet_text(self.snapdir)
+        except Exception as e:
+            self._send(503, f"fleet aggregation unavailable "
+                            f"({type(e).__name__}: {e})\n",
+                       "text/plain")
+            return
+        self._send(200, text,
+                   "text/plain; version=0.0.4; charset=utf-8")
+
+
+# ---------------------------------------------------------------------------
+# Worker process (imports quest_tpu; launched by the parent)
+# ---------------------------------------------------------------------------
+
+
+def worker_loop(journal_dir: str, *, serve_workers: int = 1,
+                poll_s: float = POLL_DEFAULT,
+                max_loops: int = 0) -> int:
+    """One fleet worker: drain the shared journal until preempted.
+
+    Each pass recovers the journal backlog (``supervisor.
+    recover_queue``), serves it with ``fleet=True`` (arming the leased
+    claim protocol; keys under a live foreign lease are deferred and
+    retried next pass), spills a metric snapshot for the parent's
+    aggregated ``/readyz``, and sleeps ``poll_s``.  A SIGTERM flips
+    the cooperative preempt flag; the pass drains and the loop exits
+    0.  ``max_loops`` bounds the loop for tests (0 = run until
+    preempted)."""
+    from quest_tpu import metrics, supervisor
+    import quest_tpu as qt
+
+    supervisor.install_preemption_handler()
+    env = qt.create_env(num_devices=1)
+    loops = 0
+    while True:
+        if supervisor.preempt_requested():
+            break
+        try:
+            st = supervisor.recover_queue(journal_dir, env)
+            reqs = st.get("requests") or []
+            if reqs:
+                supervisor.serve(reqs, journal_dir=journal_dir,
+                                 fleet=True, workers=serve_workers,
+                                 max_batch=1)
+        except Exception as e:  # one bad pass must not kill the drain
+            metrics.counter_inc("fleet.worker_pass_failures")
+            metrics.trace(f"fleet-worker: serve pass failed: "
+                          f"{type(e).__name__}: {e}")
+        metrics.write_snapshot()
+        loops += 1
+        if max_loops and loops >= max_loops:
+            break
+        if supervisor.preempt_requested():
+            break
+        time.sleep(poll_s)
+    metrics.write_snapshot()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Parent: launch + supervise the fleet
+# ---------------------------------------------------------------------------
+
+
+def _chain_context(wid: str) -> str:
+    """Per-worker trace context (the ``tools/supervise.py`` contract:
+    ONE context per relaunch chain) — an inherited parent context gets
+    a per-worker suffix so two workers' chains stay distinct."""
+    base = os.environ.get(TRACE_CONTEXT_ENV)
+    if base:
+        return f"{base}/{wid}"
+    return f"run-{os.getpid():x}-{wid}"
+
+
+def _launch_worker(i: int, attempt: int, opts) -> subprocess.Popen:
+    wid = f"fleet-w{i}"
+    env = dict(os.environ)
+    env["QUEST_WORKER_ID"] = wid
+    env["QUEST_FLEET_WORKER"] = "1"
+    env["QUEST_METRICS_SNAPDIR"] = opts.snapdir
+    env["QUEST_SUPERVISE_ATTEMPT"] = str(attempt)
+    env[TRACE_CONTEXT_ENV] = _chain_context(wid)
+    if opts.lease is not None:
+        env["QUEST_LEASE_S"] = str(opts.lease)
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           "--journal", opts.journal,
+           "--serve-workers", str(opts.serve_workers),
+           "--poll", str(opts.poll)]
+    if opts.max_loops:
+        cmd += ["--max-loops", str(opts.max_loops)]
+    return subprocess.Popen(cmd, env=env)
+
+
+def serve_fleet(opts) -> int:
+    os.makedirs(opts.journal, exist_ok=True)
+    os.makedirs(opts.snapdir, exist_ok=True)
+
+    workers = {}  # i -> {"proc", "attempt", "id"}
+    for i in range(opts.workers):
+        workers[i] = {"proc": _launch_worker(i, 1, opts),
+                      "attempt": 1, "id": f"fleet-w{i}"}
+
+    def fleet_view():
+        return [{"id": w["id"], "pid": w["proc"].pid,
+                 "attempt": w["attempt"],
+                 "alive": w["proc"].poll() is None}
+                for w in workers.values()]
+
+    FleetHandler.journal_dir = os.path.abspath(opts.journal)
+    FleetHandler.snapdir = os.path.abspath(opts.snapdir)
+    FleetHandler.max_backlog = opts.max_backlog
+    FleetHandler.fleet_view = staticmethod(fleet_view)
+    httpd, port = metrics_serve.start_in_thread(
+        opts.port, handler=FleetHandler)
+
+    manifest = os.path.join(opts.journal, FLEET_MANIFEST)
+    tmp = manifest + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"port": port, "parent_pid": os.getpid(),
+                   "snapdir": FleetHandler.snapdir,
+                   "workers": fleet_view()}, f, indent=1)
+    os.replace(tmp, manifest)
+
+    print(f"fleet-serve: listening on http://127.0.0.1:{port}",
+          flush=True)
+    print(f"fleet-serve: {opts.workers} worker(s) on journal "
+          f"{FleetHandler.journal_dir}", flush=True)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    try:
+        while not stop.is_set():
+            for i, w in workers.items():
+                rc = w["proc"].poll()
+                if rc is None or rc == 0:
+                    continue
+                if w["attempt"] > opts.max_restarts:
+                    continue  # budget spent: survivors own the claims
+                w["attempt"] += 1
+                print(f"fleet-serve: {w['id']} exited rc={rc}; "
+                      f"relaunch attempt {w['attempt']}", flush=True)
+                w["proc"] = _launch_worker(i, w["attempt"], opts)
+            stop.wait(0.2)
+    finally:
+        # fleet-wide graceful drain: forward SIGTERM (the cooperative
+        # preemption handler in every worker), bounded wait, then the
+        # stragglers get SIGKILL — the journal replays them anyway
+        for w in workers.values():
+            if w["proc"].poll() is None:
+                try:
+                    w["proc"].send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + opts.drain_s
+        for w in workers.values():
+            left = deadline - time.monotonic()
+            try:
+                w["proc"].wait(timeout=max(left, 0.1))
+            except subprocess.TimeoutExpired:
+                w["proc"].kill()
+                w["proc"].wait()
+        httpd.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="fleet serving: N workers, one shared journal, "
+                    "HTTP ingress")
+    p.add_argument("--journal", required=True,
+                   help="shared serve-journal directory")
+    p.add_argument("--workers", type=int,
+                   default=int(os.environ.get("QUEST_FLEET_WORKERS",
+                                              2)))
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--max-restarts", type=int,
+                   default=MAX_RESTARTS_DEFAULT)
+    p.add_argument("--max-backlog", type=int,
+                   default=MAX_BACKLOG_DEFAULT)
+    p.add_argument("--lease", type=float, default=None,
+                   help="lease seconds exported to workers as "
+                        "QUEST_LEASE_S")
+    p.add_argument("--poll", type=float, default=POLL_DEFAULT)
+    p.add_argument("--serve-workers", type=int, default=1)
+    p.add_argument("--snapdir", default=None,
+                   help="metric snapshot dir (default "
+                        "JOURNAL/snapshots)")
+    p.add_argument("--drain-s", type=float, default=30.0)
+    p.add_argument("--worker", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--max-loops", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    opts = p.parse_args(argv)
+    if opts.workers < 1:
+        p.error("--workers must be >= 1")
+    if opts.snapdir is None:
+        opts.snapdir = os.path.join(opts.journal, "snapshots")
+    if opts.worker:
+        return worker_loop(opts.journal,
+                           serve_workers=opts.serve_workers,
+                           poll_s=opts.poll,
+                           max_loops=opts.max_loops)
+    return serve_fleet(opts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

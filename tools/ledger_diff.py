@@ -90,6 +90,15 @@ DEFAULT_RULES = [
     # regression)
     ("counters.supervisor.journal_replay_failures", +0.0, False),
     ("counters.supervisor.poison_quarantined", +0.0, True),
+    # fleet-serving health, strictly regressive: ANY double execution
+    # of a leased key (two applied-epoch completes for one key — the
+    # lease/fencing protocol let two workers run the same request) and
+    # ANY fenced complete getting APPLIED as a result (the journal fold
+    # honoured an epoch-stale completion — the exactly-once contract
+    # broke) are regressions of the claim protocol; the baselines are
+    # 0, so the +0 rules fire on any appearance regardless of config
+    ("counters.supervisor.lease_double_run", +0.0, False),
+    ("counters.supervisor.fenced_completes_applied", +0.0, False),
     # fleet-observability health, strictly regressive: ANY corrupt
     # snapshot skipped by the fleet aggregator is a regression of the
     # atomic write-temp-then-rename spill contract (workers must never

@@ -167,11 +167,15 @@ class MetricsHandler(BaseHTTPRequestHandler):
 
 
 def start_in_thread(port: int = 0,
-                    host: str = "127.0.0.1"):
+                    host: str = "127.0.0.1", handler=None):
     """Start the endpoint on a daemon thread inside the CURRENT process
     (so scrapes see this process's live telemetry).  Returns
-    ``(server, port)``; stop with ``server.shutdown()``."""
-    server = ThreadingHTTPServer((host, port), MetricsHandler)
+    ``(server, port)``; stop with ``server.shutdown()``.  ``handler``
+    substitutes a request-handler subclass — ``tools/fleet_serve.py``
+    mounts its fleet ingress routes through here so both servers share
+    one transport (threading model, _send, silenced logging)."""
+    server = ThreadingHTTPServer((host, port),
+                                 handler or MetricsHandler)
     t = threading.Thread(target=server.serve_forever,
                          name="quest-metrics-serve", daemon=True)
     t.start()

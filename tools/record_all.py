@@ -465,6 +465,150 @@ def fleet_obs_smoke(summary) -> None:
         print(detail)
 
 
+def fleet_serve_smoke(summary) -> None:
+    """Tier-2 smoke: the fleet serving front end end to end.  Starts
+    ``tools/fleet_serve.py`` with TWO real worker subprocesses on one
+    shared journal, submits 6 requests over real HTTP, SIGKILLs one
+    worker mid-backlog, and asserts the survivor drains the backlog
+    EXACTLY-ONCE under the leased claim protocol: every ``/result``
+    eventually serves outcomes BIT-IDENTICAL to a solo in-process
+    serve of the same requests, ``/readyz`` reports the dead worker,
+    and a SIGTERM to the parent drains the fleet to exit 0.  A lease
+    that double-runs, a result that diverges from the solo path, or a
+    drain that hangs fails the recording round here instead of in the
+    first real multi-worker deployment."""
+    import json as _json
+    import selectors
+    import signal as _signal
+    import tempfile
+    import urllib.request
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    sys.path.insert(0, REPO)
+    import jax
+
+    from quest_tpu import supervisor
+    import quest_tpu as qt
+    from quest_tpu import models
+
+    t0 = time.time()
+    ok, detail = False, ""
+    proc = None
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            jdir = os.path.join(td, "journal")
+            env = qt.create_env(num_devices=1)
+            circ = models.qft(5)
+            circ.measure(0)
+            circ.measure(2)
+            keys = jax.random.split(jax.random.PRNGKey(9), 6)
+            reqs = [supervisor.BatchableRun(
+                circ, env, key=keys[i], trace_id=f"tr-{i}",
+                idempotency_key=f"sk-{i}") for i in range(6)]
+            ref = supervisor.serve(
+                reqs, journal_dir=os.path.join(td, "jref"),
+                max_batch=1)
+            if not all(r["ok"] for r in ref):
+                raise RuntimeError("solo reference serve failed")
+            import numpy as _np
+            ref_out = {f"sk-{i}": [int(x) for x in _np.asarray(
+                r["value"]["outcomes"]).reshape(-1).tolist()]
+                for i, r in enumerate(ref)}
+            ops = supervisor._encode_ops(circ.ops)
+            cenv = dict(os.environ)
+            cenv.update(
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=1")
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "fleet_serve.py"),
+                 "--journal", jdir, "--workers", "2", "--port", "0",
+                 "--max-restarts", "0", "--lease", "1",
+                 "--poll", "0.1"],
+                stdout=subprocess.PIPE, text=True, cwd=REPO, env=cenv)
+            sel = selectors.DefaultSelector()
+            sel.register(proc.stdout, selectors.EVENT_READ)
+            if not sel.select(timeout=120):
+                raise TimeoutError("no fleet-serve banner within "
+                                   "120s")
+            port = int(proc.stdout.readline().rsplit(":", 1)[-1])
+            base = f"http://127.0.0.1:{port}"
+            for i in range(6):
+                body = _json.dumps(
+                    {"ops": ops, "num_qubits": 5, "key": f"sk-{i}",
+                     "trace_id": f"tr-{i}",
+                     "prng": supervisor._encode_prng(
+                         keys[i])}).encode()
+                req = urllib.request.Request(base + "/submit",
+                                             data=body,
+                                             method="POST")
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    if _json.loads(r.read())["key"] != f"sk-{i}":
+                        raise RuntimeError("submit key mismatch")
+            with open(os.path.join(jdir, "fleet.json")) as f:
+                pids = [w["pid"] for w in _json.load(f)["workers"]]
+
+            def _state(k):
+                try:
+                    with urllib.request.urlopen(
+                            base + f"/status?key={k}",
+                            timeout=10) as r:
+                        return _json.loads(r.read())["state"]
+                except Exception:
+                    return "unknown"
+
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if any(_state(f"sk-{i}") in ("running", "done")
+                       for i in range(6)):
+                    break
+                time.sleep(0.2)
+            os.kill(pids[0], _signal.SIGKILL)  # mid-backlog
+            got = {}
+            while time.time() < deadline and len(got) < 6:
+                for i in range(6):
+                    k = f"sk-{i}"
+                    if k in got:
+                        continue
+                    try:
+                        with urllib.request.urlopen(
+                                base + f"/result?key={k}",
+                                timeout=10) as r:
+                            if r.status == 200:
+                                got[k] = _json.loads(r.read())
+                    except Exception:
+                        pass
+                time.sleep(0.3)
+            with urllib.request.urlopen(base + "/readyz",
+                                        timeout=10) as r:
+                rz = _json.loads(r.read())
+            proc.send_signal(_signal.SIGTERM)
+            rc = proc.wait(timeout=90)
+            outcomes_equal = (len(got) == 6 and all(
+                got[k]["outcomes"] == ref_out[k] for k in ref_out))
+            traces = all(got[f"sk-{i}"]["trace_id"] == f"tr-{i}"
+                         for i in range(6)) if len(got) == 6 else False
+            one_down = rz.get("workers_alive") == 1
+            ok = (outcomes_equal and traces and one_down and rc == 0
+                  and rz.get("journal_backlog") == 0)
+            if not ok:
+                detail = (f"got={len(got)} equal={outcomes_equal} "
+                          f"traces={traces} readyz={rz} rc={rc}")
+        except Exception as e:
+            detail = f"{type(e).__name__}: {e}"
+        finally:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    secs = time.time() - t0
+    summary.append(("fleet_serve", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'fleet_serve':22s} "
+          f"{secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 #: The supervised child: a checkpointed QFT run under QUEST_PREEMPT
 #: with a deterministic straggler holding the plan open long enough
 #: for the drill's SIGTERM to land mid-run.  On relaunch (a restorable
@@ -637,6 +781,7 @@ def main():
     journaled_serve_smoke(summary)
     metrics_serve_smoke(summary)
     fleet_obs_smoke(summary)
+    fleet_serve_smoke(summary)
     supervise_smoke(summary)
     chaos_drill_smoke(summary, rnd)
     n_fail = sum(1 for _, ok, _ in summary if not ok)

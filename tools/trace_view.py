@@ -298,20 +298,24 @@ def audit_table(doc: dict) -> str:
     for ev in doc["events"]:
         detail = ", ".join(
             f"{k}={ev[k]}" for k in ("attempt", "attempts", "tenant",
-                                     "index", "error", "ctx", "label",
-                                     "run_id", "supervise_attempt",
-                                     "wall_s", "events")
+                                     "index", "worker", "epoch",
+                                     "expires", "submit_seq", "error",
+                                     "ctx", "label", "run_id",
+                                     "supervise_attempt", "wall_s",
+                                     "events")
             if ev.get(k) is not None)
         lines.append(f"{ev['seq']:>4}  {ev['source']:<8}"
                      f"{ev['kind']:<14}{str(ev.get('key', '')):<14}"
                      f"{detail}")
     for key in doc["keys"]:
         req = doc["requests"][key]
+        claims = (f", claims {req['claims']}"
+                  if req.get("claims") else "")
         lines.append(f"request {key}: {' -> '.join(req['lifecycle'])} "
                      f"(accepted {req['accepted']}, launches "
                      f"{req['launches']}, completes {req['completes']}, "
                      f"failed {req['failed']}, quarantined "
-                     f"{req['quarantined']})")
+                     f"{req['quarantined']}{claims})")
     led = doc["ledger"]
     lines.append(f"ledger: {led['records']} record(s), "
                  f"{led['timeline_events']} timeline event(s), "
