@@ -124,6 +124,7 @@ from .stateio import (
 )
 from . import metrics
 from . import telemetry
+from . import slo
 from . import resilience
 from .resilience import (
     set_fault_plan,

@@ -797,7 +797,9 @@ class Circuit:
                             key = default_measure_key()
                         mkeys = jax.random.split(key, n)
                 with metrics.span("compile"):
-                    fn = self._batched_compiled(bqureg.mesh)
+                    fn = self._batched_compiled(
+                        bqureg.mesh,
+                        batch_shape=(n, self.num_qubits))
                 self._record_batched_run_stats(bqureg)
                 wall = (metrics.timeline_span(
                             "batched-run",
@@ -822,23 +824,35 @@ class Circuit:
                 metrics.annotate_run("resilience",
                                      resilience.run_counters())
 
-    def _batched_compiled(self, mesh):
+    def _batched_compiled(self, mesh, batch_shape=None):
         """Memoised jitted batched executor (per mesh + comm config +
         op stream, like :meth:`compile`); batch-size and dtype
         polymorphic — jit re-specialises per shape, the memo keeps the
-        function identity stable so it CAN cache."""
+        function identity stable so it CAN cache.  ``batch_shape`` is
+        observability-only: it stamps the compile event (the observed
+        shape a memo decision served), never the memo key."""
         from .parallel.mesh_exec import comm_config_token
 
         memo_key = ("batched", mesh, comm_config_token(),
                     tuple(self.ops))
+        fp = metrics.compile_fingerprint("batched", mesh,
+                                         tuple(self.ops))
         fn = self._compiled.get(memo_key)
         if fn is None:
             metrics.counter_inc("circuit.compile_cache_misses")
+            t0 = metrics.clock()
             with metrics.span("schedule"):
                 fn = jax.jit(self.as_batched_fn(mesh))
             self._compiled[memo_key] = fn
+            metrics.compile_event("batched", "fresh",
+                                  wall_s=metrics.clock() - t0,
+                                  fingerprint=fp,
+                                  batch_shape=batch_shape)
         else:
             metrics.counter_inc("circuit.compile_cache_hits")
+            metrics.compile_event("batched", "memo_hit",
+                                  fingerprint=fp,
+                                  batch_shape=batch_shape)
         return fn
 
     def _record_batched_run_stats(self, bqureg) -> None:
@@ -896,9 +910,12 @@ class Circuit:
         # knob mid-process must recompile, not reuse
         key = (mesh, donate, use_pallas, comm_config_token(),
                tuple(self.ops))
+        fp = metrics.compile_fingerprint("circuit", mesh, donate,
+                                         use_pallas, tuple(self.ops))
         fn = self._compiled.get(key)
         if fn is None:
             metrics.counter_inc("circuit.compile_cache_misses")
+            t0 = metrics.clock()
             with metrics.span("schedule"):
                 if use_pallas:
                     interpret = jax.default_backend() != "tpu"
@@ -907,8 +924,12 @@ class Circuit:
                     raw = self.as_fn(mesh)
             fn = jax.jit(raw, donate_argnums=(0,) if donate else ())
             self._compiled[key] = fn
+            metrics.compile_event("circuit", "fresh",
+                                  wall_s=metrics.clock() - t0,
+                                  fingerprint=fp)
         else:
             metrics.counter_inc("circuit.compile_cache_hits")
+            metrics.compile_event("circuit", "memo_hit", fingerprint=fp)
         return fn
 
     def schedule_stats(self, mesh=None) -> dict:
@@ -1158,8 +1179,12 @@ class Circuit:
 
         memo_key = ("observed", qureg.mesh, use_pallas, integ,
                     comm_config_token(), tuple(self.ops))
+        fp = metrics.compile_fingerprint("observed", qureg.mesh,
+                                         use_pallas, integ,
+                                         tuple(self.ops))
         ent = self._compiled.get(memo_key)
         if ent is None:
+            t0 = metrics.clock()
             probe = _HealthProbe(self, qureg.mesh)
             if use_pallas:
                 interpret = jax.default_backend() != "tpu"
@@ -1170,6 +1195,12 @@ class Circuit:
                 fn = self.as_fn(qureg.mesh, item_hook=probe)
             ent = (fn, probe)
             self._compiled[memo_key] = ent
+            metrics.compile_event("observed", "fresh",
+                                  wall_s=metrics.clock() - t0,
+                                  fingerprint=fp)
+        else:
+            metrics.compile_event("observed", "memo_hit",
+                                  fingerprint=fp)
         fn, probe = ent
         probe.reset()
         cursor = _RunCursor(
@@ -1420,9 +1451,13 @@ class Circuit:
                     # ledger_diff rule via the bench annotation
                     ov = metrics.timeline_comm_overlap(run_events)
                     if ov["comm_us"] > 0:
-                        metrics.annotate_run(
-                            "comm_hidden_frac",
-                            round(ov["frac"], 4))
+                        frac = round(ov["frac"], 4)
+                        metrics.annotate_run("comm_hidden_frac", frac)
+                        # also a process histogram, so the SLO
+                        # sentinel can hold a min-direction target on
+                        # overlap quality fleet-wide
+                        metrics.hist_record("run.comm_hidden_frac",
+                                            frac)
                 metrics.annotate_run("resilience",
                                      resilience.run_counters())
 

@@ -93,6 +93,7 @@ never fail the run it was observing.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import math
 import os
@@ -353,6 +354,20 @@ def _finalize(rec: dict, wall: float) -> None:
     rec["wall_s"] = round(wall, 6)
     rec["spans"] = {k: {"seconds": round(v[0], 6), "count": v[1]}
                     for k, v in rec["spans"].items()}
+    # compile-share annotation: the cold-start tax this run actually
+    # paid, priced from its own compile events (the observatory's
+    # attributed walls — NOT span("compile"), which also covers memo
+    # lookups and, historically, AOT deserialisation)
+    evs = rec.get("compile_events")
+    if evs:
+        cw = round(sum(e.get("wall_s", 0.0) for e in evs), 6)
+        rec["meta"]["compile_wall_s"] = cw
+        if wall > 0:
+            rec["meta"]["compile_share"] = round(min(cw / wall, 1.0), 4)
+        # top-level comm-config stamp (the events all carry it; any one
+        # will do) — the binding field for ledger_diff's compile.fresh
+        # rule, so cold-start counts only gate at IDENTICAL comm config
+        rec["comm_config"] = str(evs[-1].get("comm_config") or "")
     # the run's own wall time lands in the per-label SLO histogram
     # (process-wide AND on this record, which is already off the
     # attribution stack — so the bucket is added to both by hand)
@@ -521,6 +536,103 @@ def _hist_serialize(h: dict) -> dict:
             "zeros": h["zeros"]}
 
 
+# ---------------------------------------------------------------------------
+# Compile observatory (structured compile/cache-decision attribution)
+# ---------------------------------------------------------------------------
+#
+# ``span("compile")`` answers "how long"; a cold-start audit (and the
+# persistent compile cache ROADMAP item 2 will key on this) needs
+# "WHICH program, at WHICH seam, under WHICH comm config, and was it a
+# memo hit, an AOT artifact, or a fresh XLA compile".  Every compile /
+# cache decision at the five seams — Circuit.compile memo, the batched
+# program memo, the observed-path plan memo (incl. per-unique-item
+# programs), the register stream cache, and AOT load/save — reports one
+# structured event here: counters (``compile.<seam>.<outcome>`` plus
+# the ``compile.fresh`` aggregate), a ``compile.wall_s.<seam>``
+# histogram family for attributed walls, and a ``compile_events`` list
+# on the active run record(s) that ``_finalize`` prices into the
+# ``compile_share`` annotation and ``tools/compile_report.py``
+# aggregates into the fingerprint × comm-config cold-start table.
+# Events fire at COMPILE SEAMS only (build/lookup time), never per
+# executed plan item — the donated fast path stays untaxed beyond one
+# fingerprint hash per memo lookup.
+
+#: The closed outcome vocabulary — ``compile_report.py`` and the
+#: Prometheus series names both key on it.
+COMPILE_OUTCOMES = ("memo_hit", "aot_hit", "fresh", "aot_corrupt")
+
+
+def compile_fingerprint(*parts) -> str:
+    """A short stable fingerprint (16 hex chars) of a compile-cache
+    key.  Mesh-like objects (anything with ``devices`` + ``shape``) are
+    normalised to their sorted axis-name/size pairs so two workers
+    holding equivalent meshes over different device objects agree on
+    the fingerprint — the property the fleet-level cold-start table
+    (and ROADMAP item 2's warm-list) needs."""
+    def norm(p):
+        if hasattr(p, "devices") and hasattr(p, "shape"):
+            try:
+                shape = tuple(sorted((str(k), int(v))
+                                     for k, v in dict(p.shape).items()))
+            except (TypeError, ValueError):
+                shape = str(p.shape)
+            return ("mesh", shape)
+        return p
+
+    tag = repr(tuple(norm(p) for p in parts))
+    return hashlib.sha256(tag.encode()).hexdigest()[:16]
+
+
+def compile_event(seam: str, outcome: str, wall_s: float = 0.0,
+                  fingerprint: str | None = None,
+                  batch_shape=None) -> None:
+    """Record one compile/cache decision at seam ``seam``.
+
+    ``outcome`` must be one of :data:`COMPILE_OUTCOMES`.  ``wall_s`` is
+    the wall attributed to THIS event (0 for pure cache decisions and
+    for seams whose build wall is carried by an inner seam's event —
+    the stream cache's ``fresh`` delegates its wall to the ``circuit``
+    event it triggers, so summed event walls never double-count).
+    Effects: ``compile.<seam>.<outcome>`` counter, the ``compile.fresh``
+    aggregate (what the ledger_diff cold-start rule watches), a
+    ``compile.wall_s.<seam>`` histogram sample when wall is positive,
+    and one structured event on the active run record(s).  The wall is
+    rounded ONCE here, so the histogram sum and the per-event walls in
+    the ledger reconcile exactly (compile_report pins that)."""
+    if getattr(_tls, "suppress", False):
+        return
+    if outcome not in COMPILE_OUTCOMES:
+        raise ValueError(
+            f"compile_event: unknown outcome {outcome!r} "
+            f"(want one of {COMPILE_OUTCOMES})")
+    w = round(float(wall_s), 6)
+    counter_inc(f"compile.{seam}.{outcome}")
+    if outcome == "fresh":
+        counter_inc("compile.fresh")
+    if w > 0:
+        hist_record(f"compile.wall_s.{seam}", w)
+    try:
+        from .parallel.mesh_exec import comm_config_token
+        comm = "/".join(comm_config_token())
+    except Exception:  # pragma: no cover - parallel stack unavailable
+        comm = ""
+    ev = {"seam": seam, "outcome": outcome, "wall_s": w,
+          "fingerprint": fingerprint, "comm_config": comm}
+    if batch_shape is not None:
+        ev["batch_shape"] = [int(x) for x in batch_shape]
+    with _lock:
+        for rec in _stack():
+            rec.setdefault("compile_events", []).append(dict(ev))
+
+
+def hists_serialized() -> dict:
+    """Every process histogram in the SERIALIZED (string-keyed sparse
+    exponent) form that snapshots and ledger records carry — the input
+    shape ``hist_stats`` and the SLO sentinel's window math consume."""
+    with _lock:
+        return {name: _hist_serialize(h) for name, h in _hists.items()}
+
+
 def _gauges(c: dict) -> dict:
     """The point-in-time gauge set exported next to the counters —
     built from ONE counter snapshot ``c`` so a scrape (or a spilled
@@ -599,6 +711,29 @@ def _gauges(c: dict) -> dict:
         "serve.sessions_migrated": c.get(
             "supervisor.sessions_migrated", 0),
     })
+    # uptime / identity gauges: process start (Prometheus'
+    # process_start_time_seconds convention, quest_-prefixed) plus the
+    # snapshot epoch and ITS wall-clock stamp — so fleet_agg's
+    # staleness rollup is computable from a /metrics scrape alone, no
+    # snapshot-file mtimes needed
+    with _lock:
+        epoch = _snap_state["epoch"]
+    gauges.update({
+        "worker.start_time_seconds": telemetry.process_start_time(),
+        "snapshot.epoch": epoch,
+        "snapshot.time_seconds": round(time.time(), 3),
+    })
+    # SLO sentinel alert gauges (quest_alert_*): zero work when no spec
+    # is configured.  The sentinel gets the telemetry handed IN (this
+    # one counter snapshot + serialized hists + the gauges built so
+    # far) — slo.py is a stdlib-only leaf and never samples metrics
+    # itself, so there is no recursion and no extra locking
+    from . import slo  # deferred: keep the leaf import-cycle-free
+
+    if slo.configured():
+        gauges.update(slo.sample_and_evaluate(
+            clock(), counters=c, hists=hists_serialized(),
+            gauges=dict(gauges)))
     return gauges
 
 
@@ -693,6 +828,10 @@ def snapshot() -> dict:
         "worker": telemetry.worker_id(),
         "pid": os.getpid(),
         "epoch": epoch,
+        # wall-clock stamp of the snapshot itself: staleness math in
+        # fleet_agg / slo_watch prefers it over file mtimes (rsync'd
+        # or copied snapshot files keep honest ages)
+        "time": round(time.time(), 3),
         "trace": telemetry.effective_trace_id() or telemetry.from_context(),
         "counters": c,
         "hists": hists,
@@ -1173,3 +1312,5 @@ def reset() -> None:
         _snap_state["finalized"] = 0
     clear_warn_once()
     telemetry.reset()
+    from . import slo  # deferred: stdlib-only leaf
+    slo.reset()

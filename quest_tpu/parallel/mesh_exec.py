@@ -1832,6 +1832,7 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
             key = (_item_key(item), bool(senders))
             f = unique.get(key)
             if f is None:
+                t0 = metrics.clock()
                 S = item_subblocks(item, num_vec_bits, dev_bits)
                 cols, labels = sender_columns(senders, S)
                 if senders:
@@ -1856,6 +1857,16 @@ def _mesh_plan_fn(ops, num_vec_bits: int, mesh: Mesh, interpret: bool,
                 else:
                     f = jf
                 unique[key] = f
+                # compile observatory: one event per UNIQUE per-item
+                # program, at BUILD time only — repeated plan items
+                # reuse `unique` silently and execution never reports
+                # here, so the per-item path's dispatch loop stays
+                # untaxed ("never per item" is the acceptance pin)
+                metrics.compile_event(
+                    "mesh_plan", "fresh",
+                    wall_s=metrics.clock() - t0,
+                    fingerprint=metrics.compile_fingerprint(
+                        "mesh_plan", key))
             item_fns.append(f)
         layouts = plan_layouts(plan, num_vec_bits)
         metas = [dict(item_timeline_meta(item, num_vec_bits, dev_bits,

@@ -571,14 +571,27 @@ _trace = metrics.trace
 def _stream_fn(ops: tuple, num_vec_qubits: int, mesh, dtype=jnp.float32):
     dtype = jnp.dtype(dtype)
 
+    fp = metrics.compile_fingerprint("stream", ops, num_vec_qubits,
+                                     mesh, jnp.dtype(dtype).name)
+
     def build():
         _trace(f"stream build start ({len(ops)} ops)")
         metrics.counter_inc("stream.cache_misses")
-        with metrics.span("compile"):
-            fn = mesh is None and _aot_load(ops, num_vec_qubits, dtype)
+        # AOT deserialisation is NOT compile work: it gets its own
+        # aot_load span/seam so the ledger's compile-share annotation
+        # prices fresh XLA compiles only (an AOT-hit cold start used to
+        # book its load wall as "compile", overstating what a
+        # persistent compile cache could save)
+        fn = None
+        if mesh is None:
+            with metrics.span("aot_load"):
+                fn = _aot_load(ops, num_vec_qubits, dtype)
             if fn:
                 _trace("stream AOT-loaded")
-            if not fn:
+                metrics.compile_event("stream", "aot_hit",
+                                      fingerprint=fp)
+        if not fn:
+            with metrics.span("compile"):
                 from .circuit import Circuit  # deferred: avoids cycle
 
                 c = Circuit(num_vec_qubits)
@@ -587,6 +600,9 @@ def _stream_fn(ops: tuple, num_vec_qubits: int, mesh, dtype=jnp.float32):
                 if mesh is None:
                     fn = _aot_save(fn, ops, num_vec_qubits, dtype) or fn
                 _trace("stream compiled+saved")
+            # wall 0: the fresh wall is carried by the inner "circuit"
+            # event this build just triggered (no double-counting)
+            metrics.compile_event("stream", "fresh", fingerprint=fp)
         return fn
 
     from .parallel.mesh_exec import comm_config_token
@@ -600,6 +616,7 @@ def _stream_fn(ops: tuple, num_vec_qubits: int, mesh, dtype=jnp.float32):
     key = (ops, num_vec_qubits, mesh, dtype, comm_config_token())
     if key in _STREAM_CACHE:
         metrics.counter_inc("stream.cache_hits")
+        metrics.compile_event("stream", "memo_hit", fingerprint=fp)
     return lru_get(_STREAM_CACHE, key, _STREAM_CACHE_MAX, build)
 
 
@@ -660,6 +677,7 @@ def _aot_quarantine(path: str, why: str) -> None:
     the next save rebuilds them, and let the caller fall through to a
     fresh compile."""
     metrics.counter_inc("aot.corrupt_artifacts")
+    metrics.compile_event("aot_load", "aot_corrupt")
     metrics.warn_once(
         "aot_corrupt",
         f"corrupt AOT cache artifact {path!r} ({why}); rebuilding — "
@@ -932,6 +950,7 @@ def _aot_load(ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
     path = _aot_path(ops, num_vec_qubits, dtype)
     if not path or not os.path.exists(path):
         return None
+    t0 = metrics.clock()
     fn = None
     if _SPEC_AOT is not None and _SPEC_AOT[0] == path:
         _, th, holder = _SPEC_AOT
@@ -942,6 +961,11 @@ def _aot_load(ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
         fn = _aot_load_path(path)
     if fn is not None:
         metrics.counter_inc("aot.loads")
+        metrics.compile_event(
+            "aot_load", "aot_hit", wall_s=metrics.clock() - t0,
+            fingerprint=metrics.compile_fingerprint(
+                "stream", ops, num_vec_qubits, None,
+                jnp.dtype(dtype).name))
         try:
             os.utime(path)  # keep most-recently-USED ordering fresh
         except OSError:
@@ -959,6 +983,7 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
     path = _aot_path(ops, num_vec_qubits, dtype)
     if not path:
         return None
+    t0 = metrics.clock()
     try:
         aval = jax.ShapeDtypeStruct(amps_shape(1 << num_vec_qubits),
                                     jnp.dtype(dtype))
@@ -966,6 +991,14 @@ def _aot_save(jit_fn, ops: tuple, num_vec_qubits: int, dtype=jnp.float32):
     except Exception:
         return None  # explicit AOT compile unsupported: plain jit serves
     metrics.counter_inc("aot.saves")
+    # the explicit lower+compile is genuine fresh-compile work on top
+    # of the circuit build (jit alone would defer it), so it carries
+    # its own attributed wall at its own seam
+    metrics.compile_event(
+        "aot_save", "fresh", wall_s=metrics.clock() - t0,
+        fingerprint=metrics.compile_fingerprint(
+            "stream", ops, num_vec_qubits, None,
+            jnp.dtype(dtype).name))
     try:
         from jax.experimental.serialize_executable import serialize
 

@@ -1,0 +1,463 @@
+"""Live SLO burn-rate sentinel: declarative objectives over the metric
+stream, deterministic multi-window evaluation, OK/WARN/PAGE hysteresis.
+
+``tools/ledger_diff.py`` catches regressions AFTER a bench run; serving
+needs the same verdicts LIVE.  This module turns the telemetry the
+process already produces — counters, log2 histogram state, gauges (the
+exact payload of ``metrics.snapshot()``) — into named alert states that
+drive ``/readyz``, the fleet ``/healthz``, and (optionally) the
+admission gate itself.
+
+Design constraints, in force throughout:
+
+* **Stdlib-only leaf.**  No quest_tpu imports, no jax: ``metrics.py``
+  calls INTO this module (handing it one consistent counter/hist/gauge
+  sample), never the other way around, so there is no import cycle and
+  ``tools/slo_watch.py`` can load this file standalone next to snapshot
+  files on a machine with nothing else installed.
+* **Deterministic.**  Zero randomness, zero clock reads: every entry
+  point takes ``now`` explicitly (production passes ``metrics.clock()``;
+  tests pass a fake clock; ``slo_watch`` replays recorded stamps), so
+  the exact evaluation sequence — including the OK→WARN→PAGE→OK
+  transition times — replays bit-identically from the same sample
+  stream.
+* **Multi-window burn rate.**  Each objective is judged on a FAST and a
+  SLOW window simultaneously (the standard SRE burn-rate construction):
+  severity requires ``min(fast_burn, slow_burn)`` over threshold, so a
+  one-sample blip (fast high, slow low) does not page, and a
+  long-resolved incident (slow still high, fast recovered) stops
+  paging.
+* **Hysteresis.**  Upgrades (toward PAGE) are immediate; downgrades
+  require the raw verdict to hold below the current state for
+  ``hold_s`` seconds — a flapping metric pins at its worst recent
+  state instead of toggling the pager.
+
+Spec grammar (``configure(spec)`` or ``QUEST_SLO_SPEC`` — inline JSON
+when the value starts with ``[`` / ``{``, else a path to a JSON file):
+a list of objectives (or ``{"objectives": [...]}``), each::
+
+    {"name":      "run_p99",            # unique; names the alert
+     "metric":    "p99:run.wall_s.circuit_run",
+     "target":    0.5,                  # threshold, metric units
+     "direction": "max",                # "max": value<=target is good
+     "fast_s":    60.0,  "slow_s": 300.0,
+     "warn_burn": 1.0,   "page_burn": 2.0,
+     "hold_s":    120.0}
+
+Metric kinds: ``p99:<hist>`` (windowed bucket-delta quantile of a log2
+histogram — same bucket-resolution math as ``metrics.hist_stats``),
+``gauge:<name>`` (instantaneous), ``rate:<counter>`` (delta per second
+over the window), ``ratio:<a>/<b>`` (counter-delta ratio over the
+window).  Burn = value/target (direction "max") or target/value
+(direction "min"); a window with no data burns 0 (absence of evidence
+never pages).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: Env knob: inline JSON spec, or a path to one.
+SPEC_ENV = "QUEST_SLO_SPEC"
+
+#: Alert levels, in escalation order — the values of the exported
+#: ``quest_alert_*`` gauges (0 scrapes cleanly as "healthy").
+LEVELS = {"ok": 0, "warn": 1, "page": 2}
+
+#: Per-objective defaults (overridable per objective in the spec).
+DEFAULTS = {"direction": "max", "fast_s": 60.0, "slow_s": 300.0,
+            "warn_burn": 1.0, "page_burn": 2.0, "hold_s": 120.0}
+
+#: Burn values are capped here (a zero-valued "min" objective would
+#: otherwise divide to infinity and poison JSON serialisation).
+BURN_CAP = 1e9
+
+_METRIC_KINDS = ("p99", "gauge", "rate", "ratio")
+
+
+def _parse_metric(m: str) -> tuple:
+    """``"p99:run.wall_s.x"`` → ``("p99", "run.wall_s.x")`` etc."""
+    kind, sep, rest = str(m).partition(":")
+    if not sep or kind not in _METRIC_KINDS or not rest:
+        raise ValueError(
+            f"slo: bad metric {m!r} (want <kind>:<name> with kind in "
+            f"{_METRIC_KINDS})")
+    if kind == "ratio":
+        a, sep, b = rest.partition("/")
+        if not sep or not a or not b:
+            raise ValueError(f"slo: bad ratio metric {m!r} "
+                             "(want ratio:<numerator>/<denominator>)")
+        return ("ratio", a, b)
+    return (kind, rest)
+
+
+def normalize_spec(spec) -> list[dict]:
+    """Validate and default-fill a spec; returns the objective list.
+
+    Raises ``ValueError`` on duplicate names, unknown metric kinds,
+    non-positive targets/windows, or ``warn_burn > page_burn``."""
+    if isinstance(spec, dict):
+        spec = spec.get("objectives")
+    if not isinstance(spec, list) or not spec:
+        raise ValueError("slo: spec must be a non-empty list of "
+                         'objectives (or {"objectives": [...]})')
+    out, names = [], set()
+    for i, o in enumerate(spec):
+        if not isinstance(o, dict):
+            raise ValueError(f"slo: objective #{i} is not an object")
+        obj = dict(DEFAULTS)
+        obj.update(o)
+        name = str(obj.get("name") or "")
+        if not name:
+            raise ValueError(f"slo: objective #{i} has no name")
+        if name in names:
+            raise ValueError(f"slo: duplicate objective name {name!r}")
+        names.add(name)
+        obj["name"] = name
+        obj["parsed"] = _parse_metric(obj.get("metric"))
+        obj["target"] = float(obj["target"])
+        if obj["target"] <= 0:
+            raise ValueError(f"slo: objective {name!r} target must be "
+                             "positive")
+        if obj["direction"] not in ("max", "min"):
+            raise ValueError(f"slo: objective {name!r} direction must "
+                             'be "max" or "min"')
+        for k in ("fast_s", "slow_s", "warn_burn", "page_burn",
+                  "hold_s"):
+            obj[k] = float(obj[k])
+        if obj["fast_s"] <= 0 or obj["slow_s"] <= 0:
+            raise ValueError(f"slo: objective {name!r} windows must be "
+                             "positive")
+        if obj["fast_s"] > obj["slow_s"]:
+            raise ValueError(f"slo: objective {name!r} fast_s must not "
+                             "exceed slow_s")
+        if obj["warn_burn"] > obj["page_burn"]:
+            raise ValueError(f"slo: objective {name!r} warn_burn must "
+                             "not exceed page_burn")
+        if obj["hold_s"] < 0:
+            raise ValueError(f"slo: objective {name!r} hold_s must be "
+                             ">= 0")
+        out.append(obj)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Log2 histogram window math (mirror of metrics.hist_stats, kept
+# stdlib-local so this file loads standalone; tests pin the two equal)
+# ---------------------------------------------------------------------------
+
+
+def _hist_delta(cur: dict | None, base: dict | None) -> dict:
+    """Per-window histogram state: cur - base on the serialized
+    (string-keyed sparse exponent) form.  Negative deltas clamp to 0 —
+    a counter reset mid-window yields an empty window, not garbage."""
+    cur = cur or {}
+    base = base or {}
+    cb = cur.get("buckets") or {}
+    bb = base.get("buckets") or {}
+    buckets = {}
+    for e, n in cb.items():
+        d = int(n) - int(bb.get(e, 0))
+        if d > 0:
+            buckets[int(e)] = d
+    zeros = max(int(cur.get("zeros", 0)) - int(base.get("zeros", 0)), 0)
+    count = sum(buckets.values()) + zeros
+    return {"buckets": buckets, "zeros": zeros, "count": count}
+
+
+def _hist_p99(h: dict) -> float | None:
+    """Bucket-resolution p99 of a delta-histogram state — the same
+    cumulative-from-zeros walk as ``metrics._hist_quantile`` (each
+    quantile is the ``2.0**e`` upper bound of its bucket)."""
+    total = h["count"]
+    if total <= 0:
+        return None
+    target = 0.99 * total
+    cum = h["zeros"]
+    if cum >= target:
+        return 0.0
+    entries = sorted(h["buckets"].items())
+    for e, n in entries:
+        cum += n
+        if cum >= target:
+            return 2.0 ** e
+    return 2.0 ** entries[-1][0] if entries else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Sentinel
+# ---------------------------------------------------------------------------
+
+
+class Sentinel:
+    """One armed SLO spec: a bounded sample window plus per-objective
+    alert state.  All methods are deterministic functions of the
+    observed sample stream and the ``now`` values handed in."""
+
+    def __init__(self, spec):
+        self.objectives = normalize_spec(spec)
+        self.max_slow = max(o["slow_s"] for o in self.objectives)
+        # telemetry keys the spec actually references — samples are
+        # filtered to these, so the retained window stays tiny no
+        # matter how many series the process exports
+        self.need_counters: set = set()
+        self.need_hists: set = set()
+        self.need_gauges: set = set()
+        for o in self.objectives:
+            p = o["parsed"]
+            if p[0] == "p99":
+                self.need_hists.add(p[1])
+            elif p[0] == "gauge":
+                self.need_gauges.add(p[1])
+            elif p[0] == "rate":
+                self.need_counters.add(p[1])
+            else:  # ratio
+                self.need_counters.update(p[1:])
+        self.samples: list[dict] = []
+        self.state = {o["name"]: {"state": "ok", "since": None,
+                                  "below_since": None}
+                      for o in self.objectives}
+        self.last: list[dict] = []
+
+    # -- sampling ---------------------------------------------------------
+
+    def observe(self, now: float, counters: dict | None = None,
+                hists: dict | None = None,
+                gauges: dict | None = None) -> None:
+        """Fold one telemetry sample at time ``now`` into the window.
+        Samples must arrive in non-decreasing time order; an
+        out-of-order sample (clock went backwards across a merge) is
+        dropped — determinism beats completeness here."""
+        now = float(now)
+        if self.samples and now < self.samples[-1]["t"]:
+            return
+        counters = counters or {}
+        hists = hists or {}
+        gauges = gauges or {}
+        self.samples.append({
+            "t": now,
+            "counters": {k: counters.get(k, 0)
+                         for k in self.need_counters},
+            "hists": {k: hists[k] for k in self.need_hists
+                      if k in hists},
+            "gauges": {k: gauges[k] for k in self.need_gauges
+                       if k in gauges},
+        })
+        # prune: keep everything inside the longest slow window plus
+        # ONE older sample as that window's baseline
+        cutoff = now - self.max_slow
+        keep_from = 0
+        for i, s in enumerate(self.samples):
+            if s["t"] <= cutoff:
+                keep_from = i
+            else:
+                break
+        del self.samples[:keep_from]
+
+    # -- window evaluation ------------------------------------------------
+
+    def _baseline(self, now: float, window_s: float) -> dict:
+        """Newest sample at or before ``now - window_s`` (else the
+        oldest retained — a short history widens the window rather
+        than inventing data)."""
+        cutoff = now - window_s
+        base = self.samples[0]
+        for s in self.samples:
+            if s["t"] <= cutoff:
+                base = s
+            else:
+                break
+        return base
+
+    def _value(self, obj: dict, base: dict, cur: dict) -> float | None:
+        p = obj["parsed"]
+        kind = p[0]
+        if kind == "gauge":
+            return cur["gauges"].get(p[1])
+        if base is cur:
+            return None  # no window yet
+        if kind == "p99":
+            return _hist_p99(_hist_delta(cur["hists"].get(p[1]),
+                                         base["hists"].get(p[1])))
+        if kind == "rate":
+            dt = cur["t"] - base["t"]
+            if dt <= 0:
+                return None
+            d = cur["counters"].get(p[1], 0) - base["counters"].get(p[1], 0)
+            return max(float(d), 0.0) / dt
+        # ratio
+        da = cur["counters"].get(p[1], 0) - base["counters"].get(p[1], 0)
+        db = cur["counters"].get(p[2], 0) - base["counters"].get(p[2], 0)
+        if db <= 0:
+            return None
+        return max(float(da), 0.0) / float(db)
+
+    def _burn(self, obj: dict, value: float | None) -> float:
+        if value is None:
+            return 0.0
+        v = float(value)
+        t = obj["target"]
+        if obj["direction"] == "max":
+            return min(max(v, 0.0) / t, BURN_CAP)
+        # direction "min": burning when the value is BELOW target
+        if v <= 0:
+            return BURN_CAP
+        return min(t / v, BURN_CAP)
+
+    def evaluate(self, now: float) -> list[dict]:
+        """Re-judge every objective at time ``now`` against the current
+        sample window; returns (and retains, for :meth:`firing` /
+        :meth:`alert_gauges`) one result row per objective."""
+        now = float(now)
+        results = []
+        for obj in self.objectives:
+            name = obj["name"]
+            st = self.state[name]
+            if st["since"] is None:
+                st["since"] = now
+            burn_fast = burn_slow = 0.0
+            vf = vs = None
+            if self.samples:
+                cur = self.samples[-1]
+                bf = self._baseline(now, obj["fast_s"])
+                bs = self._baseline(now, obj["slow_s"])
+                vf = self._value(obj, bf, cur)
+                vs = self._value(obj, bs, cur)
+                burn_fast = self._burn(obj, vf)
+                burn_slow = self._burn(obj, vs)
+            burn = min(burn_fast, burn_slow)
+            raw = ("page" if burn >= obj["page_burn"]
+                   else "warn" if burn >= obj["warn_burn"] else "ok")
+            # hysteresis: escalate immediately, de-escalate only after
+            # the raw verdict held below the current state for hold_s
+            if LEVELS[raw] > LEVELS[st["state"]]:
+                st["state"] = raw
+                st["since"] = now
+                st["below_since"] = None
+            elif LEVELS[raw] < LEVELS[st["state"]]:
+                if st["below_since"] is None:
+                    st["below_since"] = now
+                if now - st["below_since"] >= obj["hold_s"]:
+                    st["state"] = raw
+                    st["since"] = now
+                    st["below_since"] = None
+            else:
+                st["below_since"] = None
+            results.append({
+                "name": name,
+                "state": st["state"],
+                "raw": raw,
+                "since": st["since"],
+                "burn_fast": round(burn_fast, 6),
+                "burn_slow": round(burn_slow, 6),
+                "value_fast": vf,
+                "value_slow": vs,
+                "target": obj["target"],
+                "metric": obj["metric"],
+            })
+        self.last = results
+        return results
+
+    # -- read side --------------------------------------------------------
+
+    def alert_gauges(self) -> dict:
+        """``{"alert.<name>": 0|1|2, "alert.firing": worst}`` from the
+        LAST evaluation (exported as ``quest_alert_*``; mergeable —
+        summing per-worker 0/1/2 levels still reads zero iff every
+        worker is clean, and ``max`` per worker is recoverable from the
+        per-worker snapshot files)."""
+        g = {f"alert.{r['name']}": LEVELS[r["state"]] for r in self.last}
+        g["alert.firing"] = max(
+            [LEVELS[r["state"]] for r in self.last], default=0)
+        return g
+
+    def firing(self) -> list[dict]:
+        """Result rows currently at PAGE, from the LAST evaluation (no
+        resampling — readiness probes read the sentinel's verdict, they
+        do not move its clock)."""
+        return [r for r in self.last if r["state"] == "page"]
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton (the process sentinel metrics.py consults)
+# ---------------------------------------------------------------------------
+
+_state = {"sentinel": None, "env_checked": False, "error": None}
+
+
+def configure(spec=None) -> Sentinel | None:
+    """Arm the process sentinel with ``spec`` (validated immediately;
+    raises ``ValueError`` on a bad spec).  ``configure(None)`` disarms
+    it and re-enables lazy ``QUEST_SLO_SPEC`` arming."""
+    if spec is None:
+        _state.update(sentinel=None, env_checked=False, error=None)
+        return None
+    s = Sentinel(spec)
+    _state.update(sentinel=s, env_checked=True, error=None)
+    return s
+
+
+def _from_env() -> Sentinel | None:
+    raw = (os.environ.get(SPEC_ENV) or "").strip()
+    if not raw:
+        return None
+    if not raw.startswith(("[", "{")):
+        with open(raw) as f:
+            raw = f.read()
+    return Sentinel(json.loads(raw))
+
+
+def active() -> Sentinel | None:
+    """The armed sentinel, if any — arming lazily from
+    ``QUEST_SLO_SPEC`` on first call.  A broken env spec records
+    :func:`last_error` and stays disarmed: a typo'd spec must degrade
+    the sentinel, never the scrape (or run) that consulted it."""
+    s = _state["sentinel"]
+    if s is None and not _state["env_checked"]:
+        _state["env_checked"] = True
+        try:
+            s = _from_env()
+        except (OSError, ValueError) as e:
+            _state["error"] = f"{type(e).__name__}: {e}"
+            s = None
+        _state["sentinel"] = s
+    return s
+
+
+def configured() -> bool:
+    """True when a sentinel is armed (programmatically or via env)."""
+    return active() is not None
+
+
+def last_error() -> str | None:
+    """The reason env arming failed, if it did (None otherwise)."""
+    return _state["error"]
+
+
+def sample_and_evaluate(now: float, counters: dict | None = None,
+                        hists: dict | None = None,
+                        gauges: dict | None = None) -> dict:
+    """Feed one telemetry sample at ``now`` to the armed sentinel,
+    re-evaluate, and return its alert gauges (``{}`` when disarmed) —
+    the one call ``metrics._gauges`` makes per scrape/snapshot."""
+    s = active()
+    if s is None:
+        return {}
+    s.observe(now, counters=counters, hists=hists, gauges=gauges)
+    s.evaluate(now)
+    return s.alert_gauges()
+
+
+def firing() -> list[dict]:
+    """PAGE-state rows from the armed sentinel's last evaluation
+    (empty when disarmed or clean).  Read-only: does not sample, does
+    not advance the window — safe from readiness probes and the
+    admission gate."""
+    s = _state["sentinel"]
+    return s.firing() if s is not None else []
+
+
+def reset() -> None:
+    """Disarm and forget env-arming state (test hook)."""
+    _state.update(sentinel=None, env_checked=False, error=None)

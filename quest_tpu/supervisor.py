@@ -140,7 +140,13 @@ _handlers: dict[int, object] = {}   # signum -> previous handler
 #: Admission gate config (programmatic wins over env, set_watchdog
 #: contract: None keeps, non-positive clears back to env/default).
 _gate = {"on": False, "max_inflight": None, "slo_p99_s": None,
-         "retry_after_s": None, "slo_label": None}
+         "retry_after_s": None, "slo_label": None,
+         "fleet_snapdir": None, "fleet_max_inflight": None}
+
+#: TTL cache over the merged fleet snapshot the gate consults
+#: (re-reading a snapshot directory per admit would tax every run);
+#: guarded by _lock, invalidated by configure_gate.
+_fleet_cache = {"t": None, "view": None}
 
 #: Outermost runs currently executing in this process (admission cap
 #: denominator); guarded by _lock.
@@ -434,13 +440,25 @@ def configure_gate(enabled: bool = True, *,
                    max_inflight: int | None = None,
                    slo_p99_s: float | None = None,
                    retry_after_s: float | None = None,
-                   slo_label: str | None = None) -> None:
+                   slo_label: str | None = None,
+                   fleet_snapdir: str | None = None,
+                   fleet_max_inflight: int | None = None) -> None:
     """Programmatically arm (or disarm) the admission gate and its
     bounds.  ``None`` keeps the current override; a NON-POSITIVE value
     CLEARS the override back to the env/default (the ``set_watchdog``
     contract).  Env knobs for unmodified drivers: ``QUEST_ADMISSION=1``
     arms it, with ``QUEST_MAX_INFLIGHT`` / ``QUEST_SLO_P99_S`` /
-    ``QUEST_RETRY_AFTER_S`` as the bounds."""
+    ``QUEST_RETRY_AFTER_S`` as the bounds.
+
+    Fleet-level admission (ROADMAP item 1's leftover): with
+    ``fleet_snapdir`` (or ``QUEST_FLEET_GATE_SNAPDIR``) pointing at a
+    metrics snapshot directory, the gate also consults the MERGED
+    fleet view — summed ``supervisor.inflight`` gauges against
+    ``fleet_max_inflight`` / ``QUEST_FLEET_MAX_INFLIGHT``, and the
+    fleet-merged ``run.wall_s.<label>`` p99 against the same
+    ``slo_p99_s`` bound — refreshed at most every
+    ``QUEST_FLEET_GATE_REFRESH_S`` seconds (default 1.0).  An empty
+    string clears the directory override."""
     _gate["on"] = bool(enabled)
 
     def _norm(v, cast):
@@ -451,12 +469,19 @@ def configure_gate(enabled: bool = True, *,
 
     for key, v, cast in (("max_inflight", max_inflight, int),
                          ("slo_p99_s", slo_p99_s, float),
-                         ("retry_after_s", retry_after_s, float)):
+                         ("retry_after_s", retry_after_s, float),
+                         ("fleet_max_inflight", fleet_max_inflight,
+                          int)):
         nv = _norm(v, cast)
         if nv != "keep":
             _gate[key] = nv
     if slo_label is not None:
         _gate["slo_label"] = slo_label or None
+    if fleet_snapdir is not None:
+        _gate["fleet_snapdir"] = fleet_snapdir or None
+    with _lock:
+        _fleet_cache["t"] = None
+        _fleet_cache["view"] = None
 
 
 def gate_enabled() -> bool:
@@ -500,6 +525,62 @@ def slo_label() -> str:
         or SLO_LABEL_DEFAULT
 
 
+def fleet_snapdir() -> str | None:
+    """The snapshot directory the gate's fleet checks read (None =
+    local-only admission)."""
+    return (_gate["fleet_snapdir"]
+            or os.environ.get("QUEST_FLEET_GATE_SNAPDIR") or None)
+
+
+def fleet_max_inflight() -> int | None:
+    """The FLEET-WIDE in-flight cap (summed ``supervisor.inflight``
+    gauges across worker snapshots; None = uncapped)."""
+    return _gate_param("fleet_max_inflight", "QUEST_FLEET_MAX_INFLIGHT",
+                       int, None)
+
+
+def _fleet_refresh_s() -> float:
+    try:
+        v = float(os.environ.get("QUEST_FLEET_GATE_REFRESH_S", "1.0"))
+    except ValueError:
+        return 1.0
+    return max(v, 0.0)
+
+
+def fleet_view(refresh: bool = False):
+    """The merged fleet snapshot (``metrics.merge_snapshots`` over the
+    gate's snapshot directory), TTL-cached so back-to-back admits do
+    one directory scan per ``QUEST_FLEET_GATE_REFRESH_S`` window (0 =
+    re-read every call).  None when no directory is configured or no
+    readable snapshots exist — the gate then falls back to local-only
+    checks rather than shedding on a missing fleet view."""
+    d = fleet_snapdir()
+    if not d:
+        return None
+    now = metrics.clock()
+    with _lock:
+        t = _fleet_cache["t"]
+        if (not refresh and t is not None
+                and now - t < _fleet_refresh_s()):
+            return _fleet_cache["view"]
+    snaps = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        names = []
+    for name in names:
+        if (name.startswith(metrics.SNAPSHOT_PREFIX)
+                and name.endswith(".json")):
+            snap = metrics.read_snapshot(os.path.join(d, name))
+            if snap is not None:
+                snaps.append(snap)
+    view = metrics.merge_snapshots(snaps) if snaps else None
+    with _lock:
+        _fleet_cache["t"] = now
+        _fleet_cache["view"] = view
+    return view
+
+
 def inflight() -> int:
     """Outermost runs currently executing in this process."""
     with _lock:
@@ -513,7 +594,10 @@ def _evaluate_gate(reserve_n: int = 0):
     ``shed_overload``) of a refusal.  Checks in severity order —
     unhealthy mesh first (retrying locally cannot help), then the
     concurrency cap, then the live p99-vs-SLO comparison from the SLO
-    histograms (PR 8's ``run.wall_s.<label>``).
+    histograms (PR 8's ``run.wall_s.<label>``), then the SLO
+    sentinel's PAGE verdict (``shed_slo_page``), then — when a fleet
+    snapshot directory is configured — the fleet-wide in-flight cap
+    and fleet-merged p99 (``shed_fleet``).
 
     ``reserve_n`` (the :func:`admit` path) takes that many in-flight
     slots ATOMICALLY with the cap check — check-then-increment under
@@ -549,16 +633,59 @@ def _evaluate_gate(reserve_n: int = 0):
         if need:
             _inflight[0] += need
             reserved = need
+    def _shed(reason, kind):
+        if reserved:
+            with _lock:
+                _inflight[0] -= reserved
+        return False, reason, kind
+
     slo = slo_p99_s()
     if slo is not None:
         h = metrics.histograms().get(f"run.wall_s.{slo_label()}")
         if h and h["count"] and h["p99"] is not None and h["p99"] > slo:
-            if reserved:
-                with _lock:
-                    _inflight[0] -= reserved
-            return (False, f"run.wall_s.{slo_label()} p99 "
-                           f"{h['p99']:g}s breaches the configured "
-                           f"SLO {slo:g}s", "shed_overload")
+            return _shed(f"run.wall_s.{slo_label()} p99 "
+                         f"{h['p99']:g}s breaches the configured "
+                         f"SLO {slo:g}s", "shed_overload")
+    # live SLO sentinel: a PAGE-state alert (quest_tpu.slo) sheds at
+    # admission — the same named verdict /readyz serves as 503.  Reads
+    # the sentinel's LAST evaluation only (scrapes/snapshots advance
+    # its window); WARN does not shed
+    from . import slo as _slo  # deferred: keep the leaf lazily bound
+
+    paging = _slo.firing()
+    if paging:
+        a = paging[0]
+        return _shed(f"SLO alert {a['name']!r} is PAGE "
+                     f"(burn fast {a['burn_fast']:g} / slow "
+                     f"{a['burn_slow']:g} vs target {a['target']:g} "
+                     f"on {a['metric']})", "shed_slo_page")
+    # fleet-level admission (ROADMAP item 1's leftover): the merged
+    # snapshot view — summed in-flight gauges against the fleet cap,
+    # and the fleet-merged run-wall p99 against the same SLO bound the
+    # local check used (one worker's clean local histogram must not
+    # admit while the FLEET is breaching)
+    view = fleet_view()
+    if view is not None:
+        fcap = fleet_max_inflight()
+        if fcap is not None:
+            fin = (view.get("gauges") or {}).get("supervisor.inflight",
+                                                 0)
+            if fin >= fcap:
+                return _shed(
+                    f"fleet concurrency cap saturated ({fin:g} in "
+                    f"flight across {len(view.get('workers') or {})} "
+                    f"worker(s) >= fleet cap {fcap})", "shed_fleet")
+        if slo is not None:
+            fh = (view.get("hists") or {}).get(
+                f"run.wall_s.{slo_label()}")
+            if fh:
+                st = metrics.hist_stats(fh)
+                if (st["count"] and st["p99"] is not None
+                        and st["p99"] > slo):
+                    return _shed(
+                        f"fleet run.wall_s.{slo_label()} p99 "
+                        f"{st['p99']:g}s breaches the configured SLO "
+                        f"{slo:g}s", "shed_fleet")
     if reserved:
         _tls.admit_reserved = reserved
     return True, None, None
@@ -603,13 +730,25 @@ def admit(label: str = "circuit_run", batch: int = 1) -> None:
         retry_after_s=ra)
 
 
+def slo_alert() -> dict | None:
+    """The first PAGE-state SLO alert from the sentinel's last
+    evaluation, or None — the named verdict ``/readyz`` bodies carry
+    (``quest_tpu.slo``; read-only, never advances the sentinel)."""
+    from . import slo as _slo  # deferred: keep the leaf lazily bound
+
+    paging = _slo.firing()
+    return paging[0] if paging else None
+
+
 def readiness():
     """The ``/readyz`` verdict (never counts a decision): ``(ready,
     reason, retry_after_s)`` — ready iff the process is not draining,
     is not mid journal recovery (an unreplayed backlog from a prior
     process means this replica is busy finishing crashed work — a load
-    balancer should not route new traffic here yet), AND the admission
-    gate would admit a run right now."""
+    balancer should not route new traffic here yet), no SLO sentinel
+    alert is at PAGE (the refusal NAMES the firing alert — a pager
+    needs the objective, not just a 503), AND the admission gate would
+    admit a run right now."""
     if _preempt["flag"]:
         return (False, "draining (preemption requested by "
                        f"{_preempt['source']})", retry_after_s())
@@ -618,6 +757,12 @@ def readiness():
         return (False, f"journal recovery in progress: {backlog} "
                        "unreplayed backlog entry(ies) from a prior "
                        "process", retry_after_s())
+    a = slo_alert()
+    if a is not None:
+        return (False, f"SLO alert {a['name']!r} is PAGE (burn fast "
+                       f"{a['burn_fast']:g} / slow {a['burn_slow']:g} "
+                       f"vs target {a['target']:g} on {a['metric']})",
+                retry_after_s())
     if not gate_enabled():
         return True, None, 0.0
     ok, reason, _kind = _evaluate_gate()
@@ -2568,8 +2713,11 @@ def reset() -> None:
     clear_preemption()
     uninstall_preemption_handler()
     _gate.update(on=False, max_inflight=None, slo_p99_s=None,
-                 retry_after_s=None, slo_label=None)
+                 retry_after_s=None, slo_label=None,
+                 fleet_snapdir=None, fleet_max_inflight=None)
     with _lock:
+        _fleet_cache["t"] = None
+        _fleet_cache["view"] = None
         _inflight[0] = 0
         _journal_recovery["pending"] = 0
     _batch["occupancy"] = 0

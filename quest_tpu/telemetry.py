@@ -60,8 +60,15 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 
 _lock = threading.Lock()
+
+#: Unix wall-clock stamp of process start (module import).  Exported as
+#: the ``quest_worker_start_time_seconds`` gauge so uptime and snapshot
+#: staleness are computable from a `/metrics` scrape alone — Prometheus'
+#: own ``process_start_time_seconds`` convention.
+_START_TIME = time.time()
 
 #: Monotonic run-id counter (process-wide; ids are unique per process
 #: and prefixed with the pid so multi-process pod logs stay grep-able).
@@ -153,6 +160,13 @@ def supervise_attempt() -> int | None:
 #: instead of minting a fresh one, so the whole chain shares ONE
 #: trace_id without the checkpoint-sidecar crutch.
 TRACE_CONTEXT_ENV = "QUEST_TRACE_CONTEXT"
+
+
+def process_start_time() -> float:
+    """Unix wall-clock of process start (seconds; stamped at module
+    import).  One authoritative value per worker: the start-time gauge,
+    snapshot staleness math, and uptime panels all derive from it."""
+    return round(_START_TIME, 3)
 
 
 def worker_id() -> str:

@@ -163,6 +163,10 @@ def _reset_strike_and_fault_state():
     qt.resilience.clear_mesh_health()
     qt.metrics.clear_warn_once()
     qt.supervisor.reset()
+    # the SLO sentinel is process-global too: a leftover armed spec
+    # would evaluate (and could PAGE) inside every later scrape/
+    # readiness probe in the session
+    qt.slo.reset()
 
 
 def random_statevector(n, seed):

@@ -221,7 +221,10 @@ def test_batched_mesh_fn_members_and_counters(env8):
     program): each member's result equals the unbatched whole-plan
     program's to reassociation tolerance, and a concrete call records
     the batch-scaled mesh counters."""
-    n, N = 12, 3
+    # n=10 keeps the full 8-device / dev_bits=3 plan structure; larger
+    # n only inflates the two whole-plan compiles past the tier-1
+    # wall-clock budget without adding coverage
+    n, N = 10, 3
     env = qt.create_env(num_devices=8)
     ops = list(models.qft(n).ops)
     bfn = as_batched_mesh_fn(ops, n, env.mesh)

@@ -267,8 +267,12 @@ def test_fleet_endpoint_totals_and_health(tmp_path, monkeypatch):
 
 def test_staleness_marks_worker_suspect(tmp_path, monkeypatch):
     _spill_two_workers(tmp_path, monkeypatch)
-    old = os.path.getmtime(tmp_path / "snap-w1.json")
-    os.utime(tmp_path / "snap-w1.json", (old - 120, old - 120))
+    # age the snapshot via its own embedded ``time`` stamp — the
+    # authoritative staleness timebase since the uptime/identity
+    # gauges landed (mtime is only the pre-stamp fallback)
+    snap = metrics.read_snapshot(str(tmp_path / "snap-w1.json"))
+    snap["time"] = round(snap["time"] - 120.0, 3)
+    metrics.write_snapshot(str(tmp_path), snap)
     doc = fleet_agg.fleet_health(str(tmp_path), staleness_s=60.0)
     assert doc["workers"]["w1"]["status"] == fleet_agg.STATUS_SUSPECT
     assert doc["workers"]["w2"]["status"] == fleet_agg.STATUS_OK

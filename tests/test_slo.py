@@ -1,0 +1,645 @@
+"""Compile observatory + SLO burn-rate sentinel (ISSUE 19 acceptance).
+
+Covers: (a) spec grammar — defaults, validation errors, env arming
+(inline/file/broken); (b) deterministic burn-rate evaluation — exact
+fake-clock OK→WARN→PAGE→OK transition times with hysteresis, and the
+windowed p99 pinned equal to ``metrics.hist_stats``; (c) the alert
+surface — ``quest_alert_*`` gauges in ``export_text`` (absent when
+unconfigured), ``supervisor.readiness`` naming the firing alert, the
+armed gate shedding ``shed_slo_page``; (d) fleet-level admission —
+the gate consulting merged snapshots for the fleet in-flight cap and
+fleet p99 (``shed_fleet``); (e) ``tools/slo_watch.py`` byte-identical
+ledger replay; (f) the compile observatory — events at the
+circuit/batched/observed/mesh_plan seams with memo hits on re-runs
+(never per executed item), the ``compile_share`` ledger annotation,
+the AOT load/save seam attribution bugfix (deserialisation wall under
+``aot_load``, not ``compile``) and aot_corrupt quarantine events;
+(g) ``tools/compile_report.py`` reconciliation over real artifacts
+(exit 1 on a doctored mismatch); (h) the ``counters.compile.fresh``
+ledger_diff rule, both directions plus config-mismatch skip; (i) the
+worker uptime/identity gauges and snapshot time stamps the fleet
+staleness rollup reads.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import metrics, models, slo, supervisor
+from quest_tpu.circuit import Circuit
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import ledger_diff  # noqa: E402
+import metrics_serve  # noqa: E402
+
+N = 6
+
+
+# ---------------------------------------------------------------------------
+# (a) spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_spec_defaults_and_shapes():
+    objs = slo.normalize_spec(
+        [{"name": "a", "metric": "rate:x.y", "target": 2.0}])
+    o = objs[0]
+    assert o["direction"] == "max" and o["fast_s"] == 60.0
+    assert o["slow_s"] == 300.0 and o["hold_s"] == 120.0
+    assert o["warn_burn"] == 1.0 and o["page_burn"] == 2.0
+    assert o["parsed"] == ("rate", "x.y")
+    # dict wrapper + ratio parsing
+    objs = slo.normalize_spec({"objectives": [
+        {"name": "r", "metric": "ratio:a.b/c.d", "target": 0.1}]})
+    assert objs[0]["parsed"] == ("ratio", "a.b", "c.d")
+
+
+@pytest.mark.parametrize("bad", [
+    [],
+    [{"metric": "rate:x", "target": 1}],                   # no name
+    [{"name": "a", "metric": "p42:x", "target": 1}],       # bad kind
+    [{"name": "a", "metric": "ratio:x", "target": 1}],     # no denom
+    [{"name": "a", "metric": "rate:x", "target": 0}],      # target <= 0
+    [{"name": "a", "metric": "rate:x", "target": 1,
+      "direction": "sideways"}],
+    [{"name": "a", "metric": "rate:x", "target": 1,
+      "fast_s": 90, "slow_s": 60}],                        # fast > slow
+    [{"name": "a", "metric": "rate:x", "target": 1,
+      "warn_burn": 3, "page_burn": 2}],
+    [{"name": "a", "metric": "rate:x", "target": 1, "hold_s": -1}],
+    [{"name": "a", "metric": "rate:x", "target": 1},
+     {"name": "a", "metric": "rate:y", "target": 1}],      # dup name
+])
+def test_spec_validation_errors(bad):
+    with pytest.raises(ValueError):
+        slo.normalize_spec(bad)
+
+
+def test_env_arming_inline_file_and_broken(monkeypatch, tmp_path):
+    spec = [{"name": "e", "metric": "gauge:g.x", "target": 5.0}]
+    monkeypatch.setenv("QUEST_SLO_SPEC", json.dumps(spec))
+    slo.reset()
+    assert slo.configured() and slo.last_error() is None
+    # file-path form
+    p = tmp_path / "spec.json"
+    p.write_text(json.dumps(spec))
+    monkeypatch.setenv("QUEST_SLO_SPEC", str(p))
+    slo.reset()
+    assert slo.configured()
+    # broken spec: disarmed + last_error, never an exception (and the
+    # probe caches — the file is not re-read per scrape)
+    monkeypatch.setenv("QUEST_SLO_SPEC", '[{"name": "x"')
+    slo.reset()
+    assert not slo.configured()
+    assert "ValueError" in (slo.last_error() or "") \
+        or "JSON" in (slo.last_error() or "")
+    # unconfigured process: no alert gauges in the scrape
+    monkeypatch.delenv("QUEST_SLO_SPEC")
+    slo.reset()
+    assert "quest_alert_" not in metrics.export_text()
+
+
+# ---------------------------------------------------------------------------
+# (b) deterministic burn-rate evaluation
+# ---------------------------------------------------------------------------
+
+
+def _shed_spec(**over):
+    o = {"name": "storm", "metric": "rate:t.sheds", "target": 1.0,
+         "fast_s": 10.0, "slow_s": 40.0, "warn_burn": 1.0,
+         "page_burn": 2.0, "hold_s": 20.0}
+    o.update(over)
+    return [o]
+
+
+def test_exact_transition_times_ok_warn_page_ok():
+    """THE determinism pin: a scripted counter stream through a fake
+    clock produces exact state transitions at exact times."""
+    s = slo.Sentinel(_shed_spec())
+
+    def step(t, sheds):
+        s.observe(t, counters={"t.sheds": sheds})
+        return s.evaluate(t)[0]
+
+    r = step(0.0, 0)
+    assert (r["state"], r["raw"]) == ("ok", "ok")
+    # t=10: 15 sheds over the 10s fast window (and 10s of history for
+    # the slow window) -> burn 1.5 on both -> WARN, since == 10
+    r = step(10.0, 15)
+    assert (r["state"], r["raw"], r["since"]) == ("warn", "warn", 10.0)
+    assert r["burn_fast"] == 1.5 and r["burn_slow"] == 1.5
+    # t=20: 30 more -> fast 3.0, slow 45/20 = 2.25 -> PAGE at 20
+    r = step(20.0, 45)
+    assert (r["state"], r["since"]) == ("page", 20.0)
+    assert r["burn_fast"] == 3.0 and r["burn_slow"] == 2.25
+    # t=30: drained (no new sheds): fast burn 0 -> raw ok, but the
+    # 20s hold pins PAGE (below_since = 30)
+    r = step(30.0, 45)
+    assert (r["state"], r["raw"]) == ("page", "ok")
+    # t=45: still inside the hold (45 - 30 < 20)
+    r = s.evaluate(45.0)[0]
+    assert r["state"] == "page"
+    # t=50: hold satisfied (50 - 30 >= 20) -> OK, since == 50
+    r = s.evaluate(50.0)[0]
+    assert (r["state"], r["raw"], r["since"]) == ("ok", "ok", 50.0)
+
+
+def test_replayed_stream_is_identical():
+    """Same sample stream -> identical result rows, run to run."""
+    stream = [(0.0, 0), (5.0, 4), (12.0, 9), (26.0, 9), (33.0, 40)]
+
+    def run():
+        s = slo.Sentinel(_shed_spec())
+        hist = []
+        for t, c in stream:
+            s.observe(t, counters={"t.sheds": c})
+            hist.append(s.evaluate(t))
+        return hist
+
+    assert run() == run()
+
+
+def test_out_of_order_sample_dropped_and_no_data_burns_zero():
+    s = slo.Sentinel(_shed_spec())
+    s.observe(10.0, counters={"t.sheds": 5})
+    s.observe(3.0, counters={"t.sheds": 99})  # clock went backwards
+    assert len(s.samples) == 1
+    r = s.evaluate(10.0)[0]  # single sample: no window -> burn 0
+    assert r["burn_fast"] == 0.0 and r["state"] == "ok"
+
+
+def test_min_direction_and_ratio():
+    spec = [{"name": "hidden", "metric": "ratio:t.hid/t.tot",
+             "target": 0.5, "direction": "min", "fast_s": 10.0,
+             "slow_s": 10.0, "hold_s": 0.0}]
+    s = slo.Sentinel(spec)
+    s.observe(0.0, counters={"t.hid": 0, "t.tot": 0})
+    # ratio 0.1 vs min-target 0.5 -> burn 5.0 -> PAGE
+    s.observe(10.0, counters={"t.hid": 1, "t.tot": 10})
+    r = s.evaluate(10.0)[0]
+    assert r["value_fast"] == pytest.approx(0.1)
+    assert r["burn_fast"] == 5.0 and r["state"] == "page"
+    # recovery is immediate at hold_s=0
+    s.observe(20.0, counters={"t.hid": 9, "t.tot": 10})
+    assert s.evaluate(20.0)[0]["state"] == "ok"
+
+
+def test_windowed_p99_matches_hist_stats():
+    """The sentinel's stdlib-local quantile math is pinned bit-equal to
+    ``metrics.hist_stats`` over the same serialized bucket state."""
+    name = "t.slo.p99pin"
+    for v in (0.001, 0.004, 0.004, 0.03, 0.03, 0.03, 0.9, 0.0):
+        metrics.hist_record(name, v)
+    serialized = metrics.snapshot()["hists"][name]
+    ref = metrics.hist_stats(serialized)["p99"]
+    s = slo.Sentinel([{"name": "p", "metric": f"p99:{name}",
+                       "target": 10.0, "fast_s": 5.0, "slow_s": 5.0}])
+    s.observe(0.0, hists={})           # empty baseline
+    s.observe(10.0, hists={name: serialized})
+    r = s.evaluate(10.0)[0]
+    assert r["value_fast"] == ref  # bit-equal, not approx
+
+
+# ---------------------------------------------------------------------------
+# (c) alert surface: gauges, readiness, admission
+# ---------------------------------------------------------------------------
+
+
+def _arm_paging(target=0.5):
+    """Arm the process sentinel and script it straight to PAGE."""
+    slo.configure(_shed_spec(target=target, hold_s=8.0, fast_s=4.0,
+                             slow_s=16.0))
+    slo.sample_and_evaluate(100.0, counters={"t.sheds": 0})
+    g = slo.sample_and_evaluate(104.0, counters={"t.sheds": 8})
+    assert g == {"alert.storm": 2, "alert.firing": 2}
+    return g
+
+
+def test_alert_gauges_in_scrape():
+    _arm_paging()
+    text = metrics.export_text()
+    samples = metrics_serve.parse_text(text)
+    assert samples["quest_alert_storm"] == 2.0
+    assert samples["quest_alert_firing"] == 2.0
+
+
+def test_readiness_names_firing_alert():
+    """PAGE degrades /readyz (503) with the alert NAMED — even with
+    the admission gate disarmed."""
+    assert supervisor.readiness()[0]
+    _arm_paging()
+    a = supervisor.slo_alert()
+    assert a is not None and a["name"] == "storm"
+    ready, reason, retry = supervisor.readiness()
+    assert not ready and "storm" in reason and "PAGE" in reason
+    assert retry > 0
+    # de-escalate: drained + past the hold -> ready again
+    slo.sample_and_evaluate(112.0, counters={"t.sheds": 8})
+    slo.sample_and_evaluate(121.0, counters={"t.sheds": 8})
+    assert supervisor.slo_alert() is None
+    assert supervisor.readiness()[0]
+
+
+def test_gate_sheds_on_page(env1):
+    _arm_paging()
+    supervisor.configure_gate(True, retry_after_s=3.5)
+    before = metrics.counters().get("supervisor.shed_slo_page", 0)
+    with pytest.raises(qt.QuESTOverloadError) as ei:
+        supervisor.admit("t")
+    msg = str(ei.value)
+    assert "shed_slo_page" in msg and "storm" in msg
+    assert ei.value.retry_after_s == 3.5
+    assert metrics.counters()["supervisor.shed_slo_page"] == before + 1
+    # a real run sheds the same way
+    circ = models.qft(N)
+    with pytest.raises(qt.QuESTOverloadError):
+        circ.run(qt.create_qureg(N, env1))
+
+
+# ---------------------------------------------------------------------------
+# (d) fleet-level admission
+# ---------------------------------------------------------------------------
+
+
+def _doctored_snapshot(wid, inflight=0, wall_hist=()):
+    """A real snapshot re-stamped as worker ``wid`` with scripted
+    in-flight gauge / run-wall observations."""
+    metrics.reset()
+    for v in wall_hist:
+        metrics.hist_record("run.wall_s.circuit_run", v)
+    s = metrics.snapshot()
+    s["worker"] = wid
+    s["gauges"]["supervisor.inflight"] = inflight
+    return s
+
+
+def test_fleet_inflight_cap_sheds(tmp_path, monkeypatch):
+    monkeypatch.setenv("QUEST_FLEET_GATE_REFRESH_S", "0")
+    d = str(tmp_path)
+    for wid, inf in (("fa", 3), ("fb", 2)):
+        metrics.write_snapshot(d, _doctored_snapshot(wid, inflight=inf))
+    metrics.reset()
+    supervisor.configure_gate(True, fleet_snapdir=d,
+                              fleet_max_inflight=6)
+    supervisor.admit("t")  # 5 < 6: admitted
+    supervisor.configure_gate(True, fleet_snapdir=d,
+                              fleet_max_inflight=4)
+    with pytest.raises(qt.QuESTOverloadError) as ei:
+        supervisor.admit("t")
+    assert "shed_fleet" in str(ei.value)
+    assert metrics.counters()["supervisor.shed_fleet"] >= 1
+
+
+def test_fleet_merged_p99_sheds(tmp_path, monkeypatch):
+    """One worker's clean local histogram must not admit while the
+    FLEET-merged p99 breaches the SLO."""
+    monkeypatch.setenv("QUEST_FLEET_GATE_REFRESH_S", "0")
+    d = str(tmp_path)
+    metrics.write_snapshot(
+        d, _doctored_snapshot("slow", wall_hist=[2.0] * 8))
+    metrics.reset()  # LOCAL histograms now clean
+    supervisor.configure_gate(True, fleet_snapdir=d, slo_p99_s=0.5)
+    with pytest.raises(qt.QuESTOverloadError) as ei:
+        supervisor.admit("t")
+    assert "shed_fleet" in str(ei.value) and "fleet" in str(ei.value)
+    # same bound, healthy fleet: admitted
+    supervisor.reset()
+    metrics.write_snapshot(
+        d, _doctored_snapshot("slow", wall_hist=[0.01] * 8))
+    metrics.reset()
+    supervisor.configure_gate(True, fleet_snapdir=d, slo_p99_s=0.5)
+    supervisor.admit("t")
+
+
+# ---------------------------------------------------------------------------
+# (e) slo_watch byte-identical replay
+# ---------------------------------------------------------------------------
+
+
+def _watch(ledger, spec, *extra):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "slo_watch.py"),
+         "--ledger", str(ledger), "--spec", json.dumps(spec), *extra],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+def test_slo_watch_replay_byte_identical(tmp_path, monkeypatch, env1):
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("QUEST_METRICS_FILE", str(ledger))
+    circ = models.qft(N)
+    for _ in range(2):
+        circ.run(qt.create_qureg(N, env1))
+    monkeypatch.delenv("QUEST_METRICS_FILE")
+    # a p99 objective against an absurd target pages on replay
+    spec = [{"name": "slow", "metric": "p99:run.wall_s.circuit_run",
+             "target": 1e-6, "fast_s": 0.001, "slow_s": 0.01,
+             "hold_s": 1e6}]
+    a = _watch(ledger, spec, "--fail-on-page")
+    b = _watch(ledger, spec, "--fail-on-page")
+    assert a.returncode == 1 and b.returncode == 1  # paging -> exit 1
+    assert a.stdout == b.stdout and a.stdout.count("\n") == 2
+    assert "slow PAGE" in a.stdout
+    # benign spec: exit 0, objective OK
+    ok = _watch(ledger, [{"name": "sheds",
+                          "metric": "rate:supervisor.shed_overload",
+                          "target": 5.0}], "--fail-on-page")
+    assert ok.returncode == 0 and "sheds OK" in ok.stdout
+
+
+# ---------------------------------------------------------------------------
+# (f) compile observatory
+# ---------------------------------------------------------------------------
+
+
+def _events(rec):
+    return [(e["seam"], e["outcome"]) for e in
+            rec.get("compile_events") or []]
+
+
+def test_circuit_seam_fresh_then_memo(env1):
+    circ = models.qft(N)
+    q = qt.create_qureg(N, env1)
+    circ.run(q)
+    rec1 = metrics.get_run_ledger()
+    evs1 = rec1["compile_events"]
+    assert ("circuit", "fresh") in _events(rec1)
+    fresh = [e for e in evs1 if e["outcome"] == "fresh"][0]
+    assert fresh["wall_s"] > 0 and len(fresh["fingerprint"]) == 16
+    assert "comm_config" in fresh
+    # compile-share annotation + the ledger_diff binding stamp
+    assert rec1["meta"]["compile_wall_s"] > 0
+    assert 0.0 < rec1["meta"]["compile_share"] <= 1.0
+    assert rec1["comm_config"] == fresh["comm_config"]
+    # warm re-run: memo hit only, SAME fingerprint, no fresh anywhere
+    before = metrics.counters()["compile.fresh"]
+    circ.run(qt.create_qureg(N, env1))
+    rec2 = metrics.get_run_ledger()
+    assert _events(rec2) == [("circuit", "memo_hit")]
+    assert rec2["compile_events"][0]["fingerprint"] \
+        == fresh["fingerprint"]
+    assert metrics.counters()["compile.fresh"] == before
+    # memo-hit records stay priced: zero compile wall annotated
+    assert rec2["meta"]["compile_wall_s"] == 0.0
+
+
+def test_observed_and_mesh_plan_seams_not_per_item(env8, monkeypatch):
+    """Observed-path compiles report at BUILD time only: re-running
+    the same plan adds memo hits, never new fresh/mesh_plan events —
+    the 'never per executed item' acceptance pin."""
+    monkeypatch.setenv("QUEST_HEALTH_EVERY", "1")  # forces observed
+    circ = models.random_circuit(N, depth=2, seed=11)
+    circ.measure(0)
+    circ.run(qt.create_qureg(N, env8))
+    rec1 = metrics.get_run_ledger()
+    evs = _events(rec1)
+    assert ("observed", "fresh") in evs
+    n_plan = evs.count(("mesh_plan", "fresh"))
+    assert n_plan >= 1
+    c = metrics.counters()
+    plan_fresh = c["compile.mesh_plan.fresh"]
+    total_fresh = c["compile.fresh"]
+    circ.run(qt.create_qureg(N, env8))
+    rec2 = metrics.get_run_ledger()
+    assert ("observed", "memo_hit") in _events(rec2)
+    assert all(o != "fresh" for _, o in _events(rec2))
+    c2 = metrics.counters()
+    assert c2["compile.mesh_plan.fresh"] == plan_fresh
+    assert c2["compile.fresh"] == total_fresh
+
+
+def test_batched_seam_carries_batch_shape(env8):
+    circ = models.random_circuit(N, depth=2, seed=3)
+    circ.measure(0)
+    bq = qt.create_batched_qureg(N, env8, 4)
+    circ.run_batched(bq)
+    rec = metrics.get_run_ledger()
+    ev = [e for e in rec["compile_events"]
+          if e["seam"] == "batched"][0]
+    assert ev["outcome"] == "fresh"
+    assert ev["batch_shape"] == [4, N]
+
+
+def test_default_path_purity(env1):
+    """Observatory on by default: a plain warm run emits compile
+    events at the compile seam only — one memo hit, nothing per item,
+    and zero events outside run scopes from plain counter reads."""
+    circ = models.qft(N)
+    circ.run(qt.create_qureg(N, env1))  # warm the memo
+    with metrics.run_ledger("purity_probe") as rec:
+        pass
+    assert "compile_events" not in rec  # no ambient events
+    circ.run(qt.create_qureg(N, env1))
+    rec = metrics.get_run_ledger()
+    assert len(rec["compile_events"]) == 1  # exactly the memo hit
+
+
+_AOT_SEAM_SUB = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["QUEST_AOT_CACHE"] = {cache!r}
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
+from quest_tpu import metrics, models, register
+
+n = 10
+ops = tuple(models.random_circuit(n, depth=2, seed=4).ops)
+
+def events(rec):
+    return [(e["seam"], e["outcome"]) for e in
+            rec.get("compile_events") or []]
+
+# cold: fresh compile + AOT save, both walled; stream event wall 0
+with metrics.run_ledger("cold") as rec:
+    register._stream_fn(ops, n, None)
+assert ("stream", "fresh") in events(rec), rec
+assert ("aot_save", "fresh") in events(rec), rec
+saves = [e for e in rec["compile_events"] if e["seam"] == "aot_save"]
+assert saves[0]["wall_s"] > 0
+assert rec["spans"]["compile"]["seconds"] > 0
+cold_spans = rec["spans"]
+
+# warm in-process: pure memo hit
+with metrics.run_ledger("memo") as rec:
+    register._stream_fn(ops, n, None)
+assert events(rec) == [("stream", "memo_hit")], rec
+
+# cold process simulated: cleared memo -> AOT load; the deserialise
+# wall books under aot_load, NOT compile (the span bugfix pin)
+register._STREAM_CACHE.clear()
+with metrics.run_ledger("aot") as rec:
+    register._stream_fn(ops, n, None)
+assert ("stream", "aot_hit") in events(rec), rec
+assert ("aot_load", "aot_hit") in events(rec), rec
+loads = [e for e in rec["compile_events"] if e["seam"] == "aot_load"]
+assert loads[0]["wall_s"] > 0
+assert "compile" not in rec["spans"], rec["spans"]
+assert rec["spans"]["aot_load"]["seconds"] > 0
+assert rec["meta"]["compile_wall_s"] == loads[0]["wall_s"]
+
+# corrupt artifact: quarantined + rebuilt fresh
+blobs = [f for f in os.listdir({cache!r}) if f.startswith("stream-")
+         and f.endswith(".pkl")]
+with open(os.path.join({cache!r}, blobs[0]), "r+b") as f:
+    f.write(b"garbage")
+register._STREAM_CACHE.clear()
+with metrics.run_ledger("corrupt") as rec:
+    register._stream_fn(ops, n, None)
+ev = events(rec)
+assert ("aot_load", "aot_corrupt") in ev, rec
+assert ("stream", "fresh") in ev, rec
+c = metrics.counters()
+assert c["compile.aot_load.aot_corrupt"] == 1
+assert c["aot.corrupt_artifacts"] == 1
+print("AOT_SEAMS_OK")
+"""
+
+
+def test_aot_seam_attribution_single_device(tmp_path):
+    """The satellite bugfix end to end, in a 1-device subprocess (the
+    AOT cache guards itself off on the 8-device suite host)."""
+    src = tmp_path / "sub.py"
+    cache = str(tmp_path / "aot")
+    os.makedirs(cache, exist_ok=True)
+    src.write_text(_AOT_SEAM_SUB.format(repo=REPO, cache=cache))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("QUEST_METRICS_FILE", None)
+    r = subprocess.run([sys.executable, str(src)], capture_output=True,
+                       text=True, timeout=600, env=env, cwd=tmp_path)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    assert "AOT_SEAMS_OK" in r.stdout
+
+
+def test_compile_event_validation_and_suppression():
+    with pytest.raises(ValueError):
+        metrics.compile_event("circuit", "nope")
+    before = dict(metrics.counters())
+    with metrics.suppressed():
+        metrics.compile_event("circuit", "fresh", wall_s=1.0)
+    assert metrics.counters() == before
+
+
+# ---------------------------------------------------------------------------
+# (g) compile_report reconciliation
+# ---------------------------------------------------------------------------
+
+
+def _report(*args):
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "compile_report.py"), *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+def test_compile_report_accounts_for_every_fresh(tmp_path, monkeypatch,
+                                                 env1):
+    """THE reconciliation pin: over a real run, the cold-start table's
+    fresh counts match the ``compile.fresh`` counter and the summed
+    event walls match the ``compile.wall_s.*`` histogram walls."""
+    metrics.reset()
+    ledger = tmp_path / "ledger.jsonl"
+    snaps = tmp_path / "snaps"
+    monkeypatch.setenv("QUEST_METRICS_FILE", str(ledger))
+    monkeypatch.setenv("QUEST_METRICS_SNAPDIR", str(snaps))
+    monkeypatch.setenv("QUEST_METRICS_SNAP_EVERY", "1")
+    for seed in (1, 1, 2):  # two programs, one warm hit
+        models.random_circuit(N, depth=2, seed=seed).run(
+            qt.create_qureg(N, env1))
+    monkeypatch.delenv("QUEST_METRICS_FILE")
+    monkeypatch.delenv("QUEST_METRICS_SNAPDIR")
+    r = _report("--ledger", str(ledger), "--snapdir", str(snaps),
+                "--json")
+    assert r.returncode == 0, r.stdout
+    doc = json.loads(r.stdout)
+    rc = doc["reconcile"]
+    assert rc["fresh_ok"] and rc["wall_ok"]
+    # seed 1 compiled once (its repeat is a warm memo hit), seed 2
+    # once; any further fresh events (e.g. register init programs)
+    # must still reconcile — the fresh_ok/wall_ok pins above are the
+    # real contract
+    assert rc["fresh_events"] >= 2
+    assert rc["event_wall_s"] == pytest.approx(rc["hist_wall_s"],
+                                               abs=1e-6)
+    assert len(doc["table"]) >= 2
+    # a doctored ledger (one invented fresh event) MUST fail closed
+    rec = {"label": "fake", "wall_s": 0.1, "compile_events": [
+        {"seam": "circuit", "outcome": "fresh", "wall_s": 0.05,
+         "fingerprint": "feedfacefeedface", "comm_config": ""}]}
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(ledger.read_text() + json.dumps(rec) + "\n")
+    r = _report("--ledger", str(bad), "--snapdir", str(snaps))
+    assert r.returncode == 1 and "MISMATCH" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# (h) ledger_diff rule
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_diff_compile_fresh_rule_both_directions():
+    old = {"counters": {"compile.fresh": 2}, "comm_config": "pipe/f32"}
+    up = {"counters": {"compile.fresh": 5}, "comm_config": "pipe/f32"}
+    down = {"counters": {"compile.fresh": 1}, "comm_config": "pipe/f32"}
+    other = {"counters": {"compile.fresh": 9}, "comm_config": "off/f64"}
+    v, checked, _ = ledger_diff.gate(old, up)
+    assert [x["key"] for x in v] == ["counters.compile.fresh"]
+    v, checked, _ = ledger_diff.gate(old, down)
+    assert not v
+    assert any(c["key"] == "counters.compile.fresh" for c in checked)
+    v, _, skipped = ledger_diff.gate(old, other)
+    assert not v
+    assert ("counters.compile.fresh", "config mismatch") in skipped
+    # zero baseline + any appearance: fires (the +0 contract)
+    v, _, _ = ledger_diff.gate(
+        {"counters": {"compile.fresh": 0}, "comm_config": "x"},
+        {"counters": {"compile.fresh": 1}, "comm_config": "x"})
+    assert v and v[0]["change"] == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# (i) uptime/identity gauges + snapshot stamps
+# ---------------------------------------------------------------------------
+
+
+def test_worker_identity_gauges_in_scrape():
+    import time
+
+    from quest_tpu import telemetry
+
+    samples = metrics_serve.parse_text(metrics.export_text())
+    start = samples["quest_worker_start_time_seconds"]
+    assert start == telemetry.process_start_time()
+    assert 0 < start <= time.time()
+    assert samples["quest_snapshot_time_seconds"] >= start
+    assert "quest_snapshot_epoch" in samples
+
+
+def test_snapshot_time_drives_staleness(tmp_path):
+    """fleet_agg ages workers off the snapshot's own time stamp (the
+    same value scraped as quest_snapshot_time_seconds), not mtime."""
+    import fleet_agg
+
+    s = metrics.snapshot()
+    s["worker"] = "stale-w"
+    t0 = s["time"]
+    metrics.write_snapshot(str(tmp_path), s)
+    h = fleet_agg.fleet_health(str(tmp_path), staleness_s=60.0,
+                               now=t0 + 120.0)
+    assert h["workers"]["stale-w"]["status"] == "SUSPECT"
+    assert h["workers"]["stale-w"]["age_s"] == pytest.approx(120.0)
+    h = fleet_agg.fleet_health(str(tmp_path), staleness_s=60.0,
+                               now=t0 + 5.0)
+    assert h["workers"]["stale-w"]["status"] == "OK"

@@ -867,6 +867,101 @@ def drill_overload_shed(circ, env, ndev, pallas):
            **delta)
 
 
+def drill_slo_burn_page(circ, env, ndev, pallas):
+    """Scripted overload drives the SLO sentinel's fast-window burn to
+    PAGE; ``/readyz`` 503s NAMING the alert and the armed gate sheds
+    with ``shed_slo_page``; the load drains and the alert de-escalates
+    to OK only after the hysteresis hold — all on a FAKE clock (the
+    sentinel is clocked by the ``now`` values handed in), so every
+    burn number and transition time below is exact, zero randomness."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from quest_tpu import slo
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_serve
+
+    before = metrics.counters()
+    # fast window 4s / slow 16s over the shed rate; page at burn >= 2
+    # (the DEFAULTS), de-escalate after an 8s clean hold
+    slo.configure([{"name": "shed_storm",
+                    "metric": "rate:supervisor.shed_overload",
+                    "target": 0.5, "fast_s": 4.0, "slow_s": 16.0,
+                    "hold_s": 8.0}])
+    supervisor.configure_gate(True, max_inflight=2, retry_after_s=7.5)
+    server, port = metrics_serve.start_in_thread(0)
+
+    def readyz():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=30) as r:
+                return r.status, _json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read().decode())
+
+    def state():
+        return slo.active().last[0]
+
+    try:
+        # t=100: clean baseline sample -> OK, /readyz admits
+        slo.sample_and_evaluate(100.0, counters=metrics.counters())
+        ok_before = state()["state"] == "ok" and readyz()[0] == 200
+        # scripted overload: saturate the in-flight cap and shed 8
+        # runs (a 2/s shed rate over the 4s fast window = burn 4.0)
+        sheds = 0
+        with supervisor.run_scope(None), supervisor.run_scope(None):
+            for _ in range(8):
+                try:
+                    circ.run(qt.create_qureg(N_QUBITS, env),
+                             pallas=pallas)
+                except qt.QuESTOverloadError:
+                    sheds += 1
+        # t=104: the storm lands in both windows -> PAGE, exact burns
+        slo.sample_and_evaluate(104.0, counters=metrics.counters())
+        row = state()
+        paged = (row["state"] == "page" and row["burn_fast"] == 4.0
+                 and row["burn_slow"] == 4.0)
+        code, body = readyz()
+        readyz_named = (code == 503 and not body["ready"]
+                        and body.get("alert") == "shed_storm"
+                        and "shed_storm" in body["reason"]
+                        and body["retry_after_s"] == 7.5)
+        # while PAGE, the armed gate refuses NEW load (fleet-admission
+        # wiring): shed_slo_page, with the alert named in the error
+        shed_page = False
+        try:
+            circ.run(qt.create_qureg(N_QUBITS, env), pallas=pallas)
+        except qt.QuESTOverloadError as e:
+            shed_page = "shed_slo_page" in str(e) \
+                and "shed_storm" in str(e)
+        # t=112: load drained (zero shed delta) -> raw verdict OK, but
+        # hysteresis holds PAGE; t=118 still inside the 8s hold;
+        # t=121 >= 112+8 -> OK again, /readyz admits
+        slo.sample_and_evaluate(112.0, counters=metrics.counters())
+        hold1 = state()["state"] == "page" and state()["raw"] == "ok"
+        slo.sample_and_evaluate(118.0, counters=metrics.counters())
+        hold2 = state()["state"] == "page" and readyz()[0] == 503
+        slo.sample_and_evaluate(121.0, counters=metrics.counters())
+        recovered = state()["state"] == "ok" and readyz()[0] == 200
+    finally:
+        server.shutdown()
+        supervisor.configure_gate(False, max_inflight=-1,
+                                  retry_after_s=-1.0)
+        slo.reset()
+    delta = counters_delta(before, ("supervisor.shed_overload",
+                                    "supervisor.shed_slo_page"))
+    ok = (ok_before and sheds == 8 and paged and readyz_named
+          and shed_page and hold1 and hold2 and recovered
+          and delta["supervisor.shed_overload"] == 8
+          and delta["supervisor.shed_slo_page"] == 1)
+    record("slo_burn_page", ok, ok_before=ok_before, sheds=sheds,
+           paged=paged, readyz_named=readyz_named, shed_page=shed_page,
+           hysteresis_hold=hold1 and hold2, recovered=recovered,
+           **delta)
+
+
 #: Virtual failure-domain topology of the slice scenarios: 2 slices x
 #: 4 chips over the 8-device virtual mesh (QUEST_SLICE_SHAPE).
 SLICE_SHAPE = "2x4"
@@ -1777,6 +1872,8 @@ SCENARIOS = [
      lambda c, e, n, p, r: drill_deadline_budget(c, e, p, r)),
     ("overload_shed", False,
      lambda c, e, n, p, r: drill_overload_shed(c, e, n, p)),
+    ("slo_burn_page", False,
+     lambda c, e, n, p, r: drill_slo_burn_page(c, e, n, p)),
     ("slice_loss_resume", False,
      lambda c, e, n, p, r: drill_slice_loss_resume(c, e, n, p)),
     ("dcn_straggler", False,

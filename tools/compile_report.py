@@ -1,0 +1,237 @@
+"""Cold-start cost table from the compile observatory's event stream.
+
+``metrics.compile_event`` stamps every compile/cache decision — seam,
+outcome, attributed wall, plan fingerprint, ``comm_config_token`` —
+onto the run-ledger records it happened inside.  This tool aggregates
+one or more ledger JSONL files (``$QUEST_METRICS_FILE`` spills, e.g.
+from a multi-worker fleet run) into the table ROADMAP item 2's
+persistent compile cache will be keyed on: per
+``fingerprint × comm_config``, how often each outcome fired and how
+much wall the fresh compiles cost.
+
+With ``--snapdir`` it also RECONCILES the ledger view against the
+workers' spilled metric snapshots: the number of ``fresh`` events in
+the ledgers must equal the merged ``compile.fresh`` counter, and the
+sum of per-event walls must equal the summed ``compile.wall_s.*``
+histogram totals (the wall is rounded ONCE at the event, so the two
+sides agree exactly).  A mismatch means compile activity escaped run
+attribution — exit 1, because a warm-list built from an incomplete
+table would silently under-warm.
+
+Stdlib-only (no quest_tpu / jax import): runs next to the artifacts
+on a machine with nothing else installed.
+
+Usage::
+
+    python tools/compile_report.py --ledger FILE [--ledger FILE ...]
+                                   [--snapdir DIR] [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+
+#: Reconciliation tolerance for the wall sums: both sides are sums of
+#: the SAME once-rounded (1e-6) walls, so only accumulated float error
+#: remains.
+WALL_TOL = 1e-6
+
+OUTCOMES = ("memo_hit", "aot_hit", "fresh", "aot_corrupt")
+
+
+def _crc(body: str) -> str:
+    return f"{zlib.crc32(body.encode()) & 0xFFFFFFFF:08x}"
+
+
+def read_snap(path: str) -> dict | None:
+    """Stdlib twin of ``metrics.read_snapshot`` (CRC32 frame under
+    ``"snap"``); None when torn/corrupt."""
+    try:
+        with open(path) as f:
+            frame = json.loads(f.read())
+        snap = frame["snap"]
+        if _crc(json.dumps(snap, sort_keys=True)) != frame["crc"]:
+            return None
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    return snap if isinstance(snap, dict) else None
+
+
+def scan_snapshots(snapdir: str) -> list[dict]:
+    """Readable snapshots, newest epoch per worker (the
+    ``merge_snapshots`` dedup rule — one file per worker in practice,
+    but a copied directory must not double-count)."""
+    by_worker: dict[str, dict] = {}
+    try:
+        names = sorted(os.listdir(snapdir))
+    except OSError:
+        return []
+    for name in names:
+        if not (name.startswith("snap-") and name.endswith(".json")):
+            continue
+        snap = read_snap(os.path.join(snapdir, name))
+        if not snap:
+            continue
+        wid = str(snap.get("worker") or name[5:-5])
+        prev = by_worker.get(wid)
+        if prev is None or int(snap.get("epoch") or 0) >= int(
+                prev.get("epoch") or 0):
+            by_worker[wid] = snap
+    return [by_worker[w] for w in sorted(by_worker)]
+
+
+def read_ledger_events(paths: list[str]) -> tuple[list[dict], int]:
+    """Every compile event from the given ledger JSONL files, plus the
+    count of unparseable lines (torn tails tolerated, counted)."""
+    events: list[dict] = []
+    bad = 0
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                if not isinstance(rec, dict):
+                    bad += 1
+                    continue
+                for ev in rec.get("compile_events") or ():
+                    if isinstance(ev, dict):
+                        events.append(ev)
+    return events, bad
+
+
+def build_table(events: list[dict]) -> list[dict]:
+    """Aggregate events per (fingerprint, comm_config) key: outcome
+    counts, attributed wall, and the seams that reported."""
+    rows: dict[tuple, dict] = {}
+    for ev in events:
+        key = (str(ev.get("fingerprint") or "?"),
+               str(ev.get("comm_config") or ""))
+        row = rows.get(key)
+        if row is None:
+            row = rows[key] = {
+                "fingerprint": key[0], "comm_config": key[1],
+                "seams": set(), "wall_s": 0.0,
+                **{o: 0 for o in OUTCOMES}}
+        outcome = str(ev.get("outcome") or "")
+        if outcome in OUTCOMES:
+            row[outcome] += 1
+        row["seams"].add(str(ev.get("seam") or "?"))
+        try:
+            row["wall_s"] += float(ev.get("wall_s") or 0.0)
+        except (TypeError, ValueError):
+            pass
+    out = []
+    for row in rows.values():
+        row["seams"] = sorted(row["seams"])
+        row["wall_s"] = round(row["wall_s"], 6)
+        out.append(row)
+    # costliest cold starts first; fingerprint breaks ties stably
+    out.sort(key=lambda r: (-r["wall_s"], r["fingerprint"],
+                            r["comm_config"]))
+    return out
+
+
+def reconcile(events: list[dict], snaps: list[dict]) -> dict:
+    """Ledger-vs-snapshot verdicts: fresh-event count vs the merged
+    ``compile.fresh`` counter, and summed event walls vs the summed
+    ``compile.wall_s.*`` histogram totals."""
+    fresh_events = sum(1 for ev in events if ev.get("outcome") == "fresh")
+    event_wall = sum(float(ev.get("wall_s") or 0.0) for ev in events)
+    counter_fresh = 0
+    hist_wall = 0.0
+    for snap in snaps:
+        counter_fresh += int((snap.get("counters")
+                              or {}).get("compile.fresh", 0))
+        for name, h in (snap.get("hists") or {}).items():
+            if name.startswith("compile.wall_s."):
+                hist_wall += float(h.get("sum", 0.0))
+    return {
+        "fresh_events": fresh_events,
+        "counter_fresh": counter_fresh,
+        "fresh_ok": fresh_events == counter_fresh,
+        "event_wall_s": round(event_wall, 6),
+        "hist_wall_s": round(hist_wall, 6),
+        "wall_ok": abs(event_wall - hist_wall) < WALL_TOL,
+    }
+
+
+def render(table: list[dict], recon: dict | None) -> str:
+    lines = ["fingerprint       comm_config              seams"
+             "                     fresh  memo  aot  corrupt  wall_s"]
+    for r in table:
+        lines.append(
+            f"{r['fingerprint']:<17} {r['comm_config']:<24} "
+            f"{','.join(r['seams']):<25} {r['fresh']:>5} "
+            f"{r['memo_hit']:>5} {r['aot_hit']:>4} "
+            f"{r['aot_corrupt']:>8}  {r['wall_s']:.6f}")
+    total_wall = round(sum(r["wall_s"] for r in table), 6)
+    total_fresh = sum(r["fresh"] for r in table)
+    lines.append(f"total: {len(table)} program(s), {total_fresh} fresh "
+                 f"compile(s), {total_wall:.6f}s attributed wall")
+    if recon is not None:
+        lines.append(
+            f"reconcile: fresh events {recon['fresh_events']} vs "
+            f"counter {recon['counter_fresh']} "
+            f"[{'OK' if recon['fresh_ok'] else 'MISMATCH'}]; "
+            f"event wall {recon['event_wall_s']:.6f}s vs histogram "
+            f"wall {recon['hist_wall_s']:.6f}s "
+            f"[{'OK' if recon['wall_ok'] else 'MISMATCH'}]")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv) -> int:
+    args = list(argv)
+    ledgers: list[str] = []
+    snapdir = None
+    as_json = False
+    while args:
+        a = args.pop(0)
+        if a == "--ledger" and args:
+            ledgers.append(args.pop(0))
+        elif a == "--snapdir" and args:
+            snapdir = args.pop(0)
+        elif a == "--json":
+            as_json = True
+        else:
+            print(__doc__)
+            return 2
+    if not ledgers:
+        print(__doc__)
+        return 2
+    try:
+        events, bad = read_ledger_events(ledgers)
+    except OSError as e:
+        print(f"compile_report: cannot read ledger ({e})")
+        return 2
+    table = build_table(events)
+    recon = None
+    if snapdir is not None:
+        recon = reconcile(events, scan_snapshots(snapdir))
+    if as_json:
+        doc = {"schema": "quest-tpu-compile-report/1",
+               "table": table, "events": len(events),
+               "unparseable_lines": bad}
+        if recon is not None:
+            doc["reconcile"] = recon
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        if bad:
+            print(f"note: {bad} unparseable ledger line(s) skipped")
+        sys.stdout.write(render(table, recon))
+    if recon is not None and not (recon["fresh_ok"]
+                                  and recon["wall_ok"]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -108,7 +108,17 @@ def scan_snapshots(directory: str | None = None) -> list[dict]:
             # the worker replaced/removed the file mid-scan; the
             # parsed content is still valid — treat it as fresh-now
             mtime = time.time()
-        rows.append({"path": path, "snap": snap, "mtime": mtime})
+        # staleness timebase: the snapshot's own wall-clock stamp when
+        # present (honest across copied/rsync'd files, and the SAME
+        # value the worker exports as the quest_snapshot_time_seconds
+        # gauge — so a scrape-only consumer computes identical ages);
+        # mtime covers pre-stamp snapshots
+        try:
+            stamp = float(snap.get("time") or mtime)
+        except (TypeError, ValueError):
+            stamp = mtime
+        rows.append({"path": path, "snap": snap, "mtime": mtime,
+                     "stamp": stamp})
     return rows
 
 
@@ -128,14 +138,18 @@ def fleet_health(directory: str | None = None,
     """The fleet staleness rollup: per worker, the snapshot age and an
     OK/SUSPECT verdict against the budget.  ``now`` is injectable for
     deterministic tests; production uses wall-clock ``time.time()``
-    (snapshot files carry mtimes on the same timebase)."""
+    (snapshots stamp their own ``time`` on the same timebase; mtimes
+    serve as the fallback).  The same math is computable from a
+    ``/metrics`` scrape alone: ``time() -
+    quest_snapshot_time_seconds`` per worker matches ``age_s`` here,
+    and ``quest_worker_start_time_seconds`` gives the uptime."""
     budget = staleness_s if staleness_s is not None else staleness_budget()
     t = time.time() if now is None else now
     workers: dict[str, dict] = {}
     for row in scan_snapshots(directory):
         snap = row["snap"]
         wid = str(snap.get("worker"))
-        age = max(0.0, t - row["mtime"])
+        age = max(0.0, t - row["stamp"])
         prev = workers.get(wid)
         if prev is not None and prev["epoch"] >= int(snap.get("epoch")
                                                      or 0):

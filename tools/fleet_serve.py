@@ -304,8 +304,10 @@ def sum_fleet_gauges(snapdir: str, keys: tuple) -> dict:
 
 
 def snapshot_ages(snapdir: str) -> list[dict]:
-    """Per-snapshot worker id + age rows for ``/healthz`` (mtime
-    based; the full staleness verdict lives in fleet_agg)."""
+    """Per-snapshot worker id + age rows for ``/healthz`` — preferring
+    the snapshot's own wall-clock ``time`` stamp (honest across copied
+    / rsync'd files) and falling back to file mtime for pre-stamp
+    snapshots."""
     rows = []
     try:
         names = sorted(os.listdir(snapdir))
@@ -318,13 +320,45 @@ def snapshot_ages(snapdir: str) -> list[dict]:
         path = os.path.join(snapdir, name)
         snap = read_snap(path)
         try:
-            age = now - os.path.getmtime(path)
-        except OSError:
+            stamp = float((snap or {}).get("time")
+                          or os.path.getmtime(path))
+        except (OSError, TypeError, ValueError):
             continue
         rows.append({"worker": (snap or {}).get("worker",
                                                 name[5:-5]),
-                     "age_s": round(age, 3),
+                     "age_s": round(now - stamp, 3),
                      "readable": snap is not None})
+    return rows
+
+
+def fleet_alerts(snapdir: str) -> list[dict]:
+    """Non-OK SLO alert gauges (``alert.<name>`` != 0, the
+    quest_tpu.slo sentinel's exported levels) across every readable
+    worker snapshot — what degrades the fleet ``/healthz`` with a
+    NAMED alert.  ``alert.firing`` is the per-worker rollup, not an
+    objective, so it is skipped."""
+    rows = []
+    try:
+        names = sorted(os.listdir(snapdir))
+    except OSError:
+        return rows
+    for name in names:
+        if not (name.startswith("snap-") and name.endswith(".json")):
+            continue
+        snap = read_snap(os.path.join(snapdir, name))
+        if not snap:
+            continue
+        for k in sorted(snap.get("gauges") or {}):
+            if not k.startswith("alert.") or k == "alert.firing":
+                continue
+            try:
+                level = int((snap["gauges"] or {}).get(k, 0))
+            except (TypeError, ValueError):
+                continue
+            if level > 0:
+                rows.append({"worker": snap.get("worker", name[5:-5]),
+                             "alert": k[len("alert."):],
+                             "level": level})
     return rows
 
 
@@ -558,10 +592,25 @@ class FleetHandler(metrics_serve.MetricsHandler):
                    "application/json")
 
     def _get_healthz(self) -> None:
+        """Fleet health: 503 when ANY worker's spilled snapshot shows
+        a PAGE-state SLO alert (level 2) — the body NAMES the firing
+        alert and worker and carries a ``retry_after_s`` hint, so a
+        fleet prober gets the same verdict quality a worker's own
+        ``/readyz`` serves.  WARN-level alerts ride along in
+        ``alerts`` without degrading."""
         workers = self.fleet_view() if self.fleet_view else []
-        doc = {"ok": True, "workers": workers,
-               "snapshots": snapshot_ages(self.snapdir)}
-        self._send(200, json.dumps(doc) + "\n", "application/json")
+        alerts = fleet_alerts(self.snapdir)
+        paging = [a for a in alerts if a["level"] >= 2]
+        ok = not paging
+        doc = {"ok": ok, "workers": workers,
+               "snapshots": snapshot_ages(self.snapdir),
+               "alerts": alerts}
+        if not ok:
+            doc["alert"] = paging[0]["alert"]
+            doc["alert_worker"] = paging[0]["worker"]
+            doc["retry_after_s"] = 1.0
+        self._send(200 if ok else 503, json.dumps(doc) + "\n",
+                   "application/json")
 
     def _get_metrics_fleet(self) -> None:
         try:

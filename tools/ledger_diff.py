@@ -115,6 +115,16 @@ DEFAULT_RULES = [
     # (strictly negative — the -0.0 caveat above applies here too)
     ("counters.resilience.slice_degraded", +0.0, True),
     ("counters.resilience.slice_loss_recovered", -0.001, True),
+    # compile-observatory health, strictly regressive: at identical
+    # comm config the SAME workload must pay the SAME number of fresh
+    # XLA compiles — MORE `compile.fresh` than baseline means a
+    # memo/AOT cache stopped hitting, a silent cold-start regression
+    # `fastpath_wall_s` cannot see (the tax lands before the timed
+    # region).  Binds on `comm_config` (metrics._finalize stamps the
+    # events' shared comm_config_token onto the record) so a
+    # deliberately different collective configuration — which compiles
+    # different programs — never gates against the baseline.
+    ("counters.compile.fresh", +0.0, "comm_config"),
     # structural / communication metrics: tight, config-independent
     ("mesh_exchange_bytes_qft30", +0.01, False),
     ("counters.exec.exchange_bytes", +0.01, False),

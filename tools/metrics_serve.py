@@ -150,6 +150,12 @@ class MetricsHandler(BaseHTTPRequestHandler):
                    "inflight": supervisor.inflight(),
                    "journal_backlog": supervisor.journal_backlog(),
                    "gate_enabled": supervisor.gate_enabled()}
+            # name the firing SLO alert explicitly (the reason string
+            # carries the burn detail; "alert" is the machine-readable
+            # field a pager routes on)
+            a = supervisor.slo_alert()
+            if a is not None:
+                doc["alert"] = a["name"]
             self._send(200 if ready else 503, json.dumps(doc) + "\n",
                        "application/json")
         elif path == "/":

@@ -465,6 +465,133 @@ def fleet_obs_smoke(summary) -> None:
         print(detail)
 
 
+#: One observatory worker: real runs under QUEST_METRICS_SNAPDIR +
+#: QUEST_SLO_SPEC, so its snapshots carry compile counters AND alert
+#: gauges, and its run ledger carries the per-run compile events the
+#: parent reconciles.
+_SLO_CHILD = """\
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import quest_tpu as qt
+from quest_tpu import models
+
+env = qt.create_env(num_devices=1)
+for _ in range({runs}):
+    q = qt.create_qureg(6, env)
+    models.qft(6).run(q)
+print("OK", flush=True)
+"""
+
+#: Benign SLO spec for the smoke workers: armed (so alert gauges
+#: export) but never firing (no sheds happen).
+_SLO_SMOKE_SPEC = ('[{"name": "shed_storm", "metric": '
+                   '"rate:supervisor.shed_overload", "target": 0.5}]')
+
+
+def slo_obs_smoke(summary) -> None:
+    """Tier-2 smoke: the compile observatory + SLO sentinel end to
+    end.  Two REAL subprocess workers run circuits with
+    ``QUEST_METRICS_SNAPDIR`` + ``QUEST_SLO_SPEC`` set, so their
+    snapshots carry compile counters and ``alert.*`` gauges and their
+    run ledgers carry per-run compile events; the parent then asserts
+
+    * ``tools/slo_watch.py --snapdir --replay`` (stdlib-only, spec via
+      CLI) parses the merged snapshots and reports the objective OK,
+    * the alert gauges land in a real ``/metrics`` scrape that passes
+      ``parse_text`` (armed parent sentinel + the worker identity /
+      snapshot-age gauges),
+    * ``tools/compile_report.py`` over both workers' ledgers + the
+      snapshot dir builds a non-empty cold-start table AND reconciles:
+      every ``fresh`` event is accounted for against the merged
+      ``compile.fresh`` counter and the ``compile.wall_s.*`` histogram
+      walls (exit 0; MISMATCH exits 1 and fails the round here)."""
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_serve
+
+    from quest_tpu import metrics, slo
+
+    t0 = time.time()
+    ok, detail = False, ""
+    server = None
+    with tempfile.TemporaryDirectory() as td:
+        snapdir = os.path.join(td, "snaps")
+        child = os.path.join(td, "worker.py")
+        try:
+            ledgers = {}
+            for wid, runs in (("sw1", 2), ("sw2", 3)):
+                with open(child, "w") as f:
+                    f.write(_SLO_CHILD.format(repo=REPO, runs=runs))
+                env = dict(os.environ)
+                ledgers[wid] = os.path.join(td, f"ledger-{wid}.jsonl")
+                env.update(QUEST_WORKER_ID=wid,
+                           QUEST_METRICS_SNAPDIR=snapdir,
+                           QUEST_METRICS_SNAP_EVERY="1",
+                           QUEST_METRICS_FILE=ledgers[wid],
+                           QUEST_SLO_SPEC=_SLO_SMOKE_SPEC)
+                r = subprocess.run([sys.executable, child],
+                                   capture_output=True, text=True,
+                                   cwd=REPO, env=env, timeout=600)
+                if r.returncode != 0:
+                    raise RuntimeError(f"worker {wid} failed: "
+                                       f"{r.stderr[-400:]}")
+            # stdlib watcher over the merged snapshots
+            w = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "slo_watch.py"),
+                 "--snapdir", snapdir, "--replay",
+                 "--spec", _SLO_SMOKE_SPEC],
+                capture_output=True, text=True, cwd=REPO, timeout=120)
+            watch_ok = (w.returncode == 0
+                        and "shed_storm OK" in w.stdout)
+            # alert gauges in a REAL scrape, parse_text-validated
+            slo.configure(_json.loads(_SLO_SMOKE_SPEC))
+            server, port = metrics_serve.start_in_thread(0)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as r:
+                samples = metrics_serve.parse_text(r.read().decode())
+            scrape_ok = (samples.get("quest_alert_shed_storm") == 0.0
+                         and samples.get("quest_alert_firing") == 0.0
+                         and samples.get(
+                             "quest_worker_start_time_seconds", 0) > 0)
+            # cold-start table reconciliation over the two-worker run
+            c = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "compile_report.py"),
+                 "--ledger", ledgers["sw1"],
+                 "--ledger", ledgers["sw2"],
+                 "--snapdir", snapdir],
+                capture_output=True, text=True, cwd=REPO, timeout=120)
+            report_ok = (c.returncode == 0
+                         and c.stdout.count("[OK]") == 2
+                         and " 0 fresh" not in c.stdout)
+            ok = watch_ok and scrape_ok and report_ok
+            if not ok:
+                detail = (f"watch_ok={watch_ok} scrape_ok={scrape_ok} "
+                          f"report_ok={report_ok}\n"
+                          f"watch: {w.stdout[-300:]}\n"
+                          f"report: {c.stdout[-400:]}")
+        except Exception as e:
+            detail = f"{type(e).__name__}: {e}"
+        finally:
+            if server is not None:
+                server.shutdown()
+            slo.reset()
+            metrics.reset()
+    secs = time.time() - t0
+    summary.append(("slo_obs", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'slo_obs':22s} {secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 def fleet_serve_smoke(summary) -> None:
     """Tier-2 smoke: the fleet serving front end end to end.  Starts
     ``tools/fleet_serve.py`` with TWO real worker subprocesses on one
@@ -781,6 +908,7 @@ def main():
     journaled_serve_smoke(summary)
     metrics_serve_smoke(summary)
     fleet_obs_smoke(summary)
+    slo_obs_smoke(summary)
     fleet_serve_smoke(summary)
     supervise_smoke(summary)
     chaos_drill_smoke(summary, rnd)

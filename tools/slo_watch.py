@@ -1,0 +1,271 @@
+"""Live SLO burn-rate view over spilled telemetry artifacts.
+
+Feeds the same deterministic sentinel the in-process layer runs
+(``quest_tpu/slo.py`` — loaded standalone by file path, so this tool
+needs NOTHING installed, not even jax) with telemetry read off disk,
+and renders the per-objective alert state:
+
+* ``--ledger FILE.jsonl`` — REPLAY a run-ledger spill
+  (``$QUEST_METRICS_FILE``): records are folded cumulatively in file
+  order and clocked by their own summed ``wall_s``, so replaying the
+  same file yields a BYTE-IDENTICAL alert history — the offline twin
+  of the live evaluation, and the determinism pin the test suite
+  holds.
+* ``--snapdir DIR`` — tail a fleet snapshot directory
+  (``$QUEST_METRICS_SNAPDIR``): each poll merges the newest snapshot
+  per worker (counters/gauges summed, histogram buckets
+  integer-summed) into ONE fleet sample, clocked by the newest
+  embedded snapshot ``time`` stamp.  With ``--replay`` it samples
+  once and exits; otherwise it polls every ``--poll`` seconds
+  (``--max-loops`` bounds the watch for scripting).
+
+The spec comes from ``--spec`` (inline JSON when it starts with ``[``
+or ``{``, else a file path) or ``$QUEST_SLO_SPEC`` — the same grammar
+the in-process sentinel arms from (see docs/OBSERVABILITY.md).
+
+One line per objective per evaluation::
+
+    t=104.000000 shed_storm PAGE raw=page fast=4 slow=4 value=2 \
+target=0.5 metric=rate:supervisor.shed_overload
+
+``--fail-on-page`` exits 1 when the FINAL evaluation has a paging
+objective (CI gate shape); exit 2 on usage/spec errors.
+
+Usage::
+
+    python tools/slo_watch.py (--ledger FILE | --snapdir DIR)
+        [--spec JSON_OR_PATH] [--replay] [--poll S] [--max-loops N]
+        [--fail-on-page]
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def load_slo():
+    """Load ``quest_tpu/slo.py`` standalone (stdlib-only module; by
+    file path so ``quest_tpu/__init__`` — and jax — never import)."""
+    path = os.path.join(REPO, "quest_tpu", "slo.py")
+    spec = importlib.util.spec_from_file_location("_quest_slo_watch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _compile_report():
+    """Sibling tool module (snapshot CRC reader lives there)."""
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import compile_report
+    return compile_report
+
+
+def load_spec(arg: str | None, slo) -> list | dict | None:
+    """Resolve the spec argument (or ``$QUEST_SLO_SPEC``) to the raw
+    spec document: inline JSON when it starts with ``[``/``{``, else a
+    JSON file path."""
+    s = arg if arg is not None else os.environ.get(slo.SPEC_ENV)
+    if not s or not s.strip():
+        return None
+    t = s.strip()
+    if t.startswith(("[", "{")):
+        return json.loads(t)
+    with open(s) as f:
+        return json.load(f)
+
+
+# -- telemetry folding ------------------------------------------------------
+
+
+def _hist_fold(into: dict, h: dict) -> None:
+    """Sum one serialized histogram into accumulator ``into`` (string
+    bucket keys, integer counts — the merge_snapshots rule)."""
+    b = into.setdefault("buckets", {})
+    for e, n in (h.get("buckets") or {}).items():
+        b[str(e)] = b.get(str(e), 0) + int(n)
+    into["count"] = into.get("count", 0) + int(h.get("count", 0))
+    into["sum"] = round(into.get("sum", 0.0) + float(h.get("sum", 0.0)), 9)
+    into["zeros"] = into.get("zeros", 0) + int(h.get("zeros", 0))
+
+
+def ledger_stream(path: str):
+    """Yield ``(t, counters, hists)`` cumulative telemetry states, one
+    per parseable ledger record, clocked by summed record walls (a
+    pure function of the file — the byte-identical-replay guarantee).
+    Per-record ``run.wall_s`` histograms are also folded under the
+    process-side name ``run.wall_s.<label>`` so specs written against
+    live telemetry replay unchanged."""
+    t = 0.0
+    counters: dict = {}
+    hists: dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            t = round(t + float(rec.get("wall_s") or 0.0), 6)
+            for k, v in (rec.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + v
+            for name, h in (rec.get("hist") or {}).items():
+                names = [name]
+                if name == "run.wall_s" and rec.get("label"):
+                    names.append(f"run.wall_s.{rec['label']}")
+                for n in names:
+                    _hist_fold(hists.setdefault(n, {}), h)
+            yield t, dict(counters), {k: dict(v, buckets=dict(v["buckets"]))
+                                      for k, v in hists.items()}
+
+
+def snapdir_sample(snapdir: str) -> tuple | None:
+    """One merged fleet sample ``(t, counters, hists, gauges)`` from
+    the newest readable snapshot per worker, or None when the
+    directory has nothing readable yet.  ``t`` is the newest embedded
+    snapshot ``time`` (mtime fallback for pre-stamp snapshots)."""
+    cr = _compile_report()
+    snaps = cr.scan_snapshots(snapdir)
+    if not snaps:
+        return None
+    t = 0.0
+    counters: dict = {}
+    hists: dict = {}
+    gauges: dict = {}
+    for snap in snaps:
+        try:
+            t = max(t, float(snap.get("time") or 0.0))
+        except (TypeError, ValueError):
+            pass
+        for k, v in (snap.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in (snap.get("gauges") or {}).items():
+            try:
+                gauges[k] = gauges.get(k, 0.0) + float(v)
+            except (TypeError, ValueError):
+                pass
+        for name, h in (snap.get("hists") or {}).items():
+            _hist_fold(hists.setdefault(name, {}), h)
+    if t <= 0.0:
+        t = time.time()
+    return t, counters, hists, gauges
+
+
+# -- rendering --------------------------------------------------------------
+
+
+def _g(v) -> str:
+    return "-" if v is None else f"{v:g}"
+
+
+def render_rows(rows: list[dict]) -> str:
+    """One deterministic line per objective evaluation."""
+    out = []
+    for r in rows:
+        out.append(
+            f"t={r['now']:.6f} {r['name']} {r['state'].upper()} "
+            f"raw={r['raw']} fast={_g(r['burn_fast'])} "
+            f"slow={_g(r['burn_slow'])} value={_g(r['value_fast'])} "
+            f"target={_g(r['target'])} metric={r['metric']}")
+    return "\n".join(out)
+
+
+def _evaluate(sentinel, now: float) -> list[dict]:
+    rows = sentinel.evaluate(now)
+    for r in rows:
+        r["now"] = now
+    return rows
+
+
+def main(argv) -> int:
+    args = list(argv)
+    ledger = snapdir = spec_arg = None
+    replay = fail_on_page = False
+    poll = 2.0
+    max_loops = None
+    try:
+        while args:
+            a = args.pop(0)
+            if a == "--ledger":
+                ledger = args.pop(0)
+            elif a == "--snapdir":
+                snapdir = args.pop(0)
+            elif a == "--spec":
+                spec_arg = args.pop(0)
+            elif a == "--replay":
+                replay = True
+            elif a == "--poll":
+                poll = float(args.pop(0))
+            elif a == "--max-loops":
+                max_loops = int(args.pop(0))
+            elif a == "--fail-on-page":
+                fail_on_page = True
+            else:
+                raise ValueError(a)
+    except (IndexError, ValueError):
+        print(__doc__)
+        return 2
+    if (ledger is None) == (snapdir is None):
+        print(__doc__)
+        return 2
+    slo = load_slo()
+    try:
+        raw_spec = load_spec(spec_arg, slo)
+    except (OSError, ValueError) as e:
+        print(f"slo_watch: cannot load spec ({e})")
+        return 2
+    if raw_spec is None:
+        print("slo_watch: no SLO spec (pass --spec or set "
+              f"{slo.SPEC_ENV})")
+        return 2
+    try:
+        sentinel = slo.Sentinel(raw_spec)
+    except ValueError as e:
+        print(f"slo_watch: bad spec ({e})")
+        return 2
+
+    last_rows: list[dict] = []
+    if ledger is not None:
+        try:
+            for t, counters, hists in ledger_stream(ledger):
+                sentinel.observe(t, counters=counters, hists=hists)
+                last_rows = _evaluate(sentinel, t)
+                print(render_rows(last_rows))
+        except OSError as e:
+            print(f"slo_watch: cannot read ledger ({e})")
+            return 2
+    else:
+        loops = 0
+        while True:
+            sample = snapdir_sample(snapdir)
+            if sample is not None:
+                t, counters, hists, gauges = sample
+                sentinel.observe(t, counters=counters, hists=hists,
+                                 gauges=gauges)
+                last_rows = _evaluate(sentinel, t)
+                print(render_rows(last_rows), flush=True)
+            elif replay:
+                print(f"slo_watch: no readable snapshots in {snapdir}")
+                return 2
+            loops += 1
+            if replay or (max_loops is not None and loops >= max_loops):
+                break
+            time.sleep(poll)
+    if fail_on_page and any(r["state"] == "page" for r in last_rows):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
