@@ -158,11 +158,17 @@ enum QuESTErrorCode {
     QUEST_ERROR_OVERLOAD = 7,   /* admission gate shed the run (mesh
                                  * unhealthy, concurrency cap, or SLO
                                  * p99 breach); retry after backoff   */
-    QUEST_ERROR_POISONED = 8    /* journaled serving request observed
+    QUEST_ERROR_POISONED = 8,   /* journaled serving request observed
                                  * to crash the process repeatedly;
                                  * quarantined instead of retried —
                                  * resubmit under a new idempotency
                                  * key after fixing the request       */
+    QUEST_ERROR_STORAGE = 9     /* durable storage failed (disk full /
+                                 * failing medium) past the bounded
+                                 * retry budget and the strict
+                                 * durability policy refused to serve
+                                 * without the journal; retry once
+                                 * disk pressure clears               */
 };
 /* Code/message of the most recent recoverable failure (0 / "" when the
  * last recoverable call succeeded). */

@@ -67,6 +67,7 @@ from .validation import (
     QuESTPreemptedError,
     QuESTOverloadError,
     QuESTPoisonedRequestError,
+    QuESTStorageError,
 )
 from .ops.gates import (
     hadamard,

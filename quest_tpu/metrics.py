@@ -711,6 +711,22 @@ def _gauges(c: dict) -> dict:
         "serve.sessions_migrated": c.get(
             "supervisor.sessions_migrated", 0),
     })
+    # storage-lifecycle gauges (quest_journal_* / quest_gc_*): the
+    # journal's on-disk footprint (bytes + chain length, from the last
+    # observation stateio recorded), the compaction/GC counter mirrors,
+    # and whether a degrade-policy serve is currently running WITHOUT
+    # durability (quest_journal_degraded = 1 is the disk-pressure page)
+    from . import stateio  # deferred: stateio imports metrics lazily
+
+    jstats = stateio.journal_gauge_snapshot()
+    gauges.update({
+        "journal.bytes": jstats["bytes"],
+        "journal.segments": jstats["segments"],
+        "journal.rotations": c.get("stateio.journal_rotations", 0),
+        "journal.compactions": c.get("stateio.journal_compactions", 0),
+        "journal.degraded": 1 if supervisor.journal_degraded() else 0,
+        "gc.reclaimed_bytes": c.get("stateio.gc_reclaimed_bytes", 0),
+    })
     # uptime / identity gauges: process start (Prometheus'
     # process_start_time_seconds convention, quest_-prefixed) plus the
     # snapshot epoch and ITS wall-clock stamp — so fleet_agg's

@@ -122,6 +122,7 @@ final states via :func:`resume_state`.
 from __future__ import annotations
 
 import contextlib
+import errno
 import json
 import os
 import shutil
@@ -169,7 +170,15 @@ SEAMS = frozenset({
 #: request that segfaults/OOM-kills the serving process) only on the
 #: :data:`POISON_SEAMS`, the drill fuel for the write-ahead journal's
 #: poison-request quarantine (``supervisor.serve(journal_dir=)``).
-KINDS = ("io", "runtime", "nan", "stall", "preempt", "poison")
+#: The disk-pressure kinds ``enospc`` (device full) and ``eio``
+#: (failing medium) raise :class:`OSError` with the REAL errno on the
+#: :data:`DISK_SEAMS` only — ``with_retries`` retries them like any
+#: transient I/O error, so modelling a PERSISTENTLY full disk means
+#: scripting one hit per retry attempt (budget + 1); that exhaustion is
+#: exactly what the ``QUEST_DURABILITY`` policy
+#: (``supervisor.serve(journal_dir=)``) decides on.
+KINDS = ("io", "runtime", "nan", "stall", "preempt", "poison",
+         "enospc", "eio")
 
 #: The seams that model slow/hung devices (``delay:<ms>`` / ``stall``):
 #: the ones walled by the collective watchdog.
@@ -211,6 +220,12 @@ POISON_EXIT_CODE = 137
 #: against the DCN budget and ICI-only items can never false-positive).
 #: Both are collective-fabric faults, so only the exchange seam.
 SLICE_SEAMS = ("mesh_exchange",)
+
+#: The seams that touch durable storage — the only ones the
+#: disk-pressure kinds ``enospc``/``eio`` may script: the serve WAL
+#: append, checkpoint saves, and the observability sinks.  (Read seams
+#: stay out: a full disk fails writes, not reads.)
+DISK_SEAMS = ("journal_append", "ckpt_save", "sink_write")
 
 #: Per-seam bounded retry budget (attempts AFTER the first).  Sinks are
 #: best-effort (they already degrade), so one retry; checkpoint I/O is
@@ -409,6 +424,11 @@ def _parse_plan(spec) -> list[tuple[str, int, str]]:
                 f"fault kind 'poison' models a request killing the "
                 f"process and is valid only on the "
                 f"{sorted(POISON_SEAMS)} seam, not {seam!r}")
+        if kind in ("enospc", "eio") and seam not in DISK_SEAMS:
+            raise QuESTValidationError(
+                f"fault kind {kind!r} models disk pressure and is "
+                f"valid only on the {sorted(DISK_SEAMS)} seams, "
+                f"not {seam!r}")
         if (slice_loss_param(kind) is not None
                 or dcn_flap_ms(kind) is not None) \
                 and seam not in SLICE_SEAMS:
@@ -516,9 +536,11 @@ def fault_point(name: str) -> str | None:
     deterministic SIGTERM; ``poison`` EXITS THE PROCESS immediately
     (``os._exit(POISON_EXIT_CODE)``, no drain, no checkpoint) — the
     deterministic spelling of a request that segfaults the serving
-    process, which the write-ahead journal's quarantine must bound.
-    With no plan installed this is a single dict lookup and returns
-    None."""
+    process, which the write-ahead journal's quarantine must bound;
+    ``enospc``/``eio`` raise :class:`OSError` carrying the real errno
+    (disk full / failing medium) on the :data:`DISK_SEAMS` — the
+    durability-policy drill fuel.  With no plan installed this is a
+    single dict lookup and returns None."""
     if _plan is None and not os.environ.get("QUEST_FAULT_PLAN"):
         return None
     plan = _current_plan()
@@ -565,6 +587,12 @@ def fault_point(name: str) -> str | None:
         # (mesh_exec.observe_item) owns the item context (which slice
         # map, whether the item has a DCN leg) the fault acts on
         return fired
+    if fired in ("enospc", "eio"):
+        # the REAL errno, so callers branching on e.errno (and log
+        # lines showing strerror) exercise their production path
+        num = errno.ENOSPC if fired == "enospc" else errno.EIO
+        raise OSError(num, f"{os.strerror(num)} [scripted {fired} "
+                           f"fault at seam {name!r} (hit {idx})]")
     if fired == "io":
         raise OSError(f"scripted fault at seam {name!r} (hit {idx})")
     raise RuntimeError(f"scripted fault at seam {name!r} (hit {idx})")

@@ -22,7 +22,10 @@ builds its two-slot mid-run snapshot rotation on these primitives.
 from __future__ import annotations
 
 import json
+import numbers
 import os
+import re as _re
+import time as _time
 import threading as _threading
 
 import numpy as np
@@ -420,6 +423,56 @@ JOURNAL_META = "journal.json"
 #: Current journal format (the sidecar's ``format_version``).
 JOURNAL_FORMAT_VERSION = 1
 
+# SEGMENTED JOURNAL (ISSUE 20): with ``QUEST_JOURNAL_SEGMENT_BYTES``
+# set > 0, an append first ROTATES an active ``journal.jsonl`` that
+# has reached the threshold into a numbered SEALED segment
+# ``journal-<NNNNNN>.jsonl`` (rename — same inode, so a peer's
+# in-flight O_APPEND batch lands in the sealed file, never lost or
+# duplicated), and every reader walks the CHAIN: sealed segments in
+# sequence order, active file last.  ``compact_journal`` rewrites the
+# retention-eligible sealed prefix into ONE epoch-tagged segment
+# ``journal-<NNNNNN>.c<E>.jsonl`` committed by bumping the sidecar's
+# ``epoch`` field (write-temp-then-atomic-rename): readers ignore a
+# compacted file whose epoch exceeds the sidecar's (a crash before the
+# bump), and an epoch-``E`` winner supersedes every plain segment with
+# a sequence number <= its own plus every lower-epoch compacted file
+# (a crash before the source unlinks) — so no reader ever sees a
+# half-compacted view or a record twice.  All of it is strictly
+# opt-in: with the env knob unset the journal stays the single file
+# PRs 13-15 wrote, byte-identical.
+
+#: Rotation threshold env knob (bytes; unset/0 = rotation disabled —
+#: the default single-file journal is byte-stable).
+JOURNAL_SEGMENT_BYTES_ENV = "QUEST_JOURNAL_SEGMENT_BYTES"
+
+#: Journal-logical retention age for compaction (seconds): only sealed
+#: segments at least this old (file mtime) are rewritten, so recent
+#: history stays greppable even when fully settled.
+JOURNAL_RETAIN_S_ENV = "QUEST_JOURNAL_RETAIN_S"
+JOURNAL_RETAIN_S_DEFAULT = 3600.0
+
+#: Reserved claim key the fleet compactor leases through the ordinary
+#: PR 15 claim protocol (fencing epoch, lease expiry) before touching
+#: a journal any worker may be appending claims to.
+COMPACTOR_KEY = "__compactor__"
+
+#: Sealed segment / compacted-segment file names:
+#: ``journal-000001.jsonl`` (plain, from rotation) and
+#: ``journal-000003.c2.jsonl`` (compaction output at epoch 2 covering
+#: sequences <= 3).
+_SEG_RE = _re.compile(r"^journal-(\d{6})(?:\.c(\d+))?\.jsonl$")
+
+#: Cross-process rotation mutex (O_CREAT|O_EXCL file): two workers
+#: deciding to rotate at once must not rename two generations onto one
+#: segment name.  Stale locks (a rotator that died) expire by age.
+_ROTATE_LOCK = "journal.rotate.lock"
+_ROTATE_LOCK_STALE_S = 30.0
+
+#: Last-observed journal size/shape, exported as the
+#: ``quest_journal_bytes`` / ``quest_journal_segments`` gauges
+#: (refreshed by appends, compaction, GC and ``journal_bytes``).
+_journal_stats = {"dir": None, "bytes": 0, "segments": 0}
+
 #: Serializes in-process journal appends: the torn-tail heal reads the
 #: file's last byte, and racing it against another thread's buffered
 #: multi-``write()`` flush could misread a mid-append state as a torn
@@ -508,6 +561,187 @@ def _heal_torn_tail(path: str) -> None:
     _warn_torn(path)
 
 
+def _segment_bytes_limit() -> int:
+    """The rotation threshold (``QUEST_JOURNAL_SEGMENT_BYTES``), or 0
+    when rotation is disabled (unset / unparseable / non-positive)."""
+    try:
+        v = int(os.environ.get(JOURNAL_SEGMENT_BYTES_ENV, "0"))
+    except ValueError:
+        return 0
+    return v if v > 0 else 0
+
+
+def _read_sidecar(directory: str) -> dict:
+    """The ``journal.json`` sidecar's document ({} when absent or
+    unreadable — a damaged sidecar degrades to epoch 0, which only ever
+    HIDES compacted files, never shows a stale view)."""
+    try:
+        with open(os.path.join(directory, JOURNAL_META)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def _sidecar_epoch(directory: str) -> int:
+    """The committed compaction epoch (sidecar ``epoch``; absent = 0 —
+    the sidecar PRs 13-15 wrote is byte-stable until first compaction)."""
+    try:
+        return int(_read_sidecar(directory).get("epoch", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _next_segment_seq(directory: str) -> int:
+    """The sequence number the next rotation seals under: one past the
+    highest ever used (plain OR compacted — a compacted file's sequence
+    marks ground already covered and is never reused)."""
+    top = 0
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        names = []
+    for n in names:
+        m = _SEG_RE.match(n)
+        if m:
+            top = max(top, int(m.group(1)))
+    return top + 1
+
+
+def journal_chain(directory: str) -> list[str]:
+    """The journal's read order under ``directory`` as absolute paths:
+    the winning compacted segment (highest ``(epoch, seq)`` among files
+    at or below the sidecar's committed epoch), then every plain sealed
+    segment with a HIGHER sequence, then the active ``journal.jsonl``.
+    Files a crashed compactor left behind are excluded on both sides of
+    the commit point: an output above the sidecar epoch (crash before
+    the bump) and a superseded source below the winner (crash before
+    the unlink) are equally invisible, so every reader of the chain
+    sees each record exactly once.  Missing directory: ``[]``."""
+    directory = os.path.abspath(directory)
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    epoch = _sidecar_epoch(directory)
+    plain, compacted = [], []
+    for n in names:
+        m = _SEG_RE.match(n)
+        if not m:
+            continue
+        seq, ce = int(m.group(1)), m.group(2)
+        if ce is None:
+            plain.append((seq, n))
+        elif int(ce) <= epoch:
+            compacted.append((int(ce), seq, n))
+    chain, floor = [], -1
+    if compacted:
+        _, floor, winner = max(compacted)
+        chain.append(winner)
+    chain.extend(n for seq, n in sorted(plain) if seq > floor)
+    if JOURNAL in names:
+        chain.append(JOURNAL)
+    return [os.path.join(directory, n) for n in chain]
+
+
+def journal_segments(directory: str) -> list[str]:
+    """The chain's SEALED files (everything but the active journal),
+    oldest first — what compaction may rewrite and fsck verifies
+    per-segment."""
+    return [p for p in journal_chain(directory)
+            if os.path.basename(p) != JOURNAL]
+
+
+def _size_or_zero(path: str) -> int:
+    """File size, 0 when it vanished mid-walk (a racing compactor's
+    unlink) — byte accounting tracks the survivors."""
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+def _unlink_quiet(path: str) -> bool:
+    """Best-effort unlink (lock files, superseded segments, aborted
+    outputs).  No caller's contract depends on it succeeding: a
+    leftover is invisible to every chain reader and reaped by the next
+    rotation/compaction, so the failure is reported, not raised."""
+    try:
+        os.unlink(path)
+        return True
+    except OSError:
+        return False
+
+
+def journal_bytes(directory: str) -> int:
+    """Total on-disk bytes of the journal chain under ``directory``
+    (files that vanish mid-walk — a racing compactor's unlink — count
+    0).  Also refreshes the ``quest_journal_bytes`` /
+    ``quest_journal_segments`` gauges."""
+    chain = journal_chain(directory)
+    total = sum(_size_or_zero(p) for p in chain)
+    _journal_stats.update(dir=os.path.abspath(directory), bytes=total,
+                          segments=len(chain))
+    return total
+
+
+def journal_gauge_snapshot() -> dict:
+    """Last-observed journal shape for ``metrics._gauges``:
+    ``{"dir", "bytes", "segments"}`` (zeros until a journal is first
+    appended to or measured)."""
+    return dict(_journal_stats)
+
+
+def _maybe_rotate(directory: str, path: str) -> None:
+    """Seal the active journal into the next numbered segment when it
+    has reached the configured threshold.  Runs under the in-process
+    ``_journal_lock``; cross-process exclusion is the ``O_CREAT|O_EXCL``
+    lock file (a peer holding it means the rotation is already
+    happening — this append just proceeds, landing its batch in
+    whichever file the rename race leaves at the active name; O_APPEND
+    writes follow the inode, so no record is lost either way).  A lock
+    older than ``_ROTATE_LOCK_STALE_S`` belongs to a dead rotator and
+    is broken once."""
+    limit = _segment_bytes_limit()
+    if not limit:
+        return
+    try:
+        if os.path.getsize(path) < limit:
+            return
+    except OSError:
+        return
+    lock = os.path.join(directory, _ROTATE_LOCK)
+    fd = None
+    for attempt in (0, 1):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            break
+        except FileExistsError:
+            try:
+                age = _time.time() - os.path.getmtime(lock)
+            except OSError:
+                continue  # lock vanished under us: retry once
+            if attempt == 0 and age > _ROTATE_LOCK_STALE_S:
+                _unlink_quiet(lock)
+                continue
+            return  # a live peer is rotating right now
+    if fd is None:
+        return
+    try:
+        # recheck under the lock: the peer that held it may have
+        # already sealed this generation
+        if os.path.isfile(path) and os.path.getsize(path) >= limit:
+            seq = _next_segment_seq(directory)
+            os.rename(path,
+                      os.path.join(directory, f"journal-{seq:06d}.jsonl"))
+            from . import metrics
+
+            metrics.counter_inc("stateio.journal_rotations")
+    finally:
+        os.close(fd)
+        _unlink_quiet(lock)
+
+
 def append_journal_entries(directory: str, recs: list[dict]) -> None:
     """Durably append records to the serve journal under ``directory``
     (created — with its atomically-written ``journal.json`` sidecar —
@@ -546,6 +780,7 @@ def append_journal_entries(directory: str, recs: list[dict]) -> None:
     with _journal_lock:
         if os.path.isfile(path):
             _heal_torn_tail(path)
+            _maybe_rotate(directory, path)
         f = resilience.with_retries(lambda: open(path, "a"),
                                     seam="journal_append")
         try:
@@ -556,8 +791,14 @@ def append_journal_entries(directory: str, recs: list[dict]) -> None:
             f.write("".join(lines))
             f.flush()
             os.fsync(f.fileno())
+            active_bytes = os.fstat(f.fileno()).st_size
         finally:
             f.close()
+    if _segment_bytes_limit():
+        journal_bytes(directory)  # chain may have rotated: full refresh
+    else:
+        _journal_stats.update(dir=directory, bytes=active_bytes,
+                              segments=1)
 
 
 def append_journal_entry(directory: str, rec: dict) -> None:
@@ -566,27 +807,18 @@ def append_journal_entry(directory: str, rec: dict) -> None:
     append_journal_entries(directory, [rec])
 
 
-def read_journal(directory: str) -> list[dict]:
-    """Read every valid record from the serve journal under
-    ``directory`` (missing directory/file: ``[]`` — recovery on a
-    never-journaled dir is a no-op).
-
-    Tolerated damage, in the only two shapes it can take:
-
-    * a TORN FINAL LINE — the append in flight when the process died
-      (no trailing newline, or the tail fails to parse): ignored, with
-      a one-shot ``journal_torn_tail`` warning.  The record was never
-      acknowledged, so dropping it is the correct replay semantics.
-    * an INTERIOR undecodable line or a CRC mismatch anywhere — bitrot
-      or tampering, which a crash cannot produce: the entry is skipped,
-      counted (``supervisor.journal_corrupt_entries``) and warned once;
-      the surviving records still replay.
-    """
+def _read_file_records(path: str, *, tail_ok: bool) -> list[dict]:
+    """Every valid record from ONE journal file.  ``tail_ok`` is True
+    only for the ACTIVE journal, where a newline-less or unparseable
+    final line is the append in flight when the process died — ignored
+    with a one-shot warning.  A sealed segment was newline-terminated
+    when it was rotated (the heal runs before the rename), so ANY
+    damaged line in one — torn tail included — is interior corruption:
+    skipped, counted, warned.  Raises ``FileNotFoundError`` when the
+    file vanished (a racing compactor committed mid-walk); the caller
+    restarts from a fresh chain resolution."""
     from . import metrics
 
-    path = os.path.join(os.path.abspath(directory), JOURNAL)
-    if not os.path.isfile(path):
-        return []
     with open(path) as f:
         text = f.read()
     lines = text.split("\n")
@@ -597,7 +829,7 @@ def read_journal(directory: str) -> list[dict]:
         raw = raw.strip()
         if not raw:
             continue
-        is_tail = torn_tail and n == len(lines) - 1
+        is_tail = tail_ok and torn_tail and n == len(lines) - 1
         try:
             frame = json.loads(raw)
             rec = frame["rec"]
@@ -627,4 +859,536 @@ def read_journal(directory: str) -> list[dict]:
                 "counts further damage)")
             continue
         out.append(rec)
+    return out
+
+
+def read_journal(directory: str) -> list[dict]:
+    """Read every valid record from the serve journal under
+    ``directory`` — the whole segment chain in order (sealed segments
+    oldest-first, then the active file), which is the single file
+    ``journal.jsonl`` until rotation is enabled.  Missing directory or
+    no journal files: ``[]`` — recovery on a never-journaled dir is a
+    no-op.
+
+    Tolerated damage, in the only two shapes it can take:
+
+    * a TORN FINAL LINE of the ACTIVE file — the append in flight when
+      the process died (no trailing newline, or the tail fails to
+      parse): ignored, with a one-shot ``journal_torn_tail`` warning.
+      The record was never acknowledged, so dropping it is the correct
+      replay semantics.
+    * an INTERIOR undecodable line or a CRC mismatch anywhere — bitrot
+      or tampering, which a crash cannot produce (sealed segments were
+      healed-then-renamed, so even their final line is covered): the
+      entry is skipped, counted
+      (``supervisor.journal_corrupt_entries``) and warned once; the
+      surviving records still replay.
+
+    A compaction committing mid-read makes a chain file vanish; the
+    read RESTARTS from a fresh chain resolution (each record is in
+    exactly one committed view, so the retry sees a consistent
+    whole-journal state, never a half-compacted one)."""
+    directory = os.path.abspath(directory)
+    for _ in range(5):
+        chain = journal_chain(directory)
+        if not chain:
+            return []
+        out: list[dict] = []
+        try:
+            for path in chain:
+                out.extend(_read_file_records(
+                    path, tail_ok=os.path.basename(path) == JOURNAL))
+            return out
+        except FileNotFoundError:
+            continue  # compactor replaced the chain mid-walk: restart
+    # chain churned 5 resolutions in a row (pathological); last resort:
+    # a tolerant pass that skips files vanishing under it
+    out = []
+    for path in journal_chain(directory):
+        try:
+            out.extend(_read_file_records(
+                path, tail_ok=os.path.basename(path) == JOURNAL))
+        except FileNotFoundError:
+            continue
+    return out
+
+
+def fold_journal_records(recs: list[dict]) -> dict:
+    """Fold journal records into replay state — THE journal semantics,
+    shared verbatim by ``supervisor._journal_scan`` (live replay) and
+    :func:`compact_journal` (whose self-check proves a rewrite
+    preserves exactly this fold): first ``accept`` per key (in order),
+    ``launch``/``failed`` counts, the first epoch-valid ``complete``,
+    the ``quarantine`` set, and the claim table with its fencing rules
+    — a higher epoch fences every lower one, a same-epoch same-worker
+    claim is a heartbeat renewal (expiry extends to the max), a
+    same-epoch claim by a DIFFERENT worker lost the append race (first
+    in journal order wins), a complete at a stale epoch is
+    recorded-but-ignored (``fenced``), and a second applied-epoch
+    complete counts ``double``."""
+    accepted: dict = {}
+    order: list = []
+    launches: dict = {}
+    failed: dict = {}
+    completed: dict = {}
+    completed_at: dict = {}
+    quarantined: set = set()
+    claims: dict = {}   # key -> {worker, epoch, expires, renewals, at}
+    fenced: dict = {}   # key -> ignored (epoch-stale) complete count
+    double: dict = {}   # key -> extra non-fenced epoch-stamped completes
+    for n, r in enumerate(recs):
+        k = r.get("key")
+        if k is None:
+            continue
+        kind = r.get("kind")
+        if kind == "accept":
+            if k not in accepted:
+                accepted[k] = r
+                order.append(k)
+        elif kind == "launch":
+            launches[k] = launches.get(k, 0) + 1
+        elif kind == "failed":
+            failed[k] = failed.get(k, 0) + 1
+        elif kind == "claim":
+            w, e = r.get("worker"), r.get("epoch")
+            if w is None or not isinstance(e, numbers.Integral):
+                continue  # framed fine but malformed: treat as absent
+            e = int(e)
+            exp = float(r.get("expires") or 0.0)
+            cur = claims.get(k)
+            if cur is None or e > cur["epoch"]:
+                # first claim, or a higher-epoch steal: the new epoch
+                # FENCES every lower epoch from here on
+                claims[k] = {"worker": str(w), "epoch": e,
+                             "expires": exp, "renewals": 0, "at": n}
+            elif e == cur["epoch"] and str(w) == cur["worker"]:
+                # heartbeat renewal: the holder extends its own lease
+                cur["expires"] = max(cur["expires"], exp)
+                cur["renewals"] += 1
+            # same-epoch claim by a DIFFERENT worker: the append race
+            # lost — first claim in journal order wins, later ignored
+        elif kind == "complete":
+            ce = r.get("epoch")
+            cur = claims.get(k)
+            if ce is not None and cur is not None \
+                    and int(ce) < cur["epoch"]:
+                # a fenced worker's late complete for a stolen key:
+                # recorded-but-ignored, never applied as the result
+                fenced[k] = fenced.get(k, 0) + 1
+            elif k in completed:
+                if ce is not None:
+                    # a second APPLIED-epoch complete: the same key ran
+                    # twice in the fleet (the expiry-steal race window)
+                    double[k] = double.get(k, 0) + 1
+            else:
+                completed[k] = r
+                completed_at[k] = n
+        elif kind == "quarantine":
+            quarantined.add(k)
+    return {"accepted": accepted, "order": order, "launches": launches,
+            "failed": failed, "completed": completed,
+            "completed_at": completed_at, "quarantined": quarantined,
+            "claims": claims, "fenced": fenced, "double": double,
+            "entries": len(recs)}
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once journal compaction (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def _retain_default() -> float:
+    try:
+        v = float(os.environ[JOURNAL_RETAIN_S_ENV])
+    except (KeyError, ValueError):
+        return JOURNAL_RETAIN_S_DEFAULT
+    return max(0.0, v)
+
+
+def _lease_s_local() -> float:
+    """QUEST_LEASE_S with the supervisor's 30 s default, parsed locally
+    so compaction stays importable without the (jax-heavy) supervisor
+    module; ``tests/test_storage_lifecycle.py`` pins the two parsers
+    equal."""
+    try:
+        v = float(os.environ["QUEST_LEASE_S"])
+    except (KeyError, ValueError):
+        return 30.0
+    return v if v > 0 else 30.0
+
+
+def _key_state(st: dict, k: str) -> tuple:
+    """One key's complete replay-visible state under a fold — the unit
+    of the compaction self-check (claim ``at`` excluded: record
+    positions legitimately shift when earlier records are dropped)."""
+    c = st["claims"].get(k)
+    if c is not None:
+        c = {kk: v for kk, v in c.items() if kk != "at"}
+    return (st["accepted"].get(k), st["launches"].get(k, 0),
+            st["failed"].get(k, 0), st["completed"].get(k), c,
+            st["fenced"].get(k, 0), st["double"].get(k, 0),
+            k in st["quarantined"])
+
+
+def _read_chain_files(paths: list[str]) -> list[dict]:
+    return [r for p in paths
+            for r in _read_file_records(
+                p, tail_ok=os.path.basename(p) == JOURNAL)]
+
+
+def compact_journal(directory: str, *, retain_s: float | None = None,
+                    fence: bool | None = None,
+                    now: float | None = None) -> dict:
+    """Rewrite the journal's retention-eligible sealed prefix, dropping
+    every record of a fully-SETTLED key while preserving everything
+    replay could still need.  Returns a stats dict; ``"compacted"`` is
+    False (with a ``"reason"``) when there was nothing eligible, a
+    peer holds the compactor lease, or the self-check refused.
+
+    A key's records are dropped only when ALL of: an epoch-valid
+    ``complete`` was applied (a ``failed``-only key is still backlog
+    and replays), it is not quarantined (the quarantine verdict must
+    outlive its evidence), no unexpired claim names it, its ``accept``
+    names no session (session ordering audits keep their trail), and
+    no record for it exists OUTSIDE the compacted prefix.  The active
+    file is never touched, and segments younger than ``retain_s``
+    (default ``QUEST_JOURNAL_RETAIN_S``, 3600 s, of file age) stay
+    greppable even when settled.
+
+    EXACTLY-ONCE: the kept records are written to a temp file, fsynced,
+    renamed to an epoch-``E+1`` segment (invisible — readers ignore
+    epochs above the sidecar's), the output is READ BACK and its fold
+    compared key-by-key against the original chain's
+    (:func:`_key_state`; any divergence counts
+    ``stateio.compaction_lost_keys`` and aborts with the journal
+    untouched), and only then does the sidecar's atomic rewrite bump
+    the committed epoch — after which the superseded sources are
+    unlinked (a crash between commit and unlink self-heals: the next
+    reader ignores them, the next compaction removes them).
+
+    FLEET: with ``fence=True`` (auto-detected from the presence of
+    claim records when ``fence=None``) the compactor first takes a
+    lease on :data:`COMPACTOR_KEY` through the ordinary claim protocol
+    — append a claim at the fencing epoch, re-read, and proceed only
+    if the fold says we won — so two compactors (or a compactor and a
+    zombie) can never both commit; their sidecar epochs would collide
+    but the loser aborts before writing."""
+    from . import metrics, resilience
+
+    directory = os.path.abspath(directory)
+    if retain_s is None:
+        retain_s = _retain_default()
+    if now is None:
+        now = _time.time()
+
+    def refused(reason: str) -> dict:
+        return {"compacted": False, "reason": reason,
+                "directory": directory}
+
+    chain = journal_chain(directory)
+    sealed = [p for p in chain if os.path.basename(p) != JOURNAL]
+    eligible: list[str] = []
+    for p in sealed:
+        try:
+            if os.path.getmtime(p) > now - retain_s:
+                break
+        except OSError:
+            break
+        eligible.append(p)
+    if not eligible:
+        return refused("nothing_eligible")
+    rest_paths = chain[len(eligible):]
+    try:
+        prefix = _read_chain_files(eligible)
+        rest = _read_chain_files(rest_paths)
+    except FileNotFoundError:
+        return refused("chain_changed")
+    all_recs = prefix + rest
+    if fence is None:
+        fence = any(r.get("kind") == "claim" for r in all_recs)
+    me = telemetry.worker_id()
+    if fence:
+        st0 = fold_journal_records(all_recs)
+        cur = st0["claims"].get(COMPACTOR_KEY)
+        mnow = metrics.clock()
+        if (cur is not None and cur["worker"] != me
+                and mnow < cur["expires"]):
+            return refused("compactor_leased")
+        epoch = (1 if cur is None
+                 else cur["epoch"] if cur["worker"] == me
+                 else cur["epoch"] + 1)
+        append_journal_entry(
+            directory,
+            {"kind": "claim", "key": COMPACTOR_KEY, "worker": me,
+             "epoch": epoch, "expires": mnow + _lease_s_local()})
+        # re-resolve and re-read: our claim (and any racer's) is now on
+        # disk; the fold's journal-order rule decides who won
+        chain2 = journal_chain(directory)
+        if chain2[:len(eligible)] != eligible:
+            return refused("chain_changed")
+        try:
+            rest = _read_chain_files(chain2[len(eligible):])
+        except FileNotFoundError:
+            return refused("chain_changed")
+        all_recs = prefix + rest
+        won = fold_journal_records(all_recs)["claims"].get(COMPACTOR_KEY)
+        if won is None or won["worker"] != me or won["epoch"] != epoch:
+            return refused("compactor_lost_race")
+
+    st_all = fold_journal_records(all_recs)
+    rest_keys = {r.get("key") for r in rest if r.get("key") is not None}
+    mnow = metrics.clock()
+
+    def droppable(k) -> bool:
+        if k == COMPACTOR_KEY or k in rest_keys:
+            return False
+        if k not in st_all["completed"] or k in st_all["quarantined"]:
+            return False
+        acc = st_all["accepted"].get(k)
+        if acc is not None and acc.get("session") is not None:
+            return False
+        c = st_all["claims"].get(k)
+        if c is not None and mnow < c["expires"]:
+            return False
+        return True
+
+    prefix_keys = {r.get("key") for r in prefix
+                   if r.get("key") is not None}
+    dropped = {k for k in prefix_keys if droppable(k)}
+    # COMPACTOR_KEY housekeeping: its claim trail must not itself grow
+    # without bound, but fencing monotonicity must survive — keep
+    # exactly the record the fold's final claim state came from (or
+    # nothing, when a newer compactor claim lives outside the prefix)
+    keep_comp_ids: set = set()
+    if COMPACTOR_KEY in prefix_keys and COMPACTOR_KEY not in rest_keys:
+        cw = st_all["claims"].get(COMPACTOR_KEY)
+        winner = None
+        if cw is not None:
+            for r in prefix:
+                if (r.get("key") == COMPACTOR_KEY
+                        and r.get("kind") == "claim"
+                        and str(r.get("worker")) == cw["worker"]
+                        and isinstance(r.get("epoch"), numbers.Integral)
+                        and int(r["epoch"]) == cw["epoch"]
+                        and float(r.get("expires") or 0.0)
+                        == cw["expires"]):
+                    winner = r
+                    break
+        if winner is not None:
+            keep_comp_ids = {id(winner)}
+        else:  # no reconstructable winner: keep the whole trail
+            keep_comp_ids = {id(r) for r in prefix
+                             if r.get("key") == COMPACTOR_KEY}
+
+    kept: list[dict] = []
+    for r in prefix:
+        k = r.get("key")
+        if k is None:
+            kept.append(r)  # fold-invisible: preserved conservatively
+        elif k == COMPACTOR_KEY:
+            if id(r) in keep_comp_ids:
+                kept.append(r)
+        elif k not in dropped:
+            kept.append(r)
+
+    # sequence = highest covered source; epoch = one past committed
+    out_seq = max(int(_SEG_RE.match(os.path.basename(p)).group(1))
+                  for p in eligible)
+    new_epoch = _sidecar_epoch(directory) + 1
+    out_name = f"journal-{out_seq:06d}.c{new_epoch}.jsonl"
+    out_path = os.path.join(directory, out_name)
+    tmp = os.path.join(directory, f".compact-tmp-{os.getpid()}")
+    bytes_before = sum(_size_or_zero(p) for p in eligible)
+    with open(tmp, "w") as f:
+        f.write("".join(frame_record(r) + "\n" for r in kept))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, out_path)  # invisible: epoch above the sidecar's
+
+    # SELF-CHECK before the commit point: read the OUTPUT back (disk
+    # round-trip, CRC re-verified) and prove the fold is unchanged for
+    # every surviving key and empty for every dropped one
+    readback = _read_file_records(out_path, tail_ok=False)
+    st_new = fold_journal_records(readback + rest)
+    empty = _key_state({"accepted": {}, "launches": {}, "failed": {},
+                        "completed": {}, "claims": {}, "fenced": {},
+                        "double": {}, "quarantined": set()}, None)
+    lost = []
+    for k in (prefix_keys | rest_keys) - dropped - {COMPACTOR_KEY}:
+        if _key_state(st_new, k) != _key_state(st_all, k):
+            lost.append(k)
+    for k in dropped:
+        if _key_state(st_new, k) != empty:
+            lost.append(k)
+    c_old = st_all["claims"].get(COMPACTOR_KEY)
+    c_new = st_new["claims"].get(COMPACTOR_KEY)
+
+    def _csig(c):
+        return None if c is None else (c["worker"], c["epoch"],
+                                       c["expires"])
+
+    if _csig(c_old) != _csig(c_new):
+        lost.append(COMPACTOR_KEY)
+    if lost:
+        metrics.counter_inc("stateio.compaction_lost_keys", len(lost))
+        metrics.warn_once(
+            "compaction_lost_keys",
+            f"journal compaction under {directory} would have changed "
+            f"replay state for {len(lost)} key(s) (e.g. "
+            f"{sorted(map(str, lost))[:3]}); ABORTED — journal "
+            "untouched (stateio.compaction_lost_keys counts refusals)")
+        _unlink_quiet(out_path)
+        return refused("self_check_failed")
+
+    # COMMIT: the sidecar's atomic rewrite flips every reader to the
+    # compacted view in one rename
+    meta = _read_sidecar(directory)
+    meta.setdefault("format_version", JOURNAL_FORMAT_VERSION)
+    meta.setdefault("kind", "serve-journal")
+    meta["epoch"] = new_epoch
+    resilience.with_retries(
+        lambda: resilience._write_json_atomic(
+            os.path.join(directory, JOURNAL_META), meta),
+        seam="journal_append")
+    # unlink superseded sources (and any stale orphans a crashed
+    # compactor left); a crash mid-loop self-heals — they are already
+    # invisible
+    live = {os.path.basename(p) for p in journal_chain(directory)}
+    for n in os.listdir(directory):
+        if _SEG_RE.match(n) and n not in live:
+            _unlink_quiet(os.path.join(directory, n))
+    metrics.counter_inc("stateio.journal_compactions")
+    bytes_after = _size_or_zero(out_path)
+    journal_bytes(directory)  # refresh the gauges
+    return {"compacted": True, "directory": directory,
+            "output": out_name, "epoch": new_epoch,
+            "segments_in": len(eligible), "records_in": len(prefix),
+            "records_out": len(kept), "keys_dropped": len(dropped),
+            "bytes_reclaimed": max(0, bytes_before - bytes_after)}
+
+
+# ---------------------------------------------------------------------------
+# Retention GC (ISSUE 20): bounded lifetimes for non-journal artifacts
+# ---------------------------------------------------------------------------
+
+#: GC age threshold env knob (seconds; default one week).
+GC_TTL_S_ENV = "QUEST_GC_TTL_S"
+GC_TTL_S_DEFAULT = 604800.0
+
+#: Expendable top-level FILES: trace captures (telemetry), flight
+#: recorder dumps (metrics), fleet metric snapshots
+#: (metrics.write_snapshot).  A whitelist — journal files, sidecars,
+#: ``fleet.json``, lock files and the ``latest`` pointer can never
+#: match, so GC cannot eat the durable tier even if misconfigured.
+_GC_FILE_RE = _re.compile(
+    r"^(trace-.*\.json|quest-flight-.*\.json|snap-.*\.json)$")
+
+
+def _gc_ttl_default() -> float:
+    try:
+        v = float(os.environ[GC_TTL_S_ENV])
+    except (KeyError, ValueError):
+        return GC_TTL_S_DEFAULT
+    return max(0.0, v)
+
+
+def _dir_stats(path: str) -> tuple:
+    """(newest mtime anywhere under ``path``, total bytes) — the
+    newest-file rule means a session whose ``fence.json`` was just
+    renewed (a live lease) or whose spill was just rewritten is young
+    no matter how old its other files are."""
+    newest, total = 0.0, 0
+    for root, _dirs, files in os.walk(path):
+        for n in files:
+            p = os.path.join(root, n)
+            try:
+                stt = os.stat(p)
+            except OSError:
+                continue
+            newest = max(newest, stt.st_mtime)
+            total += stt.st_size
+    try:
+        dir_mtime = os.path.getmtime(path)
+    except OSError:
+        dir_mtime = 0.0
+    return max(newest, dir_mtime), total
+
+
+def gc_storage(directory: str, *, ttl_s: float | None = None,
+               now: float | None = None,
+               dry_run: bool = False) -> dict:
+    """Age-bounded sweep of the expendable storage under ``directory``:
+    trace captures, flight-recorder dumps and fleet metric snapshots
+    older than ``ttl_s`` (default ``QUEST_GC_TTL_S``, one week), and
+    checkpoint/session-spill subdirectories (anything holding a
+    ``qureg.json``) whose NEWEST file is older than the TTL.
+
+    REFUSALS, in priority order: the slot the ``latest`` pointer names
+    is never touched regardless of age (it is the restore path's
+    truth); a directory containing any fresh file — a just-renewed
+    ``fence.json`` lease, a just-written spill — is young by the
+    newest-file rule; journal segments, sidecars, ``fleet.json`` and
+    lock files can never match the whitelist.  ``dry_run=True``
+    reports what WOULD go (same return shape) without unlinking.
+
+    Returns ``{"removed": [names], "reclaimed_bytes": n, "ttl_s",
+    "dry_run"}`` and counts ``stateio.gc_removed`` /
+    ``stateio.gc_reclaimed_bytes`` (the ``quest_gc_reclaimed_bytes``
+    gauge) for real removals."""
+    import shutil
+
+    from . import metrics
+
+    directory = os.path.abspath(directory)
+    if ttl_s is None:
+        ttl_s = _gc_ttl_default()
+    if now is None:
+        now = _time.time()
+    cutoff = now - ttl_s
+    out = {"removed": [], "reclaimed_bytes": 0, "ttl_s": ttl_s,
+           "dry_run": bool(dry_run)}
+    if not os.path.isdir(directory):
+        return out
+    try:
+        with open(os.path.join(directory, "latest")) as f:
+            live = {f.read().strip()}
+    except OSError:
+        live = set()  # no (or unreadable) latest pointer: pins nothing
+    for name in sorted(os.listdir(directory)):
+        path = os.path.join(directory, name)
+        if os.path.isfile(path):
+            if not _GC_FILE_RE.match(name):
+                continue
+            try:
+                stt = os.stat(path)
+            except OSError:
+                continue
+            if stt.st_mtime > cutoff:
+                continue
+            if not dry_run:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+            out["removed"].append(name)
+            out["reclaimed_bytes"] += stt.st_size
+        elif os.path.isdir(path):
+            if name in live:
+                continue  # the latest pointer's slot: never touched
+            if not os.path.isfile(os.path.join(path, _META)):
+                continue  # not a checkpoint/session dir: not ours
+            newest, total = _dir_stats(path)
+            if newest > cutoff:
+                continue
+            if not dry_run:
+                try:
+                    shutil.rmtree(path)
+                except OSError:
+                    continue
+            out["removed"].append(name)
+            out["reclaimed_bytes"] += total
+    if out["removed"] and not dry_run:
+        metrics.counter_inc("stateio.gc_removed", len(out["removed"]))
+        metrics.counter_inc("stateio.gc_reclaimed_bytes",
+                            out["reclaimed_bytes"])
     return out

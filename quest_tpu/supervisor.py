@@ -119,8 +119,8 @@ import weakref
 from . import metrics
 from . import telemetry
 from .validation import (QuESTOverloadError, QuESTPoisonedRequestError,
-                         QuESTPreemptedError, QuESTTimeoutError,
-                         QuESTValidationError)
+                         QuESTPreemptedError, QuESTStorageError,
+                         QuESTTimeoutError, QuESTValidationError)
 
 #: Default retry_after_s hint carried by shed runs (override via
 #: configure_gate / QUEST_RETRY_AFTER_S).
@@ -876,6 +876,148 @@ def journal_backlog() -> int:
         return _journal_recovery["pending"]
 
 
+#: Durability policy env knob: what a journaled serve does when a
+#: journal append exhausts its bounded retry budget
+#: (``resilience.RETRY_POLICY``, ``journal_append`` — a full disk, a
+#: failing medium).  ``strict`` (the default) REFUSES the affected
+#: requests typed (:class:`QuESTStorageError`, ABI code 9) rather than
+#: run work whose acceptance/claim/launch is not durable; ``degrade``
+#: keeps serving AT-LEAST-ONCE — un-journaled work re-runs on the next
+#: replay — flips the ``quest_journal_degraded`` gauge, counts every
+#: record served without durability (``supervisor.journal_degraded``),
+#: and automatically RE-ARMS the moment an append succeeds again.
+DURABILITY_ENV = "QUEST_DURABILITY"
+
+#: Whether journal appends are currently failing under the ``degrade``
+#: policy (the ``quest_journal_degraded`` gauge).  Guarded by _lock.
+_journal_state = {"degraded": False}
+
+#: Last serve-loop compaction/GC cadence firings (metrics.clock
+#: timebase; see ``QUEST_JOURNAL_COMPACT_EVERY_S`` /
+#: ``QUEST_STORAGE_GC_EVERY_S``).  Guarded by _lock.
+_storage_cadence_state = {"compact": 0.0, "gc": 0.0}
+
+
+def _durability() -> str:
+    """The active durability policy (:data:`DURABILITY_ENV`):
+    ``"strict"`` unless the env var says ``degrade`` (unknown values
+    fall back to strict — the safe side)."""
+    return ("degrade"
+            if os.environ.get(DURABILITY_ENV, "").strip().lower()
+            == "degrade" else "strict")
+
+
+def journal_degraded() -> bool:
+    """True while a journaled serve under ``QUEST_DURABILITY=degrade``
+    is running with FAILING journal appends — results are at-least-once
+    until an append succeeds again (the ``quest_journal_degraded``
+    gauge; an SLO sentinel watching it pages on sustained disk
+    pressure)."""
+    with _lock:
+        return _journal_state["degraded"]
+
+
+def _journal_rearm() -> None:
+    """A journal append succeeded: leave degraded mode (no-op when not
+    in it)."""
+    with _lock:
+        was = _journal_state["degraded"]
+        _journal_state["degraded"] = False
+    if was:
+        metrics.counter_inc("supervisor.journal_rearmed")
+        metrics.trace("serve journal re-armed: appends succeeding "
+                      "again, exactly-once durability restored")
+
+
+def _journal_write(journal_dir: str, recs: list, what: str, *,
+                   refuse: bool | None = None) -> bool:
+    """Append ``recs`` to the serve journal under the durability
+    policy.  Success: re-arms degraded mode, returns True.  An
+    :class:`OSError` surviving the bounded ``journal_append`` retry
+    budget either raises :class:`QuESTStorageError` (strict — the
+    caller converts it into typed per-request refusals) or enters
+    degraded at-least-once mode and returns False (degrade).
+    ``refuse=False`` forces the never-raise path for seams that are
+    at-least-once by design regardless of policy (quarantine
+    markers)."""
+    from . import stateio
+
+    if not recs:
+        return True
+    try:
+        stateio.append_journal_entries(journal_dir, recs)
+    except OSError as e:
+        metrics.counter_inc("supervisor.journal_append_failures",
+                            len(recs))
+        strict = (_durability() == "strict") if refuse is None \
+            else refuse
+        if strict:
+            raise QuESTStorageError(
+                f"serve journal at {journal_dir!r} could not record "
+                f"{len(recs)} {what} record(s) past the bounded retry "
+                f"budget ({type(e).__name__}: {e}); "
+                "QUEST_DURABILITY=strict refuses to proceed without "
+                "durability — retry once disk pressure clears, or "
+                "serve at-least-once with QUEST_DURABILITY=degrade"
+            ) from e
+        with _lock:
+            first = not _journal_state["degraded"]
+            _journal_state["degraded"] = True
+        metrics.counter_inc("supervisor.journal_degraded", len(recs))
+        if first:
+            metrics.warn_once(
+                "journal_degraded",
+                f"serve journal at {journal_dir!r} is failing "
+                f"({type(e).__name__}: {e}); QUEST_DURABILITY=degrade "
+                "keeps serving AT-LEAST-ONCE (un-journaled work "
+                "re-runs on the next replay) until appends succeed "
+                "again — quest_journal_degraded gauge is up")
+        return False
+    _journal_rearm()
+    return True
+
+
+def _storage_cadence(journal_dir: str, fleet_on: bool) -> None:
+    """Opt-in serve-loop storage hygiene: when
+    ``QUEST_JOURNAL_COMPACT_EVERY_S`` / ``QUEST_STORAGE_GC_EVERY_S``
+    are set > 0, a journaled serve pass runs
+    ``stateio.compact_journal`` / ``stateio.gc_storage`` on that
+    cadence (fleet serves compact FENCED through the compactor lease).
+    Failures are contained — storage hygiene must never take the serve
+    path down with it."""
+    from . import stateio
+
+    now = metrics.clock()
+    for env_name, field, run in (
+            ("QUEST_JOURNAL_COMPACT_EVERY_S", "compact",
+             lambda: stateio.compact_journal(
+                 journal_dir, fence=True if fleet_on else None)),
+            ("QUEST_STORAGE_GC_EVERY_S", "gc",
+             lambda: stateio.gc_storage(journal_dir))):
+        try:
+            every = float(os.environ.get(env_name, "0") or 0)
+        except ValueError:
+            every = 0.0
+        if every <= 0:
+            continue
+        with _lock:
+            due = now - _storage_cadence_state[field] >= every
+            if due:
+                _storage_cadence_state[field] = now
+        if not due:
+            continue
+        try:
+            run()
+        except Exception as e:
+            metrics.counter_inc("supervisor.storage_cadence_failures")
+            metrics.warn_once(
+                f"storage_cadence_{field}",
+                f"serve-loop {field} under {journal_dir!r} failed "
+                f"({type(e).__name__}: {e}); serving continues — "
+                "run tools/storage_gc.py / stateio.compact_journal "
+                "manually and check disk health")
+
+
 def session_occupancy() -> int:
     """Resident registers across every live :class:`SessionPool` (the
     ``quest_serve_session_occupancy`` gauge)."""
@@ -1218,74 +1360,13 @@ def _journal_scan(directory: str) -> dict:
     an IN-PROCESS typed failure (shed, preemption drain, executor
     error) journaled by the surviving worker — a launch with neither
     ``complete`` nor ``failed`` is the signature of a process death,
-    and only those count toward poison quarantine."""
+    and only those count toward poison quarantine.  The fold itself
+    lives in ``stateio.fold_journal_records`` — ONE set of semantics
+    shared with journal compaction, whose self-check proves a
+    rewritten journal folds identically."""
     from . import stateio
 
-    recs = stateio.read_journal(directory)
-    accepted: dict = {}
-    order: list = []
-    launches: dict = {}
-    failed: dict = {}
-    completed: dict = {}
-    completed_at: dict = {}
-    quarantined: set = set()
-    claims: dict = {}   # key -> {worker, epoch, expires, renewals, at}
-    fenced: dict = {}   # key -> ignored (epoch-stale) complete count
-    double: dict = {}   # key -> extra non-fenced epoch-stamped completes
-    for n, r in enumerate(recs):
-        k = r.get("key")
-        if k is None:
-            continue
-        kind = r.get("kind")
-        if kind == "accept":
-            if k not in accepted:
-                accepted[k] = r
-                order.append(k)
-        elif kind == "launch":
-            launches[k] = launches.get(k, 0) + 1
-        elif kind == "failed":
-            failed[k] = failed.get(k, 0) + 1
-        elif kind == "claim":
-            w, e = r.get("worker"), r.get("epoch")
-            if w is None or not isinstance(e, numbers.Integral):
-                continue  # framed fine but malformed: treat as absent
-            e = int(e)
-            exp = float(r.get("expires") or 0.0)
-            cur = claims.get(k)
-            if cur is None or e > cur["epoch"]:
-                # first claim, or a higher-epoch steal: the new epoch
-                # FENCES every lower epoch from here on
-                claims[k] = {"worker": str(w), "epoch": e,
-                             "expires": exp, "renewals": 0, "at": n}
-            elif e == cur["epoch"] and str(w) == cur["worker"]:
-                # heartbeat renewal: the holder extends its own lease
-                cur["expires"] = max(cur["expires"], exp)
-                cur["renewals"] += 1
-            # same-epoch claim by a DIFFERENT worker: the append race
-            # lost — first claim in journal order wins, later ignored
-        elif kind == "complete":
-            ce = r.get("epoch")
-            cur = claims.get(k)
-            if ce is not None and cur is not None \
-                    and int(ce) < cur["epoch"]:
-                # a fenced worker's late complete for a stolen key:
-                # recorded-but-ignored, never applied as the result
-                fenced[k] = fenced.get(k, 0) + 1
-            elif k in completed:
-                if ce is not None:
-                    # a second APPLIED-epoch complete: the same key ran
-                    # twice in the fleet (the expiry-steal race window)
-                    double[k] = double.get(k, 0) + 1
-            else:
-                completed[k] = r
-                completed_at[k] = n
-        elif kind == "quarantine":
-            quarantined.add(k)
-    return {"accepted": accepted, "order": order, "launches": launches,
-            "failed": failed, "completed": completed,
-            "completed_at": completed_at, "quarantined": quarantined,
-            "claims": claims, "fenced": fenced, "double": double,
-            "entries": len(recs)}
+    return stateio.fold_journal_records(stateio.read_journal(directory))
 
 
 def recover_queue(directory: str, env=None) -> dict:
@@ -2052,7 +2133,9 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
     if journal_dir is not None:
         from . import stateio
 
+        _storage_cadence(journal_dir, fleet_on)
         jstate = _journal_scan(journal_dir)
+        stateio.journal_bytes(journal_dir)  # refresh size/shape gauges
         jlaunches = dict(jstate["launches"])
         if fleet_on:
             # observer-side fleet accounting, once per serve pass: the
@@ -2117,9 +2200,15 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
             if k in jstate["quarantined"] \
                     or n_crash >= poison_attempts():
                 if k not in jstate["quarantined"]:
-                    stateio.append_journal_entry(
-                        journal_dir, {"kind": "quarantine", "key": k,
-                                      "attempts": n_crash})
+                    # at-least-once by design under BOTH durability
+                    # policies: an un-journaled quarantine verdict is
+                    # re-derived from the launch counts on the next
+                    # replay, so refusing the response would gain
+                    # nothing
+                    _journal_write(journal_dir,
+                                   [{"kind": "quarantine", "key": k,
+                                     "attempts": n_crash}],
+                                   "quarantine", refuse=False)
                     jstate["quarantined"].add(k)
                 metrics.counter_inc("supervisor.poison_quarantined")
                 t = _tenant_of(r)
@@ -2216,8 +2305,23 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
         # one open/write/fsync for the whole accept(+claim) batch —
         # same write-ahead guarantee (every accept durable before
         # anything launches) at 1/N the sync cost
-        stateio.append_journal_entries(journal_dir, to_append)
-        if fleet_on and claim_plan:
+        try:
+            accepts_durable = _journal_write(journal_dir, to_append,
+                                             "accept/claim")
+        except QuESTStorageError as se:
+            # strict durability: refuse (typed) every entry whose
+            # acceptance or lease failed to land — an entry accepted
+            # by a PRIOR durable pass, holding no new claim, may still
+            # run on its existing journal state
+            accepts_durable = False
+            for i, r, k, n_launch in to_accept:
+                if results[i] is not None:
+                    continue
+                if k not in jstate["accepted"] or i in claim_plan:
+                    claim_plan.pop(i, None)
+                    metrics.counter_inc("supervisor.storage_refused")
+                    results[i] = {"ok": False, "error": se}
+        if fleet_on and claim_plan and accepts_durable:
             # claim-race resolution: two workers may append same-epoch
             # claims for one key concurrently — re-scan and let journal
             # order arbitrate (the fold keeps the FIRST same-epoch
@@ -2482,8 +2586,13 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
                                         lrec["worker"] = my_wid
                                         lrec["epoch"] = claim_plan[i][1]
                                     launch_recs.append(lrec)
-                                stateio.append_journal_entries(
-                                    journal_dir, launch_recs)
+                                # strict durability: a QuESTStorageError
+                                # raised here fails the whole unit typed
+                                # (the except below) — nothing launches
+                                # with an unrecorded attempt; degrade
+                                # proceeds at-least-once
+                                _journal_write(journal_dir, launch_recs,
+                                               "launch")
                             values = _run_coalesced(
                                 [r for _i, r in group])
                             # results land FIRST: a failed complete-append
@@ -2521,6 +2630,9 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
                                     # (mirroring the launch batch above)
                                     stateio.append_journal_entries(
                                         journal_dir, comp_recs)
+                                    # appends working again: leave
+                                    # degraded at-least-once mode
+                                    _journal_rearm()
                                 except Exception as je:
                                     # whether the digest or the append
                                     # failed, none of the unit's
@@ -2563,8 +2675,13 @@ def serve(requests, *, workers: int = 2, label: str = "serve",
                     # queue behind it) — and a shed BATCH fails every
                     # member with the same typed error, the unit it was
                     # admitted as
+                    # storage refusals are lifecycle too: a full disk
+                    # under strict durability is a typed, retryable
+                    # refusal, not a regression of the exactly-once
+                    # replay contract
                     lifecycle = isinstance(e, (QuESTOverloadError,
-                                               QuESTPreemptedError))
+                                               QuESTPreemptedError,
+                                               QuESTStorageError))
                     for i, _r in group:
                         results[i] = {"ok": False, "error": e}
                         if jstate is not None and i in replays \
@@ -2720,7 +2837,12 @@ def reset() -> None:
         _fleet_cache["view"] = None
         _inflight[0] = 0
         _journal_recovery["pending"] = 0
+        _journal_state["degraded"] = False
+        _storage_cadence_state.update(compact=0.0, gc=0.0)
     _batch["occupancy"] = 0
+    from . import stateio
+
+    stateio._journal_stats.update(dir=None, bytes=0, segments=0)
     _pools.clear()
     _tls.deadlines = []
     _tls.recovering = False

@@ -350,23 +350,68 @@ JOURNAL_KINDS = ("accept", "claim", "launch", "complete", "failed",
                  "quarantine")
 
 
+def _journal_chain_forensic(directory: str) -> list[str]:
+    """Stdlib mirror of ``stateio.journal_chain``: the committed read
+    order of a (possibly segmented) journal directory — the winning
+    compacted segment at or below the sidecar's ``epoch``, plain
+    sealed segments above its sequence, then the active
+    ``journal.jsonl``.  Kept import-light (no jax) so post-mortem
+    tooling runs anywhere; a test pins it equal to stateio's."""
+    import json
+    import re
+
+    directory = os.path.abspath(directory)
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    epoch = 0
+    try:
+        with open(os.path.join(directory, "journal.json")) as f:
+            epoch = int(json.load(f).get("epoch", 0))
+    except (OSError, ValueError, TypeError, AttributeError):
+        epoch = 0
+    seg_re = re.compile(r"^journal-(\d{6})(?:\.c(\d+))?\.jsonl$")
+    plain, compacted = [], []
+    for n in names:
+        m = seg_re.match(n)
+        if not m:
+            continue
+        seq, ce = int(m.group(1)), m.group(2)
+        if ce is None:
+            plain.append((seq, n))
+        elif int(ce) <= epoch:
+            compacted.append((int(ce), seq, n))
+    chain, floor = [], -1
+    if compacted:
+        _, floor, winner = max(compacted)
+        chain.append(winner)
+    chain.extend(n for seq, n in sorted(plain) if seq > floor)
+    if "journal.jsonl" in names:
+        chain.append("journal.jsonl")
+    return [os.path.join(directory, n) for n in chain]
+
+
 def _read_journal_forensic(directory: str) -> list[dict]:
     """Stdlib mirror of ``stateio.read_journal`` for post-mortem use:
     every CRC32-framed line that parses and checksums is returned in
-    file order; torn or corrupt lines are silently skipped (the live
-    reader warns and counts — forensics over a copied journal must not
-    mutate process counters).  A test pins both readers returning the
-    SAME records over a damaged journal, so the tolerance semantics
-    cannot drift."""
+    chain order (whole segment chain, active file last); torn or
+    corrupt lines are silently skipped (the live reader warns and
+    counts — forensics over a copied journal must not mutate process
+    counters).  A test pins both readers returning the SAME records
+    over a damaged journal, so the tolerance semantics cannot
+    drift."""
     import json
     import zlib
 
-    path = os.path.join(os.path.abspath(directory), "journal.jsonl")
-    if not os.path.isfile(path):
-        return []
     out: list[dict] = []
-    with open(path) as f:
-        for raw in f.read().split("\n"):
+    for path in _journal_chain_forensic(directory):
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            continue
+        for raw in text.split("\n"):
             raw = raw.strip()
             if not raw:
                 continue

@@ -117,6 +117,21 @@ class QuESTPoisonedRequestError(QuESTError):
     code = 8
 
 
+class QuESTStorageError(QuESTError):
+    """Durable storage failed and the ``QUEST_DURABILITY=strict``
+    policy refused to proceed without it: the serve journal's append
+    exhausted its bounded retry budget (``resilience.RETRY_POLICY``,
+    ``journal_append``) — a full disk (ENOSPC), a failing medium (EIO)
+    — so the request's acceptance/claim/launch could not be made
+    durable and running it anyway would break the journal's
+    exactly-once contract.  The request did NOT run; retry it once
+    disk pressure clears (under ``QUEST_DURABILITY=degrade`` the serve
+    instead continues at-least-once and counts
+    ``supervisor.journal_degraded``)."""
+
+    code = 9
+
+
 def _fail(msg: str, func: str | None = None):
     raise QuESTValidationError(msg if func is None else f"{func}: {msg}")
 

@@ -1,0 +1,772 @@
+"""Storage-lifecycle tests (ISSUE 20): bounded durable storage — the
+segmented journal (size-triggered rotation into numbered segments),
+exactly-once compaction (write-temp / atomic-rename / sidecar epoch
+bump, fenced through the PR 15 claim protocol in fleets), the
+``QUEST_DURABILITY`` disk-fault policy (strict typed refusal with ABI
+code 9 vs at-least-once degrade with re-arm), retention GC, the
+stdlib mirrors (``tools/fleet_serve.py`` codec + chain,
+``tools/storage_gc.py``, telemetry's forensic reader), the
+``journal_fsck`` exit codes, and the new strictly-regressive
+``ledger_diff`` rules.
+
+Everything here is deterministic and in-process — the real
+multi-process kill/compact/replay chains are subprocess-drilled by
+``tools/chaos_drill.py`` rows ``disk_full_degrade`` /
+``journal_compact_replay`` / ``storage_lifecycle_fleet`` and the
+``record_all.py`` ``storage_lifecycle`` tier-2 smoke; these tests pin
+the same machinery at the API seam where a debugger can reach it.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import (metrics, models, resilience, stateio, supervisor,
+                       telemetry, validation)
+from quest_tpu.validation import (QuESTError, QuESTStorageError,
+                                  QuESTValidationError)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+N = 6
+
+
+def _measured_circ(seed=7):
+    circ = models.random_circuit(N, depth=2, seed=seed)
+    circ.measure(0)
+    return circ
+
+
+def _reqs(env, n=3, **kw):
+    keys = jax.random.split(jax.random.PRNGKey(3), n)
+    circ = _measured_circ()
+    return [supervisor.BatchableRun(
+        circ, env, key=keys[i], trace_id=f"tenant-{i}",
+        idempotency_key=f"req-{i}", **kw) for i in range(n)]
+
+
+def _counter(name, before=None):
+    v = metrics.counters().get(name, 0)
+    return v if before is None else v - before.get(name, 0)
+
+
+def _accept(key, i=0, session=None):
+    rec = {"kind": "accept", "key": key, "attempts": 1, "index": i}
+    if session is not None:
+        rec["session"] = session
+    return rec
+
+
+def _complete(key, epoch=None):
+    rec = {"kind": "complete", "key": key, "digest": "d", "at": 0.0}
+    if epoch is not None:
+        rec["epoch"] = epoch
+    return rec
+
+
+@pytest.fixture
+def seg_env(monkeypatch):
+    """Rotation armed at a small threshold for the test's duration."""
+    monkeypatch.setenv(stateio.JOURNAL_SEGMENT_BYTES_ENV, "400")
+    yield 400
+
+
+@pytest.fixture(autouse=True)
+def _fresh_journal_stats():
+    yield
+    stateio._journal_stats.update(dir=None, bytes=0, segments=0)
+
+
+def _fill(d, n, start=0, complete=True):
+    for i in range(start, start + n):
+        stateio.append_journal_entry(d, _accept(f"k{i}", i))
+        if complete:
+            stateio.append_journal_entry(d, _complete(f"k{i}"))
+
+
+# ---------------------------------------------------------------------------
+# Rotation
+# ---------------------------------------------------------------------------
+
+
+def test_no_rotation_by_default(tmp_path):
+    """Env unset: the journal stays ONE file no matter how much lands —
+    the pre-rotation on-disk layout is byte-stable."""
+    d = str(tmp_path / "j")
+    _fill(d, 30)
+    assert stateio.journal_segments(d) == []
+    assert [os.path.basename(p) for p in stateio.journal_chain(d)] \
+        == [stateio.JOURNAL]
+    assert len(stateio.read_journal(d)) == 60
+
+
+def test_rotation_at_threshold(tmp_path, seg_env):
+    """Past the byte threshold the active file is SEALED into the next
+    numbered segment; every record still replays, in order, and the
+    rotation is counted."""
+    d = str(tmp_path / "j")
+    before = metrics.counters()
+    _fill(d, 20)
+    segs = stateio.journal_segments(d)
+    assert len(segs) >= 2
+    assert all(stateio._SEG_RE.match(os.path.basename(p))
+               for p in segs)
+    # chain = sealed oldest-first, then the active file
+    chain = [os.path.basename(p) for p in stateio.journal_chain(d)]
+    assert chain[-1] == stateio.JOURNAL
+    assert chain[:-1] == sorted(chain[:-1])
+    recs = stateio.read_journal(d)
+    assert [r["key"] for r in recs if r["kind"] == "accept"] \
+        == [f"k{i}" for i in range(20)]
+    assert _counter("stateio.journal_rotations", before) >= 2
+    # every sealed segment respects the threshold (+ one batch slack)
+    for p in segs:
+        assert os.path.getsize(p) < 400 + 200
+
+
+def test_rotation_disabled_by_zero(tmp_path, monkeypatch):
+    monkeypatch.setenv(stateio.JOURNAL_SEGMENT_BYTES_ENV, "0")
+    d = str(tmp_path / "j")
+    _fill(d, 20)
+    assert stateio.journal_segments(d) == []
+
+
+def test_journal_bytes_and_gauges(tmp_path, seg_env):
+    """``journal_bytes`` sums the whole chain and feeds the
+    ``quest_journal_*`` gauges rendered by ``metrics.export_text``."""
+    d = str(tmp_path / "j")
+    _fill(d, 12)
+    total = sum(os.path.getsize(p) for p in stateio.journal_chain(d))
+    assert stateio.journal_bytes(d) == total
+    snap = stateio.journal_gauge_snapshot()
+    assert snap["dir"] == os.path.abspath(d)
+    assert snap["bytes"] == total
+    assert snap["segments"] == len(stateio.journal_chain(d))
+    text = metrics.export_text()
+    assert f"quest_journal_bytes {total}" in text
+    for gauge in ("quest_journal_segments", "quest_journal_rotations",
+                  "quest_journal_compactions", "quest_journal_degraded",
+                  "quest_gc_reclaimed_bytes"):
+        assert gauge + " " in text
+
+
+def test_torn_tail_heals_only_on_active(tmp_path, seg_env):
+    """REGRESSION: the torn-tail pardon applies to the ACTIVE file
+    only.  A sealed segment was newline-terminated when it rotated, so
+    a damaged final line there is interior corruption — counted and
+    skipped, never silently forgiven."""
+    d = str(tmp_path / "j")
+    _fill(d, 12)
+    seg = stateio.journal_segments(d)[0]
+    active = os.path.join(d, stateio.JOURNAL)
+    # torn tail on the ACTIVE file: dropped silently (in-flight append)
+    with open(active, "a") as f:
+        f.write('{"crc": "00000000", "rec": {"kind": "acc')
+    before = metrics.counters()
+    n_before = len(stateio.read_journal(d))
+    assert _counter("supervisor.journal_corrupt_entries", before) == 0
+    # the SAME damage on a sealed segment is interior corruption
+    with open(seg, "rb+") as f:
+        raw = f.read()
+        f.seek(0)
+        f.truncate(0)
+        f.write(raw[:-20])  # chop the final line's tail, no newline
+    recs = stateio.read_journal(d)
+    assert _counter("supervisor.journal_corrupt_entries", before) >= 1
+    assert len(recs) == n_before - 1
+
+
+# ---------------------------------------------------------------------------
+# Compaction
+# ---------------------------------------------------------------------------
+
+
+def _mk_settled(tmp_path, extra=(), n=10):
+    """A rotated journal of ``n`` settled keys plus ``extra`` records,
+    with everything sealed (retention satisfied via future ``now``)."""
+    d = str(tmp_path / "j")
+    os.environ[stateio.JOURNAL_SEGMENT_BYTES_ENV] = "400"
+    try:
+        _fill(d, n)
+        for rec in extra:
+            stateio.append_journal_entry(d, rec)
+        # roll the active file so every record is compaction-eligible
+        pad = "x" * 120
+        for _ in range(6):
+            stateio.append_journal_entry(d, {"kind": "note", "pad": pad})
+            if os.path.getsize(os.path.join(d, stateio.JOURNAL)) < 120:
+                break
+    finally:
+        del os.environ[stateio.JOURNAL_SEGMENT_BYTES_ENV]
+    return d
+
+
+def test_compact_drops_settled_exactly_once(tmp_path):
+    """Settled keys leave the chain; the rewrite commits through an
+    epoch-tagged output + sidecar bump; superseded sources are
+    unlinked; the fold of the survivors is unchanged."""
+    d = _mk_settled(tmp_path, extra=[_accept("pending", 99)])
+    st0 = stateio.fold_journal_records(stateio.read_journal(d))
+    before = metrics.counters()
+    res = stateio.compact_journal(d, retain_s=0.0,
+                                  now=time.time() + 60)
+    assert res["compacted"] is True
+    assert res["keys_dropped"] >= 9
+    assert res["bytes_reclaimed"] > 0
+    assert res["epoch"] == 1
+    assert stateio._sidecar_epoch(d) == 1
+    chain = [os.path.basename(p) for p in stateio.journal_chain(d)]
+    assert any(".c1." in n for n in chain)
+    # sources the output superseded are GONE (no stale-orphan debris)
+    names = {n for n in os.listdir(d) if stateio._SEG_RE.match(n)}
+    assert names == {n for n in chain if n != stateio.JOURNAL}
+    st1 = stateio.fold_journal_records(stateio.read_journal(d))
+    assert "pending" in st1["accepted"]
+    assert set(st1["completed"]) == set()
+    # dropped keys vanished entirely
+    assert all(f"k{i}" not in st1["accepted"] for i in range(10))
+    assert st0["accepted"]["pending"] == st1["accepted"]["pending"]
+    assert _counter("stateio.journal_compactions", before) == 1
+    assert _counter("stateio.compaction_lost_keys", before) == 0
+
+
+@pytest.mark.parametrize("extra,kept_key", [
+    ([_accept("pending", 99)], "pending"),                  # incomplete
+    ([_accept("flaky", 99),
+      {"kind": "failed", "key": "flaky", "error": "x"}], "flaky"),
+    ([_accept("poisoned", 99), _complete("poisoned"),
+      {"kind": "quarantine", "key": "poisoned", "attempts": 2}],
+     "poisoned"),
+    ([_accept("held", 99, session="sess-a"), _complete("held")],
+     "held"),                                               # session
+])
+def test_compact_keep_matrix(tmp_path, extra, kept_key):
+    """The keep/drop matrix: incomplete, failed-only (still backlog —
+    ``recover_queue`` replays it), quarantined (the verdict outlives
+    its evidence) and session-named keys all survive compaction."""
+    d = _mk_settled(tmp_path, extra=extra)
+    res = stateio.compact_journal(d, retain_s=0.0,
+                                  now=time.time() + 60)
+    assert res["compacted"] is True
+    recs = stateio.read_journal(d)
+    assert any(r.get("key") == kept_key for r in recs)
+    assert not any(r.get("key") == "k0" for r in recs)
+
+
+def test_compact_keeps_unexpired_claim(tmp_path):
+    """A key under a live lease is NOT dropped even when completed —
+    the claim trail is the fencing evidence; once the lease lapses the
+    next compaction reclaims it."""
+    far = metrics.clock() + 3600
+    d = _mk_settled(tmp_path, extra=[
+        _accept("leased", 99),
+        {"kind": "claim", "key": "leased", "worker": "w1", "epoch": 1,
+         "expires": far},
+        _complete("leased", epoch=1)])
+    res = stateio.compact_journal(d, retain_s=0.0,
+                                  now=time.time() + 60)
+    assert res["compacted"] is True
+    assert any(r.get("key") == "leased"
+               for r in stateio.read_journal(d))
+
+
+def test_compact_respects_retention_and_active(tmp_path, seg_env):
+    """Segments younger than the retention window — and the active
+    file, always — are untouchable: a fresh journal refuses with
+    ``nothing_eligible``."""
+    d = str(tmp_path / "j")
+    _fill(d, 12)
+    # default window (3600 s): everything is too young
+    assert stateio.compact_journal(d)["reason"] == "nothing_eligible"
+    # records ONLY in the active file: never eligible
+    d2 = str(tmp_path / "j2")
+    stateio.append_journal_entry(d2, _accept("a"))
+    res = stateio.compact_journal(d2, retain_s=0.0,
+                                  now=time.time() + 60)
+    assert res["compacted"] is False
+
+
+def test_crashed_compactor_leftovers_invisible(tmp_path):
+    """EXACTLY-ONCE through crashes: an output whose epoch is ABOVE
+    the sidecar's (crash before the commit bump) is invisible to every
+    reader, so replay state cannot change until the bump lands."""
+    d = _mk_settled(tmp_path)
+    recs0 = stateio.read_journal(d)
+    # forge the crash: a valid-looking compacted output, epoch 1, but
+    # the sidecar still says 0
+    orphan = os.path.join(d, "journal-000001.c1.jsonl")
+    with open(orphan, "w") as f:
+        f.write(stateio.frame_record(_accept("ghost")) + "\n")
+    assert orphan not in stateio.journal_chain(d)
+    assert stateio.read_journal(d) == recs0
+    # a real compaction commits at epoch 2 (one past the forged orphan
+    # would be epoch 1 = sidecar 0 + 1 — the orphan's epoch collides,
+    # so the committed rewrite REPLACES it and sweeps the debris)
+    res = stateio.compact_journal(d, retain_s=0.0,
+                                  now=time.time() + 60)
+    assert res["compacted"] is True
+    assert not any(r.get("key") == "ghost"
+                   for r in stateio.read_journal(d))
+
+
+def test_compact_fenced_by_live_peer_lease(tmp_path):
+    """FLEET fencing: a peer's unexpired COMPACTOR lease refuses the
+    compaction outright; an expired one is stolen at epoch+1 via the
+    ordinary claim protocol."""
+    far = metrics.clock() + 3600
+    d = _mk_settled(tmp_path, extra=[
+        {"kind": "claim", "key": stateio.COMPACTOR_KEY,
+         "worker": "peer", "epoch": 3, "expires": far}])
+    res = stateio.compact_journal(d, retain_s=0.0, fence=True,
+                                  now=time.time() + 60)
+    assert res == {"compacted": False, "reason": "compactor_leased",
+                   "directory": os.path.abspath(d)}
+    # the lease lapses: we steal at epoch 4 and commit
+    d2 = _mk_settled(tmp_path.joinpath("two"), extra=[
+        {"kind": "claim", "key": stateio.COMPACTOR_KEY,
+         "worker": "peer", "epoch": 3,
+         "expires": metrics.clock() - 1.0}])
+    res2 = stateio.compact_journal(d2, retain_s=0.0, fence=True,
+                                   now=time.time() + 60)
+    assert res2["compacted"] is True
+    st = stateio.fold_journal_records(stateio.read_journal(d2))
+    cl = st["claims"][stateio.COMPACTOR_KEY]
+    assert cl["epoch"] == 4
+    assert cl["worker"] == telemetry.worker_id()
+
+
+def test_fold_is_single_source_of_truth(tmp_path):
+    """``supervisor._journal_scan`` delegates to
+    ``stateio.fold_journal_records`` — one fold for live replay AND
+    the compaction self-check."""
+    d = str(tmp_path / "j")
+    now = metrics.clock()
+    recs = [
+        _accept("a"), _accept("b", 1),
+        {"kind": "claim", "key": "a", "worker": "w1", "epoch": 1,
+         "expires": now + 60},
+        {"kind": "claim", "key": "a", "worker": "w2", "epoch": 2,
+         "expires": now + 60},
+        _complete("a", epoch=1),   # fenced: stale epoch
+        _complete("a", epoch=2),   # applied
+        {"kind": "launch", "key": "b", "attempt": 1},
+    ]
+    stateio.append_journal_entries(d, recs)
+    st_scan = supervisor._journal_scan(d)
+    st_fold = stateio.fold_journal_records(stateio.read_journal(d))
+    for field in ("accepted", "order", "launches", "failed",
+                  "completed", "quarantined", "fenced", "double"):
+        assert st_scan[field] == st_fold[field]
+    assert st_fold["fenced"] == {"a": 1}
+    assert st_fold["completed"]["a"]["epoch"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Durability policy
+# ---------------------------------------------------------------------------
+
+
+def _exhaust_plan():
+    return ",".join(f"journal_append:{h}:enospc" for h in range(4))
+
+
+def test_strict_refuses_typed_then_recovers(env1, tmp_path,
+                                            monkeypatch):
+    """The retry budget exhausts on the accept batch under strict: every
+    request refused with the TYPED storage error (ABI code 9), the
+    journal untouched — and the SAME keys serve exactly-once when the
+    disk recovers."""
+    d = str(tmp_path / "j")
+    before = metrics.counters()
+    monkeypatch.setenv("QUEST_FAULT_PLAN", _exhaust_plan())
+    resilience.reset()
+    res = supervisor.serve(_reqs(env1), workers=1, max_batch=1,
+                           journal_dir=d)
+    monkeypatch.delenv("QUEST_FAULT_PLAN")
+    resilience.reset()
+    assert [r["ok"] for r in res] == [False, False, False]
+    for r in res:
+        assert isinstance(r["error"], QuESTStorageError)
+        assert r["error"].code == 9
+        assert "QUEST_DURABILITY" in str(r["error"])
+    assert _counter("supervisor.storage_refused", before) == 3
+    assert not supervisor.journal_degraded()
+    assert not any(r.get("kind") == "accept"
+                   for r in stateio.read_journal(d))
+    res2 = supervisor.serve(_reqs(env1), workers=1, max_batch=1,
+                            journal_dir=d)
+    assert all(r["ok"] for r in res2)
+    st = supervisor._journal_scan(d)
+    assert sorted(st["completed"]) == [f"req-{i}" for i in range(3)]
+    assert sum(st["double"].values()) == 0
+
+
+def test_degrade_serves_at_least_once_and_rearms(env1, tmp_path,
+                                                 monkeypatch):
+    """Under ``QUEST_DURABILITY=degrade`` the same exhausted budget
+    keeps serving: results correct, the degradation counted and
+    SLO-visible, and the flag RE-ARMED by the next successful append."""
+    d = str(tmp_path / "j")
+    before = metrics.counters()
+    monkeypatch.setenv("QUEST_DURABILITY", "degrade")
+    monkeypatch.setenv("QUEST_FAULT_PLAN", _exhaust_plan())
+    resilience.reset()
+    res = supervisor.serve(_reqs(env1), workers=1, max_batch=1,
+                           journal_dir=d)
+    monkeypatch.delenv("QUEST_FAULT_PLAN")
+    resilience.reset()
+    assert all(r["ok"] for r in res)
+    assert _counter("supervisor.journal_degraded", before) >= 1
+    assert _counter("supervisor.journal_rearmed", before) >= 1
+    assert not supervisor.journal_degraded()  # re-armed
+
+
+def test_degraded_gauge_slo_visible(tmp_path, monkeypatch):
+    """While degraded the ``quest_journal_degraded`` gauge is up — the
+    SLO/alerting surface — and drops back on re-arm."""
+    d = str(tmp_path / "j")
+    stateio.append_journal_entry(d, _accept("seed"))
+    monkeypatch.setenv("QUEST_DURABILITY", "degrade")
+    monkeypatch.setenv("QUEST_FAULT_PLAN", _exhaust_plan())
+    resilience.reset()
+    assert supervisor._journal_write(d, [_accept("x", 1)], "accept") \
+        is False
+    monkeypatch.delenv("QUEST_FAULT_PLAN")
+    resilience.reset()
+    assert supervisor.journal_degraded()
+    assert "quest_journal_degraded 1" in metrics.export_text()
+    assert supervisor._journal_write(d, [_accept("y", 2)], "accept")
+    assert "quest_journal_degraded 0" in metrics.export_text()
+
+
+def test_quarantine_marker_never_raises(tmp_path, monkeypatch):
+    """``refuse=False`` forces the never-raise path regardless of
+    policy: quarantine markers are at-least-once by design."""
+    d = str(tmp_path / "j")
+    stateio.append_journal_entry(d, _accept("seed"))
+    monkeypatch.setenv("QUEST_DURABILITY", "strict")
+    monkeypatch.setenv("QUEST_FAULT_PLAN", _exhaust_plan())
+    resilience.reset()
+    assert supervisor._journal_write(
+        d, [{"kind": "quarantine", "key": "bad", "attempts": 2}],
+        "quarantine", refuse=False) is False
+    monkeypatch.delenv("QUEST_FAULT_PLAN")
+    resilience.reset()
+
+
+def test_transient_fault_absorbed_by_retry(tmp_path, monkeypatch):
+    """One scripted enospc inside the budget stays invisible — no
+    refusal, no degrade, just a counted retry."""
+    d = str(tmp_path / "j")
+    stateio.append_journal_entry(d, _accept("seed"))
+    before = metrics.counters()
+    monkeypatch.setenv("QUEST_FAULT_PLAN", "journal_append:0:eio")
+    resilience.reset()
+    assert supervisor._journal_write(d, [_accept("x", 1)], "accept")
+    assert _counter("resilience.retries", before) >= 1
+    assert _counter("supervisor.journal_degraded", before) == 0
+    assert not supervisor.journal_degraded()
+
+
+def test_storage_error_abi_code_round_trip():
+    """ABI code 9 round-trips: the Python class, the package export and
+    the C header's ``QuESTErrorCode`` enum all agree."""
+    assert QuESTStorageError.code == 9
+    assert issubclass(QuESTStorageError, QuESTError)
+    assert qt.QuESTStorageError is QuESTStorageError
+    header = open(os.path.join(
+        REPO, "capi", "include", "QuEST.h")).read()
+    assert "QUEST_ERROR_STORAGE = 9" in header
+    assert "QUEST_ERROR_POISONED = 8," in header
+    # the taxonomy stays dense: codes 1..9, no gaps, no collisions
+    codes = sorted(cls.code for cls in (
+        validation.QuESTError, validation.QuESTValidationError,
+        validation.QuESTTimeoutError, validation.QuESTCorruptionError,
+        validation.QuESTTopologyError, validation.QuESTPreemptedError,
+        validation.QuESTOverloadError,
+        validation.QuESTPoisonedRequestError, QuESTStorageError))
+    assert codes == list(range(1, 10))
+
+
+def test_disk_fault_kinds_restricted_to_disk_seams(monkeypatch):
+    """``enospc``/``eio`` plans only arm on the disk seams, and fire
+    the REAL errno there."""
+    monkeypatch.setenv("QUEST_FAULT_PLAN", "run_item:0:enospc")
+    resilience.reset()
+    with pytest.raises(QuESTValidationError):
+        resilience.fault_point("run_item")
+    monkeypatch.setenv("QUEST_FAULT_PLAN", "ckpt_save:0:eio")
+    resilience.reset()
+    with pytest.raises(OSError) as ei:
+        resilience.fault_point("ckpt_save")
+    assert ei.value.errno == errno.EIO
+    monkeypatch.setenv("QUEST_FAULT_PLAN", "sink_write:0:enospc")
+    resilience.reset()
+    with pytest.raises(OSError) as ei:
+        resilience.fault_point("sink_write")
+    assert ei.value.errno == errno.ENOSPC
+    assert set(resilience.DISK_SEAMS) \
+        == {"journal_append", "ckpt_save", "sink_write"}
+
+
+def test_storage_cadence_runs_and_contains_failures(tmp_path,
+                                                    monkeypatch):
+    """The opt-in serve-loop cadence runs compaction + GC on their
+    intervals; a failing sweep is contained (counted, warned) and never
+    takes the serve path down."""
+    d = _mk_settled(tmp_path)
+    before = metrics.counters()
+    monkeypatch.setenv("QUEST_JOURNAL_COMPACT_EVERY_S", "0.0001")
+    monkeypatch.setenv("QUEST_STORAGE_GC_EVERY_S", "0.0001")
+    supervisor._storage_cadence_state.update(compact=-1e9, gc=-1e9)
+    monkeypatch.setenv(stateio.JOURNAL_RETAIN_S_ENV, "0")
+    # segments are mtime-fresh, so the in-cadence compaction refuses
+    # with nothing_eligible — but it RUNS, which is what's under test
+    supervisor._storage_cadence(d, False)
+    assert _counter("supervisor.storage_cadence_failures", before) == 0
+    # a crashing sweep is contained
+    supervisor._storage_cadence_state.update(compact=-1e9, gc=-1e9)
+    bogus = str(tmp_path / "not-a-dir")
+    with open(bogus, "w") as f:
+        f.write("x")
+    supervisor._storage_cadence(bogus, False)
+    # gc_storage tolerates a non-dir; compact_journal read the chain
+    # of an empty dir -> nothing_eligible.  Force a real failure:
+    monkeypatch.setattr(stateio, "compact_journal",
+                        lambda *a, **k: 1 / 0)
+    supervisor._storage_cadence_state.update(compact=-1e9, gc=-1e9)
+    supervisor._storage_cadence(d, False)  # must not raise
+    assert _counter("supervisor.storage_cadence_failures", before) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Retention GC
+# ---------------------------------------------------------------------------
+
+
+def _gc_fixture(tmp_path):
+    d = str(tmp_path / "store")
+    os.makedirs(d)
+    old = time.time() - 10 * 86400
+    for name in ("trace-a.json", "quest-flight-1.json", "snap-w.json"):
+        p = os.path.join(d, name)
+        open(p, "w").write("{}")
+        os.utime(p, (old, old))
+    open(os.path.join(d, "trace-fresh.json"), "w").write("{}")
+    p = os.path.join(d, "fleet.json")
+    open(p, "w").write("{}")
+    os.utime(p, (old, old))
+    for name, fresh_fence in (("sess-old", False), ("sess-live", True)):
+        sd = os.path.join(d, name)
+        os.makedirs(sd)
+        q = os.path.join(sd, stateio._META)
+        open(q, "w").write("{}")
+        os.utime(q, (old, old))
+        if fresh_fence:
+            open(os.path.join(sd, "fence.json"), "w").write("{}")
+        else:
+            os.utime(sd, (old, old))
+    slot = os.path.join(d, "slot-0")
+    os.makedirs(slot)
+    q = os.path.join(slot, stateio._META)
+    open(q, "w").write("{}")
+    os.utime(q, (old, old))
+    os.utime(slot, (old, old))
+    open(os.path.join(d, "latest"), "w").write("slot-0")
+    return d
+
+
+def test_gc_sweeps_expendables_refuses_live(tmp_path):
+    """Old traces/flight dumps/snapshots and stale spilled sessions go;
+    the ``latest``-pointed slot, a session with a freshly-renewed
+    fence, non-matching files and anything young survive — and
+    ``dry_run`` removes nothing."""
+    d = _gc_fixture(tmp_path)
+    before = metrics.counters()
+    dry = stateio.gc_storage(d, dry_run=True)
+    assert sorted(dry["removed"]) == ["quest-flight-1.json",
+                                     "sess-old", "snap-w.json",
+                                     "trace-a.json"]
+    assert os.path.isdir(os.path.join(d, "sess-old"))  # nothing gone
+    assert _counter("stateio.gc_removed", before) == 0
+    real = stateio.gc_storage(d)
+    assert sorted(real["removed"]) == sorted(dry["removed"])
+    assert real["reclaimed_bytes"] == dry["reclaimed_bytes"] > 0
+    left = sorted(os.listdir(d))
+    assert left == ["fleet.json", "latest", "sess-live", "slot-0",
+                    "trace-fresh.json"]
+    assert _counter("stateio.gc_removed", before) == 4
+    assert _counter("stateio.gc_reclaimed_bytes", before) \
+        == real["reclaimed_bytes"]
+
+
+def test_gc_ttl_env_knob(tmp_path, monkeypatch):
+    """``QUEST_GC_TTL_S`` drives the window; a huge TTL keeps
+    everything."""
+    d = _gc_fixture(tmp_path)
+    monkeypatch.setenv(stateio.GC_TTL_S_ENV, str(100 * 86400))
+    assert stateio.gc_storage(d)["removed"] == []
+    monkeypatch.setenv(stateio.GC_TTL_S_ENV, "not-a-number")
+    assert stateio._gc_ttl_default() == stateio.GC_TTL_S_DEFAULT
+
+
+def test_storage_gc_cli_mirror(tmp_path):
+    """``tools/storage_gc.py`` is the stdlib twin: constants pinned
+    equal, and the CLI's dry-run names exactly what the library
+    would."""
+    import storage_gc
+
+    assert storage_gc.GC_TTL_S_ENV == stateio.GC_TTL_S_ENV
+    assert storage_gc.GC_TTL_S_DEFAULT == stateio.GC_TTL_S_DEFAULT
+    assert storage_gc.GC_FILE_RE.pattern == stateio._GC_FILE_RE.pattern
+    assert storage_gc.META == stateio._META
+    d = _gc_fixture(tmp_path)
+    assert storage_gc.gc_storage(d, dry_run=True)["removed"] \
+        == stateio.gc_storage(d, dry_run=True)["removed"]
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "storage_gc.py"),
+         "--dry-run", d], capture_output=True, text=True)
+    assert r.returncode == 0
+    assert "sess-old" in r.stdout and "trace-a.json" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# Stdlib mirrors + fsck
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_serve_mirror_constants_pinned():
+    import fleet_serve
+
+    assert fleet_serve.JOURNAL_SEGMENT_BYTES_ENV \
+        == stateio.JOURNAL_SEGMENT_BYTES_ENV
+    assert fleet_serve.SEG_RE.pattern == stateio._SEG_RE.pattern
+    assert fleet_serve.ROTATE_LOCK == stateio._ROTATE_LOCK
+    assert fleet_serve.ROTATE_LOCK_STALE_S \
+        == stateio._ROTATE_LOCK_STALE_S
+
+
+def test_fleet_serve_chain_and_read_mirror(tmp_path, seg_env):
+    """The stdlib ingress resolves the SAME chain and reads the SAME
+    records as the jax-side reader — across rotation AND a committed
+    compaction (sidecar epoch honoured, crashed-compactor orphans
+    invisible)."""
+    import fleet_serve
+
+    d = _mk_settled(tmp_path, extra=[_accept("pending", 99)])
+    assert fleet_serve.journal_chain(d) == stateio.journal_chain(d)
+    assert fleet_serve.read_journal(d) == stateio.read_journal(d)
+    assert stateio.compact_journal(d, retain_s=0.0,
+                                   now=time.time() + 60)["compacted"]
+    orphan = os.path.join(d, "journal-000001.c9.jsonl")
+    open(orphan, "w").write(stateio.frame_record(_accept("gh")) + "\n")
+    assert fleet_serve.journal_chain(d) == stateio.journal_chain(d)
+    assert fleet_serve.read_journal(d) == stateio.read_journal(d)
+
+
+def test_fleet_serve_ingress_rotates(tmp_path, seg_env):
+    """The ingress-side ``append_records`` rotates at the same
+    threshold, and the jax-side replay reads its chain transparently."""
+    import fleet_serve
+
+    d = str(tmp_path / "j")
+    for i in range(20):
+        fleet_serve.append_records(d, [_accept(f"k{i}", i)])
+    assert len(stateio.journal_segments(d)) >= 1
+    keys = [r["key"] for r in stateio.read_journal(d)]
+    assert keys == [f"k{i}" for i in range(20)]
+
+
+def test_telemetry_forensic_reader_walks_chain(tmp_path, seg_env):
+    """The stdlib-only forensic reader (crash triage) sees the whole
+    committed chain — same winner/floor logic, zero jax imports."""
+    d = _mk_settled(tmp_path, extra=[_accept("pending", 99)])
+    stateio.compact_journal(d, retain_s=0.0, now=time.time() + 60)
+    want = [r for r in stateio.read_journal(d)]
+    got = telemetry._read_journal_forensic(d)
+    assert got == want
+    assert telemetry._journal_chain_forensic(d) \
+        == stateio.journal_chain(d)
+
+
+def test_lease_helper_mirror(monkeypatch):
+    assert stateio._lease_s_local() == supervisor.lease_s()
+    monkeypatch.setenv("QUEST_LEASE_S", "7.5")
+    assert stateio._lease_s_local() == supervisor.lease_s() == 7.5
+
+
+def test_journal_fsck_exit_codes(tmp_path, seg_env):
+    """0 = clean chain (torn ACTIVE tail allowed), 1 = interior
+    corruption, 2 = no journal."""
+    fsck = os.path.join(REPO, "tools", "journal_fsck.py")
+    d = str(tmp_path / "j")
+    _fill(d, 12)
+    with open(os.path.join(d, stateio.JOURNAL), "a") as f:
+        f.write('{"crc": "dead')  # torn active tail: healable
+    r = subprocess.run([sys.executable, fsck, d],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout
+    assert "reclaimable" in r.stdout
+    seg = stateio.journal_segments(d)[0]
+    lines = open(seg).read().split("\n")
+    lines[0] = lines[0][:-8] + 'XXXXXXX"'
+    open(seg, "w").write("\n".join(lines))
+    r = subprocess.run([sys.executable, fsck, d],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "CORRUPT" in r.stdout
+    r = subprocess.run([sys.executable, fsck, str(tmp_path / "nope")],
+                       capture_output=True, text=True)
+    assert r.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# Ledger rules
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_rules_fire_both_directions():
+    """``counters.supervisor.journal_degraded`` and
+    ``counters.stateio.compaction_lost_keys`` are strictly-regressive
+    +0 rules: ANY appearance fails the gate, clearing passes it."""
+    import ledger_diff
+
+    keys = [k for k, _l, _c in ledger_diff.DEFAULT_RULES]
+    assert "counters.supervisor.journal_degraded" in keys
+    assert "counters.stateio.compaction_lost_keys" in keys
+
+    def rec(deg=0.0, lost=0.0):
+        return {"metric": "chaos-q8-s28",
+                "counters": {"supervisor.journal_degraded": deg,
+                             "stateio.compaction_lost_keys": lost}}
+
+    for newrec in (rec(deg=1), rec(lost=2)):
+        bad, _ok, _skip = ledger_diff.gate(rec(), newrec)
+        assert len(bad) == 1
+        good, _ok, _skip = ledger_diff.gate(newrec, rec())
+        assert good == []
+
+
+def test_serve_updates_journal_gauges(env1, tmp_path):
+    """A journaled serve pass refreshes the storage gauges — the
+    scrape surface tracks the live journal without a manual call."""
+    d = str(tmp_path / "j")
+    res = supervisor.serve(_reqs(env1), workers=1, max_batch=1,
+                           journal_dir=d)
+    assert all(r["ok"] for r in res)
+    snap = stateio.journal_gauge_snapshot()
+    assert snap["dir"] == os.path.abspath(d)
+    assert snap["bytes"] > 0
+    assert f"quest_journal_bytes {snap['bytes']}" \
+        in metrics.export_text()

@@ -1836,6 +1836,383 @@ def drill_fleet_session_migrate(circ, env, ndev, pallas):
         shutil.rmtree(td, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Storage-lifecycle drills (ISSUE 20): bounded journals under faults
+# ---------------------------------------------------------------------------
+
+
+def _force_rotation(jdir, limit):
+    """Seal the active journal file by appending keyless filler
+    records (fold-invisible) until the rotation threshold trips — so
+    a drill's just-written records become compaction-eligible sealed
+    segments instead of hiding in the untouchable active file."""
+    from quest_tpu import stateio
+
+    before = len(stateio.journal_segments(jdir))
+    pad = "x" * max(1, limit // 4)
+    for _ in range(8):
+        stateio.append_journal_entry(jdir, {"kind": "note", "pad": pad})
+        if len(stateio.journal_segments(jdir)) > before:
+            return True
+    return False
+
+
+def drill_disk_full_degrade(circ, env, ndev, pallas):
+    # a scripted disk-full exhausts the journal_append retry budget
+    # (4 enospc hits vs 3 retries) during a journaled serve's accept
+    # batch.  QUEST_DURABILITY=strict must refuse every request TYPED
+    # (QuESTStorageError, ABI code 9) with the journal untouched and
+    # the SAME requests completing cleanly once the disk recovers;
+    # =degrade must keep serving AT-LEAST-ONCE (results correct,
+    # journal_degraded counted, the flag re-armed by the next
+    # successful append).  A single transient enospc must stay
+    # invisible (absorbed by the retry budget).
+    from quest_tpu import stateio
+    from quest_tpu.validation import QuESTStorageError
+
+    td = tempfile.mkdtemp(prefix="chaos-diskfull-")
+    plan = ",".join(f"journal_append:{h}:enospc" for h in range(4))
+    try:
+        ref = supervisor.serve(_fleet_reqs(env, n=3),
+                               journal_dir=os.path.join(td, "jref"),
+                               max_batch=1)
+        ref_out = [[int(x) for x in
+                    np.asarray(r["value"]["outcomes"])
+                    .reshape(-1).tolist()] for r in ref]
+
+        # STRICT: refuse typed, journal untouched, retryable
+        jdir_s = os.path.join(td, "journal-strict")
+        c0 = metrics.counters()
+        os.environ["QUEST_FAULT_PLAN"] = plan
+        resilience.reset()
+        res_s = supervisor.serve(_fleet_reqs(env, n=3),
+                                 journal_dir=jdir_s, max_batch=1)
+        del os.environ["QUEST_FAULT_PLAN"]
+        resilience.reset()
+        refused_typed = (len(res_s) == 3 and all(
+            not r["ok"] and isinstance(r.get("error"), QuESTStorageError)
+            and r["error"].code == 9 for r in res_s))
+        dc = counters_delta(c0, ["supervisor.storage_refused",
+                                 "supervisor.journal_degraded",
+                                 "supervisor.journal_append_failures"])
+        refused_counted = dc["supervisor.storage_refused"] == 3
+        never_degraded = (dc["supervisor.journal_degraded"] == 0
+                          and not supervisor.journal_degraded())
+        untouched = not any(
+            r.get("kind") == "accept"
+            for r in stateio.read_journal(jdir_s))
+        # the disk recovers: the SAME keys now serve exactly-once
+        res_s2 = supervisor.serve(_fleet_reqs(env, n=3),
+                                  journal_dir=jdir_s, max_batch=1)
+        recovered = (all(r["ok"] for r in res_s2)
+                     and [[int(x) for x in
+                           np.asarray(r["value"]["outcomes"])
+                           .reshape(-1).tolist()] for r in res_s2]
+                     == ref_out)
+        cc = _journal_complete_counts(jdir_s)
+        once_after = (sorted(cc) == [f"req-{i}" for i in range(3)]
+                      and set(cc.values()) == {1})
+
+        # DEGRADE: same faults, results still correct, counted, re-armed
+        jdir_d = os.path.join(td, "journal-degrade")
+        c1 = metrics.counters()
+        os.environ["QUEST_DURABILITY"] = "degrade"
+        os.environ["QUEST_FAULT_PLAN"] = plan
+        resilience.reset()
+        res_d = supervisor.serve(_fleet_reqs(env, n=3),
+                                 journal_dir=jdir_d, max_batch=1)
+        del os.environ["QUEST_FAULT_PLAN"]
+        del os.environ["QUEST_DURABILITY"]
+        resilience.reset()
+        served_degraded = (all(r["ok"] for r in res_d)
+                           and [[int(x) for x in
+                                 np.asarray(r["value"]["outcomes"])
+                                 .reshape(-1).tolist()] for r in res_d]
+                           == ref_out)
+        dd = counters_delta(c1, ["supervisor.journal_degraded",
+                                 "supervisor.journal_rearmed"])
+        degraded_counted = dd["supervisor.journal_degraded"] >= 1
+        rearmed = (dd["supervisor.journal_rearmed"] >= 1
+                   and not supervisor.journal_degraded())
+
+        # TRANSIENT: one enospc inside the budget is absorbed silently
+        jdir_t = os.path.join(td, "journal-transient")
+        c2 = metrics.counters()
+        os.environ["QUEST_FAULT_PLAN"] = "journal_append:0:enospc"
+        resilience.reset()
+        res_t = supervisor.serve(_fleet_reqs(env, n=3),
+                                 journal_dir=jdir_t, max_batch=1)
+        del os.environ["QUEST_FAULT_PLAN"]
+        resilience.reset()
+        dt = counters_delta(c2, ["supervisor.storage_refused",
+                                 "supervisor.journal_degraded",
+                                 "resilience.retries"])
+        absorbed = (all(r["ok"] for r in res_t)
+                    and dt["supervisor.storage_refused"] == 0
+                    and dt["supervisor.journal_degraded"] == 0
+                    and dt["resilience.retries"] >= 1)
+
+        ok = (refused_typed and refused_counted and never_degraded
+              and untouched and recovered and once_after
+              and served_degraded and degraded_counted and rearmed
+              and absorbed)
+        record("disk_full_degrade", ok, refused_typed=refused_typed,
+               refused_counted=refused_counted,
+               strict_never_degraded=never_degraded,
+               journal_untouched=untouched, recovered_equal=recovered,
+               exactly_once_after_refusal=once_after,
+               degrade_served_equal=served_degraded,
+               degraded_counted=degraded_counted, rearmed=rearmed,
+               transient_absorbed=absorbed)
+    finally:
+        for var in ("QUEST_FAULT_PLAN", "QUEST_DURABILITY"):
+            os.environ.pop(var, None)
+        resilience.reset()
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def drill_journal_compact_replay(circ, env, ndev, pallas):
+    # a fleet worker is SIGKILLed mid-backlog, the journal chain is
+    # COMPACTED under a fencing lease (settled keys dropped, the dead
+    # worker's incomplete/claimed keys preserved), and a second worker
+    # replays the compacted chain: it must finish exactly the
+    # surviving backlog — never re-running a dropped (settled) key —
+    # with outcomes bit-identical to an uninterrupted serve.
+    from quest_tpu import stateio
+
+    seg_bytes = 500
+    td = tempfile.mkdtemp(prefix="chaos-compact-replay-")
+    wa = wb = None
+    try:
+        jdir = os.path.join(td, "journal")
+        snapdir = os.path.join(td, "snaps")
+        os.makedirs(snapdir)
+        ref = supervisor.serve(_fleet_reqs(env),
+                               journal_dir=os.path.join(td, "jref"),
+                               max_batch=1)
+        ref_out = {f"req-{i}": [int(x) for x in
+                                np.asarray(r["value"]["outcomes"])
+                                .reshape(-1).tolist()]
+                   for i, r in enumerate(ref)}
+        os.environ["QUEST_JOURNAL_SEGMENT_BYTES"] = str(seg_bytes)
+        _seed_fleet_journal(jdir, _fleet_reqs(env))
+        # worker A: first item fast (a settled key for compaction to
+        # drop), the rest slowed so the SIGKILL lands mid-flight
+        slow = ",".join(f"run_item:{h}:delay:900" for h in (1, 2, 3))
+        wa = _spawn_fleet_worker(
+            "fleet-wA", jdir, snapdir, 1.0, td,
+            extra={"QUEST_FAULT_PLAN": slow,
+                   "QUEST_JOURNAL_SEGMENT_BYTES": str(seg_bytes)})
+        progressed = _wait_for(
+            lambda: (len(_journal_complete_counts(jdir)) >= 1
+                     and any(r.get("kind") == "launch"
+                             and r["key"] not in
+                             _journal_complete_counts(jdir)
+                             for r in stateio.read_journal(jdir))), 240)
+        if progressed:
+            wa.kill()  # SIGKILL: mid-item, claims left dangling
+            wa.wait(timeout=30)
+        time.sleep(1.6)  # the dead worker's 1.0 s leases lapse
+        rotated = _force_rotation(jdir, seg_bytes)
+        st1 = supervisor._journal_scan(jdir)
+        done_before = set(st1["completed"])
+        res = stateio.compact_journal(jdir, retain_s=0.0, fence=True,
+                                      now=time.time() + 60)
+        compacted = bool(res.get("compacted"))
+        dropped_some = res.get("keys_dropped", 0) >= 1
+        st2 = supervisor._journal_scan(jdir)
+        # settled keys left the journal entirely; unfinished keys (the
+        # killed worker's claimed backlog) survived the rewrite intact
+        dropped_gone = all(k not in st2["accepted"]
+                           and k not in st2["completed"]
+                           for k in done_before)
+        backlog_kept = (set(st2["accepted"])
+                        == {f"req-{i}" for i in range(4)} - done_before)
+        no_lost = metrics.counters().get(
+            "stateio.compaction_lost_keys", 0) == 0
+        wb = _spawn_fleet_worker(
+            "fleet-wB", jdir, snapdir, 1.0, td,
+            extra={"QUEST_JOURNAL_SEGMENT_BYTES": str(seg_bytes)})
+
+        drained = _wait_for(
+            lambda: not supervisor.recover_queue(jdir)["backlog"], 240)
+        rc_b = _stop_worker(wb)
+        st3 = supervisor._journal_scan(jdir)
+        done_after = set(st3["completed"])
+        # exactly-once ACROSS the compaction: every request completed
+        # in exactly one era — pre-compaction (then dropped as
+        # settled) or post-replay — and never both
+        all_served = (done_before | done_after
+                      == {f"req-{i}" for i in range(4)})
+        never_rerun = not (done_before & done_after)
+        no_double = sum(st3["double"].values()) == 0
+        cc = _journal_complete_counts(jdir)
+        once_in_journal = set(cc.values()) <= {1}
+        outcomes_equal = drained and all(
+            st3["completed"][k].get("outcomes") == ref_out[k]
+            for k in done_after)
+        replay_ok = metrics.counters().get(
+            "supervisor.journal_replay_failures", 0) == 0
+        ok = (progressed and rotated and compacted and dropped_some
+              and dropped_gone and backlog_kept and no_lost and drained
+              and rc_b == 0 and all_served and never_rerun
+              and no_double and once_in_journal and outcomes_equal
+              and replay_ok)
+        record("journal_compact_replay", ok, progressed=progressed,
+               rotated=rotated, compacted=compacted,
+               keys_dropped=res.get("keys_dropped"),
+               settled_gone=dropped_gone, backlog_kept=backlog_kept,
+               no_lost_keys=no_lost, drained=drained, survivor_rc=rc_b,
+               all_served=all_served, never_rerun=never_rerun,
+               no_double=no_double, once_in_journal=once_in_journal,
+               outcomes_equal=outcomes_equal,
+               replay_failures_zero=replay_ok)
+    finally:
+        os.environ.pop("QUEST_JOURNAL_SEGMENT_BYTES", None)
+        for p in (wa, wb):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def drill_storage_lifecycle_fleet(circ, env, ndev, pallas):
+    # the acceptance drill: a fleet serves 200 requests through AT
+    # LEAST two journal rotations, one mid-serve fenced compaction,
+    # one worker SIGKILL and one absorbed enospc — every request
+    # completing exactly-once, and the journal directory's total bytes
+    # ending BELOW the cap even though the fleet wrote many times that.
+    import jax
+
+    from quest_tpu import stateio
+
+    n_req = 200
+    seg_bytes = 16384
+    byte_cap = 4 * seg_bytes
+    td = tempfile.mkdtemp(prefix="chaos-storage-fleet-")
+    wa = wb = None
+    # tiny circuits keep 200 requests affordable; a 1-device env for
+    # the oracle serve (2 qubits cannot shard over the drill's 8)
+    env1 = qt.create_env(num_devices=1)
+
+    def _reqs(lo, hi):
+        c = models.qft(2)
+        c.measure(0)
+        keys = jax.random.split(jax.random.PRNGKey(11), n_req)
+        return [supervisor.BatchableRun(c, env1, key=keys[i],
+                                        trace_id=f"life-tr-{i}",
+                                        idempotency_key=f"req-{i:03d}")
+                for i in range(lo, hi)]
+
+    try:
+        jdir = os.path.join(td, "journal")
+        snapdir = os.path.join(td, "snaps")
+        os.makedirs(snapdir)
+        # outcome oracle on a SAMPLE (determinism of the full set is
+        # the claim protocol's job, proven per-key by exactly-once)
+        ref = supervisor.serve(_reqs(0, 8),
+                               journal_dir=os.path.join(td, "jref"),
+                               max_batch=4)
+        ref_out = {f"req-{i:03d}": [int(x) for x in
+                                    np.asarray(r["value"]["outcomes"])
+                                    .reshape(-1).tolist()]
+                   for i, r in enumerate(ref)}
+        os.environ["QUEST_JOURNAL_SEGMENT_BYTES"] = str(seg_bytes)
+        _seed_fleet_journal(jdir, _reqs(0, n_req))
+        bytes_seeded = sum(
+            os.path.getsize(p) for p in stateio.journal_chain(jdir))
+        # worker A serves until ~25 keys are done, then is SIGKILLed
+        wa = _spawn_fleet_worker(
+            "fleet-wA", jdir, snapdir, 1.0, td,
+            extra={"QUEST_JOURNAL_SEGMENT_BYTES": str(seg_bytes)})
+        progressed = _wait_for(
+            lambda: len(_journal_complete_counts(jdir)) >= 25, 240)
+        wa.kill()
+        wa.wait(timeout=30)
+        time.sleep(1.6)  # the dead worker's leases lapse
+        # mid-serve fenced compaction over whatever has sealed so far
+        res1 = stateio.compact_journal(jdir, retain_s=0.0, fence=True,
+                                       now=time.time() + 60)
+        mid_compacted = bool(res1.get("compacted"))
+        # worker B absorbs one scripted enospc inside its retry
+        # budget and finishes the backlog
+        wb = _spawn_fleet_worker(
+            "fleet-wB", jdir, snapdir, 1.0, td,
+            extra={"QUEST_JOURNAL_SEGMENT_BYTES": str(seg_bytes),
+                   "QUEST_FAULT_PLAN": "journal_append:3:enospc"})
+        drained = _wait_for(
+            lambda: not supervisor.recover_queue(jdir)["backlog"], 480)
+        rc_b = _stop_worker(wb, timeout=120)
+        time.sleep(1.6)  # B's final leases lapse before the last sweep
+        # retention pass an operator (or the serve-loop cadence) runs:
+        # seal the tail, compact everything settled
+        _force_rotation(jdir, seg_bytes)
+        res2 = stateio.compact_journal(jdir, retain_s=0.0, fence=True,
+                                       now=time.time() + 60)
+        final_compacted = bool(res2.get("compacted"))
+
+        st = supervisor._journal_scan(jdir)
+        cc = _journal_complete_counts(jdir)
+        # exactly-once: nothing doubled, nothing fenced-in as a second
+        # apply, no key holds two complete records in the final chain
+        no_double = sum(st["double"].values()) == 0
+        once_in_journal = set(cc.values()) <= {1}
+        sample_equal = all(
+            st["completed"][k].get("outcomes") == ref_out[k]
+            for k in ref_out if k in st["completed"])
+        # rotation really happened (segment sequence numbers are
+        # monotonic across rotations, compaction preserves the max)
+        max_seq = max(
+            (int(m.group(1)) for m in
+             (stateio._SEG_RE.match(os.path.basename(p))
+              for p in stateio.journal_chain(jdir)) if m),
+            default=0)
+        rotated_twice = max_seq >= 2
+        # B's absorbed enospc is visible in its spilled snapshot, not
+        # in any refusal/degrade counter
+        snap = (metrics.read_snapshot(
+            os.path.join(snapdir, "snap-fleet-wB.json")) or {}
+                ).get("counters", {})
+        enospc_absorbed = (snap.get("resilience.faults_injected", 0) >= 1
+                           and snap.get("resilience.retries", 0) >= 1
+                           and snap.get("supervisor.journal_degraded",
+                                        0) == 0)
+        bytes_final = sum(
+            os.path.getsize(p) for p in stateio.journal_chain(jdir))
+        bounded = (bytes_final < byte_cap
+                   and bytes_final < bytes_seeded)
+        no_lost = metrics.counters().get(
+            "stateio.compaction_lost_keys", 0) == 0
+        # the offline fsck agrees the surviving chain is clean
+        fsck = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "journal_fsck.py"), jdir],
+            capture_output=True, text=True, timeout=120)
+        fsck_clean = fsck.returncode == 0
+        ok = (progressed and mid_compacted and drained and rc_b == 0
+              and final_compacted and no_double and once_in_journal
+              and sample_equal and rotated_twice and enospc_absorbed
+              and bounded and no_lost and fsck_clean)
+        record("storage_lifecycle_fleet", ok, requests=n_req,
+               progressed=progressed, mid_compacted=mid_compacted,
+               drained=drained, survivor_rc=rc_b,
+               final_compacted=final_compacted, no_double=no_double,
+               once_in_journal=once_in_journal,
+               sample_outcomes_equal=sample_equal,
+               rotations_max_seq=max_seq,
+               enospc_absorbed=enospc_absorbed,
+               bytes_seeded=bytes_seeded, bytes_final=bytes_final,
+               byte_cap=byte_cap, bounded=bounded,
+               no_lost_keys=no_lost, fsck_clean=fsck_clean)
+    finally:
+        os.environ.pop("QUEST_JOURNAL_SEGMENT_BYTES", None)
+        for p in (wa, wb):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(td, ignore_errors=True)
+
+
 #: The scenario matrix, in execution order: (name, needs_ref, runner).
 #: ``needs_ref`` tells the per-scenario subprocess whether to pay for
 #: the 8-device reference run (the bit-identity oracle) — scenarios
@@ -1892,6 +2269,12 @@ SCENARIOS = [
      lambda c, e, n, p, r: drill_fleet_lease_fencing(c, e, n, p)),
     ("fleet_session_migrate", False,
      lambda c, e, n, p, r: drill_fleet_session_migrate(c, e, n, p)),
+    ("disk_full_degrade", False,
+     lambda c, e, n, p, r: drill_disk_full_degrade(c, e, n, p)),
+    ("journal_compact_replay", False,
+     lambda c, e, n, p, r: drill_journal_compact_replay(c, e, n, p)),
+    ("storage_lifecycle_fleet", False,
+     lambda c, e, n, p, r: drill_storage_lifecycle_fleet(c, e, n, p)),
 ]
 
 #: Per-SCENARIO subprocess wall budget (QUEST_CHAOS_SCENARIO_TIMEOUT_S):
@@ -1906,7 +2289,7 @@ SCENARIO_TIMEOUT_S = int(os.environ.get(
 
 def _counters_doc() -> dict:
     return {k: v for k, v in metrics.counters().items()
-            if k.startswith(("resilience.", "supervisor."))
+            if k.startswith(("resilience.", "supervisor.", "stateio."))
             or k == "metrics.sink_errors"}
 
 

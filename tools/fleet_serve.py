@@ -76,6 +76,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -97,6 +98,15 @@ JOURNAL_FORMAT_VERSION = 1
 
 #: Mirror of ``telemetry.TRACE_CONTEXT_ENV`` (same pin).
 TRACE_CONTEXT_ENV = "QUEST_TRACE_CONTEXT"
+
+#: Segmented-journal mirrors (``stateio.JOURNAL_SEGMENT_BYTES_ENV`` /
+#: ``stateio._SEG_RE`` / rotation lock; same test pin): the ingress
+#: rotates and reads the chain exactly like the workers so a shared
+#: journal stays bounded no matter which side appends most.
+JOURNAL_SEGMENT_BYTES_ENV = "QUEST_JOURNAL_SEGMENT_BYTES"
+SEG_RE = re.compile(r"^journal-(\d{6})(?:\.c(\d+))?\.jsonl$")
+ROTATE_LOCK = "journal.rotate.lock"
+ROTATE_LOCK_STALE_S = 30.0
 
 #: Fleet membership manifest written into the journal directory.
 FLEET_MANIFEST = "fleet.json"
@@ -150,11 +160,101 @@ def _heal_torn_tail(path: str) -> None:
         f.truncate(len(data) - len(tail))
 
 
+def journal_chain(directory: str) -> list[str]:
+    """Stdlib mirror of ``stateio.journal_chain``: the committed read
+    order — winning compacted segment (highest ``(epoch, seq)`` at or
+    below the sidecar's ``epoch``), plain sealed segments above its
+    sequence, then the active file.  Crashed-compactor leftovers on
+    either side of the commit point are invisible."""
+    directory = os.path.abspath(directory)
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    epoch = 0
+    try:
+        with open(os.path.join(directory, JOURNAL_META)) as f:
+            epoch = int(json.load(f).get("epoch", 0))
+    except (OSError, ValueError, TypeError, AttributeError):
+        epoch = 0
+    plain, compacted = [], []
+    for n in names:
+        m = SEG_RE.match(n)
+        if not m:
+            continue
+        seq, ce = int(m.group(1)), m.group(2)
+        if ce is None:
+            plain.append((seq, n))
+        elif int(ce) <= epoch:
+            compacted.append((int(ce), seq, n))
+    chain, floor = [], -1
+    if compacted:
+        _, floor, winner = max(compacted)
+        chain.append(winner)
+    chain.extend(n for seq, n in sorted(plain) if seq > floor)
+    if JOURNAL in names:
+        chain.append(JOURNAL)
+    return [os.path.join(directory, n) for n in chain]
+
+
+def _maybe_rotate(directory: str, path: str) -> None:
+    """``stateio._maybe_rotate``'s twin: seal the active file into the
+    next numbered segment at the configured threshold, under the
+    shared ``O_CREAT|O_EXCL`` lock file (stale locks broken by age)."""
+    try:
+        limit = int(os.environ.get(JOURNAL_SEGMENT_BYTES_ENV, "0"))
+    except ValueError:
+        limit = 0
+    if limit <= 0:
+        return
+    try:
+        if os.path.getsize(path) < limit:
+            return
+    except OSError:
+        return
+    lock = os.path.join(directory, ROTATE_LOCK)
+    fd = None
+    for attempt in (0, 1):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            break
+        except FileExistsError:
+            try:
+                age = time.time() - os.path.getmtime(lock)
+            except OSError:
+                continue
+            if attempt == 0 and age > ROTATE_LOCK_STALE_S:
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
+                continue
+            return
+    if fd is None:
+        return
+    try:
+        if os.path.isfile(path) and os.path.getsize(path) >= limit:
+            top = 0
+            for n in os.listdir(directory):
+                m = SEG_RE.match(n)
+                if m:
+                    top = max(top, int(m.group(1)))
+            os.rename(path, os.path.join(directory,
+                                         f"journal-{top + 1:06d}.jsonl"))
+    finally:
+        os.close(fd)
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
 def append_records(directory: str, recs: list[dict]) -> None:
     """Durably append records to the shared serve journal — the
     ingress-side twin of ``stateio.append_journal_entries``: sidecar
-    on first use, trace-context stamping, torn-tail heal, then ONE
-    O_APPEND write + flush + fsync for the whole batch."""
+    on first use, trace-context stamping, torn-tail heal, rotation at
+    the configured threshold, then ONE O_APPEND write + flush + fsync
+    for the whole batch."""
     if not recs:
         return
     directory = os.path.abspath(directory)
@@ -176,36 +276,43 @@ def append_records(directory: str, recs: list[dict]) -> None:
     with _append_lock:
         if os.path.isfile(path):
             _heal_torn_tail(path)
+            _maybe_rotate(directory, path)
         with open(path, "a") as f:
             f.write(lines)
             f.flush()
             os.fsync(f.fileno())
 
 
+def _read_one(path: str) -> list[dict]:
+    out = []
+    try:
+        with open(path) as f:
+            raws = f.read().split("\n")
+    except OSError:
+        return out
+    for raw in raws:
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            frame = json.loads(raw)
+            rec = frame["rec"]
+            if _crc(json.dumps(rec, sort_keys=True)) != frame["crc"]:
+                continue
+        except (ValueError, KeyError, TypeError):
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
 def read_journal(directory: str) -> list[dict]:
-    """Every valid record under ``directory`` — the lenient read:
-    torn tails and interior damage are SKIPPED (the workers own the
+    """Every valid record under ``directory`` in chain order — the
+    lenient read: torn tails, interior damage and files vanishing
+    under a racing compaction are SKIPPED (the workers own the
     warn/count semantics; the ingress only needs the surviving
     records to answer status queries)."""
-    path = os.path.join(os.path.abspath(directory), JOURNAL)
-    if not os.path.isfile(path):
-        return []
-    out = []
-    with open(path) as f:
-        for raw in f:
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                frame = json.loads(raw)
-                rec = frame["rec"]
-                if _crc(json.dumps(rec, sort_keys=True)) != frame["crc"]:
-                    continue
-            except (ValueError, KeyError, TypeError):
-                continue
-            if isinstance(rec, dict):
-                out.append(rec)
-    return out
+    return [r for p in journal_chain(directory) for r in _read_one(p)]
 
 
 def fold_journal(directory: str) -> dict:

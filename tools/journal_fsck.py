@@ -1,0 +1,199 @@
+"""Offline serve-journal fsck: walk the segment chain of a journal
+directory, re-verify every line's CRC32 frame, check the sidecar's
+committed compaction epoch, and estimate reclaimable bytes — all
+stdlib, so it runs on hosts without the jax stack (reuses the
+``fleet_serve`` codec mirrors, which the test suite pins byte-equal
+to ``quest_tpu.stateio``).
+
+Per segment it prints records / damaged-line counts; damage rules
+match the worker's replay semantics exactly:
+
+* a newline-less or CRC-failing FINAL line of the ACTIVE
+  ``journal.jsonl`` is a torn tail — the append in flight when a
+  process died; healable, NOT damage;
+* ANY damaged line in a sealed ``journal-NNNNNN[.cE].jsonl`` segment
+  is interior corruption (segments are newline-terminated before the
+  rotation rename), as is interior damage in the active file.
+
+It also reports compaction leftovers a crashed compactor can leave —
+outputs whose epoch is ABOVE the sidecar's (crash before the commit
+bump) and sources a committed output superseded (crash before the
+unlink) — plus an estimate of bytes ``stateio.compact_journal`` could
+reclaim now: record bytes of keys with an applied ``complete``, no
+quarantine verdict, and no unexpired claim, in sealed segments past
+the retention age.
+
+Usage::
+
+    python tools/journal_fsck.py DIRECTORY [DIRECTORY ...]
+
+Exit status: 0 every chain is clean (torn active tails allowed),
+1 interior corruption or an unreadable sidecar was found, 2 usage
+error / no journal found.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import fleet_serve  # noqa: E402  (sibling; stdlib-only at import)
+
+#: Mirror of ``stateio.JOURNAL_RETAIN_S_DEFAULT`` (test-pinned).
+RETAIN_S_DEFAULT = 3600.0
+
+
+def _check_file(path: str, *, tail_ok: bool) -> dict:
+    """One file's verdict: valid records, damaged interior lines, and
+    whether a (healable) torn tail was observed."""
+    with open(path, "rb") as f:
+        data = f.read()
+    torn = bool(data) and not data.endswith(b"\n")
+    lines = data.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    records, rec_bytes, damaged = [], [], 0
+    for i, raw in enumerate(lines):
+        is_tail = torn and i == len(lines) - 1
+        try:
+            frame = json.loads(raw.decode())
+            rec = frame["rec"]
+            ok = (fleet_serve._crc(json.dumps(rec, sort_keys=True))
+                  == frame["crc"]) and isinstance(rec, dict)
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            ok = False
+        if ok and not (is_tail and not tail_ok):
+            records.append(rec)
+            rec_bytes.append(len(raw) + 1)
+        elif is_tail and tail_ok:
+            pass  # the in-flight append; heals on the next open
+        else:
+            damaged += 1
+    return {"records": records, "rec_bytes": rec_bytes,
+            "damaged": damaged, "torn_tail": torn,
+            "bytes": len(data)}
+
+
+def _settled_keys(records: list) -> set:
+    """Keys :func:`stateio.compact_journal` would judge droppable,
+    minus the parts that need a metrics clock: completed, not
+    quarantined, claim (if any) expired against wall time."""
+    completed, quarantined, claims = set(), set(), {}
+    for r in records:
+        k = r.get("key")
+        if k is None:
+            continue
+        kind = r.get("kind")
+        if kind == "complete":
+            completed.add(k)
+        elif kind == "quarantine":
+            quarantined.add(k)
+        elif kind == "claim":
+            claims[k] = float(r.get("expires") or 0.0)
+    now = time.time()
+    return {k for k in completed
+            if k not in quarantined and claims.get(k, 0.0) <= now}
+
+
+def fsck(directory: str) -> int:
+    """Report one directory; returns 0 clean, 1 damaged, 2 missing."""
+    directory = os.path.abspath(directory)
+    meta_path = os.path.join(directory, fleet_serve.JOURNAL_META)
+    chain = fleet_serve.journal_chain(directory)
+    if not chain and not os.path.isfile(meta_path):
+        print(f"{directory}: no journal found")
+        return 2
+    epoch, sidecar_bad = 0, False
+    try:
+        with open(meta_path) as f:
+            epoch = int(json.load(f).get("epoch", 0))
+    except FileNotFoundError:
+        pass  # pre-sidecar journal: epoch 0, not damage
+    except (OSError, ValueError, TypeError, AttributeError):
+        sidecar_bad = True
+    print(f"{directory}  (epoch {epoch}"
+          f"{', SIDECAR UNREADABLE' if sidecar_bad else ''})")
+
+    live = {os.path.basename(p) for p in chain}
+    orphans = []
+    for n in sorted(os.listdir(directory)):
+        m = fleet_serve.SEG_RE.match(n)
+        if m and n not in live:
+            tag = ("uncommitted output" if m.group(2)
+                   and int(m.group(2)) > epoch else "superseded source")
+            orphans.append((n, tag))
+
+    damage = sidecar_bad
+    all_records, reclaimable = [], 0
+    now = time.time()
+    per_file = []
+    for p in chain:
+        name = os.path.basename(p)
+        tail_ok = name == fleet_serve.JOURNAL
+        try:
+            rep = _check_file(p, tail_ok=tail_ok)
+        except OSError as e:
+            print(f"  {name:28s} UNREADABLE  {e}")
+            damage = True
+            continue
+        per_file.append((p, name, rep))
+        all_records.extend(rep["records"])
+        verdict = "ok"
+        if rep["damaged"]:
+            verdict = f"CORRUPT ({rep['damaged']} damaged line(s))"
+            damage = True
+        elif rep["torn_tail"] and tail_ok:
+            verdict = "ok (torn tail, healable)"
+        print(f"  {name:28s} {verdict:32s} "
+              f"{len(rep['records']):6d} rec  {rep['bytes']:8d} B")
+
+    settled = _settled_keys(all_records)
+    for p, name, rep in per_file:
+        if name == fleet_serve.JOURNAL:
+            continue  # the active file is never compacted
+        try:
+            if os.path.getmtime(p) > now - RETAIN_S_DEFAULT:
+                continue  # younger than the default retention window
+        except OSError:
+            continue
+        reclaimable += sum(
+            nb for r, nb in zip(rep["records"], rep["rec_bytes"])
+            if r.get("key") in settled)
+    for n, tag in orphans:
+        try:
+            reclaimable += os.path.getsize(os.path.join(directory, n))
+        except OSError:
+            pass
+        print(f"  {n:28s} ORPHAN ({tag}; reclaimable)")
+    print(f"  {len(all_records)} record(s) across {len(chain)} file(s); "
+          f"~{reclaimable} B reclaimable by compaction")
+    return 1 if damage else 0
+
+
+def main(argv) -> int:
+    dirs = [a for a in argv if not a.startswith("-")]
+    if not dirs:
+        print(__doc__)
+        return 2
+    worst = 0
+    found_any = False
+    for d in dirs:
+        if not os.path.isdir(d):
+            print(f"{d}: not a directory")
+            worst = max(worst, 2)
+            continue
+        rc = fsck(d)
+        if rc != 2:
+            found_any = True
+        worst = max(worst, rc)
+    if not found_any:
+        return 2
+    return 1 if worst == 1 else (2 if worst == 2 else 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

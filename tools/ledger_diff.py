@@ -99,6 +99,17 @@ DEFAULT_RULES = [
     # 0, so the +0 rules fire on any appearance regardless of config
     ("counters.supervisor.lease_double_run", +0.0, False),
     ("counters.supervisor.fenced_completes_applied", +0.0, False),
+    # storage-lifecycle health, strictly regressive: ANY degraded
+    # journal append (the serve loop fell back to at-least-once under
+    # QUEST_DURABILITY=degrade because the durable tier was failing)
+    # and ANY compaction self-check refusal (a compacted rewrite would
+    # have changed replay state for a key — the exactly-once rewrite
+    # contract almost broke, and the abort counter is the only trace)
+    # are regressions of the bounded-storage contract; the baselines
+    # are 0, so the +0 rules fire on any appearance regardless of
+    # config
+    ("counters.supervisor.journal_degraded", +0.0, False),
+    ("counters.stateio.compaction_lost_keys", +0.0, False),
     # fleet-observability health, strictly regressive: ANY corrupt
     # snapshot skipped by the fleet aggregator is a regression of the
     # atomic write-temp-then-rename spill contract (workers must never

@@ -287,6 +287,57 @@ def journaled_serve_smoke(summary) -> None:
         print(detail)
 
 
+def storage_lifecycle_smoke(summary) -> None:
+    """Tier-2 smoke: bounded durable storage end to end — the chaos
+    harness's ``storage_lifecycle_fleet`` scenario through its own
+    per-scenario subprocess protocol: a two-worker fleet serves 200
+    requests across journal rotations (small
+    ``QUEST_JOURNAL_SEGMENT_BYTES``), one mid-serve fenced compaction,
+    one worker SIGKILL and one absorbed ``enospc``; the row asserts
+    every request completed exactly-once, the offline
+    ``journal_fsck`` found the surviving chain clean, and the journal
+    directory's final on-disk bytes are BELOW the configured cap even
+    though the fleet wrote many times that (the ``bounded`` field).  A
+    journal that grows without bound, a compaction that loses a key,
+    or a rotation that breaks replay fails the recording round here
+    instead of on a production disk."""
+    import json as _json
+    import tempfile
+
+    t0 = time.time()
+    ok, detail = False, ""
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "rows.json")
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "chaos_drill.py"), "0",
+                 "--scenario", "storage_lifecycle_fleet",
+                 "--out", out],
+                capture_output=True, text=True, cwd=REPO,
+                timeout=900)
+            with open(out) as f:
+                rows = _json.load(f)["scenarios"]
+            row = rows[0] if rows else {}
+            ok = (r.returncode == 0 and row.get("ok")
+                  and row.get("once_in_journal")
+                  and row.get("no_double")
+                  and row.get("bounded")
+                  and row.get("fsck_clean")
+                  and row.get("bytes_final", 1 << 60)
+                  < row.get("byte_cap", 0))
+            if not ok:
+                detail = f"rc={r.returncode} row={row}"
+        except Exception as e:
+            detail = f"{type(e).__name__}: {e}"
+    secs = time.time() - t0
+    summary.append(("storage_lifecycle", ok, secs))
+    print(f"{'OK  ' if ok else 'FAIL'} {'storage_lifecycle':22s} "
+          f"{secs:7.1f}s")
+    if not ok:
+        print(detail)
+
+
 def metrics_serve_smoke(summary) -> None:
     """Tier-2 smoke: start tools/metrics_serve.py (--demo populates the
     telemetry with one small run), scrape /metrics and /healthz over
@@ -906,6 +957,7 @@ def main():
     overlap_smoke(summary)
     batch_serve_smoke(summary)
     journaled_serve_smoke(summary)
+    storage_lifecycle_smoke(summary)
     metrics_serve_smoke(summary)
     fleet_obs_smoke(summary)
     slo_obs_smoke(summary)

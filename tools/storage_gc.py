@@ -1,0 +1,162 @@
+"""Standalone retention GC: age-bounded sweep of a quest-tpu storage
+directory — the stdlib CLI twin of ``stateio.gc_storage`` (same sweep
+rules, test-pinned), so operators can reclaim disk on hosts without
+the jax stack or outside a serve loop.
+
+What goes (older than the TTL): trace captures (``trace-*.json``),
+flight-recorder dumps (``quest-flight-*.json``), fleet metric
+snapshots (``snap-*.json``), and checkpoint/session-spill
+subdirectories — anything holding a ``qureg.json`` — whose NEWEST
+file is older than the TTL.
+
+What never goes: the slot the ``latest`` pointer names (the restore
+path's truth, regardless of age); any directory with one fresh file
+(a just-renewed ``fence.json`` lease keeps a live session young by
+the newest-file rule); journal segments, sidecars, ``fleet.json`` and
+lock files (the expendable-file whitelist cannot match them).
+
+Usage::
+
+    python tools/storage_gc.py [--ttl SECONDS] [--dry-run] DIR [DIR ...]
+
+``--ttl`` defaults to ``QUEST_GC_TTL_S`` (604800 s — one week).
+
+Exit status: 0 sweep ran (even if nothing was old enough), 2 usage
+error / no directory found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import shutil
+import sys
+import time
+
+#: Mirrors of ``stateio.GC_TTL_S_ENV`` / ``GC_TTL_S_DEFAULT`` /
+#: ``_GC_FILE_RE`` / ``_META`` (the test suite pins them equal).
+GC_TTL_S_ENV = "QUEST_GC_TTL_S"
+GC_TTL_S_DEFAULT = 604800.0
+GC_FILE_RE = re.compile(
+    r"^(trace-.*\.json|quest-flight-.*\.json|snap-.*\.json)$")
+META = "qureg.json"
+
+
+def _ttl_default() -> float:
+    try:
+        v = float(os.environ[GC_TTL_S_ENV])
+    except (KeyError, ValueError):
+        return GC_TTL_S_DEFAULT
+    return max(0.0, v)
+
+
+def _dir_stats(path: str) -> tuple:
+    """(newest mtime anywhere under ``path``, total bytes) — mirrors
+    ``stateio._dir_stats``."""
+    newest, total = 0.0, 0
+    for root, _dirs, files in os.walk(path):
+        for n in files:
+            p = os.path.join(root, n)
+            try:
+                stt = os.stat(p)
+            except OSError:
+                continue
+            newest = max(newest, stt.st_mtime)
+            total += stt.st_size
+    try:
+        newest = max(newest, os.path.getmtime(path))
+    except OSError:
+        pass
+    return newest, total
+
+
+def gc_storage(directory: str, *, ttl_s: float | None = None,
+               now: float | None = None, dry_run: bool = False) -> dict:
+    """``stateio.gc_storage``'s sweep, stdlib-side (no metrics
+    counters — this is the out-of-process path)."""
+    directory = os.path.abspath(directory)
+    if ttl_s is None:
+        ttl_s = _ttl_default()
+    if now is None:
+        now = time.time()
+    cutoff = now - ttl_s
+    out = {"removed": [], "reclaimed_bytes": 0, "ttl_s": ttl_s,
+           "dry_run": bool(dry_run)}
+    if not os.path.isdir(directory):
+        return out
+    live = set()
+    try:
+        with open(os.path.join(directory, "latest")) as f:
+            live.add(f.read().strip())
+    except OSError:
+        pass
+    for name in sorted(os.listdir(directory)):
+        path = os.path.join(directory, name)
+        if os.path.isfile(path):
+            if not GC_FILE_RE.match(name):
+                continue
+            try:
+                stt = os.stat(path)
+            except OSError:
+                continue
+            if stt.st_mtime > cutoff:
+                continue
+            if not dry_run:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+            out["removed"].append(name)
+            out["reclaimed_bytes"] += stt.st_size
+        elif os.path.isdir(path):
+            if name in live:
+                continue  # the latest pointer's slot: never touched
+            if not os.path.isfile(os.path.join(path, META)):
+                continue  # not a checkpoint/session dir: not ours
+            newest, total = _dir_stats(path)
+            if newest > cutoff:
+                continue
+            if not dry_run:
+                try:
+                    shutil.rmtree(path)
+                except OSError:
+                    continue
+            out["removed"].append(name)
+            out["reclaimed_bytes"] += total
+    return out
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="storage_gc",
+        description="age-bounded sweep of expendable quest-tpu storage")
+    ap.add_argument("dirs", nargs="*", metavar="DIR")
+    ap.add_argument("--ttl", type=float, default=None,
+                    help=f"age threshold in seconds (default "
+                         f"${GC_TTL_S_ENV} or {GC_TTL_S_DEFAULT:.0f})")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="report what WOULD go without unlinking")
+    args = ap.parse_args(argv)
+    if not args.dirs:
+        ap.print_help()
+        return 2
+    found_any = False
+    for d in args.dirs:
+        if not os.path.isdir(d):
+            print(f"{d}: not a directory")
+            continue
+        found_any = True
+        rep = gc_storage(d, ttl_s=args.ttl, dry_run=args.dry_run)
+        verb = "would remove" if rep["dry_run"] else "removed"
+        print(f"{os.path.abspath(d)}  (ttl {rep['ttl_s']:.0f}s)")
+        for name in rep["removed"]:
+            print(f"  {verb} {name}")
+        print(f"  {len(rep['removed'])} item(s), "
+              f"{rep['reclaimed_bytes']} B "
+              f"{'reclaimable' if rep['dry_run'] else 'reclaimed'}")
+    return 0 if found_any else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
